@@ -1,0 +1,34 @@
+"""hyperqueue_tpu — a TPU-native distributed task-graph execution framework.
+
+Capability target: It4innovations/hyperqueue (see SURVEY.md). A single server
+process holds a task graph; workers connect over TCP; a centralized scheduler
+assigns tasks to workers subject to rich resource requests (CPUs, GPUs,
+fractional amounts, non-fungible indexed resources, NUMA groups, multi-node
+gangs). Tasks are OS processes or Python functions. There is no data plane
+between tasks; the framework moves control messages and stdout/stderr streams.
+
+The TPU-native part: the per-tick scheduling assignment (which the reference
+solves with a CPU MILP, reference crates/tako/src/internal/scheduler/solver.rs)
+is reframed as a dense batch×worker constraint solve executed by a JAX solver
+(`hyperqueue_tpu.ops.assign`), jit-compiled with fixed (bucketed) shapes so one
+compiled program serves every tick.
+
+Layout:
+  ids, resources/   — data model (IDs, fixed-point amounts, requests, descriptors)
+  scheduler/        — batches -> dense snapshot -> solve -> mapping
+  ops/              — JAX kernels (the dense assignment solver)
+  models/           — scheduler policy models (greedy cut-scan, auction refinement)
+  parallel/         — jax.sharding Mesh utilities for the multi-chip solver
+  server/           — core state, reactor, RPC, jobs, client handling
+  worker/           — worker runtime, resource pools/allocator, task launcher
+  transport/        — framing, auth, encryption
+  events/           — event streamer, journal, restore
+  client/           — CLI and output formatting
+  api/              — Python user API (Client, Job, LocalCluster)
+  utils/            — small shared helpers
+"""
+
+__version__ = "0.1.0"
+
+JOURNAL_VERSION = 1
+PROTOCOL_VERSION = 1

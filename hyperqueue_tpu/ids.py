@@ -1,0 +1,58 @@
+"""Core identifier types.
+
+Reference: crates/tako/src/internal/common/ids.rs:5-60 — TaskId is a packed
+(JobId u32, JobTaskId u32) pair; WorkerId / InstanceId / ResourceId are u32
+newtypes. We keep them as plain ints (Python) packed the same way so a task id
+is a single int64-compatible scalar — which is exactly what the dense scheduler
+snapshot wants.
+"""
+
+from __future__ import annotations
+
+# A TaskId packs (job_id << 32) | job_task_id into one int.
+TASK_ID_BITS = 32
+TASK_ID_MASK = (1 << TASK_ID_BITS) - 1
+
+
+def make_task_id(job_id: int, job_task_id: int) -> int:
+    if not (0 <= job_task_id <= TASK_ID_MASK and 0 <= job_id <= TASK_ID_MASK):
+        raise ValueError(f"task id out of range: {job_id}@{job_task_id}")
+    return (job_id << TASK_ID_BITS) | job_task_id
+
+
+def task_id_job(task_id: int) -> int:
+    return task_id >> TASK_ID_BITS
+
+
+def task_id_task(task_id: int) -> int:
+    return task_id & TASK_ID_MASK
+
+
+def format_task_id(task_id: int) -> str:
+    return f"{task_id_job(task_id)}@{task_id_task(task_id)}"
+
+
+def parse_task_id(text: str) -> int:
+    job, _, task = text.partition("@")
+    return make_task_id(int(job), int(task))
+
+
+class IdCounter:
+    """Monotonic id allocator (1-based, 0 reserved as 'none')."""
+
+    __slots__ = ("_next",)
+
+    def __init__(self, start: int = 1):
+        self._next = start
+
+    def next(self) -> int:
+        value = self._next
+        self._next += 1
+        return value
+
+    def peek(self) -> int:
+        return self._next
+
+    def ensure_above(self, used: int) -> None:
+        if used >= self._next:
+            self._next = used + 1

@@ -1,0 +1,51 @@
+"""Resource data model.
+
+Mirrors the semantics of reference crates/tako/src/internal/common/resources/
+(amount.rs, request.rs, descriptor.rs, map.rs) with a dense-tensor-friendly
+representation: amounts are fixed-point ints, requests intern to small ids, and
+a set of request variants flattens to an (n_variants, n_resources) int matrix.
+"""
+
+from hyperqueue_tpu.resources.amount import (
+    FRACTIONS_PER_UNIT,
+    amount_from_float,
+    amount_from_str,
+    format_amount,
+    units_and_fractions,
+)
+from hyperqueue_tpu.resources.request import (
+    AllocationPolicy,
+    ResourceRequest,
+    ResourceRequestEntry,
+    ResourceRequestVariants,
+)
+from hyperqueue_tpu.resources.descriptor import (
+    DescriptorKind,
+    ResourceDescriptor,
+    ResourceDescriptorItem,
+)
+from hyperqueue_tpu.resources.map import (
+    CPU_RESOURCE_ID,
+    CPU_RESOURCE_NAME,
+    ResourceIdMap,
+    ResourceRqMap,
+)
+
+__all__ = [
+    "FRACTIONS_PER_UNIT",
+    "amount_from_float",
+    "amount_from_str",
+    "format_amount",
+    "units_and_fractions",
+    "AllocationPolicy",
+    "ResourceRequest",
+    "ResourceRequestEntry",
+    "ResourceRequestVariants",
+    "DescriptorKind",
+    "ResourceDescriptor",
+    "ResourceDescriptorItem",
+    "CPU_RESOURCE_ID",
+    "CPU_RESOURCE_NAME",
+    "ResourceIdMap",
+    "ResourceRqMap",
+]

@@ -1,0 +1,68 @@
+"""Fixed-point resource amounts.
+
+Reference semantics: crates/tako/src/internal/common/resources/amount.rs:7,26 —
+a ResourceAmount is a u64 with 10,000 fractions per unit, so "0.5 of a GPU" is
+representable exactly and all scheduler arithmetic is integer. Integer amounts
+are also what lets the dense solver run in int32/int64 tensors with no
+floating-point feasibility drift.
+"""
+
+from __future__ import annotations
+
+FRACTIONS_PER_UNIT = 10_000
+
+# Amounts are plain ints counted in fractions: 1 unit == 10_000.
+
+
+def amount_from_units(units: int) -> int:
+    return units * FRACTIONS_PER_UNIT
+
+
+def amount_from_float(value: float) -> int:
+    return round(value * FRACTIONS_PER_UNIT)
+
+
+def amount_from_str(text: str) -> int:
+    """Parse "2", "0.5", "1.25" into a fixed-point amount.
+
+    Rejects more than 4 fractional digits (cannot be represented), matching the
+    reference parser behavior.
+    """
+    text = text.strip()
+    if not text:
+        raise ValueError("empty resource amount")
+    if text.startswith("-"):
+        raise ValueError("resource amount cannot be negative")
+    whole, dot, frac = text.partition(".")
+    if whole and not whole.isdigit():
+        raise ValueError(f"invalid resource amount {text!r}")
+    if dot and frac and not frac.isdigit():
+        raise ValueError(f"invalid resource amount {text!r}")
+    if not whole and not frac:
+        raise ValueError(f"invalid resource amount {text!r}")
+    units = int(whole) if whole else 0
+    if dot and frac:
+        if len(frac) > 4:
+            raise ValueError(
+                f"resource amount {text!r} has more than 4 fractional digits"
+            )
+        fractions = int(frac.ljust(4, "0"))
+    else:
+        fractions = 0
+    return units * FRACTIONS_PER_UNIT + fractions
+
+
+def units_and_fractions(amount: int) -> tuple[int, int]:
+    return divmod(amount, FRACTIONS_PER_UNIT)
+
+
+def format_amount(amount: int) -> str:
+    units, fractions = units_and_fractions(amount)
+    if fractions == 0:
+        return str(units)
+    return f"{units}.{fractions:04d}".rstrip("0")
+
+
+def amount_ceil_units(amount: int) -> int:
+    """Round up to whole units (used e.g. for CPU core counts for pinning)."""
+    return -(-amount // FRACTIONS_PER_UNIT)

@@ -1,0 +1,167 @@
+"""Worker resource descriptors — what a worker physically offers.
+
+Reference semantics: crates/tako/src/internal/common/resources/descriptor.rs —
+ResourceDescriptorKind List/Groups/Range/Sum (descriptor.rs:22) plus coupling
+of group-structured resources with weights (descriptor.rs:249-295).
+
+A descriptor is the worker-side truth; the server only needs the summed
+amounts per resource (dense vector) plus group shapes for multi-group policy
+checks, which `summary()` provides.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT
+
+
+class DescriptorKind(enum.Enum):
+    LIST = "list"        # explicit non-fungible indices (e.g. GPU ids)
+    GROUPS = "groups"    # indices partitioned into groups (NUMA sockets)
+    RANGE = "range"      # contiguous integer indices
+    SUM = "sum"          # fungible amount only (e.g. memory bytes)
+
+
+@dataclass(frozen=True, slots=True)
+class ResourceDescriptorItem:
+    name: str
+    kind: DescriptorKind
+    # LIST: groups == [values]; GROUPS: one list per group; RANGE: values built
+    # from range_start..range_end; SUM: sum_size only.
+    groups: tuple[tuple[str, ...], ...] = ()
+    range_start: int = 0
+    range_end: int = -1  # inclusive
+    sum_size: int = 0  # fixed-point fractions
+
+    @classmethod
+    def list(cls, name: str, values: list[str]) -> "ResourceDescriptorItem":
+        return cls(name=name, kind=DescriptorKind.LIST, groups=(tuple(values),))
+
+    @classmethod
+    def range(cls, name: str, start: int, end: int) -> "ResourceDescriptorItem":
+        return cls(name=name, kind=DescriptorKind.RANGE, range_start=start, range_end=end)
+
+    @classmethod
+    def group_list(cls, name: str, groups: list[list[str]]) -> "ResourceDescriptorItem":
+        return cls(
+            name=name,
+            kind=DescriptorKind.GROUPS,
+            groups=tuple(tuple(g) for g in groups),
+        )
+
+    @classmethod
+    def sum(cls, name: str, size: int) -> "ResourceDescriptorItem":
+        """size in fixed-point fractions."""
+        return cls(name=name, kind=DescriptorKind.SUM, sum_size=size)
+
+    def validate(self) -> None:
+        if self.kind in (DescriptorKind.LIST, DescriptorKind.GROUPS):
+            seen: set[str] = set()
+            for group in self.groups:
+                for value in group:
+                    if value in seen:
+                        raise ValueError(
+                            f"duplicate index {value!r} in resource {self.name!r}"
+                        )
+                    seen.add(value)
+            if not seen:
+                raise ValueError(f"resource {self.name!r} has no indices")
+        elif self.kind is DescriptorKind.RANGE:
+            if self.range_end < self.range_start:
+                raise ValueError(f"empty range for resource {self.name!r}")
+        elif self.kind is DescriptorKind.SUM:
+            if self.sum_size <= 0:
+                raise ValueError(f"resource {self.name!r} has zero size")
+
+    def index_groups(self) -> list[list[str]]:
+        """Concrete indices per group (SUM has none)."""
+        if self.kind is DescriptorKind.RANGE:
+            return [[str(i) for i in range(self.range_start, self.range_end + 1)]]
+        if self.kind in (DescriptorKind.LIST, DescriptorKind.GROUPS):
+            return [list(g) for g in self.groups]
+        return []
+
+    def total_amount(self) -> int:
+        """Total capacity in fixed-point fractions."""
+        if self.kind is DescriptorKind.SUM:
+            return self.sum_size
+        return sum(len(g) for g in self.index_groups()) * FRACTIONS_PER_UNIT
+
+    def n_groups(self) -> int:
+        groups = self.index_groups()
+        return len(groups) if groups else 1
+
+
+@dataclass(frozen=True, slots=True)
+class ResourceDescriptorCoupling:
+    """Declares that the listed group-structured resources are coupled (e.g.
+    cpus and gpus attached to the same NUMA node); the worker allocator then
+    prefers allocations whose groups align. Reference descriptor.rs:249-295."""
+
+    names: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True, slots=True)
+class ResourceDescriptor:
+    items: tuple[ResourceDescriptorItem, ...]
+    coupling: ResourceDescriptorCoupling | None = None
+
+    def validate(self) -> None:
+        names = [item.name for item in self.items]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate resource name in descriptor")
+        for item in self.items:
+            item.validate()
+        if self.coupling:
+            for name in self.coupling.names:
+                if name not in names:
+                    raise ValueError(f"coupling references unknown resource {name!r}")
+
+    def item(self, name: str) -> ResourceDescriptorItem | None:
+        for it in self.items:
+            if it.name == name:
+                return it
+        return None
+
+    @classmethod
+    def simple_cpus(cls, n_cpus: int) -> "ResourceDescriptor":
+        return cls(
+            items=(ResourceDescriptorItem.range("cpus", 0, n_cpus - 1),)
+        )
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ResourceDescriptor":
+        items = []
+        for it in data.get("items", []):
+            items.append(
+                ResourceDescriptorItem(
+                    name=it["name"],
+                    kind=DescriptorKind(it["kind"]),
+                    groups=tuple(tuple(g) for g in it.get("groups", ())),
+                    range_start=it.get("range_start", 0),
+                    range_end=it.get("range_end", -1),
+                    sum_size=it.get("sum_size", 0),
+                )
+            )
+        coupling = None
+        if data.get("coupling"):
+            coupling = ResourceDescriptorCoupling(names=tuple(data["coupling"]))
+        return cls(items=tuple(items), coupling=coupling)
+
+    def to_dict(self) -> dict:
+        return {
+            "items": [
+                {
+                    "name": it.name,
+                    "kind": it.kind.value,
+                    "groups": [list(g) for g in it.groups],
+                    "range_start": it.range_start,
+                    "range_end": it.range_end,
+                    "sum_size": it.sum_size,
+                }
+                for it in self.items
+            ],
+            "coupling": list(self.coupling.names) if self.coupling else None,
+        }

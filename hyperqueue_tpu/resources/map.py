@@ -1,0 +1,68 @@
+"""Interning maps for resource names and request variants.
+
+Reference semantics: crates/tako/src/internal/common/resources/map.rs —
+resource names intern to dense ResourceIds with CPU pinned to id 0 (map.rs:7);
+ResourceRequestVariants intern to ResourceRqIds via GlobalResourceMapping
+(map.rs:15,95-117) so each distinct request crosses the wire and enters the
+scheduler exactly once. rq-ids are the row space of the dense solver snapshot.
+"""
+
+from __future__ import annotations
+
+from hyperqueue_tpu.resources.request import ResourceRequestVariants
+
+CPU_RESOURCE_NAME = "cpus"
+CPU_RESOURCE_ID = 0
+
+
+class ResourceIdMap:
+    """name <-> dense resource id; CPU is always id 0."""
+
+    def __init__(self):
+        self._names: list[str] = [CPU_RESOURCE_NAME]
+        self._ids: dict[str, int] = {CPU_RESOURCE_NAME: CPU_RESOURCE_ID}
+
+    def get_or_create(self, name: str) -> int:
+        rid = self._ids.get(name)
+        if rid is None:
+            rid = len(self._names)
+            self._names.append(name)
+            self._ids[name] = rid
+        return rid
+
+    def get(self, name: str) -> int | None:
+        return self._ids.get(name)
+
+    def name_of(self, resource_id: int) -> str:
+        return self._names[resource_id]
+
+    def names(self) -> list[str]:
+        return list(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+
+class ResourceRqMap:
+    """ResourceRequestVariants <-> dense rq-id."""
+
+    def __init__(self):
+        self._variants: list[ResourceRequestVariants] = []
+        self._ids: dict[ResourceRequestVariants, int] = {}
+
+    def get_or_create(self, rqv: ResourceRequestVariants) -> int:
+        rq_id = self._ids.get(rqv)
+        if rq_id is None:
+            rq_id = len(self._variants)
+            self._variants.append(rqv)
+            self._ids[rqv] = rq_id
+        return rq_id
+
+    def get_variants(self, rq_id: int) -> ResourceRequestVariants:
+        return self._variants[rq_id]
+
+    def all(self) -> list[ResourceRequestVariants]:
+        return list(self._variants)
+
+    def __len__(self) -> int:
+        return len(self._variants)

@@ -1,0 +1,152 @@
+"""Resource requests and variants.
+
+Reference semantics: crates/tako/src/internal/common/resources/request.rs —
+ * AllocationRequest policies Compact/ForceCompact/Tight/ForceTight/Scatter/All
+   (request.rs:14-21)
+ * ResourceRequest { n_nodes, entries, min_time, weight } (request.rs:137)
+ * ResourceRequestVariants = OR-list of requests (request.rs:230)
+
+Requests are immutable + hashable so they intern to small rq-ids
+(resources/map.py); tasks store only the rq-id and the scheduler works on
+request *classes*, never individual tasks.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT, format_amount
+
+
+class AllocationPolicy(enum.Enum):
+    """How concrete resource indices are chosen on the worker.
+
+    COMPACT prefers few NUMA groups; TIGHT minimizes group count strictly
+    (best effort unless FORCE_*); SCATTER spreads across groups; ALL takes
+    every index of the resource (amount is then the whole pool).
+    """
+
+    COMPACT = "compact"
+    FORCE_COMPACT = "compact!"
+    TIGHT = "tight"
+    FORCE_TIGHT = "tight!"
+    SCATTER = "scatter"
+    ALL = "all"
+
+    @classmethod
+    def parse(cls, text: str) -> "AllocationPolicy":
+        for member in cls:
+            if member.value == text:
+                return member
+        raise ValueError(f"unknown allocation policy {text!r}")
+
+
+@dataclass(frozen=True, slots=True)
+class ResourceRequestEntry:
+    resource_id: int
+    amount: int  # fixed-point fractions; ignored (pool size) for policy ALL
+    policy: AllocationPolicy = AllocationPolicy.COMPACT
+
+    def __post_init__(self):
+        if self.amount < 0:
+            raise ValueError("resource amount cannot be negative")
+
+
+@dataclass(frozen=True, slots=True)
+class ResourceRequest:
+    """One conjunctive resource request.
+
+    n_nodes > 0 turns this into a multi-node gang request: the task gets
+    n_nodes exclusive workers from one worker group and `entries` are ignored
+    (reference solver.rs:177-209 models these with per-group count variables).
+    """
+
+    entries: tuple[ResourceRequestEntry, ...] = ()
+    n_nodes: int = 0
+    min_time_secs: float = 0.0
+
+    def __post_init__(self):
+        ids = [e.resource_id for e in self.entries]
+        if len(set(ids)) != len(ids):
+            raise ValueError("duplicate resource in request")
+        if ids != sorted(ids):
+            object.__setattr__(
+                self,
+                "entries",
+                tuple(sorted(self.entries, key=lambda e: e.resource_id)),
+            )
+
+    @property
+    def is_multi_node(self) -> bool:
+        return self.n_nodes > 0
+
+    def amount_of(self, resource_id: int) -> int:
+        for entry in self.entries:
+            if entry.resource_id == resource_id:
+                return entry.amount
+        return 0
+
+    def validate(self) -> None:
+        if self.n_nodes == 0 and not self.entries:
+            raise ValueError("resource request is empty")
+        for entry in self.entries:
+            if entry.amount == 0 and entry.policy is not AllocationPolicy.ALL:
+                raise ValueError("zero resource amount in request")
+
+    def describe(self, names: list[str] | None = None) -> str:
+        if self.is_multi_node:
+            return f"nodes={self.n_nodes}"
+        parts = []
+        for entry in self.entries:
+            name = (
+                names[entry.resource_id]
+                if names and entry.resource_id < len(names)
+                else f"res{entry.resource_id}"
+            )
+            if entry.policy is AllocationPolicy.ALL:
+                parts.append(f"{name}=all")
+            else:
+                parts.append(f"{name}={format_amount(entry.amount)}")
+        if self.min_time_secs:
+            parts.append(f"min_time={self.min_time_secs}s")
+        return " ".join(parts)
+
+
+DEFAULT_CPU_REQUEST = ResourceRequest(
+    entries=(ResourceRequestEntry(resource_id=0, amount=FRACTIONS_PER_UNIT),)
+)
+
+
+@dataclass(frozen=True, slots=True)
+class ResourceRequestVariants:
+    """OR-alternatives: the scheduler may satisfy any single variant.
+
+    Reference request.rs:230; variant order is the user's preference order and
+    breaks ties in the solver objective.
+    """
+
+    variants: tuple[ResourceRequest, ...] = field(
+        default=(DEFAULT_CPU_REQUEST,)
+    )
+
+    def __post_init__(self):
+        if not self.variants:
+            raise ValueError("request variants cannot be empty")
+
+    @classmethod
+    def single(cls, request: ResourceRequest) -> "ResourceRequestVariants":
+        return cls(variants=(request,))
+
+    @property
+    def is_multi_node(self) -> bool:
+        return self.variants[0].is_multi_node
+
+    def validate(self) -> None:
+        for variant in self.variants:
+            variant.validate()
+        if len({v.is_multi_node for v in self.variants}) != 1:
+            raise ValueError("cannot mix multi-node and single-node variants")
+
+    def min_time_secs(self) -> float:
+        return min(v.min_time_secs for v in self.variants)

@@ -1,0 +1,18 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding is validated
+without TPU hardware; the driver separately dry-runs __graft_entry__ the same
+way). Must be set before jax import anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,177 @@
+"""Data-model unit tests (amounts, requests, descriptors, interning).
+
+Modeled on reference resource tests in
+crates/tako/src/internal/common/resources/*.rs unit tests.
+"""
+
+import pytest
+
+from hyperqueue_tpu.ids import (
+    IdCounter,
+    format_task_id,
+    make_task_id,
+    parse_task_id,
+    task_id_job,
+    task_id_task,
+)
+from hyperqueue_tpu.resources import (
+    CPU_RESOURCE_ID,
+    AllocationPolicy,
+    DescriptorKind,
+    ResourceDescriptor,
+    ResourceDescriptorItem,
+    ResourceIdMap,
+    ResourceRequest,
+    ResourceRequestEntry,
+    ResourceRequestVariants,
+    ResourceRqMap,
+    amount_from_str,
+    format_amount,
+)
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT, amount_ceil_units
+from hyperqueue_tpu.resources.worker_resources import WorkerResources
+
+
+def test_task_id_packing():
+    tid = make_task_id(7, 123)
+    assert task_id_job(tid) == 7
+    assert task_id_task(tid) == 123
+    assert parse_task_id(format_task_id(tid)) == tid
+
+
+def test_id_counter():
+    c = IdCounter()
+    assert c.next() == 1
+    assert c.next() == 2
+    c.ensure_above(10)
+    assert c.next() == 11
+
+
+def test_amount_parsing():
+    assert amount_from_str("2") == 2 * FRACTIONS_PER_UNIT
+    assert amount_from_str("0.5") == 5000
+    assert amount_from_str("1.25") == 12500
+    assert amount_from_str("0.0001") == 1
+    for bad in ["0.00001", "", "1.-5", "+2", ".", "1..2", "-1", "x"]:
+        with pytest.raises(ValueError):
+            amount_from_str(bad)
+    assert amount_from_str(".5") == 5000
+    assert amount_from_str("3.") == 30000
+    assert format_amount(12500) == "1.25"
+    assert format_amount(30000) == "3"
+    assert amount_ceil_units(10001) == 2
+    assert amount_ceil_units(10000) == 1
+
+
+def test_request_sorting_and_dedup():
+    rq = ResourceRequest(
+        entries=(
+            ResourceRequestEntry(2, 10000),
+            ResourceRequestEntry(0, 20000),
+        )
+    )
+    assert [e.resource_id for e in rq.entries] == [0, 2]
+    with pytest.raises(ValueError):
+        ResourceRequest(
+            entries=(
+                ResourceRequestEntry(0, 10000),
+                ResourceRequestEntry(0, 20000),
+            )
+        )
+
+
+def test_request_validation():
+    with pytest.raises(ValueError):
+        ResourceRequest().validate()
+    with pytest.raises(ValueError):
+        ResourceRequest(
+            entries=(ResourceRequestEntry(0, 0),)
+        ).validate()
+    # policy ALL allows zero amount
+    ResourceRequest(
+        entries=(ResourceRequestEntry(0, 0, AllocationPolicy.ALL),)
+    ).validate()
+    mn = ResourceRequest(n_nodes=4)
+    mn.validate()
+    assert mn.is_multi_node
+    with pytest.raises(ValueError):
+        ResourceRequestVariants(
+            variants=(mn, ResourceRequest(entries=(ResourceRequestEntry(0, 1),)))
+        ).validate()
+
+
+def test_interning():
+    rqmap = ResourceRqMap()
+    a = ResourceRequestVariants.single(
+        ResourceRequest(entries=(ResourceRequestEntry(0, 10000),))
+    )
+    b = ResourceRequestVariants.single(
+        ResourceRequest(entries=(ResourceRequestEntry(0, 10000),))
+    )
+    c = ResourceRequestVariants.single(
+        ResourceRequest(entries=(ResourceRequestEntry(0, 20000),))
+    )
+    assert rqmap.get_or_create(a) == rqmap.get_or_create(b) == 0
+    assert rqmap.get_or_create(c) == 1
+    assert rqmap.get_variants(1) == c
+
+    idmap = ResourceIdMap()
+    assert idmap.get_or_create("cpus") == CPU_RESOURCE_ID
+    assert idmap.get_or_create("gpus") == 1
+    assert idmap.get_or_create("gpus") == 1
+    assert idmap.name_of(1) == "gpus"
+
+
+def test_descriptor():
+    desc = ResourceDescriptor(
+        items=(
+            ResourceDescriptorItem.range("cpus", 0, 7),
+            ResourceDescriptorItem.list("gpus", ["0", "1"]),
+            ResourceDescriptorItem.group_list("numa", [["0", "1"], ["2", "3"]]),
+            ResourceDescriptorItem.sum("mem", 1024 * FRACTIONS_PER_UNIT),
+        )
+    )
+    desc.validate()
+    assert desc.item("cpus").total_amount() == 8 * FRACTIONS_PER_UNIT
+    assert desc.item("gpus").total_amount() == 2 * FRACTIONS_PER_UNIT
+    assert desc.item("numa").n_groups() == 2
+    assert desc.item("mem").total_amount() == 1024 * FRACTIONS_PER_UNIT
+    assert desc.item("mem").index_groups() == []
+    rt = ResourceDescriptor.from_dict(desc.to_dict())
+    assert rt == desc
+    with pytest.raises(ValueError):
+        ResourceDescriptor(
+            items=(ResourceDescriptorItem.list("gpus", ["0", "0"]),)
+        ).validate()
+
+
+def test_worker_resources():
+    idmap = ResourceIdMap()
+    desc = ResourceDescriptor(
+        items=(
+            ResourceDescriptorItem.range("cpus", 0, 15),
+            ResourceDescriptorItem.list("gpus", ["0", "1"]),
+        )
+    )
+    wr = WorkerResources.from_descriptor(desc, idmap)
+    assert wr.amount(0) == 16 * FRACTIONS_PER_UNIT
+    assert wr.amount(1) == 2 * FRACTIONS_PER_UNIT
+    assert wr.amount(5) == 0
+    # 16 cpus + 2 gpus: disjoint cpu-only and gpu-only tasks can coexist
+    assert wr.task_max_count() == 18
+
+    ok = ResourceRequest(
+        entries=(
+            ResourceRequestEntry(0, 4 * FRACTIONS_PER_UNIT),
+            ResourceRequestEntry(1, 5000),
+        )
+    )
+    too_big = ResourceRequest(
+        entries=(ResourceRequestEntry(1, 3 * FRACTIONS_PER_UNIT),)
+    )
+    assert wr.is_capable_of(ok)
+    assert not wr.is_capable_of(too_big)
+    assert wr.is_capable_of_rqv(
+        ResourceRequestVariants(variants=(too_big, ok))
+    )
+    assert wr.to_dense_row(4) == [160000, 20000, 0, 0]
