@@ -1,0 +1,11 @@
+"""Scheduler policy models.
+
+A "model" here is a scheduling policy: it consumes a dense tick snapshot and
+produces per-(batch, variant, worker) task counts. `greedy` is the production
+cut-scan model (jitted, bucketed shapes). Future models (auction refinement,
+LP-polish) plug in behind the same interface so `--scheduler=` can select them.
+"""
+
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+
+__all__ = ["GreedyCutScanModel"]

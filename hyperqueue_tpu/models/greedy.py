@@ -1,0 +1,86 @@
+"""Greedy cut-scan scheduling model: bucketing + compile-cache around the kernel.
+
+The kernel (ops/assign.py) needs static shapes; real ticks have varying worker
+counts, batch counts, resource counts and variant counts. This wrapper pads
+every dimension up to a bucket (powers of two with a small floor) so that in
+steady state every tick hits one already-compiled program — the same trick the
+reference uses to keep its MILP warm is unnecessary there but essential under
+XLA (see SURVEY.md §7 "Fixed shapes on TPU").
+
+Padding is semantically inert: padded workers have zero free resources and
+zero task slots; padded batches have size 0; padded variants are all-zero
+need rows which `_variant_capacity` masks off.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hyperqueue_tpu.ops.assign import (
+    INF_TIME,
+    greedy_cut_scan,
+    scarcity_weights,
+)
+
+
+def _bucket(n: int, floor: int) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class GreedyCutScanModel:
+    """Stateless apart from jit's own compile cache."""
+
+    def __init__(
+        self,
+        worker_floor: int = 8,
+        batch_floor: int = 8,
+        resource_floor: int = 4,
+        variant_floor: int = 1,
+    ):
+        self.worker_floor = worker_floor
+        self.batch_floor = batch_floor
+        self.resource_floor = resource_floor
+        self.variant_floor = variant_floor
+
+    def solve(
+        self,
+        free: np.ndarray,       # (W, R) int32
+        nt_free: np.ndarray,    # (W,) int32
+        lifetime: np.ndarray,   # (W,) int32 seconds, INF_TIME when unlimited
+        needs: np.ndarray,      # (B, V, R) int32
+        sizes: np.ndarray,      # (B,) int32/int64
+        min_time: np.ndarray,   # (B, V) int32 seconds
+    ) -> np.ndarray:
+        """Returns counts (B, V, W) int32 (unpadded)."""
+        n_w, n_r = free.shape
+        n_b, n_v, _ = needs.shape
+
+        pw = _bucket(n_w, self.worker_floor)
+        pb = _bucket(max(n_b, 1), self.batch_floor)
+        pr = _bucket(max(n_r, 1), self.resource_floor)
+        pv = _bucket(max(n_v, 1), self.variant_floor)
+
+        free_p = np.zeros((pw, pr), dtype=np.int32)
+        free_p[:n_w, :n_r] = free
+        nt_p = np.zeros(pw, dtype=np.int32)
+        nt_p[:n_w] = nt_free
+        life_p = np.zeros(pw, dtype=np.int32)
+        life_p[:n_w] = lifetime
+        needs_p = np.zeros((pb, pv, pr), dtype=np.int32)
+        needs_p[:n_b, :n_v, :n_r] = needs
+        sizes_p = np.zeros(pb, dtype=np.int32)
+        sizes_p[:n_b] = np.minimum(sizes, np.int32(2**30))
+        mt_p = np.zeros((pb, pv), dtype=np.int32)
+        mt_p[:n_b, :n_v] = min_time
+        # absent variants must never be eligible: give them infinite min_time
+        mt_p[:, n_v:] = int(INF_TIME)
+
+        scarcity = scarcity_weights(free_p.astype(np.int64).sum(axis=0))
+
+        counts, _free_after, _nt_after = greedy_cut_scan(
+            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, scarcity
+        )
+        return np.asarray(counts)[:n_b, :n_v, :n_w]

@@ -1,0 +1,141 @@
+"""Dense scheduling-tick assignment kernel (JAX).
+
+This is the TPU re-host of the reference's per-tick MILP
+(crates/tako/src/internal/scheduler/solver.rs:16-461). The reference builds an
+integer program with one variable per (worker, rq-batch, variant) and solves it
+with HiGHS on the CPU; here the same decision — "how many tasks of each request
+class go to each worker this tick" — is computed by a single jit-compiled
+program: a `lax.scan` over priority-ordered batches whose body does only dense
+(W,) / (W,R) integer vector ops, so the whole tick runs on-device with no
+host round-trips and fixed (bucketed) shapes.
+
+Semantics preserved from the reference solver:
+  * Strict priority dominance with gap relaxation (solver.rs:240-410): batches
+    are scanned highest-priority first; a lower batch sees only the free
+    resources left after every higher batch packed maximally, which is exactly
+    the reference's blocking-constraint-with-gap outcome for a single tick.
+  * Resource variants (request.rs:230): each batch carries up to V variant
+    need-vectors tried in user preference order.
+  * min_time (request.rs:137): a variant is masked off on workers whose
+    remaining lifetime is shorter.
+  * Worker objective weights (solver.rs:520-549): the water-fill visits
+    workers in an order that penalizes burning scarce resources a batch does
+    not request, then lower index first.
+
+Inputs are all integers (fixed-point resource fractions); no floating-point
+feasibility drift is possible.
+
+Shapes (padded to buckets by the caller, models/greedy.py):
+  free      (W, R) int32   free resource fractions per worker
+  nt_free   (W,)   int32   remaining simultaneous-task slots per worker
+  lifetime  (W,)   int32   remaining worker lifetime seconds (INF_TIME if none)
+  needs     (B, V, R) int32  per-batch per-variant request vector; an all-zero
+                             variant row is "variant absent"
+  sizes     (B,)   int32   number of ready tasks in the batch (0 = padding row)
+  min_time  (B, V) int32   per-variant minimal task duration in seconds
+  scarcity  (R,)   float32 precomputed scarcity weight per resource
+Output:
+  counts    (B, V, W) int32  tasks of batch b, variant v to start on worker w
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+INF_TIME = jnp.int32(2**31 - 1)
+# Quantization of the waste score into the integer sort key: key =
+# waste_q * W + worker_index, waste_q in [0, _WASTE_Q]. With W <= 16384 the
+# key stays well inside int32.
+_WASTE_Q = 65536
+
+
+def _variant_capacity(free, nt_free, need, time_ok):
+    """(W,) int32: how many tasks of `need` fit on each worker right now."""
+    # floor(free / need) per resource where need > 0, else unlimited
+    needed = need > 0
+    # avoid div by zero: where need == 0 use 1 and mask with a large number
+    denom = jnp.where(needed, need, 1)
+    per_res = jnp.where(needed[None, :], free // denom[None, :], jnp.int32(2**30))
+    cap = jnp.min(per_res, axis=1)
+    cap = jnp.minimum(cap, nt_free)
+    cap = jnp.where(time_ok, cap, 0)
+    # an absent (all-zero) variant must contribute nothing
+    cap = jnp.where(jnp.any(needed), cap, 0)
+    return jnp.maximum(cap, 0)
+
+
+def _water_fill(cap, remaining, order_key):
+    """Assign up to `remaining` tasks across workers, preferring low order_key.
+
+    Returns (assign (W,) int32, assigned_total int32). Pure vector math: sort
+    workers by key, cumulative-sum capacities, clip, inverse-permute.
+    """
+    order = jnp.argsort(order_key)  # stable; ascending
+    cap_sorted = cap[order]
+    cum = jnp.cumsum(cap_sorted)
+    take_sorted = jnp.clip(remaining - (cum - cap_sorted), 0, cap_sorted)
+    inv = jnp.argsort(order)
+    assign = take_sorted[inv]
+    return assign, jnp.sum(take_sorted)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def greedy_cut_scan(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
+    """Scan priority-ordered batches, water-filling each over the workers.
+
+    See module docstring for shapes/semantics. Returns (counts, free_after,
+    nt_free_after).
+    """
+    n_variants = needs.shape[1]
+
+    def batch_body(carry, batch):
+        free, nt_free = carry
+        b_needs, b_size, b_min_time = batch
+        remaining = b_size
+        counts_v = []
+        for v in range(n_variants):  # V is tiny and static: unrolled
+            need = b_needs[v]
+            time_ok = b_min_time[v] <= lifetime
+            cap = _variant_capacity(free, nt_free, need, time_ok)
+            cap = jnp.minimum(cap, remaining)
+            # Worker order: burning resources the batch does not request is
+            # penalized by their scarcity; ties broken by worker index
+            # (reference solver.rs:520-549 objective weights). scarcity is
+            # normalized to sum 1 so waste is in [0, 1]; the key is integer to
+            # keep the index tiebreak exact.
+            n_workers = cap.shape[0]
+            unneeded = (free > 0) & (need[None, :] == 0)
+            waste = jnp.sum(unneeded * scarcity[None, :], axis=1)
+            waste_q = jnp.round(waste * _WASTE_Q).astype(jnp.int32)
+            idx = jnp.arange(n_workers, dtype=jnp.int32)
+            order_key = jnp.where(
+                cap > 0, waste_q * n_workers + idx, jnp.int32(2**31 - 1)
+            )
+            assign, assigned = _water_fill(cap, remaining, order_key)
+            remaining = remaining - assigned
+            free = free - assign[:, None] * need[None, :]
+            nt_free = nt_free - assign
+            counts_v.append(assign)
+        return (free, nt_free), jnp.stack(counts_v)
+
+    (free, nt_free), counts = jax.lax.scan(
+        batch_body, (free, nt_free), (needs, sizes, min_time)
+    )
+    return counts, free, nt_free
+
+
+def scarcity_weights(total_amounts: jnp.ndarray) -> jnp.ndarray:
+    """(R,) float32 scarcity per resource, normalized to sum 1.
+
+    Rarer cluster-wide => larger weight. Resources with zero total capacity
+    get weight 0 (nobody can waste them). total_amounts: (R,) summed capacity
+    across workers.
+    """
+    total = total_amounts.astype(jnp.float32)
+    present = total > 0
+    inv = jnp.where(present, jnp.max(total) / jnp.maximum(total, 1.0), 0.0)
+    norm = jnp.sum(inv)
+    return jnp.where(norm > 0, inv / jnp.maximum(norm, 1e-9), 0.0)

@@ -1,0 +1,63 @@
+"""Pure-Python reference implementation of the tick assignment semantics.
+
+An independent, loop-based implementation of exactly the semantics the JAX
+kernel (ops/assign.py) must satisfy. It is the executable spec for golden
+tests (mirroring how the reference's tier-1 Rust tests encode scheduler
+semantics, SURVEY.md §4) and is deliberately written in the dumbest possible
+style — no vectorization — so a human can audit it against the reference's
+solver behavior.
+"""
+
+from __future__ import annotations
+
+
+def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
+    """Same contract as ops.assign.greedy_cut_scan, lists/nested lists in,
+    counts[b][v][w] out. Mutates nothing."""
+    n_w = len(free)
+    n_r = len(free[0]) if n_w else 0
+    free = [list(row) for row in free]
+    nt_free = list(nt_free)
+    n_b = len(needs)
+    n_v = len(needs[0]) if n_b else 0
+    counts = [[[0] * n_w for _ in range(n_v)] for _ in range(n_b)]
+
+    for b in range(n_b):
+        remaining = sizes[b]
+        for v in range(n_v):
+            need = needs[b][v]
+            if not any(x > 0 for x in need):
+                continue  # absent variant
+            # capacity per worker
+            caps = []
+            for w in range(n_w):
+                if min_time[b][v] > lifetime[w]:
+                    caps.append(0)
+                    continue
+                cap = nt_free[w]
+                for r in range(n_r):
+                    if need[r] > 0:
+                        cap = min(cap, free[w][r] // need[r])
+                caps.append(max(cap, 0))
+            # worker order: scarcity-weighted waste of unrequested resources,
+            # then index (quantized exactly like the kernel)
+            def key(w):
+                waste = sum(
+                    scarcity[r]
+                    for r in range(n_r)
+                    if free[w][r] > 0 and need[r] == 0
+                )
+                return (round(waste * 65536), w)
+
+            for w in sorted(range(n_w), key=key):
+                if remaining <= 0:
+                    break
+                take = min(caps[w], remaining)
+                if take <= 0:
+                    continue
+                counts[b][v][w] = take
+                remaining -= take
+                nt_free[w] -= take
+                for r in range(n_r):
+                    free[w][r] -= take * need[r]
+    return counts

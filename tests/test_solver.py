@@ -1,0 +1,213 @@
+"""Golden tests for the dense tick solver.
+
+These encode the scheduler semantics the reference tier-1 Rust tests pin down
+(crates/tako/src/internal/tests/test_scheduler_sn.rs): strict priority
+dominance, resource variants, fractional amounts, min_time masking, task-slot
+caps — plus randomized cross-checks of the JAX kernel against the pure-Python
+oracle.
+"""
+
+import numpy as np
+import pytest
+
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.scheduler.oracle import solve_oracle
+
+U = 10_000  # one resource unit in fractions
+INF = int(INF_TIME)
+
+MODEL = GreedyCutScanModel()
+
+
+def run(free, nt_free, lifetime, needs, sizes, min_time):
+    free = np.asarray(free, dtype=np.int32)
+    counts = MODEL.solve(
+        free=free,
+        nt_free=np.asarray(nt_free, dtype=np.int32),
+        lifetime=np.asarray(lifetime, dtype=np.int32),
+        needs=np.asarray(needs, dtype=np.int32),
+        sizes=np.asarray(sizes, dtype=np.int32),
+        min_time=np.asarray(min_time, dtype=np.int32),
+    )
+    return counts
+
+
+def test_single_batch_spreads_over_workers():
+    # 3 workers x 4 cpus; 10 one-cpu tasks -> 4+4+2 in index order
+    counts = run(
+        free=[[4 * U]] * 3,
+        nt_free=[8] * 3,
+        lifetime=[INF] * 3,
+        needs=[[[U]]],
+        sizes=[10],
+        min_time=[[0]],
+    )
+    assert counts[0, 0].tolist() == [4, 4, 2]
+
+
+def test_priority_dominance():
+    # one worker, 4 cpus. High-prio batch (first row) takes all; low gets none.
+    counts = run(
+        free=[[4 * U]],
+        nt_free=[8],
+        lifetime=[INF],
+        needs=[[[U]], [[U]]],
+        sizes=[4, 4],
+        min_time=[[0], [0]],
+    )
+    assert counts[0, 0, 0] == 4
+    assert counts[1, 0, 0] == 0
+
+
+def test_gap_relaxation():
+    # High-prio needs 3 cpus: one fits (free 4), leaving gap 1; low-prio
+    # 1-cpu tasks fill the gap even though high-prio tasks remain unplaced.
+    counts = run(
+        free=[[4 * U]],
+        nt_free=[8],
+        lifetime=[INF],
+        needs=[[[3 * U]], [[U]]],
+        sizes=[5, 5],
+        min_time=[[0], [0]],
+    )
+    assert counts[0, 0, 0] == 1
+    assert counts[1, 0, 0] == 1
+
+
+def test_variants_preference_and_fallback():
+    # Batch may use 1 gpu (preferred) or 2 cpus. Worker0 has only cpus,
+    # worker1 has 1 gpu + cpus. 3 tasks: 1 runs on the gpu variant (w1),
+    # the rest fall back to cpu variant.
+    counts = run(
+        free=[[4 * U, 0], [4 * U, 1 * U]],
+        nt_free=[8, 8],
+        lifetime=[INF, INF],
+        needs=[[[0, U], [2 * U, 0]]],
+        sizes=[3],
+        min_time=[[0, 0]],
+    )
+    gpu_variant = counts[0, 0]
+    cpu_variant = counts[0, 1]
+    assert gpu_variant.tolist() == [0, 1]
+    assert cpu_variant.sum() == 2
+
+
+def test_fractional_resources():
+    # 1 gpu, tasks need 0.5 gpu each -> exactly 2 fit
+    counts = run(
+        free=[[4 * U, 1 * U]],
+        nt_free=[8],
+        lifetime=[INF],
+        needs=[[[U, U // 2]]],
+        sizes=[5],
+        min_time=[[0]],
+    )
+    assert counts[0, 0, 0] == 2
+
+
+def test_min_time_masks_short_lived_worker():
+    # Two workers; w0 has 100s left, w1 unlimited. Task min_time 3600s.
+    counts = run(
+        free=[[4 * U], [4 * U]],
+        nt_free=[8, 8],
+        lifetime=[100, INF],
+        needs=[[[U]]],
+        sizes=[8],
+        min_time=[[3600]],
+    )
+    assert counts[0, 0].tolist() == [0, 4]
+
+
+def test_task_slot_cap():
+    counts = run(
+        free=[[100 * U]],
+        nt_free=[3],
+        lifetime=[INF],
+        needs=[[[U]]],
+        sizes=[50],
+        min_time=[[0]],
+    )
+    assert counts[0, 0, 0] == 3
+
+
+def test_scarcity_avoids_gpu_worker_for_cpu_tasks():
+    # w0 is a GPU box (scarce resource), w1 is cpu-only. CPU-only tasks that
+    # fit entirely on w1 must prefer w1 despite its higher index.
+    counts = run(
+        free=[[8 * U, 2 * U], [8 * U, 0]],
+        nt_free=[16, 16],
+        lifetime=[INF, INF],
+        needs=[[[U, 0]]],
+        sizes=[8],
+        min_time=[[0]],
+    )
+    assert counts[0, 0].tolist() == [0, 8]
+
+
+def test_empty_and_padding_batches():
+    counts = run(
+        free=[[4 * U]],
+        nt_free=[8],
+        lifetime=[INF],
+        needs=[[[U]], [[0]]],  # second batch is an all-zero padding row
+        sizes=[0, 7],
+        min_time=[[0], [0]],
+    )
+    assert counts.sum() == 0
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_cross_check_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n_w = int(rng.integers(1, 9))
+    n_r = int(rng.integers(1, 4))
+    n_b = int(rng.integers(1, 6))
+    n_v = int(rng.integers(1, 3))
+    free = rng.integers(0, 8, size=(n_w, n_r)) * U
+    nt_free = rng.integers(0, 10, size=n_w)
+    lifetime = np.where(rng.random(n_w) < 0.2, 100, INF)
+    needs = rng.integers(0, 3, size=(n_b, n_v, n_r)) * (U // 2)
+    sizes = rng.integers(0, 12, size=n_b)
+    min_time = np.where(rng.random((n_b, n_v)) < 0.2, 3600, 0)
+
+    counts = run(free, nt_free, lifetime, needs, sizes, min_time)
+
+    from hyperqueue_tpu.ops.assign import scarcity_weights
+
+    pad_free = np.zeros((8 if n_w <= 8 else 16, 4), dtype=np.int64)
+    pad_free[:n_w, :n_r] = free
+    scarcity = np.asarray(scarcity_weights(pad_free.sum(axis=0)))[:n_r]
+    expected = solve_oracle(
+        free.tolist(),
+        nt_free.tolist(),
+        lifetime.tolist(),
+        needs.tolist(),
+        sizes.tolist(),
+        min_time.tolist(),
+        scarcity.tolist(),
+    )
+    assert counts.tolist() == expected
+
+
+def test_feasibility_invariants_random():
+    # whatever the assignment, resources and slots must never go negative
+    rng = np.random.default_rng(123)
+    for _ in range(5):
+        n_w, n_r, n_b = 6, 3, 8
+        free = rng.integers(0, 16, size=(n_w, n_r)) * U
+        nt_free = rng.integers(1, 6, size=n_w)
+        needs = rng.integers(0, 4, size=(n_b, 1, n_r)) * (U // 4)
+        sizes = rng.integers(0, 40, size=n_b)
+        counts = run(
+            free,
+            nt_free,
+            [INF] * n_w,
+            needs,
+            sizes,
+            np.zeros((n_b, 1), dtype=np.int32),
+        )
+        used = np.einsum("bvw,bvr->wr", counts, needs)
+        assert (used <= free).all()
+        assert (counts.sum(axis=(0, 1)) <= nt_free).all()
+        assert (counts.sum(axis=(1, 2)) <= sizes).all()
