@@ -1,0 +1,167 @@
+"""One scheduling tick: queues -> batches -> dense snapshot -> solve -> mapping.
+
+Reference factoring: crates/tako/src/internal/scheduler/main.rs:40-46
+(batches -> solver -> mapping). The dense snapshot is the seam where the work
+moves to the TPU: everything up to `model.solve` is host bookkeeping over
+dicts; the solve itself sees only integer tensors (SURVEY.md §3.2).
+
+Batches: per rq-id queue, each distinct priority level becomes a cut, capped
+at MAX_CUTS_PER_QUEUE with the tail merged into the last cut (reference
+batches.rs:183-217 prunes similarly). Batches from all queues are solved
+jointly, globally ordered by priority.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
+from hyperqueue_tpu.scheduler.queues import Priority, TaskQueues
+
+MAX_CUTS_PER_QUEUE = 32
+# Values above this get range-compressed before entering the int32 kernel.
+MAX_SAFE_AMOUNT = 2**30
+
+
+@dataclass
+class Batch:
+    rq_id: int
+    priority: Priority
+    size: int
+
+
+@dataclass
+class WorkerRow:
+    worker_id: int
+    free: list[int]       # dense fractions, aligned to ResourceIdMap
+    nt_free: int
+    lifetime_secs: int    # INF_TIME if unlimited
+
+
+@dataclass
+class Assignment:
+    task_id: int
+    worker_id: int
+    rq_id: int
+    variant: int
+
+
+def create_batches(queues: TaskQueues) -> list[Batch]:
+    batches: list[Batch] = []
+    for rq_id, queue in queues.items():
+        sizes = queue.priority_sizes()
+        if len(sizes) > MAX_CUTS_PER_QUEUE:
+            head = sizes[: MAX_CUTS_PER_QUEUE - 1]
+            tail_count = sum(n for _, n in sizes[MAX_CUTS_PER_QUEUE - 1 :])
+            tail_priority = sizes[MAX_CUTS_PER_QUEUE - 1][0]
+            sizes = head + [(tail_priority, tail_count)]
+        for priority, count in sizes:
+            batches.append(Batch(rq_id=rq_id, priority=priority, size=count))
+    batches.sort(key=lambda b: (b.priority, -b.rq_id), reverse=True)
+    return batches
+
+
+def _range_compress(needs: np.ndarray, free: np.ndarray) -> None:
+    """Shift down any resource column whose values exceed int32-safe range.
+
+    needs are ceil-shifted (request never shrinks to zero) and free floor-
+    shifted, so feasibility decisions stay sound (never optimistic).
+    """
+    for r in range(free.shape[1]):
+        peak = max(
+            int(free[:, r].max(initial=0)), int(needs[:, :, r].max(initial=0))
+        )
+        shift = 0
+        while (peak >> shift) >= MAX_SAFE_AMOUNT:
+            shift += 1
+        if shift:
+            nonzero = needs[:, :, r] > 0
+            needs[:, :, r] = np.where(
+                nonzero,
+                np.maximum((needs[:, :, r] + (1 << shift) - 1) >> shift, 1),
+                0,
+            )
+            free[:, r] >>= shift
+
+
+def run_tick(
+    queues: TaskQueues,
+    workers: list[WorkerRow],
+    rq_map: ResourceRqMap,
+    resource_map: ResourceIdMap,
+    model,
+) -> list[Assignment]:
+    """Solve one tick and pop assigned tasks from the queues.
+
+    Removes assigned tasks from `queues`; does NOT touch worker resource
+    accounting — the caller (reactor) applies each Assignment to its Worker
+    state, which keeps one owner for the free/nt_free bookkeeping.
+    """
+    batches = create_batches(queues)
+    if not batches or not workers:
+        return []
+
+    n_w = len(workers)
+    n_r = len(resource_map)
+    n_b = len(batches)
+    n_v = max(
+        len(rq_map.get_variants(b.rq_id).variants) for b in batches
+    )
+
+    free = np.zeros((n_w, n_r), dtype=np.int64)
+    nt_free = np.zeros(n_w, dtype=np.int32)
+    lifetime = np.zeros(n_w, dtype=np.int32)
+    for i, row in enumerate(workers):
+        free[i, : len(row.free)] = row.free
+        nt_free[i] = max(row.nt_free, 0)
+        lifetime[i] = row.lifetime_secs
+
+    needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
+    sizes = np.zeros(n_b, dtype=np.int32)
+    min_time = np.zeros((n_b, n_v), dtype=np.int32)
+    min_time[:] = int(INF_TIME)  # absent variants never eligible
+    for bi, batch in enumerate(batches):
+        sizes[bi] = min(batch.size, 2**30)
+        variants = rq_map.get_variants(batch.rq_id).variants
+        for vi, variant in enumerate(variants):
+            min_time[bi, vi] = min(int(variant.min_time_secs), int(INF_TIME))
+            for entry in variant.entries:
+                needs[bi, vi, entry.resource_id] = entry.amount
+
+    _range_compress(needs, free)
+    free32 = free.astype(np.int32)
+    counts = model.solve(
+        free=free32,
+        nt_free=nt_free,
+        lifetime=lifetime,
+        needs=needs.astype(np.int32),
+        sizes=sizes,
+        min_time=min_time,
+    )
+
+    assignments: list[Assignment] = []
+    counts = np.asarray(counts)
+    for bi, batch in enumerate(batches):
+        per_worker = counts[bi]  # (V, W)
+        if per_worker.sum() == 0:
+            continue
+        queue = queues.queue(batch.rq_id)
+        variants = rq_map.get_variants(batch.rq_id).variants
+        for vi in range(len(variants)):
+            for wi in np.nonzero(per_worker[vi])[0]:
+                n = int(per_worker[vi][wi])
+                task_ids = queue.take(batch.priority, n)
+                row = workers[wi]
+                for task_id in task_ids:
+                    assignments.append(
+                        Assignment(
+                            task_id=task_id,
+                            worker_id=row.worker_id,
+                            rq_id=batch.rq_id,
+                            variant=vi,
+                        )
+                    )
+    return assignments

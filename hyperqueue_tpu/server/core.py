@@ -1,0 +1,76 @@
+"""Server core state.
+
+Reference: crates/tako/src/internal/server/core.rs:42-62 — the single source
+of truth mutated only by the reactor on the single-threaded server loop:
+task map, worker map, interning maps, ready queues, id counters. Purity of
+the scheduler (a function of a snapshot of this state) is what makes the TPU
+offload possible; nothing here holds locks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.ids import IdCounter
+from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
+from hyperqueue_tpu.resources.request import ResourceRequestVariants
+from hyperqueue_tpu.scheduler.queues import TaskQueues
+from hyperqueue_tpu.scheduler.tick import WorkerRow
+from hyperqueue_tpu.server.task import Task, TaskState
+from hyperqueue_tpu.server.worker import Worker
+
+
+@dataclass
+class Core:
+    tasks: dict[int, Task] = field(default_factory=dict)
+    workers: dict[int, Worker] = field(default_factory=dict)
+    resource_map: ResourceIdMap = field(default_factory=ResourceIdMap)
+    rq_map: ResourceRqMap = field(default_factory=ResourceRqMap)
+    queues: TaskQueues = field(default_factory=TaskQueues)
+    worker_id_counter: IdCounter = field(default_factory=IdCounter)
+    # multi-node gang tasks waiting for enough workers, in priority order
+    mn_queue: list[int] = field(default_factory=list)
+    scheduling_needed: bool = False
+
+    def intern_rqv(self, rqv: ResourceRequestVariants) -> int:
+        return self.rq_map.get_or_create(rqv)
+
+    def worker_rows(self) -> list[WorkerRow]:
+        """Snapshot rows for the tick; excludes workers reserved for gangs."""
+        return [
+            WorkerRow(
+                worker_id=w.worker_id,
+                free=w.free,
+                nt_free=w.nt_free,
+                lifetime_secs=w.lifetime_secs(),
+            )
+            for w in self.workers.values()
+            if w.mn_task == 0
+        ]
+
+    def variant_amounts(self, rq_id: int, variant: int) -> list[tuple[int, int]]:
+        """[(resource_id, amount)] of the chosen variant for accounting."""
+        rqv = self.rq_map.get_variants(rq_id)
+        return [
+            (e.resource_id, e.amount)
+            for e in rqv.variants[variant].entries
+        ]
+
+    def sanity_check(self) -> None:
+        """Debug invariant walk (reference core.rs:274-430)."""
+        self.queues.sanity_check()
+        for task in self.tasks.values():
+            if task.state is TaskState.WAITING:
+                assert task.unfinished_deps > 0, task
+            if task.state in (TaskState.ASSIGNED, TaskState.RUNNING):
+                assert task.assigned_worker in self.workers or task.mn_workers
+        for worker in self.workers.values():
+            for rid, amount in enumerate(worker.free):
+                assert 0 <= amount <= worker.resources.amount(rid), (
+                    worker.worker_id,
+                    rid,
+                    amount,
+                )
+            for task_id in worker.assigned_tasks:
+                task = self.tasks.get(task_id)
+                assert task is not None and task.assigned_worker == worker.worker_id

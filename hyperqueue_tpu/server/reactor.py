@@ -1,0 +1,342 @@
+"""Reactor: every mutation of the server core happens here.
+
+Reference: crates/tako/src/internal/server/reactor.rs — on_new_worker,
+on_remove_worker (requeue + crash counters), on_new_tasks (dep counting),
+on_task_update, on_cancel_tasks. The scheduler is invoked between reactor
+batches via an "ask_for_scheduling" flag + wakeup, never reentrantly
+(reference server/comm.rs:61-101).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from hyperqueue_tpu.resources.request import AllocationPolicy
+from hyperqueue_tpu.scheduler.tick import run_tick
+from hyperqueue_tpu.server.core import Core
+from hyperqueue_tpu.server.task import Task, TaskState
+from hyperqueue_tpu.server.worker import Worker
+
+logger = logging.getLogger(__name__)
+
+
+class Comm(Protocol):
+    def send_compute(self, worker_id: int, tasks: list[dict]) -> None: ...
+    def send_cancel(self, worker_id: int, task_ids: list[int]) -> None: ...
+    def ask_for_scheduling(self) -> None: ...
+
+
+class EventSink(Protocol):
+    """Upward channel to the product (jobs) layer.
+
+    Reference: the EventProcessor trait (tako events.rs:7-33) — the only way
+    task-graph news reaches jobs/journal/clients.
+    """
+
+    def on_task_started(self, task_id: int, instance_id: int, worker_ids: list[int]) -> None: ...
+    def on_task_finished(self, task_id: int) -> None: ...
+    def on_task_failed(self, task_id: int, message: str) -> None: ...
+    def on_task_canceled(self, task_id: int) -> None: ...
+    def on_worker_new(self, worker: Worker) -> None: ...
+    def on_worker_lost(self, worker_id: int, reason: str) -> None: ...
+
+
+def on_new_tasks(core: Core, comm: Comm, tasks: list[Task]) -> None:
+    """Insert tasks, count dependencies, enqueue the ready ones.
+
+    Reference reactor.rs:188 (on_new_tasks).
+    """
+    for task in tasks:
+        core.tasks[task.task_id] = task
+    for task in tasks:
+        unfinished = 0
+        for dep_id in task.deps:
+            dep = core.tasks.get(dep_id)
+            if dep is None or dep.state is TaskState.FINISHED:
+                continue
+            dep.consumers.add(task.task_id)
+            unfinished += 1
+        task.unfinished_deps = unfinished
+        if unfinished == 0:
+            _make_ready(core, task)
+    comm.ask_for_scheduling()
+
+
+def _make_ready(core: Core, task: Task) -> None:
+    task.state = TaskState.READY
+    rqv = core.rq_map.get_variants(task.rq_id)
+    if rqv.is_multi_node:
+        core.mn_queue.append(task.task_id)
+        core.mn_queue.sort(key=lambda t: core.tasks[t].priority, reverse=True)
+    else:
+        core.queues.add(task.rq_id, task.priority, task.task_id)
+
+
+def on_new_worker(core: Core, comm: Comm, events: EventSink, worker: Worker) -> None:
+    core.workers[worker.worker_id] = worker
+    events.on_worker_new(worker)
+    comm.ask_for_scheduling()
+
+
+def on_remove_worker(
+    core: Core, comm: Comm, events: EventSink, worker_id: int, reason: str
+) -> None:
+    """Worker lost: requeue its tasks with crash accounting.
+
+    Reference reactor.rs:64 — sn tasks go back to the queues with
+    crash_counter+1 and die at the crash limit; for mn tasks, loss of a
+    non-root worker does NOT fail the task (reference CHANGELOG v0.25.1) but
+    the gang is torn down and rescheduled.
+    """
+    worker = core.workers.pop(worker_id, None)
+    if worker is None:
+        return
+    events.on_worker_lost(worker_id, reason)
+    for task_id in list(worker.assigned_tasks):
+        task = core.tasks.get(task_id)
+        if task is None or task.is_done:
+            continue
+        was_running = task.state is TaskState.RUNNING
+        task.assigned_worker = 0
+        task.increment_instance()
+        if was_running and task.crashed():
+            task.state = TaskState.FAILED
+            _propagate_failure(core, events, task, "worker lost too many times")
+            continue
+        task.state = TaskState.WAITING
+        _make_ready(core, task)
+    if worker.mn_task:
+        task = core.tasks.get(worker.mn_task)
+        if task is not None and not task.is_done:
+            _teardown_gang(core, comm, events, task, lost_worker=worker_id)
+    comm.ask_for_scheduling()
+
+
+def _teardown_gang(
+    core: Core, comm: Comm, events: EventSink, task: Task, lost_worker: int
+) -> None:
+    root = task.mn_workers[0] if task.mn_workers else 0
+    for wid in task.mn_workers:
+        w = core.workers.get(wid)
+        if w is not None:
+            w.mn_task = 0
+            if wid != lost_worker and task.state is TaskState.RUNNING:
+                comm.send_cancel(wid, [task.task_id])
+    task.mn_workers = ()
+    task.increment_instance()
+    if lost_worker == root and task.state is TaskState.RUNNING and task.crashed():
+        task.state = TaskState.FAILED
+        _propagate_failure(core, events, task, "gang root lost too many times")
+        return
+    task.state = TaskState.WAITING
+    _make_ready(core, task)
+
+
+def on_task_running(
+    core: Core, events: EventSink, task_id: int, instance_id: int
+) -> None:
+    task = core.tasks.get(task_id)
+    if task is None or task.instance_id != instance_id or task.is_done:
+        return  # stale message from a previous incarnation
+    if task.state is TaskState.ASSIGNED:
+        task.state = TaskState.RUNNING
+        workers = list(task.mn_workers) or [task.assigned_worker]
+        events.on_task_started(task_id, instance_id, workers)
+
+
+def on_task_finished(
+    core: Core, comm: Comm, events: EventSink, task_id: int, instance_id: int
+) -> None:
+    task = core.tasks.get(task_id)
+    if task is None or task.instance_id != instance_id or task.is_done:
+        return
+    _release_task_resources(core, task)
+    task.state = TaskState.FINISHED
+    events.on_task_finished(task_id)
+    for consumer_id in sorted(task.consumers):
+        consumer = core.tasks.get(consumer_id)
+        if consumer is None or consumer.state is not TaskState.WAITING:
+            continue
+        consumer.unfinished_deps -= 1
+        if consumer.unfinished_deps == 0:
+            _make_ready(core, consumer)
+    task.consumers.clear()
+    comm.ask_for_scheduling()
+
+
+def on_task_failed(
+    core: Core,
+    comm: Comm,
+    events: EventSink,
+    task_id: int,
+    instance_id: int,
+    message: str,
+) -> None:
+    task = core.tasks.get(task_id)
+    if task is None or task.instance_id != instance_id or task.is_done:
+        return
+    _release_task_resources(core, task)
+    task.state = TaskState.FAILED
+    _propagate_failure(core, events, task, message)
+    comm.ask_for_scheduling()
+
+
+def _propagate_failure(
+    core: Core, events: EventSink, task: Task, message: str
+) -> None:
+    """Fail the task and transitively cancel waiting consumers."""
+    events.on_task_failed(task.task_id, message)
+    stack = sorted(task.consumers)
+    task.consumers.clear()
+    while stack:
+        tid = stack.pop()
+        consumer = core.tasks.get(tid)
+        if consumer is None or consumer.is_done:
+            continue
+        consumer.state = TaskState.CANCELED
+        events.on_task_canceled(tid)
+        stack.extend(sorted(consumer.consumers))
+        consumer.consumers.clear()
+
+
+def on_cancel_tasks(
+    core: Core, comm: Comm, events: EventSink, task_ids: list[int]
+) -> list[int]:
+    """Cancel tasks (and transitively their consumers). Returns ids actually
+    canceled. Reference reactor.rs:706."""
+    canceled: list[int] = []
+    stack = list(task_ids)
+    per_worker: dict[int, list[int]] = {}
+    while stack:
+        tid = stack.pop()
+        task = core.tasks.get(tid)
+        if task is None or task.is_done:
+            continue
+        stack.extend(sorted(task.consumers))
+        task.consumers.clear()
+        if task.state is TaskState.READY:
+            rqv = core.rq_map.get_variants(task.rq_id)
+            if rqv.is_multi_node:
+                if tid in core.mn_queue:
+                    core.mn_queue.remove(tid)
+            else:
+                core.queues.remove(task.rq_id, tid)
+        elif task.state in (TaskState.ASSIGNED, TaskState.RUNNING):
+            notify = list(task.mn_workers) or [task.assigned_worker]
+            _release_task_resources(core, task)
+            for wid in notify:
+                if wid:
+                    per_worker.setdefault(wid, []).append(tid)
+        task.state = TaskState.CANCELED
+        events.on_task_canceled(tid)
+        canceled.append(tid)
+    for wid, tids in per_worker.items():
+        comm.send_cancel(wid, tids)
+    if canceled:
+        comm.ask_for_scheduling()
+    return canceled
+
+
+def _release_task_resources(core: Core, task: Task) -> None:
+    if task.mn_workers:
+        for wid in task.mn_workers:
+            w = core.workers.get(wid)
+            if w is not None:
+                w.mn_task = 0
+        task.mn_workers = ()
+        return
+    worker = core.workers.get(task.assigned_worker)
+    if worker is not None and task.task_id in worker.assigned_tasks:
+        amounts = core.variant_amounts(task.rq_id, task.assigned_variant)
+        worker.unassign(task.task_id, amounts)
+    task.assigned_worker = 0
+
+
+def schedule(core: Core, comm: Comm, events: EventSink, model) -> int:
+    """Run one scheduling tick: gangs first (host-side), then the dense solve.
+
+    Returns the number of tasks assigned. Reference scheduler/main.rs:48
+    (run_scheduling = batches -> solver -> mapping -> send).
+    """
+    assigned = 0
+    per_worker_msgs: dict[int, list[dict]] = {}
+
+    # --- multi-node gangs: all-or-nothing N idle workers from one group ---
+    if core.mn_queue:
+        remaining_mn = []
+        for task_id in core.mn_queue:
+            task = core.tasks.get(task_id)
+            if task is None or task.is_done:
+                continue
+            rqv = core.rq_map.get_variants(task.rq_id)
+            n_nodes = rqv.variants[0].n_nodes
+            groups: dict[str, list[Worker]] = {}
+            for w in core.workers.values():
+                if w.mn_task == 0 and w.is_idle():
+                    groups.setdefault(w.group, []).append(w)
+            chosen: list[Worker] | None = None
+            for members in groups.values():
+                if len(members) >= n_nodes:
+                    chosen = sorted(members, key=lambda w: w.worker_id)[:n_nodes]
+                    break
+            if chosen is None:
+                remaining_mn.append(task_id)
+                continue
+            for w in chosen:
+                w.mn_task = task_id
+            task.mn_workers = tuple(w.worker_id for w in chosen)
+            task.state = TaskState.ASSIGNED
+            root = chosen[0]
+            msg = _compute_message(core, task, variant=0)
+            msg["node_ids"] = list(task.mn_workers)
+            msg["node_hostnames"] = [
+                core.workers[wid].configuration.hostname
+                for wid in task.mn_workers
+            ]
+            per_worker_msgs.setdefault(root.worker_id, []).append(msg)
+            assigned += 1
+        core.mn_queue = remaining_mn
+
+    # --- single-node: dense solve ---
+    rows = core.worker_rows()
+    if rows and core.queues.total_ready():
+        assignments = run_tick(
+            core.queues, rows, core.rq_map, core.resource_map, model
+        )
+        for a in assignments:
+            task = core.tasks[a.task_id]
+            worker = core.workers[a.worker_id]
+            task.state = TaskState.ASSIGNED
+            task.assigned_worker = a.worker_id
+            task.assigned_variant = a.variant
+            worker.assign(a.task_id, core.variant_amounts(a.rq_id, a.variant))
+            per_worker_msgs.setdefault(a.worker_id, []).append(
+                _compute_message(core, task, a.variant)
+            )
+            assigned += 1
+
+    for worker_id, msgs in per_worker_msgs.items():
+        comm.send_compute(worker_id, msgs)
+    return assigned
+
+
+def _compute_message(core: Core, task: Task, variant: int) -> dict:
+    rqv = core.rq_map.get_variants(task.rq_id)
+    request = rqv.variants[variant]
+    entries = [
+        {
+            "name": core.resource_map.name_of(e.resource_id),
+            "amount": e.amount,
+            "policy": e.policy.value,
+        }
+        for e in request.entries
+    ]
+    return {
+        "id": task.task_id,
+        "instance": task.instance_id,
+        "body": task.body,
+        "entries": entries,
+        "n_nodes": request.n_nodes,
+        "priority": list(task.priority),
+    }

@@ -1,0 +1,61 @@
+"""Server-side task state machine.
+
+Reference: crates/tako/src/internal/server/task.rs:22-43 —
+Waiting{unfinished_deps} -> Assigned -> Running -> Finished, with instance ids
+(restart counter, task.rs) so stale messages from a previous incarnation are
+discarded, and crash counters driving the CrashLimit policy
+(reference gateway.rs:96-106).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class TaskState(enum.Enum):
+    WAITING = "waiting"     # has unfinished dependencies
+    READY = "ready"         # in a scheduler queue
+    ASSIGNED = "assigned"   # compute message sent to a worker
+    RUNNING = "running"     # worker reported start
+    FINISHED = "finished"
+    FAILED = "failed"
+    CANCELED = "canceled"
+
+
+TERMINAL_STATES = (TaskState.FINISHED, TaskState.FAILED, TaskState.CANCELED)
+
+DEFAULT_CRASH_LIMIT = 5  # reference gateway.rs: MaxCrashes(5)
+
+
+@dataclass(slots=True)
+class Task:
+    task_id: int
+    rq_id: int
+    priority: tuple[int, int] = (0, 0)
+    body: dict = field(default_factory=dict)
+    deps: tuple[int, ...] = ()
+    crash_limit: int = DEFAULT_CRASH_LIMIT
+
+    state: TaskState = TaskState.WAITING
+    unfinished_deps: int = 0
+    consumers: set[int] = field(default_factory=set)
+    instance_id: int = 0
+    crash_counter: int = 0
+    assigned_worker: int = 0  # 0 = none
+    assigned_variant: int = 0
+    # multi-node gangs: workers allocated to this task (root first)
+    mn_workers: tuple[int, ...] = ()
+
+    @property
+    def is_done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def increment_instance(self) -> int:
+        self.instance_id += 1
+        return self.instance_id
+
+    def crashed(self) -> bool:
+        """Register a crash (worker lost while running); True if over limit."""
+        self.crash_counter += 1
+        return self.crash_limit > 0 and self.crash_counter >= self.crash_limit

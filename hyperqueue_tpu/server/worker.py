@@ -1,0 +1,121 @@
+"""Server-side view of a connected worker.
+
+Reference: crates/tako/src/internal/server/worker.rs:30-63 — tracks assigned
+tasks, free resources (dense, mirrors the solver's columns), capability
+checks, time-limit and heartbeat state. The free/nt_free fields are exactly
+the WorkerRow the tick snapshot copies out (scheduler/tick.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+from hyperqueue_tpu.resources.map import ResourceIdMap
+from hyperqueue_tpu.resources.worker_resources import WorkerResources
+
+
+@dataclass
+class WorkerConfiguration:
+    descriptor: ResourceDescriptor
+    hostname: str = "localhost"
+    group: str = "default"
+    heartbeat_secs: float = 8.0
+    time_limit_secs: float = 0.0  # 0 = unlimited
+    idle_timeout_secs: float = 0.0
+    on_server_lost: str = "stop"  # stop | finish-running
+    overview_interval_secs: float = 0.0
+    listen_address: str = ""
+
+    def to_wire(self) -> dict:
+        return {
+            "descriptor": self.descriptor.to_dict(),
+            "hostname": self.hostname,
+            "group": self.group,
+            "heartbeat_secs": self.heartbeat_secs,
+            "time_limit_secs": self.time_limit_secs,
+            "idle_timeout_secs": self.idle_timeout_secs,
+            "on_server_lost": self.on_server_lost,
+            "overview_interval_secs": self.overview_interval_secs,
+            "listen_address": self.listen_address,
+        }
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "WorkerConfiguration":
+        return cls(
+            descriptor=ResourceDescriptor.from_dict(data["descriptor"]),
+            hostname=data.get("hostname", "localhost"),
+            group=data.get("group", "default"),
+            heartbeat_secs=data.get("heartbeat_secs", 8.0),
+            time_limit_secs=data.get("time_limit_secs", 0.0),
+            idle_timeout_secs=data.get("idle_timeout_secs", 0.0),
+            on_server_lost=data.get("on_server_lost", "stop"),
+            overview_interval_secs=data.get("overview_interval_secs", 0.0),
+            listen_address=data.get("listen_address", ""),
+        )
+
+
+@dataclass
+class Worker:
+    worker_id: int
+    configuration: WorkerConfiguration
+    resources: WorkerResources
+    started_at: float = field(default_factory=time.monotonic)
+
+    # dense scheduling state (the tick snapshot reads these directly)
+    free: list[int] = field(default_factory=list)
+    nt_free: int = 0
+    assigned_tasks: set[int] = field(default_factory=set)
+    # multi-node: task id this worker is reserved for (0 = none)
+    mn_task: int = 0
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+    @classmethod
+    def create(
+        cls,
+        worker_id: int,
+        configuration: WorkerConfiguration,
+        resource_map: ResourceIdMap,
+    ) -> "Worker":
+        resources = WorkerResources.from_descriptor(
+            configuration.descriptor, resource_map
+        )
+        worker = cls(
+            worker_id=worker_id,
+            configuration=configuration,
+            resources=resources,
+        )
+        worker.free = list(resources.amounts)
+        worker.nt_free = resources.task_max_count()
+        return worker
+
+    @property
+    def group(self) -> str:
+        return self.configuration.group
+
+    def lifetime_secs(self) -> int:
+        limit = self.configuration.time_limit_secs
+        if limit <= 0:
+            return int(INF_TIME)
+        remaining = limit - (time.monotonic() - self.started_at)
+        return max(int(remaining), 0)
+
+    def assign(self, task_id: int, amounts: list[tuple[int, int]]) -> None:
+        """amounts: [(resource_id, fraction_amount)] of the chosen variant."""
+        self.assigned_tasks.add(task_id)
+        for rid, amount in amounts:
+            if rid < len(self.free):
+                self.free[rid] -= amount
+        self.nt_free -= 1
+
+    def unassign(self, task_id: int, amounts: list[tuple[int, int]]) -> None:
+        self.assigned_tasks.discard(task_id)
+        for rid, amount in amounts:
+            if rid < len(self.free):
+                self.free[rid] += amount
+        self.nt_free += 1
+
+    def is_idle(self) -> bool:
+        return not self.assigned_tasks and self.mn_task == 0

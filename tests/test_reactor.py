@@ -1,0 +1,202 @@
+"""Reactor + scheduling semantics tests (tier-1 equivalent).
+
+Modeled on reference crates/tako/src/internal/tests/test_reactor.rs and
+test_scheduler_sn.rs/test_scheduler_mn.rs: dependency counting, assignment,
+worker loss with crash counters, cancellation propagation, gang scheduling.
+"""
+
+from hyperqueue_tpu.server.task import TaskState
+
+from utils_env import TestEnv
+
+
+def test_simple_assign_and_finish():
+    env = TestEnv()
+    env.worker(cpus=4)
+    (t1,) = env.submit()
+    assert env.state(t1) is TaskState.READY
+    assert env.schedule() == 1
+    assert env.state(t1) is TaskState.ASSIGNED
+    env.start_all_assigned()
+    assert env.state(t1) is TaskState.RUNNING
+    env.finish(t1)
+    assert env.state(t1) is TaskState.FINISHED
+    assert env.events.finished == [t1]
+    # worker resources fully returned
+    w = next(iter(env.core.workers.values()))
+    assert w.free == w.resources.amounts
+    assert not w.assigned_tasks
+
+
+def test_dependencies_gate_readiness():
+    env = TestEnv()
+    env.worker(cpus=4)
+    (a,) = env.submit()
+    (b,) = env.submit(deps=[a])
+    (c,) = env.submit(deps=[a, b])
+    assert env.state(b) is TaskState.WAITING
+    env.schedule()
+    env.start_all_assigned()
+    env.finish(a)
+    assert env.state(b) is TaskState.READY
+    assert env.state(c) is TaskState.WAITING
+    env.schedule()
+    env.start_all_assigned()
+    env.finish(b)
+    assert env.state(c) is TaskState.READY
+
+
+def test_resources_limit_concurrency():
+    env = TestEnv()
+    env.worker(cpus=4)
+    ids = env.submit(n=10, rqv=env.rqv(cpus=2))
+    assert env.schedule() == 2  # only 2 x 2cpu fit on 4 cpus
+    assigned = [t for t in ids if env.state(t) is TaskState.ASSIGNED]
+    assert len(assigned) == 2
+    env.start_all_assigned()
+    env.finish(assigned[0])
+    assert env.schedule() == 1
+
+
+def test_failure_cancels_consumers():
+    env = TestEnv()
+    env.worker()
+    (a,) = env.submit()
+    (b,) = env.submit(deps=[a])
+    (c,) = env.submit(deps=[b])
+    env.schedule()
+    env.start_all_assigned()
+    env.fail(a)
+    assert env.state(a) is TaskState.FAILED
+    assert env.state(b) is TaskState.CANCELED
+    assert env.state(c) is TaskState.CANCELED
+    assert env.events.failed[0][0] == a
+    assert set(env.events.canceled) == {b, c}
+
+
+def test_worker_lost_requeues_and_crash_limit():
+    env = TestEnv()
+    w = env.worker(cpus=4)
+    (t,) = env.submit()
+    env.schedule()
+    env.start_all_assigned()
+    instance0 = env.core.tasks[t].instance_id
+    env.lose_worker(w.worker_id)
+    # task went back to waiting->ready with a bumped instance
+    assert env.state(t) is TaskState.READY
+    assert env.core.tasks[t].crash_counter == 1
+    assert env.core.tasks[t].instance_id == instance0 + 1
+
+    # crash it until the limit (default 5)
+    for _ in range(4):
+        w = env.worker(cpus=4)
+        env.schedule()
+        env.start_all_assigned()
+        env.lose_worker(w.worker_id)
+    assert env.state(t) is TaskState.FAILED
+
+
+def test_assigned_but_not_running_does_not_count_as_crash():
+    env = TestEnv()
+    w = env.worker(cpus=4)
+    (t,) = env.submit()
+    env.schedule()
+    env.lose_worker(w.worker_id)  # never reported running
+    assert env.state(t) is TaskState.READY
+    assert env.core.tasks[t].crash_counter == 0
+
+
+def test_stale_instance_messages_ignored():
+    env = TestEnv()
+    w = env.worker(cpus=4)
+    (t,) = env.submit()
+    env.schedule()
+    env.start_all_assigned()
+    old_instance = env.core.tasks[t].instance_id
+    env.lose_worker(w.worker_id)
+    env.worker(cpus=4)
+    env.schedule()
+    from hyperqueue_tpu.server import reactor
+
+    # stale "finished" from the dead incarnation must be dropped
+    reactor.on_task_finished(env.core, env.comm, env.events, t, old_instance)
+    assert env.state(t) is not TaskState.FINISHED
+
+
+def test_cancel_ready_and_running():
+    env = TestEnv()
+    env.worker(cpus=1)
+    a, b = env.submit(n=2)
+    env.schedule()  # only a assigned (1 cpu)
+    env.start_all_assigned()
+    out = env.cancel([a, b])
+    assert set(out) == {a, b}
+    assert env.state(a) is TaskState.CANCELED
+    assert env.state(b) is TaskState.CANCELED
+    # running task got a cancel message to its worker
+    assert any(a in tids for _, tids in env.comm.cancels)
+
+
+def test_priorities_respected():
+    env = TestEnv()
+    env.worker(cpus=1)
+    (low,) = env.submit(priority=(0, 0))
+    (high,) = env.submit(priority=(5, 0))
+    env.schedule()
+    assert env.state(high) is TaskState.ASSIGNED
+    assert env.state(low) is TaskState.READY
+
+
+def test_variants_fall_back():
+    env = TestEnv()
+    env.worker(cpus=4)  # no gpus
+    rqv = env.rqv(variants=[env.rq(gpus=1), env.rq(cpus=2)])
+    (t,) = env.submit(rqv=rqv)
+    env.schedule()
+    assert env.state(t) is TaskState.ASSIGNED
+    task = env.core.tasks[t]
+    assert task.assigned_variant == 1  # gpu variant impossible
+
+
+def test_gang_scheduling_all_or_nothing():
+    env = TestEnv()
+    env.worker(cpus=2, group="g1")
+    env.worker(cpus=2, group="g1")
+    (t,) = env.submit(rqv=env.rqv(n_nodes=3))
+    env.schedule()
+    assert env.state(t) is TaskState.READY  # only 2 workers in the group
+    env.worker(cpus=2, group="g1")
+    env.schedule()
+    assert env.state(t) is TaskState.ASSIGNED
+    task = env.core.tasks[t]
+    assert len(task.mn_workers) == 3
+    # compute message went to the root only, carrying the node list
+    (wid, msgs), = env.comm.compute
+    assert wid == task.mn_workers[0]
+    assert msgs[0]["node_ids"] == list(task.mn_workers)
+    # gang workers refuse other work while reserved
+    ids = env.submit(n=4)
+    env.schedule()
+    assert all(env.state(i) is TaskState.READY for i in ids)
+
+
+def test_gang_non_root_loss_restarts_without_fail():
+    env = TestEnv()
+    workers = [env.worker(cpus=2, group="g1") for _ in range(2)]
+    (t,) = env.submit(rqv=env.rqv(n_nodes=2))
+    env.schedule()
+    env.start_all_assigned()
+    task = env.core.tasks[t]
+    non_root = task.mn_workers[1]
+    env.lose_worker(non_root)
+    assert env.state(t) is TaskState.READY  # rescheduled, not failed
+    assert task.crash_counter == 0
+
+
+def test_worker_added_after_submit_triggers_assignment():
+    env = TestEnv()
+    ids = env.submit(n=3)
+    assert env.schedule() == 0
+    env.worker(cpus=4)
+    assert env.schedule() == 3
+    assert all(env.state(i) is TaskState.ASSIGNED for i in ids)

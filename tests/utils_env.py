@@ -1,0 +1,195 @@
+"""In-memory test environment for core/reactor/scheduler tests.
+
+Mirrors the reference tier-1 infra (crates/tako/src/internal/tests/utils/):
+TestComm captures outgoing messages, builders create tasks/workers tersely,
+and every step can re-validate core invariants via sanity_check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.ids import make_task_id
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT
+from hyperqueue_tpu.resources.descriptor import (
+    ResourceDescriptor,
+    ResourceDescriptorItem,
+)
+from hyperqueue_tpu.resources.request import (
+    ResourceRequest,
+    ResourceRequestEntry,
+    ResourceRequestVariants,
+)
+from hyperqueue_tpu.server import reactor
+from hyperqueue_tpu.server.core import Core
+from hyperqueue_tpu.server.task import Task
+from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+
+
+@dataclass
+class TestComm:
+    compute: list[tuple[int, list[dict]]] = field(default_factory=list)
+    cancels: list[tuple[int, list[int]]] = field(default_factory=list)
+    scheduling_asked: int = 0
+
+    def send_compute(self, worker_id, tasks):
+        self.compute.append((worker_id, tasks))
+
+    def send_cancel(self, worker_id, task_ids):
+        self.cancels.append((worker_id, task_ids))
+
+    def ask_for_scheduling(self):
+        self.scheduling_asked += 1
+
+    def assigned_by_worker(self) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        for wid, tasks in self.compute:
+            out.setdefault(wid, []).extend(t["id"] for t in tasks)
+        return out
+
+
+@dataclass
+class TestEvents:
+    started: list[int] = field(default_factory=list)
+    finished: list[int] = field(default_factory=list)
+    failed: list[tuple[int, str]] = field(default_factory=list)
+    canceled: list[int] = field(default_factory=list)
+    workers_new: list[int] = field(default_factory=list)
+    workers_lost: list[tuple[int, str]] = field(default_factory=list)
+
+    def on_task_started(self, task_id, instance_id, worker_ids):
+        self.started.append(task_id)
+
+    def on_task_finished(self, task_id):
+        self.finished.append(task_id)
+
+    def on_task_failed(self, task_id, message):
+        self.failed.append((task_id, message))
+
+    def on_task_canceled(self, task_id):
+        self.canceled.append(task_id)
+
+    def on_worker_new(self, worker):
+        self.workers_new.append(worker.worker_id)
+
+    def on_worker_lost(self, worker_id, reason):
+        self.workers_lost.append((worker_id, reason))
+
+
+class TestEnv:
+    __test__ = False  # not a pytest test class
+
+    def __init__(self):
+        self.core = Core()
+        self.comm = TestComm()
+        self.events = TestEvents()
+        self.model = GreedyCutScanModel()
+        self._task_seq = 0
+
+    # --- builders -----------------------------------------------------
+    def worker(self, cpus=4, gpus=0, group="default", time_limit=0.0) -> Worker:
+        items = [ResourceDescriptorItem.range("cpus", 0, cpus - 1)]
+        if gpus:
+            items.append(
+                ResourceDescriptorItem.list("gpus", [str(i) for i in range(gpus)])
+            )
+        config = WorkerConfiguration(
+            descriptor=ResourceDescriptor(items=tuple(items)),
+            group=group,
+            time_limit_secs=time_limit,
+        )
+        w = Worker.create(
+            self.core.worker_id_counter.next(), config, self.core.resource_map
+        )
+        reactor.on_new_worker(self.core, self.comm, self.events, w)
+        return w
+
+    def rqv(self, cpus=1, gpus=0.0, n_nodes=0, min_time=0.0, variants=None):
+        if variants is not None:
+            return ResourceRequestVariants(variants=tuple(variants))
+        return ResourceRequestVariants.single(
+            self.rq(cpus=cpus, gpus=gpus, n_nodes=n_nodes, min_time=min_time)
+        )
+
+    def rq(self, cpus=1, gpus=0.0, n_nodes=0, min_time=0.0):
+        if n_nodes:
+            return ResourceRequest(n_nodes=n_nodes, min_time_secs=min_time)
+        entries = [
+            ResourceRequestEntry(
+                self.core.resource_map.get_or_create("cpus"),
+                int(cpus * FRACTIONS_PER_UNIT),
+            )
+        ]
+        if gpus:
+            entries.append(
+                ResourceRequestEntry(
+                    self.core.resource_map.get_or_create("gpus"),
+                    int(gpus * FRACTIONS_PER_UNIT),
+                )
+            )
+        return ResourceRequest(entries=tuple(entries), min_time_secs=min_time)
+
+    def submit(self, n=1, rqv=None, deps=(), priority=(0, 0), job=1, body=None):
+        """Create n tasks; returns their ids."""
+        if rqv is None:
+            rqv = self.rqv()
+        rq_id = self.core.intern_rqv(rqv)
+        tasks = []
+        for _ in range(n):
+            self._task_seq += 1
+            tasks.append(
+                Task(
+                    task_id=make_task_id(job, self._task_seq),
+                    rq_id=rq_id,
+                    priority=priority,
+                    deps=tuple(deps),
+                    body=body or {},
+                )
+            )
+        reactor.on_new_tasks(self.core, self.comm, tasks)
+        return [t.task_id for t in tasks]
+
+    # --- actions ------------------------------------------------------
+    def schedule(self) -> int:
+        n = reactor.schedule(self.core, self.comm, self.events, self.model)
+        self.core.sanity_check()
+        return n
+
+    def start_all_assigned(self):
+        """Worker acks: report every ASSIGNED task as running."""
+        from hyperqueue_tpu.server.task import TaskState
+
+        for task in list(self.core.tasks.values()):
+            if task.state is TaskState.ASSIGNED:
+                reactor.on_task_running(
+                    self.core, self.events, task.task_id, task.instance_id
+                )
+
+    def finish(self, task_id):
+        task = self.core.tasks[task_id]
+        reactor.on_task_finished(
+            self.core, self.comm, self.events, task_id, task.instance_id
+        )
+        self.core.sanity_check()
+
+    def fail(self, task_id, message="boom"):
+        task = self.core.tasks[task_id]
+        reactor.on_task_failed(
+            self.core, self.comm, self.events, task_id, task.instance_id, message
+        )
+        self.core.sanity_check()
+
+    def lose_worker(self, worker_id):
+        reactor.on_remove_worker(
+            self.core, self.comm, self.events, worker_id, "connection lost"
+        )
+        self.core.sanity_check()
+
+    def cancel(self, task_ids):
+        out = reactor.on_cancel_tasks(self.core, self.comm, self.events, task_ids)
+        self.core.sanity_check()
+        return out
+
+    def state(self, task_id):
+        return self.core.tasks[task_id].state
