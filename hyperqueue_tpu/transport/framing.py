@@ -1,0 +1,43 @@
+"""Length-delimited frames with msgpack payloads.
+
+Reference: crates/tako/src/internal/transfer/transport.rs:4-8 — little-endian
+length-prefixed frames, max 128 MiB (lib.rs:31), bincode payloads. We use
+msgpack (self-describing, language-neutral) over a u32-LE length prefix.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import msgpack
+
+MAX_FRAME_SIZE = 128 * 1024 * 1024
+_LEN = struct.Struct("<I")
+
+
+class FrameError(Exception):
+    pass
+
+
+def pack_payload(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack_payload(data: bytes):
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, data: bytes) -> None:
+    if len(data) > MAX_FRAME_SIZE:
+        raise FrameError(f"frame too large: {len(data)}")
+    writer.write(_LEN.pack(len(data)) + data)
+    await writer.drain()
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME_SIZE:
+        raise FrameError(f"frame too large: {length}")
+    return await reader.readexactly(length)

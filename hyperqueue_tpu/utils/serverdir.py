@@ -1,0 +1,123 @@
+"""Server directory and access records.
+
+Reference: crates/hyperqueue/src/common/serverdir.rs:18-216 — a per-server
+directory (default ~/.hq-tpu-server/NNN) holding access.json with host/ports
+and the two pre-shared secret keys (client plane, worker plane), plus an
+`hq-current` symlink to the newest instance. `generate-access` style
+pre-shared deployment works by copying this file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+from dataclasses import dataclass
+from pathlib import Path
+
+ACCESS_FILE = "access.json"
+CURRENT_LINK = "hq-current"
+
+
+@dataclass
+class AccessRecord:
+    server_uid: str
+    host: str
+    client_port: int
+    worker_port: int
+    client_key: str | None  # hex; None = auth disabled on that plane
+    worker_key: str | None
+    version: int = 1
+
+    def to_json(self) -> dict:
+        return {
+            "version": self.version,
+            "server_uid": self.server_uid,
+            "client": {"host": self.host, "port": self.client_port, "key": self.client_key},
+            "worker": {"host": self.host, "port": self.worker_port, "key": self.worker_key},
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "AccessRecord":
+        return cls(
+            server_uid=data["server_uid"],
+            host=data["client"]["host"],
+            client_port=data["client"]["port"],
+            worker_port=data["worker"]["port"],
+            client_key=data["client"].get("key"),
+            worker_key=data["worker"].get("key"),
+            version=data.get("version", 1),
+        )
+
+    def client_key_bytes(self) -> bytes | None:
+        return bytes.fromhex(self.client_key) if self.client_key else None
+
+    def worker_key_bytes(self) -> bytes | None:
+        return bytes.fromhex(self.worker_key) if self.worker_key else None
+
+
+def default_server_dir() -> Path:
+    root = os.environ.get("HQ_SERVER_DIR")
+    if root:
+        return Path(root)
+    return Path.home() / ".hq-tpu-server"
+
+
+def generate_access(
+    host: str,
+    client_port: int,
+    worker_port: int,
+    disable_client_auth: bool = False,
+    disable_worker_auth: bool = False,
+) -> AccessRecord:
+    return AccessRecord(
+        server_uid=secrets.token_hex(8),
+        host=host,
+        client_port=client_port,
+        worker_port=worker_port,
+        client_key=None if disable_client_auth else secrets.token_hex(32),
+        worker_key=None if disable_worker_auth else secrets.token_hex(32),
+    )
+
+
+def create_instance_dir(server_dir: Path) -> Path:
+    """Create server_dir/NNN (next free number) and point hq-current at it."""
+    server_dir.mkdir(parents=True, exist_ok=True)
+    n = 1
+    existing = [
+        int(p.name) for p in server_dir.iterdir() if p.name.isdigit()
+    ]
+    if existing:
+        n = max(existing) + 1
+    instance = server_dir / f"{n:03d}"
+    instance.mkdir()
+    link = server_dir / CURRENT_LINK
+    tmp = server_dir / f".{CURRENT_LINK}.tmp"
+    if tmp.is_symlink() or tmp.exists():
+        tmp.unlink()
+    tmp.symlink_to(instance.name)
+    tmp.replace(link)
+    return instance
+
+
+def store_access(instance_dir: Path, record: AccessRecord) -> None:
+    path = instance_dir / ACCESS_FILE
+    with open(path, "w") as f:
+        json.dump(record.to_json(), f, indent=2)
+    os.chmod(path, 0o600)
+
+
+def load_access(server_dir: Path) -> AccessRecord:
+    """Load the current instance's access record."""
+    direct = server_dir / ACCESS_FILE
+    if direct.exists():
+        with open(direct) as f:
+            return AccessRecord.from_json(json.load(f))
+    current = server_dir / CURRENT_LINK
+    path = current / ACCESS_FILE
+    if not path.exists():
+        raise FileNotFoundError(
+            f"no running server found in {server_dir} (missing {ACCESS_FILE})"
+        )
+    with open(path) as f:
+        return AccessRecord.from_json(json.load(f))
