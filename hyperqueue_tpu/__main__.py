@@ -1,0 +1,3 @@
+from hyperqueue_tpu.client.cli import main
+
+main()

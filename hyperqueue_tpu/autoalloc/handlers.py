@@ -1,0 +1,226 @@
+"""Queue handlers: build and submit PBS/Slurm allocations.
+
+Reference: crates/hyperqueue/src/server/autoalloc/queue/{pbs,slurm,common}.rs —
+a QueueHandler trait with qsub/sbatch script builders and qstat/sacct status
+refresh. External binaries are resolved via PATH, which is also how the test
+mock takes over (reference tests/autoalloc/mock; ours: fake executables on
+PATH writing their argv to files).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shlex
+import sys
+import tempfile
+from pathlib import Path
+
+from hyperqueue_tpu.autoalloc.state import QueueParams
+
+
+class SubmitError(Exception):
+    pass
+
+
+def _format_walltime(secs: float) -> str:
+    secs = int(secs)
+    return f"{secs // 3600:02d}:{(secs % 3600) // 60:02d}:{secs % 60:02d}"
+
+
+def _worker_command(server_dir: str, queue_id: int, params: QueueParams) -> str:
+    args = [
+        sys.executable,
+        "-m",
+        "hyperqueue_tpu",
+        "worker",
+        "start",
+        "--server-dir",
+        server_dir,
+        "--idle-timeout",
+        str(params.idle_timeout_secs),
+        "--time-limit",
+        str(params.time_limit_secs),
+        "--on-server-lost",
+        "finish-running",
+        *params.worker_args,
+    ]
+    return " ".join(shlex.quote(a) for a in args)
+
+
+class QueueHandler:
+    """Common machinery; subclasses define submit/status binaries + script."""
+
+    manager = "none"
+    submit_binary = "true"
+
+    def __init__(self, server_dir: str, work_dir: Path):
+        self.server_dir = server_dir
+        self.work_dir = Path(work_dir)
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+
+    def build_script(self, queue_id: int, params: QueueParams) -> str:
+        raise NotImplementedError
+
+    def parse_submit_output(self, stdout: str) -> str:
+        raise NotImplementedError
+
+    async def submit_allocation(
+        self, queue_id: int, params: QueueParams, dry_run: bool = False
+    ) -> str:
+        """Run qsub/sbatch on a generated script; returns the allocation id."""
+        script = self.build_script(queue_id, params)
+        fd, path = tempfile.mkstemp(
+            suffix=".sh", prefix=f"hq-alloc-q{queue_id}-", dir=self.work_dir
+        )
+        with os.fdopen(fd, "w") as f:
+            f.write(script)
+        os.chmod(path, 0o755)
+        cmd = [self.submit_binary, *params.additional_args, path]
+        if dry_run:
+            return f"dry-run:{path}"
+        process = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        stdout, stderr = await process.communicate()
+        if process.returncode != 0:
+            raise SubmitError(
+                f"{self.submit_binary} failed "
+                f"(exit {process.returncode}): {stderr.decode(errors='replace')}"
+            )
+        return self.parse_submit_output(stdout.decode())
+
+    async def refresh_statuses(self, allocation_ids: list[str]) -> dict[str, str]:
+        """allocation_id -> queued|running|finished|failed."""
+        raise NotImplementedError
+
+    async def remove_allocation(self, allocation_id: str) -> None:
+        raise NotImplementedError
+
+    async def _run(self, *cmd) -> tuple[int, str]:
+        process = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        stdout, _ = await process.communicate()
+        return process.returncode, stdout.decode(errors="replace")
+
+
+class PbsHandler(QueueHandler):
+    manager = "pbs"
+    submit_binary = "qsub"
+
+    def build_script(self, queue_id: int, params: QueueParams) -> str:
+        worker_cmd = _worker_command(self.server_dir, queue_id, params)
+        lines = [
+            "#!/bin/bash",
+            f"#PBS -N hq-alloc-{queue_id}",
+            f"#PBS -l select={params.workers_per_alloc}",
+            f"#PBS -l walltime={_format_walltime(params.time_limit_secs)}",
+            "export HQ_ALLOC_QUEUE=%d" % queue_id,
+            'export HQ_ALLOC_ID="$PBS_JOBID"',
+        ]
+        if params.workers_per_alloc > 1:
+            lines.append(
+                f"pbsdsh -- bash -l -c {shlex.quote(worker_cmd)}"
+            )
+        else:
+            lines.append(worker_cmd)
+        return "\n".join(lines) + "\n"
+
+    def parse_submit_output(self, stdout: str) -> str:
+        allocation_id = stdout.strip().splitlines()[-1].strip()
+        if not allocation_id:
+            raise SubmitError("qsub returned no job id")
+        return allocation_id
+
+    async def refresh_statuses(self, allocation_ids):
+        out: dict[str, str] = {}
+        if not allocation_ids:
+            return out
+        code, text = await self._run("qstat", "-f", *allocation_ids)
+        current = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("Job Id:"):
+                current = line.split(":", 1)[1].strip()
+            elif line.startswith("job_state") and current:
+                state = line.split("=")[-1].strip()
+                out[current] = {
+                    "Q": "queued", "H": "queued", "R": "running",
+                    "F": "finished", "E": "running",
+                }.get(state, "failed")
+        for aid in allocation_ids:
+            out.setdefault(aid, "finished")  # vanished from qstat
+        return out
+
+    async def remove_allocation(self, allocation_id: str) -> None:
+        await self._run("qdel", allocation_id)
+
+
+class SlurmHandler(QueueHandler):
+    manager = "slurm"
+    submit_binary = "sbatch"
+
+    def build_script(self, queue_id: int, params: QueueParams) -> str:
+        worker_cmd = _worker_command(self.server_dir, queue_id, params)
+        lines = [
+            "#!/bin/bash",
+            f"#SBATCH --job-name=hq-alloc-{queue_id}",
+            f"#SBATCH --nodes={params.workers_per_alloc}",
+            f"#SBATCH --time={_format_walltime(params.time_limit_secs)}",
+            "export HQ_ALLOC_QUEUE=%d" % queue_id,
+            'export HQ_ALLOC_ID="$SLURM_JOB_ID"',
+        ]
+        if params.workers_per_alloc > 1:
+            lines.append(f"srun --overlap bash -c {shlex.quote(worker_cmd)}")
+        else:
+            lines.append(worker_cmd)
+        return "\n".join(lines) + "\n"
+
+    def parse_submit_output(self, stdout: str) -> str:
+        # "Submitted batch job 12345"
+        for token in reversed(stdout.split()):
+            if token.isdigit():
+                return token
+        raise SubmitError(f"cannot parse sbatch output: {stdout!r}")
+
+    async def refresh_statuses(self, allocation_ids):
+        out: dict[str, str] = {}
+        if not allocation_ids:
+            return out
+        code, text = await self._run(
+            "sacct", "-j", ",".join(allocation_ids), "-o", "JobID,State",
+            "--noheader", "--parsable2",
+        )
+        for line in text.splitlines():
+            parts = line.strip().split("|")
+            if len(parts) < 2 or "." in parts[0]:
+                continue
+            jid, state = parts[0], parts[1].split()[0] if parts[1] else ""
+            out[jid] = {
+                "PENDING": "queued",
+                "RUNNING": "running",
+                "COMPLETED": "finished",
+                "COMPLETING": "running",
+                "CANCELLED": "failed",
+                "FAILED": "failed",
+                "TIMEOUT": "finished",
+            }.get(state, "failed" if state else "queued")
+        for aid in allocation_ids:
+            out.setdefault(aid, "finished")
+        return out
+
+    async def remove_allocation(self, allocation_id: str) -> None:
+        await self._run("scancel", allocation_id)
+
+
+def make_handler(manager: str, server_dir: str, work_dir: Path) -> QueueHandler:
+    if manager == "pbs":
+        return PbsHandler(server_dir, work_dir)
+    if manager == "slurm":
+        return SlurmHandler(server_dir, work_dir)
+    raise ValueError(f"unknown manager {manager!r} (expected pbs or slurm)")

@@ -1,0 +1,249 @@
+"""Autoalloc service: the periodic planning/submission loop.
+
+Reference: crates/hyperqueue/src/server/autoalloc/process.rs —
+autoalloc_process (:41): interval tick doing refresh_queue_allocations (:800)
+via the queue handler, then perform_submits (:367): a fake-worker query
+against the scheduler (:416 -> tako query.rs:12) decides how many allocations
+each queue should have in flight, bounded by compute_submission_permit (:500).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from pathlib import Path
+
+import numpy as np
+
+from hyperqueue_tpu.autoalloc.handlers import SubmitError, make_handler
+from hyperqueue_tpu.autoalloc.state import (
+    Allocation,
+    AutoAllocState,
+    QueueParams,
+)
+from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.resources.worker_resources import WorkerResources
+from hyperqueue_tpu.scheduler.tick import WorkerRow, create_batches
+from hyperqueue_tpu.worker.hwdetect import detect_resources
+
+logger = logging.getLogger("hq.autoalloc")
+
+REFRESH_INTERVAL = 2.0
+
+
+class AutoAllocService:
+    def __init__(self, server, work_dir: Path):
+        self.server = server
+        self.state = AutoAllocState()
+        self.work_dir = Path(work_dir)
+        self._handlers: dict[int, object] = {}
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    def handler_for(self, queue):
+        handler = self._handlers.get(queue.queue_id)
+        if handler is None:
+            handler = make_handler(
+                queue.params.manager,
+                str(self.server.server_dir),
+                self.work_dir / f"queue-{queue.queue_id}",
+            )
+            self._handlers[queue.queue_id] = handler
+        return handler
+
+    # ------------------------------------------------------------------
+    async def _loop(self) -> None:
+        logger.info("autoalloc service started")
+        while True:
+            try:
+                await self.refresh_allocations()
+                await self.perform_submits()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - autoalloc must not die
+                logger.exception("autoalloc tick failed")
+            await asyncio.sleep(REFRESH_INTERVAL)
+
+    async def refresh_allocations(self) -> None:
+        for queue in self.state.queues.values():
+            active = [a.allocation_id for a in queue.active_allocations()
+                      if not a.allocation_id.startswith("dry-run:")]
+            if not active:
+                continue
+            handler = self.handler_for(queue)
+            try:
+                statuses = await handler.refresh_statuses(active)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("status refresh failed for queue %d: %s",
+                               queue.queue_id, e)
+                continue
+            order = {"queued": 0, "running": 1, "finished": 2, "failed": 2}
+            for allocation_id, status in statuses.items():
+                alloc = queue.allocations.get(allocation_id)
+                if alloc is None or alloc.status == status:
+                    continue
+                # never move backwards: a worker connecting marks the
+                # allocation running even while the manager still reports it
+                # queued (status propagation lag)
+                if order[status] < order[alloc.status]:
+                    continue
+                self._transition(queue, alloc, status)
+
+    def _transition(self, queue, alloc: Allocation, status: str) -> None:
+        alloc.status = status
+        now = time.time()
+        if status == "running" and not alloc.started_at:
+            alloc.started_at = now
+            self.server.emit_event(
+                "alloc-started",
+                {"queue_id": queue.queue_id, "alloc": alloc.allocation_id},
+            )
+        elif status in ("finished", "failed"):
+            alloc.ended_at = now
+            self.server.emit_event(
+                f"alloc-{status}",
+                {"queue_id": queue.queue_id, "alloc": alloc.allocation_id},
+            )
+
+    # ------------------------------------------------------------------
+    def _fake_worker_demand(self, queue) -> int:
+        """How many NEW workers would receive load right now?
+
+        Reference scheduler/query.rs:12-80 — create fake workers per queue
+        descriptor and rerun batches+solver against them; the count of fake
+        workers that got tasks is the demand. Here: simulate
+        backlog*workers_per_alloc fake workers with the queue's resources and
+        run the dense solve over (real + fake) workers non-destructively.
+        """
+        core = self.server.core
+        n_fake = queue.params.backlog * queue.params.workers_per_alloc
+        if n_fake <= 0:
+            return 0
+        if not core.queues.total_ready() and not core.mn_queue:
+            return len(core.mn_queue)
+        # fake worker resources: detected from this host as an approximation
+        # (the reference uses the queue descriptor's declared resources)
+        fake_resources = WorkerResources.from_descriptor(
+            detect_resources(), core.resource_map
+        )
+        rows = core.worker_rows()
+        first_fake = len(rows)
+        for i in range(n_fake):
+            rows.append(
+                WorkerRow(
+                    worker_id=-(i + 1),
+                    free=list(fake_resources.amounts),
+                    nt_free=fake_resources.task_max_count(),
+                    lifetime_secs=min(
+                        int(queue.params.time_limit_secs), int(INF_TIME)
+                    ),
+                )
+            )
+        batches = create_batches(core.queues)
+        if not batches:
+            return 0
+        n_r = len(core.resource_map)
+        free = np.zeros((len(rows), n_r), dtype=np.int64)
+        nt_free = np.zeros(len(rows), dtype=np.int32)
+        lifetime = np.zeros(len(rows), dtype=np.int32)
+        for i, row in enumerate(rows):
+            free[i, : len(row.free)] = row.free
+            nt_free[i] = max(row.nt_free, 0)
+            lifetime[i] = row.lifetime_secs
+        n_b = len(batches)
+        n_v = max(
+            len(core.rq_map.get_variants(b.rq_id).variants) for b in batches
+        )
+        needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
+        sizes = np.zeros(n_b, dtype=np.int32)
+        min_time = np.full((n_b, n_v), int(INF_TIME), dtype=np.int32)
+        for bi, batch in enumerate(batches):
+            sizes[bi] = min(batch.size, 2**30)
+            for vi, variant in enumerate(
+                core.rq_map.get_variants(batch.rq_id).variants
+            ):
+                min_time[bi, vi] = min(int(variant.min_time_secs), int(INF_TIME))
+                for entry in variant.entries:
+                    needs[bi, vi, entry.resource_id] = entry.amount
+        counts = self.server.model.solve(
+            free=free.astype(np.int32),
+            nt_free=nt_free,
+            lifetime=lifetime,
+            needs=needs.astype(np.int32),
+            sizes=sizes,
+            min_time=min_time,
+        )
+        fake_load = np.asarray(counts).sum(axis=(0, 1))[first_fake:]
+        return int((fake_load > 0).sum())
+
+    async def perform_submits(self) -> None:
+        for queue in list(self.state.queues.values()):
+            if not queue.can_submit_now():
+                continue
+            demand = self._fake_worker_demand(queue)
+            logger.debug("queue %d demand=%d", queue.queue_id, demand)
+            if demand <= 0:
+                continue
+            allocs_needed = -(-demand // queue.params.workers_per_alloc)
+            # permit: stay within backlog and max worker count
+            permit = queue.params.backlog - len(queue.queued_allocations())
+            if queue.params.max_worker_count:
+                headroom = (
+                    queue.params.max_worker_count - queue.active_worker_count()
+                )
+                permit = min(
+                    permit, headroom // max(queue.params.workers_per_alloc, 1)
+                )
+            for _ in range(max(0, min(allocs_needed, permit))):
+                await self._submit_one(queue)
+
+    async def _submit_one(self, queue) -> None:
+        handler = self.handler_for(queue)
+        try:
+            allocation_id = await handler.submit_allocation(
+                queue.queue_id, queue.params
+            )
+        except (SubmitError, OSError) as e:
+            logger.warning("allocation submit failed: %s", e)
+            self.server.emit_event(
+                "alloc-submit-failed",
+                {"queue_id": queue.queue_id, "error": str(e)},
+            )
+            if queue.on_submit_fail():
+                queue.state = "paused"
+                self.server.emit_event(
+                    "alloc-queue-paused", {"queue_id": queue.queue_id}
+                )
+            return
+        queue.on_submit_ok()
+        queue.allocations[allocation_id] = Allocation(
+            allocation_id=allocation_id,
+            queue_id=queue.queue_id,
+            worker_count=queue.params.workers_per_alloc,
+        )
+        self.server.emit_event(
+            "alloc-queued",
+            {"queue_id": queue.queue_id, "alloc": allocation_id},
+        )
+
+    # ------------------------------------------------------------------
+    def on_worker_connected(self, worker_id: int, alloc_id: str) -> None:
+        queue, alloc = self.state.find_allocation(alloc_id)
+        if alloc is not None:
+            alloc.connected_workers.add(worker_id)
+            if alloc.status == "queued":
+                self._transition(queue, alloc, "running")
+
+    async def dry_run(self, params: QueueParams) -> dict:
+        handler = make_handler(
+            params.manager, str(self.server.server_dir), self.work_dir / "dryrun"
+        )
+        script = handler.build_script(0, params)
+        return {"script": script, "submit_binary": handler.submit_binary}

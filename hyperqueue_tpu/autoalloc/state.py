@@ -1,0 +1,129 @@
+"""Autoalloc state: queues and allocations.
+
+Reference: crates/hyperqueue/src/server/autoalloc/state.rs:22-399 —
+AllocationQueue descriptors and the Allocation lifecycle
+Queued -> Running -> Finished/Failed, plus the rate limiter with exponential
+backoff that pauses repeatedly-failing queues (process.rs:881,1209).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.ids import IdCounter
+
+MAX_SUBMIT_FAILS_BEFORE_PAUSE = 3
+BACKOFF_BASE_SECS = 2.0
+BACKOFF_MAX_SECS = 300.0
+
+
+@dataclass
+class QueueParams:
+    manager: str  # "pbs" | "slurm"
+    backlog: int = 1              # allocations kept in the batch queue
+    workers_per_alloc: int = 1
+    max_worker_count: int = 0     # 0 = unlimited
+    time_limit_secs: float = 3600.0
+    name: str = ""
+    worker_args: list[str] = field(default_factory=list)  # extra hq args
+    additional_args: list[str] = field(default_factory=list)  # qsub/sbatch args
+    idle_timeout_secs: float = 300.0
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "QueueParams":
+        return cls(**{k: v for k, v in data.items() if k in cls.__dataclass_fields__})
+
+
+@dataclass
+class Allocation:
+    allocation_id: str          # manager job id (qsub/sbatch output)
+    queue_id: int
+    worker_count: int
+    status: str = "queued"      # queued | running | finished | failed
+    queued_at: float = field(default_factory=time.time)
+    started_at: float = 0.0
+    ended_at: float = 0.0
+    connected_workers: set[int] = field(default_factory=set)
+
+    @property
+    def is_active(self) -> bool:
+        return self.status in ("queued", "running")
+
+    def to_wire(self) -> dict:
+        return {
+            "id": self.allocation_id,
+            "queue": self.queue_id,
+            "worker_count": self.worker_count,
+            "status": self.status,
+            "queued_at": self.queued_at,
+            "started_at": self.started_at,
+            "ended_at": self.ended_at,
+            "workers": sorted(self.connected_workers),
+        }
+
+
+@dataclass
+class AllocationQueue:
+    queue_id: int
+    params: QueueParams
+    state: str = "running"  # running | paused
+    allocations: dict[str, Allocation] = field(default_factory=dict)
+    consecutive_failures: int = 0
+    next_submit_at: float = 0.0
+
+    def active_allocations(self) -> list[Allocation]:
+        return [a for a in self.allocations.values() if a.is_active]
+
+    def queued_allocations(self) -> list[Allocation]:
+        return [a for a in self.allocations.values() if a.status == "queued"]
+
+    def active_worker_count(self) -> int:
+        return sum(a.worker_count for a in self.active_allocations())
+
+    def on_submit_ok(self) -> None:
+        self.consecutive_failures = 0
+        self.next_submit_at = 0.0
+
+    def on_submit_fail(self) -> bool:
+        """Returns True if the queue should be paused."""
+        self.consecutive_failures += 1
+        backoff = min(
+            BACKOFF_BASE_SECS * (2 ** (self.consecutive_failures - 1)),
+            BACKOFF_MAX_SECS,
+        )
+        self.next_submit_at = time.time() + backoff
+        return self.consecutive_failures >= MAX_SUBMIT_FAILS_BEFORE_PAUSE
+
+    def can_submit_now(self) -> bool:
+        return self.state == "running" and time.time() >= self.next_submit_at
+
+    def to_wire(self) -> dict:
+        return {
+            "id": self.queue_id,
+            "state": self.state,
+            "params": self.params.to_wire(),
+            "allocations": [a.to_wire() for a in self.allocations.values()],
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+class AutoAllocState:
+    def __init__(self):
+        self.queues: dict[int, AllocationQueue] = {}
+        self.queue_id_counter = IdCounter()
+
+    def add_queue(self, params: QueueParams) -> AllocationQueue:
+        queue = AllocationQueue(self.queue_id_counter.next(), params)
+        self.queues[queue.queue_id] = queue
+        return queue
+
+    def find_allocation(self, allocation_id: str):
+        for queue in self.queues.values():
+            alloc = queue.allocations.get(allocation_id)
+            if alloc is not None:
+                return queue, alloc
+        return None, None

@@ -1,0 +1,946 @@
+"""The `hq` command-line interface.
+
+Reference: crates/hyperqueue/src/common/cli.rs:186-211 and bin/hq.rs:432-553 —
+subcommand tree: server / worker / submit / job / task / output-log / alloc /
+journal / dashboard. One binary drives everything; here it is
+`python -m hyperqueue_tpu` (alias script `bin/hq`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+from hyperqueue_tpu import __version__
+from hyperqueue_tpu.client.connection import ClientError, ClientSession
+from hyperqueue_tpu.client.output import fail, make_output
+from hyperqueue_tpu.resources.amount import amount_from_str
+from hyperqueue_tpu.utils import serverdir
+from hyperqueue_tpu.utils.placeholders import fill_placeholders
+
+
+def _add_common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--server-dir",
+        default=None,
+        help="server directory (default: ~/.hq-tpu-server or $HQ_SERVER_DIR)",
+    )
+    parser.add_argument(
+        "--output-mode",
+        choices=["cli", "json", "quiet"],
+        default=os.environ.get("HQ_OUTPUT_MODE", "cli"),
+    )
+
+
+def _server_dir(args) -> Path:
+    if args.server_dir:
+        return Path(args.server_dir)
+    return serverdir.default_server_dir()
+
+
+def _session(args) -> ClientSession:
+    try:
+        return ClientSession(_server_dir(args))
+    except FileNotFoundError as e:
+        fail(str(e))
+
+
+# ---------------------------------------------------------------- selectors
+def parse_selector(text: str, last_id: int | None = None) -> list[int]:
+    """Job/task selectors: "3", "1-5", "1,3-4", "last", "all" (reference
+    transfer/messages.rs:255-285 IdSelector)."""
+    if text == "all":
+        return []
+    if text == "last":
+        if last_id is None:
+            fail("no jobs submitted yet")
+        return [last_id]
+    ids: list[int] = []
+    for part in text.split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            ids.extend(range(int(lo), int(hi) + 1))
+        elif part:
+            ids.append(int(part))
+    return ids
+
+
+def _resolve_job_selector(session: ClientSession, text: str) -> list[int]:
+    jobs = session.request({"op": "job_list"})["jobs"]
+    if text == "all":
+        return sorted(j["id"] for j in jobs)
+    last = max((j["id"] for j in jobs), default=None)
+    return parse_selector(text, last)
+
+
+# ---------------------------------------------------------------- server cmds
+def cmd_server_start(args) -> None:
+    import asyncio
+    import logging
+
+    logging.basicConfig(
+        level=os.environ.get("HQ_LOG", "INFO").upper(),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    # Enforce the scheduler's JAX platform via jax.config: site preloads may
+    # hard-set the platform (e.g. a TPU plugin overriding jax_platforms after
+    # reading its own env), which both ignores JAX_PLATFORMS=cpu and makes
+    # every test server contend for one real TPU chip.
+    import jax
+
+    if args.scheduler == "tpu":
+        pass  # keep the environment default (the TPU platform)
+    elif args.scheduler == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    async def go():
+        server = Server(
+            server_dir=_server_dir(args),
+            host=args.host,
+            client_port=args.client_port,
+            worker_port=args.worker_port,
+            disable_client_auth=args.disable_client_authentication,
+            disable_worker_auth=args.disable_worker_authentication,
+            scheduler=args.scheduler,
+            journal_path=Path(args.journal) if args.journal else None,
+        )
+        access = await server.start()
+        print(
+            f"+-- HyperQueue TPU server [{access.server_uid}] --\n"
+            f"| clients: {access.host}:{access.client_port}\n"
+            f"| workers: {access.host}:{access.worker_port}\n"
+            f"+--",
+            flush=True,
+        )
+        await server.run_until_stopped()
+
+    asyncio.run(go())
+
+
+def cmd_server_stop(args) -> None:
+    with _session(args) as session:
+        session.request({"op": "stop_server"})
+    make_output(args.output_mode).message("server stopped")
+
+
+def cmd_server_info(args) -> None:
+    with _session(args) as session:
+        info = session.request({"op": "server_info"})
+    info.pop("op", None)
+    make_output(args.output_mode).record(info)
+
+
+def cmd_server_generate_access(args) -> None:
+    record = serverdir.generate_access(
+        host=args.host,
+        client_port=args.client_port,
+        worker_port=args.worker_port,
+    )
+    with open(args.access_file, "w") as f:
+        json.dump(record.to_json(), f, indent=2)
+    os.chmod(args.access_file, 0o600)
+    make_output(args.output_mode).message(f"access file written to {args.access_file}")
+
+
+# ---------------------------------------------------------------- worker cmds
+def cmd_worker_start(args) -> None:
+    import asyncio
+
+    from hyperqueue_tpu.server.worker import WorkerConfiguration
+    from hyperqueue_tpu.worker.hwdetect import detect_resources
+    from hyperqueue_tpu.worker.parser import parse_resource_definition
+    from hyperqueue_tpu.worker.runtime import run_worker
+
+    from hyperqueue_tpu.worker.manager import detect_manager
+
+    access = serverdir.load_access(_server_dir(args))
+    manager_info = detect_manager(args.manager)
+    descriptor = detect_resources(
+        n_cpus=args.cpus,
+        no_hyper_threading=args.no_hyper_threading,
+    )
+    if args.resource:
+        from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+
+        items = {item.name: item for item in descriptor.items}
+        for spec in args.resource:
+            item = parse_resource_definition(spec)
+            items[item.name] = item
+        descriptor = ResourceDescriptor(items=tuple(items.values()))
+    descriptor.validate()
+    time_limit = args.time_limit or 0.0
+    if not time_limit and manager_info.remaining_secs:
+        time_limit = manager_info.remaining_secs
+    config = WorkerConfiguration(
+        descriptor=descriptor,
+        hostname=os.uname().nodename,
+        group=args.group,
+        heartbeat_secs=args.heartbeat,
+        time_limit_secs=time_limit,
+        idle_timeout_secs=args.idle_timeout or 0.0,
+        on_server_lost=args.on_server_lost,
+        manager=manager_info.manager,
+        manager_job_id=manager_info.job_id,
+        alloc_id=os.environ.get("HQ_ALLOC_ID", ""),
+    )
+    asyncio.run(
+        run_worker(
+            access.host,
+            access.worker_port,
+            access.worker_key_bytes(),
+            config,
+            zero_worker=args.zero_worker,
+        )
+    )
+
+
+def cmd_worker_list(args) -> None:
+    with _session(args) as session:
+        workers = session.request({"op": "worker_list"})["workers"]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(workers)
+        return
+    out.table(
+        ["id", "hostname", "group", "running", "resources"],
+        [
+            [
+                w["id"],
+                w["hostname"],
+                w["group"],
+                w["n_running"],
+                " ".join(f"{k}={v / 10_000:g}" for k, v in w["resources"].items()),
+            ]
+            for w in workers
+        ],
+    )
+
+
+def cmd_worker_stop(args) -> None:
+    with _session(args) as session:
+        ids = parse_selector(args.selector)
+        if not ids:
+            ids = [w["id"] for w in session.request({"op": "worker_list"})["workers"]]
+        result = session.request({"op": "worker_stop", "worker_ids": ids})
+    make_output(args.output_mode).message(
+        f"stopped workers: {result['stopped']}"
+    )
+
+
+# ---------------------------------------------------------------- submit
+def _parse_env(pairs: list[str]) -> dict:
+    env = {}
+    for pair in pairs or []:
+        key, sep, value = pair.partition("=")
+        if not sep:
+            fail(f"invalid --env {pair!r}, expected KEY=VALUE")
+        env[key] = value
+    return env
+
+
+def _build_request(args) -> dict:
+    entries = []
+    if args.cpus:
+        entries.append(
+            {"name": "cpus", "amount": amount_from_str(args.cpus), "policy": "compact"}
+        )
+    for spec in args.resource_request or []:
+        name, sep, amount = spec.partition("=")
+        if not sep:
+            fail(f"invalid --resource {spec!r}, expected name=amount")
+        policy = "compact"
+        if amount == "all":
+            entries.append({"name": name, "amount": 0, "policy": "all"})
+            continue
+        entries.append(
+            {"name": name, "amount": amount_from_str(amount), "policy": policy}
+        )
+    variant = {
+        "n_nodes": args.nodes or 0,
+        "min_time": args.time_request or 0.0,
+        "entries": entries,
+    }
+    return {"variants": [variant]}
+
+
+def cmd_submit(args) -> None:
+    if not args.command:
+        fail("no command given")
+    submit_dir = os.getcwd()
+    body_base = {
+        "cmd": list(args.command),
+        "env": _parse_env(args.env),
+        "cwd": args.cwd,
+        "stdout": args.stdout,
+        "stderr": args.stderr,
+        "submit_dir": submit_dir,
+    }
+    if args.stdin:
+        body_base["stdin"] = sys.stdin.buffer.read()
+    request = _build_request(args)
+
+    task_ids: list[int] | None = None
+    entry_values: list[str] | None = None
+    if args.array:
+        task_ids = parse_selector(args.array)
+    if args.each_line:
+        with open(args.each_line) as f:
+            entry_values = [line.rstrip("\n") for line in f]
+    elif args.from_json:
+        with open(args.from_json) as f:
+            data = json.load(f)
+        if not isinstance(data, list):
+            fail("--from-json expects a JSON array")
+        entry_values = [json.dumps(v) for v in data]
+
+    tasks = []
+    if entry_values is not None:
+        ids = task_ids or list(range(len(entry_values)))
+        if len(ids) != len(entry_values):
+            fail("--array size does not match number of entries")
+        for tid, entry in zip(ids, entry_values):
+            body = dict(body_base)
+            body["entry"] = entry
+            tasks.append(
+                {"id": tid, "body": body, "request": request,
+                 "priority": args.priority, "crash_limit": args.crash_limit}
+            )
+    elif task_ids is not None:
+        for tid in task_ids:
+            tasks.append(
+                {"id": tid, "body": dict(body_base), "request": request,
+                 "priority": args.priority, "crash_limit": args.crash_limit}
+            )
+    else:
+        tasks.append(
+            {"id": 0, "body": dict(body_base), "request": request,
+             "priority": args.priority, "crash_limit": args.crash_limit}
+        )
+
+    job_desc = {
+        "name": args.name or Path(args.command[0]).name,
+        "submit_dir": submit_dir,
+        "max_fails": args.max_fails,
+        "tasks": tasks,
+    }
+    if args.job is not None:
+        job_desc["job_id"] = args.job
+
+    with _session(args) as session:
+        response = session.request({"op": "submit", "job": job_desc})
+        job_id = response["job_id"]
+        out = make_output(args.output_mode)
+        if args.output_mode == "quiet":
+            out.value(job_id)
+        else:
+            out.message(
+                f"Job submitted successfully, job ID: {job_id}"
+                f" ({response['n_tasks']} tasks)"
+            )
+        if args.wait:
+            info = session.request({"op": "job_wait", "job_ids": [job_id]})
+            job = info["jobs"][0] if info["jobs"] else None
+            ok = job is not None and not (
+                job["counters"]["failed"] or job["counters"]["canceled"]
+            )
+            out.message(f"job {job_id} {job['status'] if job else 'unknown'}")
+            if not ok:
+                raise SystemExit(1)
+
+
+# ---------------------------------------------------------------- job cmds
+def cmd_job_list(args) -> None:
+    with _session(args) as session:
+        jobs = session.request({"op": "job_list"})["jobs"]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(jobs)
+        return
+    out.table(
+        ["id", "name", "status", "tasks", "finished", "failed"],
+        [
+            [
+                j["id"],
+                j["name"],
+                j["status"],
+                j["n_tasks"],
+                j["counters"]["finished"],
+                j["counters"]["failed"],
+            ]
+            for j in sorted(jobs, key=lambda j: j["id"])
+        ],
+    )
+
+
+def cmd_job_info(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(jobs)
+        return
+    for job in jobs:
+        record = {k: v for k, v in job.items() if k != "tasks"}
+        record["counters"] = " ".join(
+            f"{k}={v}" for k, v in record.pop("counters").items()
+        )
+        out.record(record)
+
+
+def cmd_job_wait(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        t0 = time.time()
+        jobs = session.request({"op": "job_wait", "job_ids": ids})["jobs"]
+    out = make_output(args.output_mode)
+    bad = [
+        j for j in jobs
+        if j["counters"]["failed"] or j["counters"]["canceled"]
+    ]
+    out.message(
+        f"waited {time.time() - t0:.1f}s; "
+        f"{len(jobs) - len(bad)} succeeded, {len(bad)} with failures"
+    )
+    if bad:
+        raise SystemExit(1)
+
+
+def cmd_job_cancel(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        result = session.request({"op": "job_cancel", "job_ids": ids})["result"]
+    make_output(args.output_mode).value(result)
+
+
+def cmd_job_forget(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        result = session.request({"op": "job_forget", "job_ids": ids})
+    make_output(args.output_mode).message(
+        f"forgot {result['forgotten']} job(s)"
+    )
+
+
+def cmd_job_cat(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+    if not jobs:
+        fail("job not found")
+    stream = args.stream
+    for job in jobs:
+        detail = job
+        task_filter = (
+            set(parse_selector(args.tasks)) if args.tasks else None
+        )
+        for task in detail["tasks"]:
+            if task_filter is not None and task["id"] not in task_filter:
+                continue
+            mapping = {
+                "JOB_ID": str(job["id"]),
+                "TASK_ID": str(task["id"]),
+                "INSTANCE_ID": "0",
+                "SUBMIT_DIR": job["submit_dir"],
+            }
+            path = fill_placeholders(
+                f"%{{SUBMIT_DIR}}/job-%{{JOB_ID}}/%{{TASK_ID}}.{stream}", mapping
+            )
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    sys.stdout.buffer.write(f.read())
+    sys.stdout.flush()
+
+
+def cmd_job_open(args) -> None:
+    with _session(args) as session:
+        response = session.request(
+            {"op": "open_job", "name": args.name or "job",
+             "submit_dir": os.getcwd(), "max_fails": args.max_fails}
+        )
+    out = make_output(args.output_mode)
+    if args.output_mode == "quiet":
+        out.value(response["job_id"])
+    else:
+        out.message(f"opened job {response['job_id']}")
+
+
+def cmd_job_close(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        response = session.request({"op": "close_job", "job_ids": ids})
+    make_output(args.output_mode).message(f"closed jobs: {response['closed']}")
+
+
+# ---------------------------------------------------------------- alloc
+def _alloc_params(args) -> dict:
+    return {
+        "manager": args.manager,
+        "backlog": args.backlog,
+        "workers_per_alloc": args.workers_per_alloc,
+        "max_worker_count": args.max_worker_count or 0,
+        "time_limit_secs": args.time_limit,
+        "name": args.name or "",
+        "worker_args": args.worker_args or [],
+        "additional_args": args.additional_args or [],
+        "idle_timeout_secs": args.idle_timeout,
+    }
+
+
+def cmd_alloc_add(args) -> None:
+    with _session(args) as session:
+        response = session.request(
+            {"op": "alloc_add", "params": _alloc_params(args)}
+        )
+    out = make_output(args.output_mode)
+    if args.output_mode == "quiet":
+        out.value(response["queue_id"])
+    else:
+        out.message(f"allocation queue {response['queue_id']} created")
+
+
+def cmd_alloc_list(args) -> None:
+    with _session(args) as session:
+        queues = session.request({"op": "alloc_list"})["queues"]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(queues)
+        return
+    out.table(
+        ["id", "manager", "state", "backlog", "workers/alloc", "allocations"],
+        [
+            [
+                q["id"],
+                q["params"]["manager"],
+                q["state"],
+                q["params"]["backlog"],
+                q["params"]["workers_per_alloc"],
+                len(q["allocations"]),
+            ]
+            for q in queues
+        ],
+    )
+
+
+def cmd_alloc_info(args) -> None:
+    with _session(args) as session:
+        queues = session.request({"op": "alloc_list"})["queues"]
+    queue = next((q for q in queues if q["id"] == args.queue_id), None)
+    if queue is None:
+        fail(f"allocation queue {args.queue_id} not found")
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(queue)
+        return
+    out.table(
+        ["alloc", "status", "workers", "connected"],
+        [
+            [a["id"], a["status"], a["worker_count"], len(a["workers"])]
+            for a in queue["allocations"]
+        ],
+    )
+
+
+def cmd_alloc_remove(args) -> None:
+    with _session(args) as session:
+        session.request({"op": "alloc_remove", "queue_id": args.queue_id})
+    make_output(args.output_mode).message(
+        f"allocation queue {args.queue_id} removed"
+    )
+
+
+def cmd_alloc_pause(args) -> None:
+    with _session(args) as session:
+        response = session.request(
+            {"op": "alloc_pause", "queue_id": args.queue_id,
+             "pause": args.alloc_cmd == "pause"}
+        )
+    make_output(args.output_mode).message(
+        f"allocation queue {args.queue_id} is now {response['state']}"
+    )
+
+
+def cmd_alloc_dry_run(args) -> None:
+    with _session(args) as session:
+        response = session.request(
+            {"op": "alloc_dry_run", "params": _alloc_params(args)}
+        )
+    out = make_output(args.output_mode)
+    out.message(f"would submit via {response['submit_binary']}:")
+    out.message(response["script"])
+
+
+# ---------------------------------------------------------------- journal
+def cmd_journal_export(args) -> None:
+    from hyperqueue_tpu.events.journal import Journal
+
+    for record in Journal.read_all(Path(args.journal_file)):
+        print(json.dumps(record, default=str))
+
+
+def cmd_journal_flush(args) -> None:
+    with _session(args) as session:
+        session.request({"op": "journal_flush"})
+    make_output(args.output_mode).message("journal flushed")
+
+
+def cmd_journal_prune(args) -> None:
+    with _session(args) as session:
+        result = session.request({"op": "journal_prune"})
+    make_output(args.output_mode).message(
+        f"journal pruned: kept {result['kept_records']} records "
+        f"for live jobs {result['live_jobs']}"
+    )
+
+
+def cmd_journal_stream(args) -> None:
+    import asyncio
+
+    from hyperqueue_tpu.transport.auth import (
+        ROLE_CLIENT,
+        ROLE_SERVER,
+        do_authentication,
+    )
+
+    access = serverdir.load_access(_server_dir(args))
+
+    async def go():
+        reader, writer = await asyncio.open_connection(
+            access.host, access.client_port
+        )
+        conn = await do_authentication(
+            reader, writer, ROLE_CLIENT, ROLE_SERVER, access.client_key_bytes()
+        )
+        await conn.send(
+            {
+                "op": "stream_events",
+                "history": args.history,
+                "filter": args.filter or [],
+            }
+        )
+        while True:
+            msg = await conn.recv()
+            if msg.get("op") == "event":
+                print(json.dumps(msg["record"], default=str), flush=True)
+            elif msg.get("op") == "stream_live" and not args.follow:
+                return
+
+    try:
+        asyncio.run(go())
+    except (ConnectionError, OSError, EOFError):
+        pass
+
+
+# ---------------------------------------------------------------- task cmds
+def cmd_task_list(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value([{"job": j["id"], "tasks": j["tasks"]} for j in jobs])
+        return
+    for job in jobs:
+        out.table(
+            ["job", "task", "status", "workers", "error"],
+            [
+                [job["id"], t["id"], t["status"],
+                 ",".join(map(str, t["workers"])), t["error"][:60]]
+                for t in job["tasks"]
+            ],
+        )
+
+
+# ---------------------------------------------------------------- parser
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="hq", description="HyperQueue-TPU: task-graph execution framework"
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    # server
+    server = sub.add_parser("server", help="server management")
+    ssub = server.add_subparsers(dest="server_cmd", required=True)
+    p = ssub.add_parser("start")
+    _add_common(p)
+    p.add_argument("--host", default=None)
+    p.add_argument("--client-port", type=int, default=0)
+    p.add_argument("--worker-port", type=int, default=0)
+    p.add_argument("--disable-client-authentication", action="store_true")
+    p.add_argument("--disable-worker-authentication", action="store_true")
+    p.add_argument("--scheduler", choices=["auto", "cpu", "tpu"], default="auto")
+    p.add_argument("--journal", default=None)
+    p.set_defaults(fn=cmd_server_start)
+    p = ssub.add_parser("stop")
+    _add_common(p)
+    p.set_defaults(fn=cmd_server_stop)
+    p = ssub.add_parser("info")
+    _add_common(p)
+    p.set_defaults(fn=cmd_server_info)
+    p = ssub.add_parser("generate-access")
+    _add_common(p)
+    p.add_argument("access_file")
+    p.add_argument("--host", required=True)
+    p.add_argument("--client-port", type=int, required=True)
+    p.add_argument("--worker-port", type=int, required=True)
+    p.set_defaults(fn=cmd_server_generate_access)
+
+    # worker
+    worker = sub.add_parser("worker", help="worker management")
+    wsub = worker.add_subparsers(dest="worker_cmd", required=True)
+    p = wsub.add_parser("start")
+    _add_common(p)
+    p.add_argument("--cpus", type=int, default=None)
+    p.add_argument("--resource", action="append", default=None,
+                   help='e.g. "gpus=[0,1]", "mem=sum(1024)", "x=range(1-5)"')
+    p.add_argument("--group", default="default")
+    p.add_argument("--no-hyper-threading", action="store_true")
+    p.add_argument("--heartbeat", type=float, default=8.0)
+    p.add_argument("--time-limit", type=float, default=None)
+    p.add_argument("--idle-timeout", type=float, default=None)
+    p.add_argument("--on-server-lost", choices=["stop", "finish-running"],
+                   default="stop")
+    p.add_argument("--manager", choices=["auto", "pbs", "slurm", "none"],
+                   default="auto",
+                   help="batch manager detection (time limit from walltime)")
+    p.add_argument("--zero-worker", action="store_true",
+                   help="benchmark mode: tasks succeed instantly, no spawn")
+    p.set_defaults(fn=cmd_worker_start)
+    p = wsub.add_parser("list")
+    _add_common(p)
+    p.set_defaults(fn=cmd_worker_list)
+    p = wsub.add_parser("stop")
+    _add_common(p)
+    p.add_argument("selector")
+    p.set_defaults(fn=cmd_worker_stop)
+
+    # submit
+    p = sub.add_parser("submit", help="submit a job")
+    _add_common(p)
+    p.add_argument("--name", default=None)
+    p.add_argument("--cpus", default=None)
+    p.add_argument("--resource", dest="resource_request", action="append")
+    p.add_argument("--nodes", type=int, default=None)
+    p.add_argument("--time-request", type=float, default=None)
+    p.add_argument("--priority", type=int, default=0)
+    p.add_argument("--max-fails", type=int, default=None)
+    p.add_argument("--crash-limit", type=int, default=5)
+    p.add_argument("--array", default=None)
+    p.add_argument("--each-line", default=None)
+    p.add_argument("--from-json", default=None)
+    p.add_argument("--env", action="append")
+    p.add_argument("--cwd", default=None)
+    p.add_argument("--stdout", default=None)
+    p.add_argument("--stderr", default=None)
+    p.add_argument("--stdin", action="store_true")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--job", type=int, default=None,
+                   help="submit into an existing open job")
+    p.add_argument("--directives", choices=["auto", "file", "off"],
+                   default="auto",
+                   help="parse #HQ directive lines from the submitted script")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    # job
+    job = sub.add_parser("job", help="job inspection")
+    jsub = job.add_subparsers(dest="job_cmd", required=True)
+    p = jsub.add_parser("list")
+    _add_common(p)
+    p.set_defaults(fn=cmd_job_list)
+    for name, fn, extra in [
+        ("info", cmd_job_info, ()),
+        ("wait", cmd_job_wait, ()),
+        ("cancel", cmd_job_cancel, ()),
+        ("forget", cmd_job_forget, ()),
+        ("close", cmd_job_close, ()),
+    ]:
+        p = jsub.add_parser(name)
+        _add_common(p)
+        p.add_argument("selector")
+        p.set_defaults(fn=fn)
+    p = jsub.add_parser("cat")
+    _add_common(p)
+    p.add_argument("selector")
+    p.add_argument("stream", choices=["stdout", "stderr"])
+    p.add_argument("--tasks", default=None)
+    p.set_defaults(fn=cmd_job_cat)
+    p = jsub.add_parser("open")
+    _add_common(p)
+    p.add_argument("--name", default=None)
+    p.add_argument("--max-fails", type=int, default=None)
+    p.set_defaults(fn=cmd_job_open)
+    p = jsub.add_parser("submit-file", help="submit a TOML job definition")
+    _add_common(p)
+    p.add_argument("job_file")
+    p.add_argument("--wait", action="store_true")
+    p.set_defaults(fn=cmd_job_submit_file)
+
+    # alloc
+    alloc = sub.add_parser("alloc", help="automatic allocation (PBS/Slurm)")
+    asub = alloc.add_subparsers(dest="alloc_cmd", required=True)
+
+    def add_alloc_params(p):
+        # NOTE: manager must come after the options on the command line OR
+        # options before the positional; argparse interleaves fine as long as
+        # extra manager args are passed behind a literal "--"
+        p.add_argument("--backlog", type=int, default=1)
+        p.add_argument("--workers-per-alloc", type=int, default=1)
+        p.add_argument("--max-worker-count", type=int, default=None)
+        p.add_argument("--time-limit", type=float, default=3600.0)
+        p.add_argument("--idle-timeout", type=float, default=300.0)
+        p.add_argument("--name", default=None)
+        p.add_argument("--worker-args", action="append")
+        p.add_argument("manager", choices=["pbs", "slurm"])
+        p.add_argument("additional_args", nargs="*",
+                       help="extra qsub/sbatch arguments after --")
+
+    p = asub.add_parser("add")
+    _add_common(p)
+    add_alloc_params(p)
+    p.set_defaults(fn=cmd_alloc_add)
+    p = asub.add_parser("dry-run")
+    _add_common(p)
+    add_alloc_params(p)
+    p.set_defaults(fn=cmd_alloc_dry_run)
+    p = asub.add_parser("list")
+    _add_common(p)
+    p.set_defaults(fn=cmd_alloc_list)
+    for name, fn in [("info", cmd_alloc_info), ("remove", cmd_alloc_remove),
+                     ("pause", cmd_alloc_pause), ("resume", cmd_alloc_pause)]:
+        p = asub.add_parser(name)
+        _add_common(p)
+        p.add_argument("queue_id", type=int)
+        p.set_defaults(fn=fn)
+
+    # journal
+    journal = sub.add_parser("journal", help="event journal")
+    josub = journal.add_subparsers(dest="journal_cmd", required=True)
+    p = josub.add_parser("export", help="dump a journal file as NDJSON")
+    _add_common(p)
+    p.add_argument("journal_file")
+    p.set_defaults(fn=cmd_journal_export)
+    p = josub.add_parser("flush")
+    _add_common(p)
+    p.set_defaults(fn=cmd_journal_flush)
+    p = josub.add_parser("prune")
+    _add_common(p)
+    p.set_defaults(fn=cmd_journal_prune)
+    p = josub.add_parser("stream", help="stream live server events as NDJSON")
+    _add_common(p)
+    p.add_argument("--history", action="store_true",
+                   help="replay journaled history first")
+    p.add_argument("--follow", action="store_true",
+                   help="keep streaming live events")
+    p.add_argument("--filter", action="append",
+                   help="event kind prefix filter (job/task/worker/alloc)")
+    p.set_defaults(fn=cmd_journal_stream)
+
+    # task
+    task = sub.add_parser("task", help="task inspection")
+    tsub = task.add_subparsers(dest="task_cmd", required=True)
+    p = tsub.add_parser("list")
+    _add_common(p)
+    p.add_argument("selector")
+    p.set_defaults(fn=cmd_task_list)
+    p = tsub.add_parser("explain", help="why is this task (not) running")
+    _add_common(p)
+    p.add_argument("job_id", type=int)
+    p.add_argument("task_id", type=int)
+    p.set_defaults(fn=cmd_task_explain)
+
+    return parser
+
+
+def cmd_task_explain(args) -> None:
+    with _session(args) as session:
+        result = session.request(
+            {"op": "task_explain", "job_id": args.job_id,
+             "task_id": args.task_id}
+        )
+    result.pop("op", None)
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(result)
+        return
+    out.message(f"task {args.job_id}@{args.task_id}: {result['state']}")
+    if result["n_waiting_deps"]:
+        out.message(f"waiting for {result['n_waiting_deps']} dependencies")
+    for w in result["workers"]:
+        if w["runnable"]:
+            out.message(f"worker {w['id']} ({w['hostname']}): can run")
+        else:
+            for v in w["variants"]:
+                for reason in v["blocked"]:
+                    out.message(
+                        f"worker {w['id']} ({w['hostname']}) "
+                        f"variant {v['variant']}: {reason}"
+                    )
+
+
+def cmd_job_submit_file(args) -> None:
+    from hyperqueue_tpu.client.jobfile import JobFileError, load_job_file
+
+    try:
+        job_desc = load_job_file(args.job_file, os.getcwd())
+    except JobFileError as e:
+        fail(str(e))
+    with _session(args) as session:
+        response = session.request({"op": "submit", "job": job_desc})
+        job_id = response["job_id"]
+        out = make_output(args.output_mode)
+        if args.output_mode == "quiet":
+            out.value(job_id)
+        else:
+            out.message(
+                f"Job submitted successfully, job ID: {job_id}"
+                f" ({response['n_tasks']} tasks)"
+            )
+        if args.wait:
+            info = session.request({"op": "job_wait", "job_ids": [job_id]})
+            job = info["jobs"][0] if info["jobs"] else None
+            if job is None or job["counters"]["failed"] or job["counters"]["canceled"]:
+                raise SystemExit(1)
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(argv)
+    if args.cmd == "submit":
+        if args.command and args.command[0] == "--":
+            args.command = args.command[1:]
+        # #HQ directives from the submitted script; explicit CLI args win
+        # because they come later in the re-parsed argv
+        from hyperqueue_tpu.client.directives import (
+            parse_directives,
+            should_parse,
+        )
+
+        if args.command and should_parse(args.command[0], args.directives):
+            tokens = parse_directives(args.command[0])
+            if tokens:
+                idx = argv.index("submit")
+                args = build_parser().parse_args(
+                    argv[: idx + 1] + tokens + argv[idx + 1 :]
+                )
+                if args.command and args.command[0] == "--":
+                    args.command = args.command[1:]
+    try:
+        args.fn(args)
+    except ClientError as e:
+        fail(str(e))
+    except KeyboardInterrupt:
+        raise SystemExit(130)
+
+
+if __name__ == "__main__":
+    main()

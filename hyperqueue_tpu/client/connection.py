@@ -1,0 +1,64 @@
+"""Synchronous client connection for CLI commands.
+
+Each CLI invocation opens one authenticated connection on the client plane
+(reference client/mod.rs does the same via its async runtime).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from pathlib import Path
+
+from hyperqueue_tpu.transport.auth import (
+    ROLE_CLIENT,
+    ROLE_SERVER,
+    do_authentication,
+)
+from hyperqueue_tpu.utils import serverdir
+
+
+class ClientError(Exception):
+    pass
+
+
+class ClientSession:
+    """Sync facade: runs its own event loop for request/response exchanges."""
+
+    def __init__(self, server_dir: Path):
+        self.access = serverdir.load_access(Path(server_dir))
+        self._loop = asyncio.new_event_loop()
+        self._conn = self._loop.run_until_complete(self._connect())
+
+    async def _connect(self):
+        reader, writer = await asyncio.open_connection(
+            self.access.host, self.access.client_port
+        )
+        return await do_authentication(
+            reader,
+            writer,
+            ROLE_CLIENT,
+            ROLE_SERVER,
+            self.access.client_key_bytes(),
+        )
+
+    def request(self, msg: dict, timeout: float | None = None) -> dict:
+        async def go():
+            await self._conn.send(msg)
+            return await self._conn.recv()
+
+        coro = asyncio.wait_for(go(), timeout) if timeout else go()
+        response = self._loop.run_until_complete(coro)
+        if isinstance(response, dict) and response.get("op") == "error":
+            raise ClientError(response.get("message", "server error"))
+        return response
+
+    def close(self) -> None:
+        self._conn.close()
+        self._loop.run_until_complete(self._conn.wait_closed())
+        self._loop.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

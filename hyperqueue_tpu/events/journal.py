@@ -1,0 +1,115 @@
+"""Append-only event journal with torn-tail tolerance.
+
+Reference: crates/hyperqueue/src/server/event/journal/ — header-versioned
+append-only file of serialized events (`hqjl0002`, write.rs:12-76), flushed
+periodically and synchronously after client-visible mutations; a torn tail
+(crash mid-write) is detected and truncated on restore (read.rs:60); pruning
+rewrites the file dropping completed jobs (prune.rs).
+
+Format here: 8-byte magic "hqtpujl1", then records of [u32-LE length][msgpack
+payload].
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from pathlib import Path
+
+import msgpack
+
+MAGIC = b"hqtpujl1"
+_LEN = struct.Struct("<I")
+
+
+class Journal:
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._file = None
+
+    def open_for_append(self) -> None:
+        exists = self.path.exists() and self.path.stat().st_size >= len(MAGIC)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if exists:
+            # drop a torn tail before appending
+            valid_end = self._scan_valid_end()
+            self._file = open(self.path, "r+b")
+            self._file.truncate(valid_end)
+            self._file.seek(valid_end)
+        else:
+            self._file = open(self.path, "wb")
+            self._file.write(MAGIC)
+            self._file.flush()
+
+    def _scan_valid_end(self) -> int:
+        with open(self.path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError(f"{self.path} is not a journal file")
+            pos = len(MAGIC)
+            while True:
+                header = f.read(_LEN.size)
+                if len(header) < _LEN.size:
+                    return pos
+                (length,) = _LEN.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length:
+                    return pos
+                pos = f.tell()
+
+    def write(self, record: dict) -> None:
+        data = msgpack.packb(record, use_bin_type=True)
+        self._file.write(_LEN.pack(len(data)) + data)
+
+    def flush(self, sync: bool = False) -> None:
+        if self._file is not None:
+            self._file.flush()
+            if sync:
+                os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        if self._file is not None:
+            self.flush(sync=True)
+            self._file.close()
+            self._file = None
+
+    @staticmethod
+    def read_all(path: Path):
+        """Yield records, silently stopping at a torn tail (reference
+        read.rs:109-235 tests this tolerance)."""
+        with open(path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError(f"{path} is not a journal file")
+            while True:
+                header = f.read(_LEN.size)
+                if len(header) < _LEN.size:
+                    return
+                (length,) = _LEN.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length:
+                    return
+                try:
+                    yield msgpack.unpackb(payload, raw=False)
+                except Exception:
+                    return
+
+    @staticmethod
+    def prune(path: Path, keep_jobs: set[int]) -> int:
+        """Rewrite the journal keeping only events of `keep_jobs` (live jobs);
+        worker lifecycle events are dropped. Returns records kept."""
+        tmp = Path(str(path) + ".prune")
+        kept = 0
+        with open(tmp, "wb") as out:
+            out.write(MAGIC)
+            for record in Journal.read_all(path):
+                job = record.get("job")
+                if job is not None and job not in keep_jobs:
+                    continue
+                if job is None:
+                    continue  # worker/overview events are not restorable state
+                data = msgpack.packb(record, use_bin_type=True)
+                out.write(_LEN.pack(len(data)) + data)
+                kept += 1
+            out.flush()
+            os.fsync(out.fileno())
+        tmp.replace(path)
+        return kept

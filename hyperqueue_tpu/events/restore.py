@@ -1,0 +1,131 @@
+"""Crash-resume: replay the journal into a fresh server state.
+
+Reference: crates/hyperqueue/src/server/restore.rs — StateRestorer replays
+events, reconstructs jobs/open-state, re-submits unfinished tasks into the
+core with preserved instance/crash counters (gateway.rs:201-205) so stale
+messages from pre-crash workers are discarded; finished tasks are skipped and
+their dependents see them as satisfied.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from hyperqueue_tpu.events.journal import Journal
+from hyperqueue_tpu.ids import make_task_id
+from hyperqueue_tpu.server import reactor
+from hyperqueue_tpu.server.protocol import rqv_from_wire
+from hyperqueue_tpu.server.task import Task
+
+logger = logging.getLogger("hq.restore")
+
+TERMINAL = {"task-finished": "finished", "task-failed": "failed",
+            "task-canceled": "canceled"}
+
+
+def restore_from_journal(server) -> None:
+    """Replay server.journal_path into server.jobs/server.core."""
+    task_status: dict[tuple[int, int], tuple[str, str]] = {}
+    task_instances: dict[tuple[int, int], int] = {}
+    job_descs: dict[int, list[dict]] = {}
+    n_events = 0
+
+    for record in Journal.read_all(server.journal_path):
+        n_events += 1
+        kind = record.get("event")
+        job_id = record.get("job")
+        if kind == "job-submitted":
+            desc = record.get("desc") or {}
+            job = server.jobs.jobs.get(job_id)
+            if job is None:
+                job = server.jobs.create_job(
+                    name=desc.get("name", "job"),
+                    submit_dir=desc.get("submit_dir", "/"),
+                    max_fails=desc.get("max_fails"),
+                    is_open=desc.get("open", False),
+                    job_id=job_id,
+                )
+            for t in desc.get("tasks", []):
+                server.jobs.attach_task(job, t.get("id", 0), t)
+            job_descs.setdefault(job_id, []).extend(desc.get("tasks", []))
+        elif kind == "job-opened":
+            if job_id not in server.jobs.jobs:
+                server.jobs.create_job(
+                    name=record.get("name", "job"),
+                    submit_dir=record.get("submit_dir", "/"),
+                    is_open=True,
+                    job_id=job_id,
+                )
+        elif kind == "job-closed":
+            job = server.jobs.jobs.get(job_id)
+            if job is not None:
+                job.is_open = False
+        elif kind in TERMINAL:
+            task_status[(job_id, record["task"])] = (
+                TERMINAL[kind],
+                record.get("error", ""),
+            )
+        elif kind == "task-started":
+            key = (job_id, record["task"])
+            task_instances[key] = task_instances.get(key, 0) + 1
+
+    # apply terminal statuses to job counters
+    for (job_id, task_id), (status, error) in task_status.items():
+        job = server.jobs.jobs.get(job_id)
+        if job is None or task_id not in job.tasks:
+            continue
+        info = job.tasks[task_id]
+        info.status = status
+        info.error = error
+        job.counters[status] += 1
+
+    # re-submit unfinished tasks into the core
+    resubmitted = 0
+    for job_id, descs in job_descs.items():
+        job = server.jobs.jobs.get(job_id)
+        if job is None:
+            continue
+        new_tasks = []
+        for t in descs:
+            job_task_id = t.get("id", 0)
+            if (job_id, job_task_id) in task_status:
+                continue  # already terminal
+            rqv = rqv_from_wire(t.get("request") or {}, server.core.resource_map)
+            rq_id = server.core.intern_rqv(rqv)
+            deps = tuple(
+                make_task_id(job_id, d)
+                for d in t.get("deps", ())
+                if task_status.get((job_id, d), ("",))[0] != "finished"
+            )
+            # failed/canceled dependency => this task can never run; mark it
+            dead_dep = any(
+                task_status.get((job_id, d), ("",))[0] in ("failed", "canceled")
+                for d in t.get("deps", ())
+            )
+            if dead_dep:
+                job.tasks[job_task_id].status = "canceled"
+                job.counters["canceled"] += 1
+                continue
+            task = Task(
+                task_id=make_task_id(job_id, job_task_id),
+                rq_id=rq_id,
+                priority=(int(t.get("priority", 0)), -job_id),
+                body=t.get("body", {}),
+                deps=deps,
+                crash_limit=int(t.get("crash_limit", 5)),
+            )
+            # preserved instance counter: stale pre-crash worker messages
+            # carry older instance ids and are dropped (reference
+            # gateway.rs:204 adjust_instance_id_and_crash_counters)
+            task.instance_id = task_instances.get((job_id, job_task_id), 0)
+            new_tasks.append(task)
+        if new_tasks:
+            reactor.on_new_tasks(server.core, server.comm, new_tasks)
+            resubmitted += len(new_tasks)
+    logger.info(
+        "restored %d jobs (%d events, %d tasks resubmitted) from %s",
+        len(server.jobs.jobs),
+        n_events,
+        resubmitted,
+        server.journal_path,
+    )

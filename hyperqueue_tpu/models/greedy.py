@@ -17,10 +17,11 @@ from __future__ import annotations
 import numpy as np
 
 from hyperqueue_tpu.ops.assign import (
-    INF_TIME,
     greedy_cut_scan,
+    host_visit_classes,
     scarcity_weights,
 )
+from hyperqueue_tpu.utils.constants import INF_TIME
 
 
 def _bucket(n: int, floor: int) -> int:
@@ -78,9 +79,18 @@ class GreedyCutScanModel:
         # absent variants must never be eligible: give them infinite min_time
         mt_p[:, n_v:] = int(INF_TIME)
 
-        scarcity = scarcity_weights(free_p.astype(np.int64).sum(axis=0))
+        scarcity = np.asarray(
+            scarcity_weights(free_p.astype(np.int64).sum(axis=0))
+        ).astype(np.float32)
+        class_m, order_ids = host_visit_classes(free_p, needs_p, scarcity)
+        # bucket the mask-table dimension so steady-state ticks reuse the
+        # compiled program; padding rows are all-class-0 (never referenced)
+        pm = _bucket(class_m.shape[0], 4)
+        if pm > class_m.shape[0]:
+            pad = np.zeros((pm - class_m.shape[0], pw), dtype=np.int32)
+            class_m = np.concatenate([class_m, pad], axis=0)
 
         counts, _free_after, _nt_after = greedy_cut_scan(
-            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, scarcity
+            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
         )
         return np.asarray(counts)[:n_b, :n_v, :n_w]

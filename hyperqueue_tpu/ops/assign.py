@@ -45,20 +45,37 @@ import functools
 import jax
 import jax.numpy as jnp
 
-INF_TIME = jnp.int32(2**31 - 1)
+from hyperqueue_tpu.utils.constants import INF_TIME  # noqa: E402
 # Quantization of the waste score into the integer sort key: key =
 # waste_q * W + worker_index, waste_q in [0, _WASTE_Q]. With W <= 16384 the
 # key stays well inside int32.
 _WASTE_Q = 65536
 
 
+MAX_KERNEL_AMOUNT = 2**23  # all amounts must be below this (float32-exact)
+
+
 def _variant_capacity(free, nt_free, need, time_ok):
-    """(W,) int32: how many tasks of `need` fit on each worker right now."""
-    # floor(free / need) per resource where need > 0, else unlimited
+    """(W,) int32: how many tasks of `need` fit on each worker right now.
+
+    TPUs have no hardware integer division; XLA expands `//` into a long
+    scalar sequence that dominated the scan. Instead: float32 division plus an
+    exact integer fixup. Precondition (enforced by the range compression in
+    scheduler/tick.py / models/greedy.py): free and need < 2^23, so both are
+    exactly representable in float32 and the float quotient is within 1 of
+    the true floor — two int32 multiply-compare corrections make it exact.
+    """
     needed = need > 0
-    # avoid div by zero: where need == 0 use 1 and mask with a large number
     denom = jnp.where(needed, need, 1)
-    per_res = jnp.where(needed[None, :], free // denom[None, :], jnp.int32(2**30))
+    q = jnp.floor(
+        free.astype(jnp.float32) * (1.0 / denom.astype(jnp.float32))[None, :]
+    ).astype(jnp.int32)
+    # exact floor-division fixup (all int32 multiplies)
+    too_big = q * denom[None, :] > free
+    q = q - too_big.astype(jnp.int32)
+    too_small = (q + 1) * denom[None, :] <= free
+    q = q + too_small.astype(jnp.int32)
+    per_res = jnp.where(needed[None, :], q, jnp.int32(2**30))
     cap = jnp.min(per_res, axis=1)
     cap = jnp.minimum(cap, nt_free)
     cap = jnp.where(time_ok, cap, 0)
@@ -71,29 +88,111 @@ def _water_fill(cap, remaining, order_key):
     """Assign up to `remaining` tasks across workers, preferring low order_key.
 
     Returns (assign (W,) int32, assigned_total int32). Pure vector math: sort
-    workers by key, cumulative-sum capacities, clip, inverse-permute.
+    workers by key, cumulative-sum capacities, clip, inverse-permute. Used by
+    the sharded path; the single-chip scan uses the gather-free classed
+    variant below (arbitrary-permutation gathers cost ~140us each on TPU).
     """
     order = jnp.argsort(order_key)  # stable; ascending
+    inv = jnp.argsort(order)
     cap_sorted = cap[order]
     cum = jnp.cumsum(cap_sorted)
     take_sorted = jnp.clip(remaining - (cum - cap_sorted), 0, cap_sorted)
-    inv = jnp.argsort(order)
     assign = take_sorted[inv]
     return assign, jnp.sum(take_sorted)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def greedy_cut_scan(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
+def _water_fill_classed(cap, remaining, class_onehot):
+    """Water-fill in (waste-class asc, worker-index asc) visit order without
+    any sort or permutation gather.
+
+    class_onehot: (W, C) int32 0/1, class 0 visited first; within a class,
+    workers are visited in index order. The prefix (capacity absorbed before
+    worker w) = total capacity of strictly-lower classes + exclusive
+    index-order cumsum within w's own class — all elementwise ops + cumsums,
+    which TPUs execute in microseconds where a 1024-element permutation
+    gather costs ~140us.
+    """
+    cap_c = cap[:, None] * class_onehot  # (W, C)
+    per_class = jnp.sum(cap_c, axis=0)  # (C,)
+    class_before = jnp.cumsum(per_class) - per_class  # exclusive (C,)
+    within_excl = jnp.cumsum(cap_c, axis=0) - cap_c  # (W, C)
+    prefix = jnp.sum(
+        (within_excl + class_before[None, :]) * class_onehot, axis=1
+    )
+    assign = jnp.clip(remaining - prefix, 0, cap)
+    return assign, jnp.sum(assign)
+
+
+# fixed class-axis width for the gather-free water-fill; distinct waste
+# levels per mask are bounded by distinct worker resource patterns and are
+# clamped here (overflowing classes merge into the last one, which only
+# relaxes the preference order among the most-wasteful workers)
+N_VISIT_CLASSES = 16
+
+
+def host_visit_classes(free0, needs, scarcity):
+    """Precompute worker visit classes per distinct request mask (numpy).
+
+    The preference order (avoid burning scarce resources a request does not
+    need, then lower worker index — reference solver.rs:520-549 objective) is
+    a per-tick static choice depending only on (a) which resources each
+    request does NOT use and (b) which resources each worker has. Distinct
+    "unused resource" masks per tick are few (M << B*V). Instead of materializing
+    permutations (arbitrary-permutation gathers cost ~140us per scan step on
+    TPU), each worker gets a visit CLASS = dense rank of its waste score; the
+    kernel water-fills class-by-class with cumsums only.
+
+    Returns (class_m (M, W) int32 in [0, N_VISIT_CLASSES), order_ids (B, V)
+    int32). Only ~M*W ints cross the host->device boundary per tick.
+    """
+    import numpy as np
+
+    n_b, n_v, _n_r = needs.shape
+    has = np.asarray(free0) > 0  # (W, R)
+    masks = np.asarray(needs) == 0  # (B, V, R): resources NOT requested
+    flat = masks.reshape(n_b * n_v, -1)
+    uniq, inverse = np.unique(flat, axis=0, return_inverse=True)
+    weighted = has * np.asarray(scarcity)[None, :]  # (W, R)
+    waste = np.einsum("mr,wr->mw", uniq.astype(np.float32), weighted)
+    waste_q = np.round(waste * _WASTE_Q).astype(np.int64)
+    class_m = np.empty_like(waste_q, dtype=np.int32)
+    for m in range(waste_q.shape[0]):
+        levels = np.unique(waste_q[m])  # sorted ascending
+        class_m[m] = np.searchsorted(levels, waste_q[m]).astype(np.int32)
+    np.clip(class_m, 0, N_VISIT_CLASSES - 1, out=class_m)
+    order_ids = inverse.reshape(n_b, n_v).astype(np.int32)
+    return class_m, order_ids
+
+
+def greedy_cut_scan_impl(
+    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids
+):
     """Scan priority-ordered batches, water-filling each over the workers.
 
-    See module docstring for shapes/semantics. Returns (counts, free_after,
-    nt_free_after).
+    Un-jitted implementation (jit-wrapped below; also reused by the driver
+    entry). class_m (M, W) int32 + order_ids (B, V) int32 come from
+    host_visit_orders: per distinct request mask, each worker's visit class
+    (0 = visited first). Expanded to per-batch one-hots with one gather here
+    (outside the scan — in-scan dynamic row gathers cost ~140us/step) and
+    ride the scan xs. See module docstring for shapes/semantics. Returns
+    (counts, free_after, nt_free_after).
     """
     n_variants = needs.shape[1]
+    class_ids = class_m[order_ids]  # (B, V, W)
+    # one-hot per batch as scan xs: (B, V, W, C) int32 — built with one
+    # broadcasted compare outside the scan. The optimization barrier stops
+    # XLA from fusing this into the scan body (it would re-gather
+    # class_m[order_ids[i]] every step — a dynamic row gather costing
+    # ~140us/step; measured 84ms vs 0.1ms for the whole tick).
+    onehots = (
+        class_ids[..., None]
+        == jnp.arange(N_VISIT_CLASSES, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    onehots = jax.lax.optimization_barrier(onehots)
 
     def batch_body(carry, batch):
         free, nt_free = carry
-        b_needs, b_size, b_min_time = batch
+        b_needs, b_size, b_min_time, b_onehot = batch
         remaining = b_size
         counts_v = []
         for v in range(n_variants):  # V is tiny and static: unrolled
@@ -101,20 +200,9 @@ def greedy_cut_scan(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
             time_ok = b_min_time[v] <= lifetime
             cap = _variant_capacity(free, nt_free, need, time_ok)
             cap = jnp.minimum(cap, remaining)
-            # Worker order: burning resources the batch does not request is
-            # penalized by their scarcity; ties broken by worker index
-            # (reference solver.rs:520-549 objective weights). scarcity is
-            # normalized to sum 1 so waste is in [0, 1]; the key is integer to
-            # keep the index tiebreak exact.
-            n_workers = cap.shape[0]
-            unneeded = (free > 0) & (need[None, :] == 0)
-            waste = jnp.sum(unneeded * scarcity[None, :], axis=1)
-            waste_q = jnp.round(waste * _WASTE_Q).astype(jnp.int32)
-            idx = jnp.arange(n_workers, dtype=jnp.int32)
-            order_key = jnp.where(
-                cap > 0, waste_q * n_workers + idx, jnp.int32(2**31 - 1)
+            assign, assigned = _water_fill_classed(
+                cap, remaining, b_onehot[v]
             )
-            assign, assigned = _water_fill(cap, remaining, order_key)
             remaining = remaining - assigned
             free = free - assign[:, None] * need[None, :]
             nt_free = nt_free - assign
@@ -122,20 +210,51 @@ def greedy_cut_scan(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
         return (free, nt_free), jnp.stack(counts_v)
 
     (free, nt_free), counts = jax.lax.scan(
-        batch_body, (free, nt_free), (needs, sizes, min_time)
+        batch_body,
+        (free, nt_free),
+        (needs, sizes, min_time, onehots),
     )
     return counts, free, nt_free
 
 
-def scarcity_weights(total_amounts: jnp.ndarray) -> jnp.ndarray:
-    """(R,) float32 scarcity per resource, normalized to sum 1.
+greedy_cut_scan = functools.partial(jax.jit, donate_argnums=(0, 1))(
+    greedy_cut_scan_impl
+)
+
+
+def solve_tick(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
+    """Convenience wrapper: host-computed visit classes + jitted scan."""
+    class_m, order_ids = host_visit_classes(free, needs, scarcity)
+    return greedy_cut_scan(
+        jnp.asarray(free),
+        jnp.asarray(nt_free),
+        lifetime,
+        needs,
+        sizes,
+        min_time,
+        class_m,
+        order_ids,
+    )
+
+
+def scarcity_weights(total_amounts) -> "np.ndarray":
+    """(R,) float32 scarcity per resource, normalized to sum 1 (numpy, host).
 
     Rarer cluster-wide => larger weight. Resources with zero total capacity
     get weight 0 (nobody can waste them). total_amounts: (R,) summed capacity
     across workers.
+
+    Deliberately numpy, not jnp: this feeds the host-side visit-class
+    computation, and a single EAGER jnp op degrades every subsequent compiled
+    dispatch on the axon TPU runtime from ~40us to ~80ms (measured) — the
+    server must never run eager device ops.
     """
-    total = total_amounts.astype(jnp.float32)
+    import numpy as np
+
+    total = np.asarray(total_amounts, dtype=np.float64)
     present = total > 0
-    inv = jnp.where(present, jnp.max(total) / jnp.maximum(total, 1.0), 0.0)
-    norm = jnp.sum(inv)
-    return jnp.where(norm > 0, inv / jnp.maximum(norm, 1e-9), 0.0)
+    inv = np.where(present, total.max(initial=0.0) / np.maximum(total, 1.0), 0.0)
+    norm = inv.sum()
+    if norm <= 0:
+        return np.zeros_like(total, dtype=np.float32)
+    return (inv / norm).astype(np.float32)

@@ -1,0 +1,124 @@
+"""Multi-chip sharded tick solver.
+
+Scaling model: the dense tick state is (W, R) with W = workers — the axis that
+grows with cluster size (reference target: 1k workers, BASELINE.json 1M x 1k).
+We shard W across a jax.sharding.Mesh axis "w" with shard_map; batches/needs
+are replicated (they are tiny: B x V x R ints).
+
+The only cross-device dependency in the cut-scan is the water-fill's global
+prefix: "how much of this batch was absorbed by workers on earlier devices".
+That is one all_gather of per-device capacity sums (D scalars) per variant
+step — pure ICI traffic, no host round-trip, no resharding of the (W, R)
+state. Worker preference order becomes device-major (device 0's workers
+first, scarcity-aware within a device), which is a valid deterministic
+preference order of the same family the single-chip kernel uses.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hyperqueue_tpu.ops.assign import _variant_capacity, _water_fill
+
+_WASTE_Q = 65536
+
+
+def make_worker_mesh(n_devices: int | None = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(devices, axis_names=("w",))
+
+
+def _sharded_body(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
+    """shard_map body: free/nt_free/lifetime are local worker shards."""
+    axis = "w"
+    my_dev = jax.lax.axis_index(axis)
+    n_dev = jax.lax.axis_size(axis)
+    n_variants = needs.shape[1]
+
+    def batch_body(carry, batch):
+        free, nt_free, = carry
+        b_needs, b_size, b_min_time = batch
+        remaining_global = b_size
+        counts_v = []
+        for v in range(n_variants):
+            need = b_needs[v]
+            time_ok = b_min_time[v] <= lifetime
+            cap = _variant_capacity(free, nt_free, need, time_ok)
+            cap = jnp.minimum(cap, remaining_global)
+            local_sum = jnp.sum(cap)
+            # global exclusive prefix over devices: capacity absorbed by
+            # lower-index devices comes first (device-major worker order)
+            all_sums = jax.lax.all_gather(local_sum, axis)  # (D,)
+            offset = jnp.sum(jnp.where(jnp.arange(n_dev) < my_dev, all_sums, 0))
+            local_remaining = jnp.clip(
+                remaining_global - offset, 0, local_sum
+            )
+            # scarcity-aware order within the local shard
+            unneeded = (free > 0) & (need[None, :] == 0)
+            waste = jnp.sum(unneeded * scarcity[None, :], axis=1)
+            waste_q = jnp.round(waste * _WASTE_Q).astype(jnp.int32)
+            idx = jnp.arange(cap.shape[0], dtype=jnp.int32)
+            order_key = jnp.where(
+                cap > 0, waste_q * cap.shape[0] + idx, jnp.int32(2**31 - 1)
+            )
+            assign, assigned_local = _water_fill(cap, local_remaining, order_key)
+            assigned_global = jax.lax.psum(assigned_local, axis)
+            remaining_global = remaining_global - assigned_global
+            free = free - assign[:, None] * need[None, :]
+            nt_free = nt_free - assign
+            counts_v.append(assign)
+        return (free, nt_free), jnp.stack(counts_v)
+
+    (free, nt_free), counts = jax.lax.scan(
+        batch_body, (free, nt_free), (needs, sizes, min_time)
+    )
+    return counts, free, nt_free
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def sharded_cut_scan(
+    mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, scarcity
+):
+    """Worker-sharded variant of ops.assign.greedy_cut_scan.
+
+    free (W, R), nt_free/lifetime (W,) sharded on axis "w"; needs/sizes/
+    min_time/scarcity replicated. Returns counts (B, V, W) sharded on W.
+    """
+    return jax.shard_map(
+        _sharded_body,
+        mesh=mesh,
+        in_specs=(
+            P("w", None),   # free
+            P("w"),         # nt_free
+            P("w"),         # lifetime
+            P(),            # needs
+            P(),            # sizes
+            P(),            # min_time
+            P(),            # scarcity
+        ),
+        out_specs=(P(None, None, "w"), P("w", None), P("w")),
+        check_vma=False,
+    )(free, nt_free, lifetime, needs, sizes, min_time, scarcity)
+
+
+def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
+                      min_time, scarcity):
+    """Device-put the tick tensors with the proper shardings."""
+    w2 = NamedSharding(mesh, P("w", None))
+    w1 = NamedSharding(mesh, P("w"))
+    rep = NamedSharding(mesh, P())
+    return (
+        jax.device_put(free, w2),
+        jax.device_put(nt_free, w1),
+        jax.device_put(lifetime, w1),
+        jax.device_put(needs, rep),
+        jax.device_put(sizes, rep),
+        jax.device_put(min_time, rep),
+        jax.device_put(scarcity, rep),
+    )

@@ -16,6 +16,7 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
     counts[b][v][w] out. Mutates nothing."""
     n_w = len(free)
     n_r = len(free[0]) if n_w else 0
+    free0 = [list(row) for row in free]  # visit order derives from this
     free = [list(row) for row in free]
     nt_free = list(nt_free)
     n_b = len(needs)
@@ -39,13 +40,14 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
                     if need[r] > 0:
                         cap = min(cap, free[w][r] // need[r])
                 caps.append(max(cap, 0))
-            # worker order: scarcity-weighted waste of unrequested resources,
-            # then index (quantized exactly like the kernel)
+            # worker order: scarcity-weighted waste of unrequested resources
+            # (computed from the tick's INITIAL free state, like the kernel's
+            # precomputed visit orders), then index
             def key(w):
                 waste = sum(
                     scarcity[r]
                     for r in range(n_r)
-                    if free[w][r] > 0 and need[r] == 0
+                    if free0[w][r] > 0 and need[r] == 0
                 )
                 return (round(waste * 65536), w)
 

@@ -17,13 +17,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.utils.constants import INF_TIME
 from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
 from hyperqueue_tpu.scheduler.queues import Priority, TaskQueues
 
 MAX_CUTS_PER_QUEUE = 32
-# Values above this get range-compressed before entering the int32 kernel.
-MAX_SAFE_AMOUNT = 2**30
+# Values above this get range-compressed before entering the kernel — the
+# kernel requires amounts to be float32-exact (ops/assign.MAX_KERNEL_AMOUNT).
+MAX_SAFE_AMOUNT = 2**23
 
 
 @dataclass

@@ -1,0 +1,791 @@
+"""Server process: bootstrap, RPC planes, scheduler loop, event bridge.
+
+Reference: crates/hyperqueue/src/server/bootstrap.rs (init_hq_server),
+crates/tako/src/internal/server/rpc.rs (connection handling) and
+scheduler/main.rs (Notify-woken, min-delay-throttled scheduler loop). The
+whole server is one asyncio event loop — the reference's deliberately
+single-threaded design (SURVEY.md §5 race detection) carried over: state is
+mutated only from reactor handlers running on this loop, so the scheduler
+snapshot needs no locks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import time
+from pathlib import Path
+
+from hyperqueue_tpu.ids import task_id_job, task_id_task, make_task_id
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.server import reactor
+from hyperqueue_tpu.server.core import Core
+from hyperqueue_tpu.server.jobs import JobManager
+from hyperqueue_tpu.server.protocol import rqv_from_wire
+from hyperqueue_tpu.server.task import Task, TaskState
+from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+from hyperqueue_tpu.transport.auth import (
+    ROLE_CLIENT,
+    ROLE_SERVER,
+    ROLE_WORKER,
+    AuthError,
+    Connection,
+    do_authentication,
+)
+from hyperqueue_tpu.utils import serverdir
+
+logger = logging.getLogger("hq.server")
+
+SCHEDULE_MIN_DELAY = 0.03  # seconds; reference msd default 500ms prod / 20ms test
+
+
+class CommSender:
+    """Per-worker outgoing queues + the scheduling wakeup flag.
+
+    Reference: internal/server/comm.rs (CommSender) — unbounded channel per
+    worker so the reactor never blocks on a slow connection.
+    """
+
+    def __init__(self):
+        self._queues: dict[int, asyncio.Queue] = {}
+        self.scheduling_event = asyncio.Event()
+
+    def register_worker(self, worker_id: int) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[worker_id] = q
+        return q
+
+    def unregister_worker(self, worker_id: int) -> None:
+        self._queues.pop(worker_id, None)
+
+    def _send(self, worker_id: int, message: dict) -> None:
+        q = self._queues.get(worker_id)
+        if q is not None:
+            q.put_nowait(message)
+
+    # reactor.Comm protocol
+    def send_compute(self, worker_id: int, tasks: list[dict]) -> None:
+        self._send(worker_id, {"op": "compute", "tasks": tasks})
+
+    def send_cancel(self, worker_id: int, task_ids: list[int]) -> None:
+        self._send(worker_id, {"op": "cancel", "task_ids": task_ids})
+
+    def send_stop(self, worker_id: int) -> None:
+        self._send(worker_id, {"op": "stop"})
+
+    def ask_for_scheduling(self) -> None:
+        self.scheduling_event.set()
+
+
+class EventBridge:
+    """reactor.EventSink -> jobs layer + waiters (+ journal, task 6)."""
+
+    def __init__(self, server: "Server"):
+        self.server = server
+
+    def on_task_started(self, task_id, instance_id, worker_ids):
+        self.server.jobs.on_task_started(
+            task_id_job(task_id), task_id, worker_ids
+        )
+        self.server.emit_event(
+            "task-started",
+            {"job": task_id_job(task_id), "task": task_id_task(task_id),
+             "workers": worker_ids},
+        )
+
+    def on_task_restarted(self, task_id):
+        self.server.jobs.on_task_restarted(task_id_job(task_id), task_id)
+        self.server.emit_event(
+            "task-restarted",
+            {"job": task_id_job(task_id), "task": task_id_task(task_id)},
+        )
+
+    def on_task_finished(self, task_id):
+        self.server.jobs.on_task_finished(task_id_job(task_id), task_id)
+        self.server.emit_event(
+            "task-finished",
+            {"job": task_id_job(task_id), "task": task_id_task(task_id)},
+        )
+        self.server.check_job_completion(task_id_job(task_id))
+
+    def on_task_failed(self, task_id, message):
+        to_cancel = self.server.jobs.on_task_failed(
+            task_id_job(task_id), task_id, message
+        )
+        self.server.emit_event(
+            "task-failed",
+            {"job": task_id_job(task_id), "task": task_id_task(task_id),
+             "error": message},
+        )
+        if to_cancel:
+            self.server.schedule_cancel(to_cancel)
+        self.server.check_job_completion(task_id_job(task_id))
+
+    def on_task_canceled(self, task_id):
+        self.server.jobs.on_task_canceled(task_id_job(task_id), task_id)
+        self.server.emit_event(
+            "task-canceled",
+            {"job": task_id_job(task_id), "task": task_id_task(task_id)},
+        )
+        self.server.check_job_completion(task_id_job(task_id))
+
+    def on_worker_new(self, worker):
+        self.server.emit_event(
+            "worker-connected",
+            {"id": worker.worker_id, "hostname": worker.configuration.hostname,
+             "group": worker.group},
+        )
+
+    def on_worker_lost(self, worker_id, reason):
+        self.server.emit_event(
+            "worker-lost", {"id": worker_id, "reason": reason}
+        )
+
+
+class Server:
+    def __init__(
+        self,
+        server_dir: Path,
+        host: str | None = None,
+        client_port: int = 0,
+        worker_port: int = 0,
+        disable_client_auth: bool = False,
+        disable_worker_auth: bool = False,
+        scheduler: str = "auto",
+        schedule_min_delay: float = SCHEDULE_MIN_DELAY,
+        journal_path: Path | None = None,
+        idle_worker_stop: bool = False,
+    ):
+        self.server_dir = Path(server_dir)
+        self.host = host or socket.gethostname()
+        self.client_port = client_port
+        self.worker_port = worker_port
+        self.disable_client_auth = disable_client_auth
+        self.disable_worker_auth = disable_worker_auth
+        self.schedule_min_delay = schedule_min_delay
+        self.core = Core()
+        self.jobs = JobManager()
+        self.comm = CommSender()
+        self.events = EventBridge(self)
+        self.model = GreedyCutScanModel()
+        self.scheduler_kind = scheduler
+        self.access: serverdir.AccessRecord | None = None
+        self.autoalloc = None
+        self.journal = None
+        self.journal_path = journal_path
+        self._stop_event = asyncio.Event()
+        self._job_waiters: dict[int, list[asyncio.Event]] = {}
+        self._event_listeners: list[asyncio.Queue] = []
+        self._worker_conns: dict[int, Connection] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._servers: list[asyncio.base_events.Server] = []
+        self.started_at = time.time()
+
+    # ------------------------------------------------------------------
+    async def start(self) -> serverdir.AccessRecord:
+        if self.journal_path is not None:
+            from hyperqueue_tpu.events.journal import Journal
+            from hyperqueue_tpu.events.restore import restore_from_journal
+
+            self.journal = Journal(self.journal_path)
+            if self.journal_path.exists():
+                restore_from_journal(self)
+            self.journal.open_for_append()
+
+        client_srv = await asyncio.start_server(
+            self._handle_client_conn, "0.0.0.0", self.client_port
+        )
+        worker_srv = await asyncio.start_server(
+            self._handle_worker_conn, "0.0.0.0", self.worker_port
+        )
+        self._servers = [client_srv, worker_srv]
+        self.client_port = client_srv.sockets[0].getsockname()[1]
+        self.worker_port = worker_srv.sockets[0].getsockname()[1]
+
+        instance_dir = serverdir.create_instance_dir(self.server_dir)
+        self.access = serverdir.generate_access(
+            self.host,
+            self.client_port,
+            self.worker_port,
+            disable_client_auth=self.disable_client_auth,
+            disable_worker_auth=self.disable_worker_auth,
+        )
+        serverdir.store_access(instance_dir, self.access)
+
+        from hyperqueue_tpu.autoalloc.service import AutoAllocService
+
+        self.autoalloc = AutoAllocService(self, instance_dir / "autoalloc")
+        self.autoalloc.start()
+        self._tasks.append(asyncio.create_task(self._scheduler_loop()))
+        logger.info(
+            "server started uid=%s client=%s:%d worker=%s:%d",
+            self.access.server_uid,
+            self.host,
+            self.client_port,
+            self.host,
+            self.worker_port,
+        )
+        return self.access
+
+    async def run_until_stopped(self) -> None:
+        await self._stop_event.wait()
+        await self.shutdown()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    async def shutdown(self) -> None:
+        if getattr(self, "autoalloc", None) is not None:
+            self.autoalloc.stop()
+        for wid in list(self._worker_conns):
+            self.comm.send_stop(wid)
+        await asyncio.sleep(0.05)
+        for t in self._tasks:
+            t.cancel()
+        for srv in self._servers:
+            srv.close()
+        for conn in self._worker_conns.values():
+            conn.close()
+        if self.journal is not None:
+            self.journal.close()
+
+    # --- events out ----------------------------------------------------
+    def emit_event(self, kind: str, payload: dict) -> None:
+        record = {"time": time.time(), "event": kind, **payload}
+        if self.journal is not None:
+            self.journal.write(record)
+            # flush to the OS on every event: a crashed server process then
+            # restores everything (fsync-against-OS-crash happens on close
+            # and on `hq journal flush`, reference --journal-flush-period)
+            self.journal.flush()
+        for q in self._event_listeners:
+            q.put_nowait(record)
+
+    def schedule_cancel(self, task_ids: list[int]) -> None:
+        reactor.on_cancel_tasks(self.core, self.comm, self.events, task_ids)
+
+    def check_job_completion(self, job_id: int) -> None:
+        job = self.jobs.jobs.get(job_id)
+        if job is None:
+            return
+        if job.is_terminated():
+            self.emit_event("job-completed", {"job": job_id, "status": job.status()})
+        # waiters are satisfied when every task submitted SO FAR is terminal —
+        # for open jobs that is the useful "wait" semantics (the job itself
+        # terminates only when closed)
+        if job.all_tasks_done():
+            for event in self._job_waiters.pop(job_id, []):
+                event.set()
+
+    # --- scheduler loop ------------------------------------------------
+    async def _scheduler_loop(self) -> None:
+        while True:
+            await self.comm.scheduling_event.wait()
+            await asyncio.sleep(self.schedule_min_delay)
+            self.comm.scheduling_event.clear()
+            t0 = time.perf_counter()
+            n = reactor.schedule(self.core, self.comm, self.events, self.model)
+            if n:
+                logger.debug(
+                    "tick assigned %d tasks in %.2f ms",
+                    n,
+                    (time.perf_counter() - t0) * 1e3,
+                )
+
+    # --- worker plane ---------------------------------------------------
+    async def _handle_worker_conn(self, reader, writer) -> None:
+        worker_id = 0
+        try:
+            conn = await do_authentication(
+                reader,
+                writer,
+                ROLE_SERVER,
+                ROLE_WORKER,
+                self.access.worker_key_bytes() if self.access else None,
+            )
+            register = await conn.recv()
+            if register.get("op") != "register":
+                raise AuthError("expected register message")
+            config = WorkerConfiguration.from_wire(register["config"])
+            worker = Worker.create(
+                self.core.worker_id_counter.next(), config, self.core.resource_map
+            )
+            worker_id = worker.worker_id
+            queue = self.comm.register_worker(worker_id)
+            self._worker_conns[worker_id] = conn
+            await conn.send(
+                {
+                    "op": "registered",
+                    "worker_id": worker_id,
+                    "server_uid": self.access.server_uid if self.access else "",
+                    "heartbeat_secs": config.heartbeat_secs,
+                }
+            )
+            reactor.on_new_worker(self.core, self.comm, self.events, worker)
+            if config.alloc_id and getattr(self, "autoalloc", None):
+                self.autoalloc.on_worker_connected(worker_id, config.alloc_id)
+
+            sender = asyncio.create_task(self._worker_sender(conn, queue))
+            try:
+                await self._worker_recv_loop(conn, worker)
+            finally:
+                sender.cancel()
+        except (
+            AuthError,
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+        ) as e:
+            logger.info("worker connection ended: %s", e)
+        finally:
+            if worker_id:
+                self._worker_conns.pop(worker_id, None)
+                self.comm.unregister_worker(worker_id)
+                if worker_id in self.core.workers:
+                    reactor.on_remove_worker(
+                        self.core, self.comm, self.events, worker_id, "connection lost"
+                    )
+            writer.close()
+
+    async def _worker_sender(self, conn: Connection, queue: asyncio.Queue):
+        while True:
+            msg = await queue.get()
+            await conn.send(msg)
+
+    async def _worker_recv_loop(self, conn: Connection, worker: Worker) -> None:
+        while True:
+            msg = await conn.recv()
+            op = msg.get("op")
+            worker.last_heartbeat = time.monotonic()
+            if op == "task_running":
+                reactor.on_task_running(
+                    self.core, self.events, msg["id"], msg["instance"]
+                )
+            elif op == "task_finished":
+                reactor.on_task_finished(
+                    self.core, self.comm, self.events, msg["id"], msg["instance"]
+                )
+            elif op == "task_failed":
+                reactor.on_task_failed(
+                    self.core,
+                    self.comm,
+                    self.events,
+                    msg["id"],
+                    msg["instance"],
+                    msg.get("error", "task failed"),
+                )
+            elif op == "heartbeat":
+                pass
+            elif op == "overview":
+                self.emit_event(
+                    "worker-overview",
+                    {"id": worker.worker_id, "hw": msg.get("hw", {})},
+                )
+            else:
+                logger.warning("unknown worker message %r", op)
+
+    # --- client plane ---------------------------------------------------
+    async def _handle_client_conn(self, reader, writer) -> None:
+        try:
+            conn = await do_authentication(
+                reader,
+                writer,
+                ROLE_SERVER,
+                ROLE_CLIENT,
+                self.access.client_key_bytes() if self.access else None,
+            )
+            while True:
+                msg = await conn.recv()
+                if msg.get("op") == "stream_events":
+                    await self._stream_events(conn, msg)
+                    break
+                response = await self._handle_client_message(msg)
+                if response is not None:
+                    await conn.send(response)
+        except (
+            AuthError,
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+        ) as e:
+            logger.debug("client connection ended: %s", e)
+        finally:
+            writer.close()
+
+    async def _handle_client_message(self, msg: dict) -> dict | None:
+        op = msg.get("op")
+        handler = getattr(self, f"_client_{op.replace('-', '_')}", None)
+        if handler is None:
+            return {"op": "error", "message": f"unknown operation {op!r}"}
+        try:
+            return await handler(msg)
+        except Exception as e:  # noqa: BLE001 - client errors must not kill the server
+            logger.exception("error handling client %r", op)
+            return {"op": "error", "message": str(e)}
+
+    async def _client_server_info(self, msg: dict) -> dict:
+        return {
+            "op": "server_info",
+            "server_uid": self.access.server_uid if self.access else "",
+            "version": "0.1.0",
+            "client_port": self.client_port,
+            "worker_port": self.worker_port,
+            "started_at": self.started_at,
+            "n_workers": len(self.core.workers),
+            "n_jobs": len(self.jobs.jobs),
+            "scheduler": self.scheduler_kind,
+        }
+
+    async def _client_stop_server(self, msg: dict) -> dict:
+        asyncio.get_running_loop().call_soon(self.stop)
+        return {"op": "ok"}
+
+    async def _client_submit(self, msg: dict) -> dict:
+        job_desc = msg["job"]
+        job_id = job_desc.get("job_id")
+        if job_id is not None and job_id in self.jobs.jobs:
+            job = self.jobs.jobs[job_id]
+            if not job.is_open:
+                return {"op": "error", "message": f"job {job_id} is not open"}
+        else:
+            job = self.jobs.create_job(
+                name=job_desc.get("name", "job"),
+                submit_dir=job_desc.get("submit_dir", os.getcwd()),
+                max_fails=job_desc.get("max_fails"),
+                is_open=job_desc.get("open", False),
+                job_id=job_id,
+            )
+        new_tasks = self._build_tasks(job, job_desc)
+        self.emit_event(
+            "job-submitted", {"job": job.job_id, "desc": job_desc,
+                              "n_tasks": len(new_tasks)}
+        )
+        reactor.on_new_tasks(self.core, self.comm, new_tasks)
+        return {"op": "submit_response", "job_id": job.job_id,
+                "n_tasks": len(new_tasks)}
+
+    def _build_tasks(self, job, job_desc: dict) -> list[Task]:
+        """Convert a submit description into core tasks.
+
+        Reference: server/client/submit.rs build_tasks_array/build_tasks_graph.
+        """
+        new_tasks: list[Task] = []
+        used = set(job.tasks)
+        for t in job_desc.get("tasks", []):
+            job_task_id = t.get("id")
+            if job_task_id is None:
+                job_task_id = (max(used) + 1) if used else 0
+            if job_task_id in used:
+                raise ValueError(f"duplicate task id {job_task_id}")
+            used.add(job_task_id)
+            rqv = rqv_from_wire(t.get("request") or {}, self.core.resource_map)
+            rq_id = self.core.intern_rqv(rqv)
+            task_id = self.jobs.attach_task(job, job_task_id, t)
+            deps = tuple(
+                make_task_id(job.job_id, d) for d in t.get("deps", ())
+            )
+            new_tasks.append(
+                Task(
+                    task_id=task_id,
+                    rq_id=rq_id,
+                    priority=(int(t.get("priority", 0)), -job.job_id),
+                    body=t.get("body", {}),
+                    deps=deps,
+                    crash_limit=int(t.get("crash_limit", 5)),
+                )
+            )
+        return new_tasks
+
+    async def _client_job_list(self, msg: dict) -> dict:
+        return {
+            "op": "job_list",
+            "jobs": [j.to_info() for j in self.jobs.jobs.values()],
+        }
+
+    async def _client_job_info(self, msg: dict) -> dict:
+        out = []
+        for job_id in msg["job_ids"]:
+            job = self.jobs.jobs.get(job_id)
+            if job is not None:
+                out.append(job.to_detail())
+        return {"op": "job_info", "jobs": out}
+
+    async def _client_job_wait(self, msg: dict) -> dict:
+        events = []
+        for job_id in msg["job_ids"]:
+            job = self.jobs.jobs.get(job_id)
+            if job is None or job.all_tasks_done():
+                continue
+            event = asyncio.Event()
+            self._job_waiters.setdefault(job_id, []).append(event)
+            events.append(event)
+        if events:
+            await asyncio.gather(*(e.wait() for e in events))
+        return await self._client_job_info(msg)
+
+    async def _client_job_cancel(self, msg: dict) -> dict:
+        canceled = []
+        for job_id in msg["job_ids"]:
+            job = self.jobs.jobs.get(job_id)
+            if job is None:
+                continue
+            task_ids = [
+                make_task_id(job_id, t.job_task_id)
+                for t in job.tasks.values()
+                if t.status in ("waiting", "running")
+            ]
+            out = reactor.on_cancel_tasks(
+                self.core, self.comm, self.events, task_ids
+            )
+            canceled.append({"job": job_id, "n_canceled": len(out)})
+            self.check_job_completion(job_id)
+        return {"op": "job_cancel", "result": canceled}
+
+    async def _client_job_forget(self, msg: dict) -> dict:
+        forgotten = 0
+        for job_id in msg["job_ids"]:
+            job = self.jobs.jobs.get(job_id)
+            if job is None or not job.is_terminated():
+                continue
+            del self.jobs.jobs[job_id]
+            for job_task_id in job.tasks:
+                self.core.tasks.pop(make_task_id(job_id, job_task_id), None)
+            forgotten += 1
+        return {"op": "job_forget", "forgotten": forgotten}
+
+    async def _client_open_job(self, msg: dict) -> dict:
+        job = self.jobs.create_job(
+            name=msg.get("name", "job"),
+            submit_dir=msg.get("submit_dir", os.getcwd()),
+            max_fails=msg.get("max_fails"),
+            is_open=True,
+        )
+        self.emit_event("job-opened", {"job": job.job_id, "name": job.name})
+        return {"op": "open_job", "job_id": job.job_id}
+
+    async def _client_close_job(self, msg: dict) -> dict:
+        closed = []
+        for job_id in msg["job_ids"]:
+            job = self.jobs.jobs.get(job_id)
+            if job is not None and job.is_open:
+                job.is_open = False
+                closed.append(job_id)
+                self.emit_event("job-closed", {"job": job_id})
+                self.check_job_completion(job_id)
+        return {"op": "close_job", "closed": closed}
+
+    # --- autoalloc ops ---------------------------------------------------
+    async def _client_alloc_add(self, msg: dict) -> dict:
+        from hyperqueue_tpu.autoalloc.state import QueueParams
+
+        params = QueueParams.from_wire(msg["params"])
+        if params.manager not in ("pbs", "slurm"):
+            return {"op": "error",
+                    "message": f"unknown manager {params.manager!r}"}
+        queue = self.autoalloc.state.add_queue(params)
+        self.emit_event(
+            "alloc-queue-created",
+            {"queue_id": queue.queue_id, "manager": params.manager},
+        )
+        return {"op": "alloc_add", "queue_id": queue.queue_id}
+
+    async def _client_alloc_list(self, msg: dict) -> dict:
+        return {
+            "op": "alloc_list",
+            "queues": [q.to_wire() for q in self.autoalloc.state.queues.values()],
+        }
+
+    async def _client_alloc_remove(self, msg: dict) -> dict:
+        queue = self.autoalloc.state.queues.pop(msg["queue_id"], None)
+        if queue is None:
+            return {"op": "error", "message": "allocation queue not found"}
+        handler = self.autoalloc.handler_for(queue)
+        for alloc in queue.active_allocations():
+            try:
+                await handler.remove_allocation(alloc.allocation_id)
+            except Exception:  # noqa: BLE001
+                logger.warning("failed to remove allocation %s",
+                               alloc.allocation_id)
+        self.emit_event("alloc-queue-removed", {"queue_id": msg["queue_id"]})
+        return {"op": "ok"}
+
+    async def _client_alloc_pause(self, msg: dict) -> dict:
+        queue = self.autoalloc.state.queues.get(msg["queue_id"])
+        if queue is None:
+            return {"op": "error", "message": "allocation queue not found"}
+        queue.state = "paused" if msg.get("pause", True) else "running"
+        if queue.state == "running":
+            queue.consecutive_failures = 0
+            queue.next_submit_at = 0.0
+        return {"op": "ok", "state": queue.state}
+
+    async def _client_alloc_dry_run(self, msg: dict) -> dict:
+        from hyperqueue_tpu.autoalloc.state import QueueParams
+
+        params = QueueParams.from_wire(msg["params"])
+        result = await self.autoalloc.dry_run(params)
+        return {"op": "alloc_dry_run", **result}
+
+    async def _client_task_explain(self, msg: dict) -> dict:
+        """Why is this task (not) running? Reference server/explain.rs:11-98 —
+        per worker x per variant, which constraints block."""
+        job_id, job_task_id = msg["job_id"], msg["task_id"]
+        task = self.core.tasks.get(make_task_id(job_id, job_task_id))
+        if task is None:
+            job = self.jobs.jobs.get(job_id)
+            if job is not None and job_task_id in job.tasks:
+                info = job.tasks[job_task_id]
+                return {
+                    "op": "task_explain",
+                    "state": info.status,
+                    "workers": [],
+                    "n_waiting_deps": 0,
+                }
+            return {"op": "error", "message": "task not found"}
+        rqv = self.core.rq_map.get_variants(task.rq_id)
+        workers = []
+        for w in self.core.workers.values():
+            variants = []
+            for vi, variant in enumerate(rqv.variants):
+                blocked = []
+                if variant.is_multi_node:
+                    group_size = sum(
+                        1 for x in self.core.workers.values()
+                        if x.group == w.group
+                    )
+                    if group_size < variant.n_nodes:
+                        blocked.append(
+                            f"group '{w.group}' has {group_size} < "
+                            f"{variant.n_nodes} workers"
+                        )
+                else:
+                    for entry in variant.entries:
+                        name = self.core.resource_map.name_of(entry.resource_id)
+                        have_total = w.resources.amount(entry.resource_id)
+                        have_free = (
+                            w.free[entry.resource_id]
+                            if entry.resource_id < len(w.free)
+                            else 0
+                        )
+                        if have_total < entry.amount:
+                            blocked.append(
+                                f"needs {entry.amount / 10_000:g} {name}, "
+                                f"worker has {have_total / 10_000:g}"
+                            )
+                        elif have_free < entry.amount:
+                            blocked.append(
+                                f"waiting for {name} "
+                                f"(free {have_free / 10_000:g} of "
+                                f"{entry.amount / 10_000:g} needed)"
+                            )
+                if variant.min_time_secs and (
+                    w.lifetime_secs() < variant.min_time_secs
+                ):
+                    blocked.append(
+                        f"needs {variant.min_time_secs:g}s but worker has "
+                        f"{w.lifetime_secs()}s left"
+                    )
+                variants.append({"variant": vi, "blocked": blocked})
+            workers.append(
+                {
+                    "id": w.worker_id,
+                    "hostname": w.configuration.hostname,
+                    "variants": variants,
+                    "runnable": any(not v["blocked"] for v in variants),
+                }
+            )
+        return {
+            "op": "task_explain",
+            "state": task.state.value,
+            "n_waiting_deps": task.unfinished_deps,
+            "workers": workers,
+        }
+
+    async def _client_worker_list(self, msg: dict) -> dict:
+        return {
+            "op": "worker_list",
+            "workers": [
+                {
+                    "id": w.worker_id,
+                    "hostname": w.configuration.hostname,
+                    "group": w.group,
+                    "n_running": len(w.assigned_tasks),
+                    "resources": {
+                        self.core.resource_map.name_of(i): amount
+                        for i, amount in enumerate(w.resources.amounts)
+                        if amount
+                    },
+                }
+                for w in self.core.workers.values()
+            ],
+        }
+
+    async def _client_worker_stop(self, msg: dict) -> dict:
+        stopped = []
+        for wid in msg["worker_ids"]:
+            if wid in self.core.workers:
+                self.comm.send_stop(wid)
+                stopped.append(wid)
+        return {"op": "worker_stop", "stopped": stopped}
+
+    async def _client_task_list(self, msg: dict) -> dict:
+        job = self.jobs.jobs.get(msg["job_id"])
+        if job is None:
+            return {"op": "error", "message": f"job {msg['job_id']} not found"}
+        return {"op": "task_list", "job": job.to_detail()}
+
+    async def _stream_events(self, conn: Connection, msg: dict) -> None:
+        """Stream events to this client until it disconnects.
+
+        Reference: event/streamer.rs fan-out with EventFilterFlags
+        (streamer.rs:36-44); `history=True` first replays the journal.
+        """
+        prefixes = tuple(msg.get("filter") or ())
+        queue: asyncio.Queue = asyncio.Queue()
+        self._event_listeners.append(queue)
+        try:
+            if msg.get("history") and self.journal_path is not None:
+                from hyperqueue_tpu.events.journal import Journal
+
+                self.journal.flush()
+                for record in Journal.read_all(self.journal_path):
+                    if not prefixes or record.get("event", "").startswith(prefixes):
+                        await conn.send({"op": "event", "record": record})
+            await conn.send({"op": "stream_live"})
+            while True:
+                record = await queue.get()
+                if not prefixes or record.get("event", "").startswith(prefixes):
+                    await conn.send({"op": "event", "record": record})
+        finally:
+            self._event_listeners.remove(queue)
+
+    async def _client_journal_flush(self, msg: dict) -> dict:
+        if self.journal is None:
+            return {"op": "error", "message": "server runs without a journal"}
+        self.journal.flush(sync=True)
+        return {"op": "ok"}
+
+    async def _client_journal_prune(self, msg: dict) -> dict:
+        """Drop completed jobs from the journal (reference journal/prune.rs)."""
+        if self.journal is None:
+            return {"op": "error", "message": "server runs without a journal"}
+        from hyperqueue_tpu.events.journal import Journal
+
+        live = {
+            job_id
+            for job_id, job in self.jobs.jobs.items()
+            if not job.is_terminated()
+        }
+        self.journal.close()
+        kept = Journal.prune(self.journal_path, live)
+        self.journal.open_for_append()
+        # live jobs' submit events survived the prune; re-log nothing
+        return {"op": "ok", "kept_records": kept, "live_jobs": sorted(live)}
+
+
+async def run_server(**kwargs) -> None:
+    server = Server(**kwargs)
+    await server.start()
+    await server.run_until_stopped()

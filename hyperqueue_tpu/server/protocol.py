@@ -1,0 +1,68 @@
+"""Wire codecs for resource requests and task descriptions.
+
+Reference: crates/hyperqueue/src/transfer/messages.rs (client<->server DTOs)
+and crates/tako/src/internal/messages/worker.rs (server<->worker). Resource
+requests travel as name-keyed dicts (workers and clients don't know the
+server's interned ids); the server converts to interned form on arrival.
+"""
+
+from __future__ import annotations
+
+from hyperqueue_tpu.resources.map import ResourceIdMap
+from hyperqueue_tpu.resources.request import (
+    AllocationPolicy,
+    ResourceRequest,
+    ResourceRequestEntry,
+    ResourceRequestVariants,
+)
+
+
+def rqv_to_wire(rqv: ResourceRequestVariants, resource_map: ResourceIdMap) -> dict:
+    return {
+        "variants": [
+            {
+                "n_nodes": v.n_nodes,
+                "min_time": v.min_time_secs,
+                "entries": [
+                    {
+                        "name": resource_map.name_of(e.resource_id),
+                        "amount": e.amount,
+                        "policy": e.policy.value,
+                    }
+                    for e in v.entries
+                ],
+            }
+            for v in rqv.variants
+        ]
+    }
+
+
+def rqv_from_wire(data: dict, resource_map: ResourceIdMap) -> ResourceRequestVariants:
+    variants = []
+    for v in data.get("variants") or [{}]:
+        entries = tuple(
+            ResourceRequestEntry(
+                resource_id=resource_map.get_or_create(e["name"]),
+                amount=int(e["amount"]),
+                policy=AllocationPolicy.parse(e.get("policy", "compact")),
+            )
+            for e in v.get("entries", [])
+        )
+        if not entries and not v.get("n_nodes"):
+            # default: 1 cpu
+            entries = (
+                ResourceRequestEntry(
+                    resource_id=resource_map.get_or_create("cpus"),
+                    amount=10_000,
+                ),
+            )
+        variants.append(
+            ResourceRequest(
+                entries=entries,
+                n_nodes=int(v.get("n_nodes", 0)),
+                min_time_secs=float(v.get("min_time", 0.0)),
+            )
+        )
+    rqv = ResourceRequestVariants(variants=tuple(variants))
+    rqv.validate()
+    return rqv

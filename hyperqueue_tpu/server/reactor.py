@@ -35,6 +35,7 @@ class EventSink(Protocol):
     """
 
     def on_task_started(self, task_id: int, instance_id: int, worker_ids: list[int]) -> None: ...
+    def on_task_restarted(self, task_id: int) -> None: ...
     def on_task_finished(self, task_id: int) -> None: ...
     def on_task_failed(self, task_id: int, message: str) -> None: ...
     def on_task_canceled(self, task_id: int) -> None: ...
@@ -104,6 +105,8 @@ def on_remove_worker(
             task.state = TaskState.FAILED
             _propagate_failure(core, events, task, "worker lost too many times")
             continue
+        if was_running:
+            events.on_task_restarted(task_id)
         task.state = TaskState.WAITING
         _make_ready(core, task)
     if worker.mn_task:
@@ -129,6 +132,8 @@ def _teardown_gang(
         task.state = TaskState.FAILED
         _propagate_failure(core, events, task, "gang root lost too many times")
         return
+    if task.state is TaskState.RUNNING:
+        events.on_task_restarted(task.task_id)
     task.state = TaskState.WAITING
     _make_ready(core, task)
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
-from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.utils.constants import INF_TIME
 from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
 from hyperqueue_tpu.resources.map import ResourceIdMap
 from hyperqueue_tpu.resources.worker_resources import WorkerResources
@@ -28,6 +28,10 @@ class WorkerConfiguration:
     on_server_lost: str = "stop"  # stop | finish-running
     overview_interval_secs: float = 0.0
     listen_address: str = ""
+    # autoalloc linkage: batch manager + allocation id (HQ_ALLOC_ID env)
+    manager: str = "none"
+    manager_job_id: str = ""
+    alloc_id: str = ""
 
     def to_wire(self) -> dict:
         return {
@@ -40,6 +44,9 @@ class WorkerConfiguration:
             "on_server_lost": self.on_server_lost,
             "overview_interval_secs": self.overview_interval_secs,
             "listen_address": self.listen_address,
+            "manager": self.manager,
+            "manager_job_id": self.manager_job_id,
+            "alloc_id": self.alloc_id,
         }
 
     @classmethod
@@ -54,6 +61,9 @@ class WorkerConfiguration:
             on_server_lost=data.get("on_server_lost", "stop"),
             overview_interval_secs=data.get("overview_interval_secs", 0.0),
             listen_address=data.get("listen_address", ""),
+            manager=data.get("manager", "none"),
+            manager_job_id=data.get("manager_job_id", ""),
+            alloc_id=data.get("alloc_id", ""),
         )
 
 

@@ -1,0 +1,5 @@
+"""Shared numeric constants (importable without pulling in JAX)."""
+
+# "unlimited remaining lifetime" sentinel for worker time limits; fits int32
+# so it can flow straight into the dense solver tensors.
+INF_TIME = 2**31 - 1

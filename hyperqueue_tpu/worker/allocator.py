@@ -1,0 +1,249 @@
+"""Worker-side resource pools and allocator.
+
+Reference: crates/tako/src/internal/worker/resources/{pool.rs,allocator.rs} —
+pools hold concrete indices (non-fungible), possibly partitioned into NUMA
+groups, or a fungible sum; allocations claim whole indices plus at most one
+fractional share, and the claimed indices surface to tasks as
+HQ_RESOURCE_VALUES_<name> env vars.
+
+Policies (reference pool.rs:164-456):
+  compact  — prefer few groups (best effort)
+  compact! — must use the minimal possible number of groups
+  tight    — prefer the groups that end up most fully used
+  tight!   — strict version of tight
+  scatter  — spread across groups round-robin
+  all      — claim every free index of the resource
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT
+from hyperqueue_tpu.resources.descriptor import (
+    DescriptorKind,
+    ResourceDescriptor,
+    ResourceDescriptorItem,
+)
+from hyperqueue_tpu.resources.request import AllocationPolicy
+
+
+@dataclass
+class ResourceClaim:
+    resource: str
+    indices: list[str]                       # fully claimed indices
+    fraction_index: str | None = None        # index claimed fractionally
+    fraction: int = 0
+    sum_amount: int = 0                      # for SUM pools
+
+    def amount(self) -> int:
+        return (
+            len(self.indices) * FRACTIONS_PER_UNIT
+            + self.fraction
+            + self.sum_amount
+        )
+
+    def env_value(self) -> str:
+        labels = list(self.indices)
+        if self.fraction_index is not None:
+            labels.append(self.fraction_index)
+        return ",".join(labels)
+
+
+@dataclass
+class Allocation:
+    claims: list[ResourceClaim] = field(default_factory=list)
+
+    def claim_for(self, resource: str) -> ResourceClaim | None:
+        for claim in self.claims:
+            if claim.resource == resource:
+                return claim
+        return None
+
+
+class _IndexPool:
+    """Pool of concrete indices in groups; tracks full and fractional use."""
+
+    def __init__(self, groups: list[list[str]]):
+        self.groups = groups
+        self.group_of: dict[str, int] = {}
+        for gi, group in enumerate(groups):
+            for label in group:
+                self.group_of[label] = gi
+        self.free: list[str] = [label for group in groups for label in group]
+        # partially claimed: label -> remaining fraction (0..FRACTIONS)
+        self.partial: dict[str, int] = {}
+
+    def total_free(self) -> int:
+        return len(self.free) * FRACTIONS_PER_UNIT + sum(self.partial.values())
+
+    def _group_free_count(self) -> dict[int, int]:
+        counts = {gi: 0 for gi in range(len(self.groups))}
+        for label in self.free:
+            counts[self.group_of[label]] += 1
+        return counts
+
+    def _ordered_free(self, policy: AllocationPolicy, n_units: int) -> list[str]:
+        """Free indices ordered so the first n_units match the policy."""
+        counts = self._group_free_count()
+        if policy in (AllocationPolicy.SCATTER,):
+            # round-robin across groups
+            by_group: dict[int, list[str]] = {}
+            for label in self.free:
+                by_group.setdefault(self.group_of[label], []).append(label)
+            out: list[str] = []
+            while any(by_group.values()):
+                for gi in sorted(by_group):
+                    if by_group[gi]:
+                        out.append(by_group[gi].pop(0))
+            return out
+        if policy in (
+            AllocationPolicy.TIGHT,
+            AllocationPolicy.FORCE_TIGHT,
+        ):
+            # prefer groups with the FEWEST free indices (fill them up)
+            return sorted(
+                self.free, key=lambda l: (counts[self.group_of[l]], self.group_of[l], l)
+            )
+        # compact/default: prefer groups with the MOST free indices so the
+        # allocation lands in as few groups as possible
+        return sorted(
+            self.free,
+            key=lambda l: (-counts[self.group_of[l]], self.group_of[l], l),
+        )
+
+    def allocate(self, amount: int, policy: AllocationPolicy) -> ResourceClaim | None:
+        if policy is AllocationPolicy.ALL:
+            if self.partial or not self.free:
+                return None
+            claim = ResourceClaim(resource="", indices=list(self.free))
+            self.free.clear()
+            return claim
+        units, fraction = divmod(amount, FRACTIONS_PER_UNIT)
+        if self.total_free() < amount:
+            return None
+        if len(self.free) < units or (
+            fraction
+            and len(self.free) == units
+            and not any(f >= fraction for f in self.partial.values())
+        ):
+            return None
+        ordered = self._ordered_free(policy, units)
+        if policy in (AllocationPolicy.FORCE_COMPACT,):
+            # all units must come from the minimal number of groups
+            counts = self._group_free_count()
+            need = units + (1 if fraction else 0)
+            best = sorted(counts.values(), reverse=True)
+            got, n_groups = 0, 0
+            for c in best:
+                if got >= need:
+                    break
+                got += c
+                n_groups += 1
+            # verify the ordered prefix uses exactly n_groups groups
+            prefix = ordered[:need]
+            if len({self.group_of[l] for l in prefix}) > max(n_groups, 1):
+                return None
+        taken = ordered[:units]
+        claim = ResourceClaim(resource="", indices=taken)
+        for label in taken:
+            self.free.remove(label)
+        if fraction:
+            # prefer an already-partial index with enough remaining
+            donor = None
+            for label, remaining in sorted(self.partial.items()):
+                if remaining >= fraction:
+                    donor = label
+                    break
+            if donor is not None:
+                self.partial[donor] -= fraction
+                if self.partial[donor] == 0:
+                    del self.partial[donor]
+            else:
+                # break a fresh free index (prefer same ordering)
+                rest = [l for l in ordered[units:] if l in self.free]
+                if not rest:
+                    # roll back
+                    self.free.extend(taken)
+                    return None
+                donor = rest[0]
+                self.free.remove(donor)
+                self.partial[donor] = FRACTIONS_PER_UNIT - fraction
+            claim.fraction_index = donor
+            claim.fraction = fraction
+        return claim
+
+    def release(self, claim: ResourceClaim) -> None:
+        self.free.extend(claim.indices)
+        if claim.fraction_index is not None:
+            remaining = self.partial.get(claim.fraction_index, 0) + claim.fraction
+            if remaining >= FRACTIONS_PER_UNIT:
+                self.partial.pop(claim.fraction_index, None)
+                self.free.append(claim.fraction_index)
+            else:
+                self.partial[claim.fraction_index] = remaining
+
+
+class _SumPool:
+    def __init__(self, size: int):
+        self.free = size
+
+    def total_free(self) -> int:
+        return self.free
+
+    def allocate(self, amount: int, policy: AllocationPolicy) -> ResourceClaim | None:
+        if policy is AllocationPolicy.ALL:
+            if self.free == 0:
+                return None
+            claim = ResourceClaim(resource="", indices=[], sum_amount=self.free)
+            self.free = 0
+            return claim
+        if self.free < amount:
+            return None
+        self.free -= amount
+        return ResourceClaim(resource="", indices=[], sum_amount=amount)
+
+    def release(self, claim: ResourceClaim) -> None:
+        self.free += claim.sum_amount
+
+
+class ResourceAllocator:
+    """All pools of one worker; try_allocate is all-or-nothing.
+
+    Reference allocator.rs:215 (try_allocate) — on failure the request waits;
+    the server should rarely over-assign because its dense view mirrors these
+    pools, but races on fractional packing are possible and handled by
+    queueing on the worker (worker/runtime.py blocked queue).
+    """
+
+    def __init__(self, descriptor: ResourceDescriptor):
+        self.pools: dict[str, _IndexPool | _SumPool] = {}
+        for item in descriptor.items:
+            if item.kind is DescriptorKind.SUM:
+                self.pools[item.name] = _SumPool(item.sum_size)
+            else:
+                self.pools[item.name] = _IndexPool(item.index_groups())
+
+    def try_allocate(self, entries: list[dict]) -> Allocation | None:
+        """entries: [{name, amount, policy}] from the compute message."""
+        allocation = Allocation()
+        for entry in entries:
+            pool = self.pools.get(entry["name"])
+            policy = AllocationPolicy.parse(entry.get("policy", "compact"))
+            if pool is None:
+                self._rollback(allocation)
+                return None
+            claim = pool.allocate(int(entry["amount"]), policy)
+            if claim is None:
+                self._rollback(allocation)
+                return None
+            claim.resource = entry["name"]
+            allocation.claims.append(claim)
+        return allocation
+
+    def _rollback(self, allocation: Allocation) -> None:
+        for claim in allocation.claims:
+            self.pools[claim.resource].release(claim)
+
+    def release(self, allocation: Allocation) -> None:
+        self._rollback(allocation)

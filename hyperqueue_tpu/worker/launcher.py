@@ -1,0 +1,159 @@
+"""Task launcher: turn a compute message into a supervised OS process.
+
+Reference: crates/hyperqueue/src/worker/start/program.rs (build_program_task)
+— placeholder resolution, stdout/stderr redirection, stdin injection, HQ_*
+environment, per-resource env vars with the concrete claimed indices, node
+files for multi-node gangs, and a zero-cost mode for overhead benchmarking
+(program.rs:498,622 `zero_worker`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+from dataclasses import dataclass
+from pathlib import Path
+
+from hyperqueue_tpu.ids import task_id_job, task_id_task
+from hyperqueue_tpu.utils.placeholders import fill_placeholders, task_placeholder_map
+from hyperqueue_tpu.worker.allocator import Allocation
+
+
+@dataclass
+class LaunchedTask:
+    process: asyncio.subprocess.Process | None
+    stdout_path: str | None
+    stderr_path: str | None
+
+    async def wait(self) -> tuple[int, str]:
+        """Returns (exit_code, error_detail)."""
+        if self.process is None:  # zero-worker mode
+            return 0, ""
+        code = await self.process.wait()
+        detail = ""
+        if code != 0 and self.stderr_path and os.path.exists(self.stderr_path):
+            try:
+                with open(self.stderr_path, "rb") as f:
+                    f.seek(max(0, os.path.getsize(self.stderr_path) - 2048))
+                    detail = f.read().decode(errors="replace")
+            except OSError:
+                pass
+        return code, detail
+
+    def kill(self) -> None:
+        if self.process is not None and self.process.returncode is None:
+            try:
+                os.killpg(self.process.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    self.process.kill()
+                except ProcessLookupError:
+                    pass
+
+
+async def launch_task(
+    task_msg: dict,
+    allocation: Allocation | None,
+    server_uid: str,
+    worker_id: int,
+    zero_worker: bool = False,
+) -> LaunchedTask:
+    """Spawn the task process described by a compute message.
+
+    task_msg: {id, instance, body{cmd,env,cwd,stdout,stderr,stdin}, entries,
+    node_ids?, node_hostnames?}.
+    """
+    if zero_worker:
+        # benchmarking mode: skip process spawn entirely, instant success
+        return LaunchedTask(process=None, stdout_path=None, stderr_path=None)
+
+    body = task_msg.get("body") or {}
+    task_id = task_msg["id"]
+    job_id = task_id_job(task_id)
+    job_task_id = task_id_task(task_id)
+    submit_dir = body.get("submit_dir") or os.getcwd()
+    mapping = task_placeholder_map(
+        job_id=job_id,
+        job_task_id=job_task_id,
+        instance_id=task_msg.get("instance", 0),
+        submit_dir=submit_dir,
+        server_uid=server_uid,
+    )
+
+    cwd = body.get("cwd") or submit_dir
+    cwd = fill_placeholders(cwd, mapping)
+    mapping["CWD"] = cwd
+    Path(cwd).mkdir(parents=True, exist_ok=True)
+
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in (body.get("env") or {}).items()})
+    env["HQ_JOB_ID"] = str(job_id)
+    env["HQ_TASK_ID"] = str(job_task_id)
+    env["HQ_INSTANCE_ID"] = str(task_msg.get("instance", 0))
+    env["HQ_SUBMIT_DIR"] = submit_dir
+    env["HQ_SERVER_UID"] = server_uid
+    env["HQ_WORKER_ID"] = str(worker_id)
+    env["HQ_ENTRY"] = body.get("entry", "") or ""
+    if not env["HQ_ENTRY"]:
+        env.pop("HQ_ENTRY")
+
+    if allocation is not None:
+        for claim in allocation.claims:
+            name = claim.resource
+            value = claim.env_value()
+            env[f"HQ_RESOURCE_VALUES_{name}"] = value
+            env[f"HQ_RESOURCE_REQUEST_{name}"] = str(claim.amount())
+            if name == "cpus":
+                env["HQ_CPUS"] = value
+                # CPU pinning hint for OpenMP-style programs (reference
+                # program.rs:350 additionally taskset-pins; we export the
+                # portable subset)
+                env["OMP_NUM_THREADS"] = str(max(len(claim.indices), 1))
+
+    # multi-node gang: write the node file and expose it
+    node_hostnames = task_msg.get("node_hostnames")
+    if node_hostnames:
+        task_dir = Path(cwd) / f".hq-task-{job_id}-{job_task_id}"
+        task_dir.mkdir(parents=True, exist_ok=True)
+        node_file = task_dir / "hq_nodes"
+        node_file.write_text("\n".join(node_hostnames) + "\n")
+        env["HQ_NODE_FILE"] = str(node_file)
+        env["HQ_HOST_FILE"] = str(node_file)
+        env["HQ_NUM_NODES"] = str(len(node_hostnames))
+
+    def open_stdio(key: str):
+        spec = body.get(key)
+        if spec == "none":
+            return asyncio.subprocess.DEVNULL, None
+        if not spec:
+            spec = f"%{{SUBMIT_DIR}}/job-%{{JOB_ID}}/%{{TASK_ID}}.{key}"
+        path = fill_placeholders(spec, mapping)
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        return open(path, "wb"), path
+
+    stdout_f, stdout_path = open_stdio("stdout")
+    stderr_f, stderr_path = open_stdio("stderr")
+
+    stdin_data = body.get("stdin")
+    cmd = [fill_placeholders(str(c), mapping) for c in body["cmd"]]
+    try:
+        process = await asyncio.create_subprocess_exec(
+            *cmd,
+            cwd=cwd,
+            env=env,
+            stdin=asyncio.subprocess.PIPE if stdin_data else asyncio.subprocess.DEVNULL,
+            stdout=stdout_f,
+            stderr=stderr_f,
+            start_new_session=True,  # own process group => killable subtree
+        )
+    finally:
+        for f in (stdout_f, stderr_f):
+            if hasattr(f, "close"):
+                f.close()
+    if stdin_data:
+        process.stdin.write(stdin_data)
+        process.stdin.write_eof()
+    return LaunchedTask(
+        process=process, stdout_path=stdout_path, stderr_path=stderr_path
+    )

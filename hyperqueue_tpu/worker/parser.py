@@ -1,0 +1,90 @@
+"""Resource definition parser for `hq worker start --resource`.
+
+Reference: crates/hyperqueue/src/worker/parser.rs (718 LoC) — syntaxes:
+  name=range(1-5)          indices 1..5
+  name=[a,b,c]             explicit list
+  name=[[a,b],[c,d]]       groups (NUMA)
+  name=sum(1024)           fungible amount (units)
+  name=4 / name=4x2        shorthand: N indices / N groups x M
+"""
+
+from __future__ import annotations
+
+import re
+
+from hyperqueue_tpu.resources.amount import amount_from_str
+from hyperqueue_tpu.resources.descriptor import ResourceDescriptorItem
+
+
+class ResourceParseError(ValueError):
+    pass
+
+
+def parse_resource_definition(spec: str) -> ResourceDescriptorItem:
+    name, sep, value = spec.partition("=")
+    name = name.strip()
+    value = value.strip()
+    if not sep or not name or not value:
+        raise ResourceParseError(
+            f"invalid resource definition {spec!r}, expected name=value"
+        )
+
+    m = re.fullmatch(r"range\((\d+)-(\d+)\)", value)
+    if m:
+        lo, hi = int(m.group(1)), int(m.group(2))
+        if hi < lo:
+            raise ResourceParseError(f"empty range in {spec!r}")
+        return ResourceDescriptorItem.range(name, lo, hi)
+
+    m = re.fullmatch(r"sum\(([\d.]+)\)", value)
+    if m:
+        return ResourceDescriptorItem.sum(name, amount_from_str(m.group(1)))
+
+    if value.startswith("[["):
+        groups = _parse_nested_list(value, spec)
+        return ResourceDescriptorItem.group_list(name, groups)
+
+    if value.startswith("["):
+        if not value.endswith("]"):
+            raise ResourceParseError(f"unterminated list in {spec!r}")
+        items = [v.strip() for v in value[1:-1].split(",") if v.strip()]
+        if not items:
+            raise ResourceParseError(f"empty list in {spec!r}")
+        return ResourceDescriptorItem.list(name, items)
+
+    m = re.fullmatch(r"(\d+)x(\d+)", value)
+    if m:
+        n_groups, per_group = int(m.group(1)), int(m.group(2))
+        groups = [
+            [str(g * per_group + i) for i in range(per_group)]
+            for g in range(n_groups)
+        ]
+        return ResourceDescriptorItem.group_list(name, groups)
+
+    if value.isdigit():
+        return ResourceDescriptorItem.range(name, 0, int(value) - 1)
+
+    raise ResourceParseError(f"cannot parse resource definition {spec!r}")
+
+
+def _parse_nested_list(value: str, spec: str) -> list[list[str]]:
+    if not value.endswith("]]"):
+        raise ResourceParseError(f"unterminated group list in {spec!r}")
+    inner = value[1:-1].strip()
+    groups: list[list[str]] = []
+    depth = 0
+    current = ""
+    for ch in inner:
+        if ch == "[":
+            depth += 1
+            current = ""
+        elif ch == "]":
+            depth -= 1
+            items = [v.strip() for v in current.split(",") if v.strip()]
+            if items:
+                groups.append(items)
+        elif depth > 0:
+            current += ch
+    if not groups:
+        raise ResourceParseError(f"empty group list in {spec!r}")
+    return groups

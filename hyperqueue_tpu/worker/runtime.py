@@ -1,0 +1,248 @@
+"""Worker runtime: connect, register, run tasks, report results.
+
+Reference: crates/tako/src/internal/worker/rpc.rs (run_worker) — a select loop
+over the server message stream, heartbeat timer, idle timeout and time limit;
+plus worker/reactor.rs (compute_tasks -> try_start_task -> launch). Tasks that
+cannot allocate resources right now (fractional packing races) sit in a
+blocked queue retried after every release.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from hyperqueue_tpu.server.worker import WorkerConfiguration
+from hyperqueue_tpu.transport.auth import (
+    ROLE_SERVER,
+    ROLE_WORKER,
+    Connection,
+    do_authentication,
+)
+from hyperqueue_tpu.worker.allocator import ResourceAllocator
+from hyperqueue_tpu.worker.launcher import LaunchedTask, launch_task
+
+logger = logging.getLogger("hq.worker")
+
+
+class RunningTask:
+    __slots__ = ("msg", "allocation", "launched", "future")
+
+    def __init__(self, msg, allocation, launched, future):
+        self.msg = msg
+        self.allocation = allocation
+        self.launched: LaunchedTask = launched
+        self.future: asyncio.Task = future
+
+
+class WorkerRuntime:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        secret_key: bytes | None,
+        configuration: WorkerConfiguration,
+        zero_worker: bool = False,
+    ):
+        self.host = host
+        self.port = port
+        self.secret_key = secret_key
+        self.configuration = configuration
+        self.zero_worker = zero_worker
+        self.allocator = ResourceAllocator(configuration.descriptor)
+        self.worker_id = 0
+        self.server_uid = ""
+        self.running: dict[int, RunningTask] = {}
+        self.blocked: list[dict] = []
+        self.last_task_time = time.monotonic()
+        self.started_at = time.monotonic()
+        self._conn: Connection | None = None
+        self._send_lock = asyncio.Lock()
+        self._stop = asyncio.Event()
+
+    async def _send(self, msg: dict) -> None:
+        async with self._send_lock:
+            await self._conn.send(msg)
+
+    async def run(self) -> None:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self._conn = await do_authentication(
+            reader, writer, ROLE_WORKER, ROLE_SERVER, self.secret_key
+        )
+        await self._conn.send(
+            {"op": "register", "config": self.configuration.to_wire()}
+        )
+        registered = await self._conn.recv()
+        if registered.get("op") != "registered":
+            raise RuntimeError(f"registration failed: {registered}")
+        self.worker_id = registered["worker_id"]
+        self.server_uid = registered.get("server_uid", "")
+        logger.info("registered as worker %d", self.worker_id)
+
+        tasks = [
+            asyncio.create_task(self._message_loop()),
+            asyncio.create_task(self._heartbeat_loop()),
+            asyncio.create_task(self._limits_loop()),
+        ]
+        stop_wait = asyncio.create_task(self._stop.wait())
+        try:
+            done, pending = await asyncio.wait(
+                tasks + [stop_wait], return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                if t is not stop_wait and t.exception():
+                    raise t.exception()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            if self.configuration.on_server_lost == "finish-running":
+                logger.warning("server lost (%s); finishing running tasks", e)
+                await self._finish_running_then_exit()
+            else:
+                logger.warning("server lost (%s); stopping", e)
+        finally:
+            for t in tasks + [stop_wait]:
+                t.cancel()
+            for rt in self.running.values():
+                rt.launched.kill()
+            if self._conn:
+                self._conn.close()
+
+    async def _finish_running_then_exit(self) -> None:
+        while self.running:
+            await asyncio.sleep(0.1)
+
+    async def _message_loop(self) -> None:
+        while True:
+            msg = await self._conn.recv()
+            op = msg.get("op")
+            if op == "compute":
+                for task_msg in msg["tasks"]:
+                    self._try_start(task_msg)
+            elif op == "cancel":
+                for task_id in msg["task_ids"]:
+                    self._cancel_task(task_id)
+            elif op == "stop":
+                self._stop.set()
+                return
+            else:
+                logger.warning("unknown server message %r", op)
+
+    def _try_start(self, task_msg: dict) -> None:
+        allocation = self.allocator.try_allocate(task_msg.get("entries", []))
+        if allocation is None and task_msg.get("entries"):
+            logger.debug("task %d blocked on resources", task_msg["id"])
+            self.blocked.append(task_msg)
+            return
+        future = asyncio.create_task(self._run_task(task_msg, allocation))
+        self.running[task_msg["id"]] = RunningTask(
+            task_msg, allocation, None, future
+        )
+
+    async def _run_task(self, task_msg: dict, allocation) -> None:
+        task_id = task_msg["id"]
+        instance = task_msg.get("instance", 0)
+        try:
+            launched = await launch_task(
+                task_msg,
+                allocation,
+                server_uid=self.server_uid,
+                worker_id=self.worker_id,
+                zero_worker=self.zero_worker,
+            )
+            rt = self.running.get(task_id)
+            if rt is not None:
+                rt.launched = launched
+            await self._send(
+                {"op": "task_running", "id": task_id, "instance": instance}
+            )
+            code, detail = await launched.wait()
+            if code == 0:
+                await self._send(
+                    {"op": "task_finished", "id": task_id, "instance": instance}
+                )
+            else:
+                error = f"program exited with code {code}"
+                if detail:
+                    error += f"\nstderr (tail):\n{detail}"
+                await self._send(
+                    {
+                        "op": "task_failed",
+                        "id": task_id,
+                        "instance": instance,
+                        "error": error,
+                    }
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - report, don't kill the worker
+            logger.exception("task %d launch failed", task_id)
+            try:
+                await self._send(
+                    {
+                        "op": "task_failed",
+                        "id": task_id,
+                        "instance": instance,
+                        "error": f"failed to launch: {e}",
+                    }
+                )
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            self.last_task_time = time.monotonic()
+            rt = self.running.pop(task_id, None)
+            if rt is not None and rt.allocation is not None:
+                self.allocator.release(rt.allocation)
+            self._retry_blocked()
+
+    def _retry_blocked(self) -> None:
+        blocked, self.blocked = self.blocked, []
+        for task_msg in blocked:
+            self._try_start(task_msg)
+
+    def _cancel_task(self, task_id: int) -> None:
+        self.blocked = [t for t in self.blocked if t["id"] != task_id]
+        rt = self.running.get(task_id)
+        if rt is not None:
+            if rt.launched is not None:
+                rt.launched.kill()
+            else:
+                rt.future.cancel()
+
+    async def _heartbeat_loop(self) -> None:
+        interval = max(self.configuration.heartbeat_secs, 0.5)
+        while True:
+            await asyncio.sleep(interval)
+            await self._send({"op": "heartbeat"})
+
+    async def _limits_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            now = time.monotonic()
+            limit = self.configuration.time_limit_secs
+            if limit > 0 and now - self.started_at >= limit:
+                logger.info("time limit reached; stopping")
+                self._stop.set()
+                return
+            idle = self.configuration.idle_timeout_secs
+            if (
+                idle > 0
+                and not self.running
+                and not self.blocked
+                and now - self.last_task_time >= idle
+            ):
+                logger.info("idle timeout reached; stopping")
+                self._stop.set()
+                return
+
+
+async def run_worker(
+    host: str,
+    port: int,
+    secret_key: bytes | None,
+    configuration: WorkerConfiguration,
+    zero_worker: bool = False,
+) -> None:
+    runtime = WorkerRuntime(
+        host, port, secret_key, configuration, zero_worker=zero_worker
+    )
+    await runtime.run()

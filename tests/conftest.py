@@ -8,11 +8,23 @@ way). Must be set before jax import anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-override: the environment presets JAX_PLATFORMS=axon (real TPU) and
+# PRELOADS jax via a PYTHONPATH sitecustomize, so the env var was already
+# captured by jax config at interpreter start — jax.config.update is the only
+# effective override. XLA_FLAGS is still read at first backend init, so the
+# env var works for the virtual device count. Subprocesses spawned by e2e
+# tests get JAX_PLATFORMS=cpu in their env, which their own jax picks up at
+# interpreter start (before their sitecustomize captured it... it captures
+# the env we set, so plain env inheritance works there).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

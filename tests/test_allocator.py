@@ -1,0 +1,144 @@
+"""Worker-side resource pool / allocator tests.
+
+Mirrors reference crates/tako/src/internal/worker/resources/test_allocator.rs
+(policies, fractions, groups, rollback) at the scale this round implements.
+"""
+
+import pytest
+
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT as U
+from hyperqueue_tpu.resources.descriptor import (
+    ResourceDescriptor,
+    ResourceDescriptorItem,
+)
+from hyperqueue_tpu.worker.allocator import ResourceAllocator
+
+
+def make_allocator(groups=None, cpus=8, mem=None):
+    items = []
+    if groups:
+        items.append(ResourceDescriptorItem.group_list("cpus", groups))
+    else:
+        items.append(ResourceDescriptorItem.range("cpus", 0, cpus - 1))
+    items.append(ResourceDescriptorItem.list("gpus", ["0", "1"]))
+    if mem:
+        items.append(ResourceDescriptorItem.sum("mem", mem))
+    return ResourceAllocator(ResourceDescriptor(items=tuple(items)))
+
+
+def entry(name, amount, policy="compact"):
+    return {"name": name, "amount": amount, "policy": policy}
+
+
+def test_simple_allocate_release():
+    alloc = make_allocator()
+    a = alloc.try_allocate([entry("cpus", 4 * U)])
+    assert a is not None
+    claim = a.claim_for("cpus")
+    assert len(claim.indices) == 4
+    assert claim.env_value().count(",") == 3
+    b = alloc.try_allocate([entry("cpus", 5 * U)])
+    assert b is None  # only 4 left
+    alloc.release(a)
+    b = alloc.try_allocate([entry("cpus", 8 * U)])
+    assert b is not None
+
+
+def test_fractional_sharing():
+    alloc = make_allocator()
+    # two tasks each take 0.5 gpu -> must share one physical gpu
+    a = alloc.try_allocate([entry("gpus", U // 2)])
+    b = alloc.try_allocate([entry("gpus", U // 2)])
+    assert a and b
+    assert a.claim_for("gpus").fraction_index == b.claim_for("gpus").fraction_index
+    # a third 0.5 share goes to the second gpu
+    c = alloc.try_allocate([entry("gpus", U // 2)])
+    assert c.claim_for("gpus").fraction_index != a.claim_for("gpus").fraction_index
+    # 1.5 gpus: one full index + half of the remaining fraction donor
+    alloc.release(a)
+    alloc.release(c)
+    d = alloc.try_allocate([entry("gpus", U + U // 2)])
+    assert d is not None
+    assert len(d.claim_for("gpus").indices) == 1
+    assert d.claim_for("gpus").fraction == U // 2
+
+
+def test_all_policy():
+    alloc = make_allocator()
+    a = alloc.try_allocate([entry("cpus", 0, "all")])
+    assert len(a.claim_for("cpus").indices) == 8
+    assert alloc.try_allocate([entry("cpus", 1)]) is None
+    alloc.release(a)
+    assert alloc.try_allocate([entry("cpus", 1)]) is not None
+
+
+def test_sum_pool():
+    alloc = make_allocator(mem=100 * U)
+    a = alloc.try_allocate([entry("mem", 60 * U)])
+    assert a.claim_for("mem").sum_amount == 60 * U
+    assert alloc.try_allocate([entry("mem", 50 * U)]) is None
+    alloc.release(a)
+    assert alloc.try_allocate([entry("mem", 100 * U)]) is not None
+
+
+def test_compact_prefers_single_group():
+    groups = [["0", "1", "2", "3"], ["4", "5", "6", "7"]]
+    alloc = make_allocator(groups=groups)
+    # fill group 0 partially so group 1 has more space
+    hold = alloc.try_allocate([entry("cpus", 2 * U)])
+    a = alloc.try_allocate([entry("cpus", 3 * U, "compact")])
+    got_groups = {
+        alloc.pools["cpus"].group_of[i] for i in a.claim_for("cpus").indices
+    }
+    assert len(got_groups) == 1  # fits entirely in the emptier group
+
+
+def test_scatter_spreads_groups():
+    groups = [["0", "1", "2", "3"], ["4", "5", "6", "7"]]
+    alloc = make_allocator(groups=groups)
+    a = alloc.try_allocate([entry("cpus", 4 * U, "scatter")])
+    got_groups = {
+        alloc.pools["cpus"].group_of[i] for i in a.claim_for("cpus").indices
+    }
+    assert len(got_groups) == 2
+
+
+def test_tight_fills_partial_group():
+    groups = [["0", "1", "2", "3"], ["4", "5", "6", "7"]]
+    alloc = make_allocator(groups=groups)
+    alloc.try_allocate([entry("cpus", 3 * U)])  # leaves 1 free in a group
+    a = alloc.try_allocate([entry("cpus", 1 * U, "tight")])
+    # tight prefers the group with fewest free indices
+    (idx,) = a.claim_for("cpus").indices
+    assert alloc.pools["cpus"].group_of[idx] == 0
+
+
+def test_force_compact_fails_when_split_needed():
+    groups = [["0", "1"], ["2", "3"]]
+    alloc = make_allocator(groups=groups)
+    hold = alloc.try_allocate([entry("cpus", 1 * U)])
+    # 3 cpus can't come from the minimal group count (needs ceil(3/2)=2
+    # groups, but with one group at 1 free it would need... still 2) —
+    # grab feasible: [2,3]+[1] spans 2 groups; minimal possible for an
+    # empty pool would be 2 as well, so this succeeds
+    a = alloc.try_allocate([entry("cpus", 3 * U, "compact!")])
+    assert a is not None
+    alloc.release(a)
+    # 4 cpus now: only 3 free, fails outright
+    assert alloc.try_allocate([entry("cpus", 4 * U, "compact!")]) is None
+
+
+def test_multi_resource_rollback():
+    alloc = make_allocator()
+    # gpus exhausted after this
+    hold = alloc.try_allocate([entry("gpus", 2 * U)])
+    before = list(alloc.pools["cpus"].free)
+    a = alloc.try_allocate([entry("cpus", 2 * U), entry("gpus", 1 * U)])
+    assert a is None
+    # cpu claim must have been rolled back
+    assert sorted(alloc.pools["cpus"].free) == sorted(before)
+
+
+def test_unknown_resource_fails():
+    alloc = make_allocator()
+    assert alloc.try_allocate([entry("fpgas", U)]) is None

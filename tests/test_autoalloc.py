@@ -1,0 +1,198 @@
+"""Autoalloc tests.
+
+Tier-4 equivalent of the reference's mock harness (tests/autoalloc/mock/):
+fake qsub/sbatch/qstat/sacct executables are placed on PATH; they record
+their argv and return scripted responses, letting tests drive the
+queue/run/fail lifecycle without a real batch scheduler.
+"""
+
+import json
+import os
+import stat
+import textwrap
+import time
+
+import pytest
+
+from hyperqueue_tpu.autoalloc.handlers import PbsHandler, SlurmHandler
+from hyperqueue_tpu.autoalloc.state import AllocationQueue, QueueParams
+
+from utils_e2e import HqEnv, wait_until
+
+
+# ----------------------------------------------------------------- unit
+def test_slurm_script_and_parse(tmp_path):
+    handler = SlurmHandler("/srv", tmp_path)
+    params = QueueParams(manager="slurm", workers_per_alloc=2,
+                         time_limit_secs=3661)
+    script = handler.build_script(3, params)
+    assert "#SBATCH --nodes=2" in script
+    assert "#SBATCH --time=01:01:01" in script
+    assert "worker start" in script
+    assert 'HQ_ALLOC_ID="$SLURM_JOB_ID"' in script
+    assert handler.parse_submit_output("Submitted batch job 777\n") == "777"
+
+
+def test_pbs_script_and_parse(tmp_path):
+    handler = PbsHandler("/srv", tmp_path)
+    params = QueueParams(manager="pbs", workers_per_alloc=1,
+                         time_limit_secs=600)
+    script = handler.build_script(1, params)
+    assert "#PBS -l select=1" in script
+    assert "#PBS -l walltime=00:10:00" in script
+    assert handler.parse_submit_output("123.headnode\n") == "123.headnode"
+
+
+def test_queue_backoff_pauses():
+    queue = AllocationQueue(1, QueueParams(manager="slurm"))
+    assert queue.can_submit_now()
+    assert not queue.on_submit_fail()
+    assert not queue.can_submit_now()  # backoff
+    assert not queue.on_submit_fail()
+    assert queue.on_submit_fail()  # third failure -> pause signal
+
+
+# ----------------------------------------------------------------- mock e2e
+def make_mock_bins(bin_dir, log_dir, fail_sbatch=False):
+    bin_dir.mkdir(parents=True, exist_ok=True)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    sbatch = bin_dir / "sbatch"
+    if fail_sbatch:
+        sbatch.write_text("#!/bin/bash\necho 'queue is full' >&2\nexit 1\n")
+    else:
+        sbatch.write_text(
+            textwrap.dedent(
+                f"""\
+                #!/bin/bash
+                n_file="{log_dir}/counter"
+                n=$(cat "$n_file" 2>/dev/null || echo 0)
+                n=$((n+1))
+                echo $n > "$n_file"
+                echo "$@" >> "{log_dir}/sbatch.log"
+                cp "${{@: -1}}" "{log_dir}/script-$n.sh"
+                echo "Submitted batch job $n"
+                """
+            )
+        )
+    sacct = bin_dir / "sacct"
+    sacct.write_text(
+        textwrap.dedent(
+            f"""\
+            #!/bin/bash
+            state=$(cat "{log_dir}/state" 2>/dev/null || echo PENDING)
+            n=$(cat "{log_dir}/counter" 2>/dev/null || echo 0)
+            for i in $(seq 1 $n); do echo "$i|$state"; done
+            """
+        )
+    )
+    scancel = bin_dir / "scancel"
+    scancel.write_text(f"#!/bin/bash\necho \"$@\" >> {log_dir}/scancel.log\n")
+    for f in (sbatch, sacct, scancel):
+        f.chmod(f.stat().st_mode | stat.S_IEXEC)
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_autoalloc_submits_on_demand(env, tmp_path):
+    bin_dir, log_dir = tmp_path / "bin", tmp_path / "log"
+    make_mock_bins(bin_dir, log_dir)
+    os.environ["PATH"] = f"{bin_dir}:{os.environ['PATH']}"
+    try:
+        env.start_server()
+        env.command(["alloc", "add", "slurm", "--backlog", "2"])
+        # demand: pending tasks with no workers
+        env.command(["submit", "--array", "1-8", "--", "sleep", "1"])
+        wait_until(
+            lambda: (log_dir / "sbatch.log").exists(),
+            timeout=25,
+            message="sbatch invoked",
+        )
+        queues = json.loads(
+            env.command(["alloc", "list", "--output-mode", "json"])
+        )
+        assert queues[0]["params"]["manager"] == "slurm"
+        assert len(queues[0]["allocations"]) >= 1
+        assert all(a["status"] == "queued" for a in queues[0]["allocations"])
+        # the generated script starts a worker and exports HQ_ALLOC_ID
+        script = (log_dir / "script-1.sh").read_text()
+        assert "worker start" in script
+        assert "HQ_ALLOC_ID" in script
+        # allocations transition to running when sacct reports it
+        (log_dir / "state").write_text("RUNNING")
+        def running():
+            qs = json.loads(
+                env.command(["alloc", "list", "--output-mode", "json"])
+            )
+            return any(
+                a["status"] == "running" for a in qs[0]["allocations"]
+            )
+        wait_until(running, timeout=25, message="allocation running")
+    finally:
+        os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)
+
+
+def test_autoalloc_backoff_pauses_queue(env, tmp_path):
+    bin_dir, log_dir = tmp_path / "bin", tmp_path / "log"
+    make_mock_bins(bin_dir, log_dir, fail_sbatch=True)
+    os.environ["PATH"] = f"{bin_dir}:{os.environ['PATH']}"
+    try:
+        env.start_server()
+        env.command(["alloc", "add", "slurm"])
+        env.command(["submit", "--", "sleep", "1"])
+
+        def paused():
+            qs = json.loads(
+                env.command(["alloc", "list", "--output-mode", "json"])
+            )
+            return qs[0]["state"] == "paused"
+
+        wait_until(paused, timeout=60, message="queue paused after failures")
+        # resume clears the backoff
+        env.command(["alloc", "resume", "1"])
+        qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+        assert qs[0]["state"] == "running"
+    finally:
+        os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)
+
+
+def test_alloc_dry_run(env):
+    env.start_server()
+    out = env.command(["alloc", "dry-run", "pbs", "--workers-per-alloc", "2"])
+    assert "qsub" in out
+    assert "#PBS -l select=2" in out
+
+
+def test_autoalloc_worker_links_to_allocation(env, tmp_path):
+    bin_dir, log_dir = tmp_path / "bin", tmp_path / "log"
+    make_mock_bins(bin_dir, log_dir)
+    os.environ["PATH"] = f"{bin_dir}:{os.environ['PATH']}"
+    try:
+        env.start_server()
+        env.command(["alloc", "add", "slurm"])
+        env.command(["submit", "--", "true"])
+        wait_until(
+            lambda: (log_dir / "sbatch.log").exists(),
+            timeout=25,
+            message="sbatch invoked",
+        )
+        # emulate the allocation's worker connecting (HQ_ALLOC_ID=1)
+        os.environ["HQ_ALLOC_ID"] = "1"
+        try:
+            env.start_worker()
+        finally:
+            del os.environ["HQ_ALLOC_ID"]
+        def linked():
+            qs = json.loads(
+                env.command(["alloc", "list", "--output-mode", "json"])
+            )
+            allocs = qs[0]["allocations"]
+            return allocs and allocs[0]["workers"]
+        wait_until(linked, timeout=30, message="worker linked to allocation")
+        qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+        assert qs[0]["allocations"][0]["status"] == "running"
+    finally:
+        os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)

@@ -1,0 +1,173 @@
+"""CLI feature tests: directives, TOML job files, task explain, selectors,
+placeholders parsing (reference tests/test_directives.py, test_jobfile.py,
+test_explain.py, test_placeholders.py)."""
+
+import json
+import textwrap
+
+import pytest
+
+from hyperqueue_tpu.client.cli import parse_selector
+from hyperqueue_tpu.client.directives import parse_directives
+from hyperqueue_tpu.client.jobfile import JobFileError, load_job_file
+from hyperqueue_tpu.utils.placeholders import fill_placeholders
+from hyperqueue_tpu.worker.parser import (
+    ResourceParseError,
+    parse_resource_definition,
+)
+
+from utils_e2e import HqEnv
+
+
+def test_selector_parsing():
+    assert parse_selector("3") == [3]
+    assert parse_selector("1-3,7") == [1, 2, 3, 7]
+    assert parse_selector("all") == []
+    assert parse_selector("last", last_id=9) == [9]
+
+
+def test_placeholders():
+    out = fill_placeholders(
+        "%{SUBMIT_DIR}/job-%{JOB_ID}/%{TASK_ID}.%{UNKNOWN}",
+        {"SUBMIT_DIR": "/x", "JOB_ID": "2", "TASK_ID": "5"},
+    )
+    assert out == "/x/job-2/5.%{UNKNOWN}"
+
+
+def test_resource_definition_parser():
+    item = parse_resource_definition("gpus=[0,1,3]")
+    assert item.index_groups() == [["0", "1", "3"]]
+    item = parse_resource_definition("cpus=range(2-5)")
+    assert item.index_groups() == [["2", "3", "4", "5"]]
+    item = parse_resource_definition("numa=[[0,1],[2,3]]")
+    assert item.n_groups() == 2
+    item = parse_resource_definition("mem=sum(1024)")
+    assert item.total_amount() == 1024 * 10_000
+    item = parse_resource_definition("cpus=2x4")
+    assert item.n_groups() == 2
+    assert item.total_amount() == 8 * 10_000
+    item = parse_resource_definition("cpus=6")
+    assert item.total_amount() == 6 * 10_000
+    for bad in ["cpus", "x=range(5-2)", "x=[]", "x=sum(abc)", "x=foo"]:
+        with pytest.raises(ResourceParseError):
+            parse_resource_definition(bad)
+
+
+def test_directive_parsing(tmp_path):
+    script = tmp_path / "job.sh"
+    script.write_text(
+        textwrap.dedent(
+            """\
+            #!/bin/bash
+            #HQ --cpus=2 --name directive-job
+            #HQ --priority 3
+            # plain comment, ignored
+            echo hello
+            #HQ --ignored-after-code
+            """
+        )
+    )
+    assert parse_directives(script) == [
+        "--cpus=2", "--name", "directive-job", "--priority", "3",
+    ]
+
+
+def test_jobfile_parsing(tmp_path):
+    jf = tmp_path / "job.toml"
+    jf.write_text(
+        textwrap.dedent(
+            """\
+            name = "pipeline"
+            max_fails = 1
+
+            [[task]]
+            id = 0
+            command = ["echo", "prepare"]
+
+            [[task]]
+            id = 1
+            command = ["echo", "train"]
+            deps = [0]
+            priority = 2
+            [[task.request]]
+            resources = { cpus = "2", gpus = "0.5" }
+            time_request = 60.0
+            [[task.request]]
+            resources = { cpus = "4" }
+            """
+        )
+    )
+    desc = load_job_file(jf, "/submit")
+    assert desc["name"] == "pipeline"
+    assert desc["max_fails"] == 1
+    assert len(desc["tasks"]) == 2
+    t1 = desc["tasks"][1]
+    assert t1["deps"] == [0]
+    assert len(t1["request"]["variants"]) == 2
+    assert t1["request"]["variants"][0]["entries"][1]["amount"] == 5000
+
+    bad = tmp_path / "bad.toml"
+    bad.write_text('[[task]]\nid = 0\ncommand = ["x"]\ndeps = [5]\n')
+    with pytest.raises(JobFileError):
+        load_job_file(bad, "/submit")
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_directives_e2e(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    script = env.work_dir / "task.sh"
+    script.write_text("#!/bin/bash\n#HQ --name from-directive\necho ran\n")
+    script.chmod(0o755)
+    env.command(["submit", "--wait", "--", "bash", str(script)])
+    # auto mode triggers only when script is the command itself
+    env.command(["submit", "--wait", str(script)])
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    names = {j["name"] for j in jobs}
+    assert "from-directive" in names
+
+
+def test_jobfile_e2e_graph(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    jf = env.work_dir / "job.toml"
+    jf.write_text(
+        textwrap.dedent(
+            """\
+            name = "graph"
+
+            [[task]]
+            id = 0
+            command = ["bash", "-c", "echo first > order.txt"]
+
+            [[task]]
+            id = 1
+            command = ["bash", "-c", "echo second >> order.txt"]
+            deps = [0]
+            """
+        )
+    )
+    env.command(["job", "submit-file", str(jf), "--wait"])
+    assert (env.work_dir / "order.txt").read_text() == "first\nsecond\n"
+
+
+def test_task_explain_e2e(env):
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    # needs 8 cpus: never runnable on a 2-cpu worker
+    env.command(["submit", "--cpus", "8", "--", "true"])
+    out = json.loads(
+        env.command(["task", "explain", "1", "0", "--output-mode", "json"])
+    )
+    assert out["state"] in ("ready", "waiting")
+    w = out["workers"][0]
+    assert not w["runnable"]
+    assert "needs 8 cpus" in w["variants"][0]["blocked"][0]

@@ -1,0 +1,202 @@
+"""End-to-end tests against real server/worker/CLI processes.
+
+Tier-3 equivalent of the reference Python suite (tests/test_job.py,
+test_array.py, test_server.py, ...).
+"""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_submit_echo_roundtrip(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    out = env.command(["submit", "--wait", "--", "echo", "hello", "world"])
+    assert "Job submitted successfully" in out
+    cat = env.command(["job", "cat", "last", "stdout"])
+    assert cat.strip() == "hello world"
+
+
+def test_job_list_and_info(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--name", "myjob", "--wait", "--", "true"])
+    listing = json.loads(
+        env.command(["job", "list", "--output-mode", "json"])
+    )
+    assert len(listing) == 1
+    assert listing[0]["name"] == "myjob"
+    assert listing[0]["status"] == "finished"
+    info = json.loads(
+        env.command(["job", "info", "1", "--output-mode", "json"])
+    )
+    assert info[0]["n_tasks"] == 1
+
+
+def test_failing_task_reports_error(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--wait", "--", "bash", "-c", "echo oops >&2; exit 3"],
+        expect_fail=True,
+    )
+    tasks = json.loads(
+        env.command(["task", "list", "1", "--output-mode", "json"])
+    )
+    task = tasks[0]["tasks"][0]
+    assert task["status"] == "failed"
+    assert "exited with code 3" in task["error"]
+    assert "oops" in task["error"]
+
+
+def test_task_array_with_env(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        [
+            "submit", "--array", "1-4", "--wait", "--",
+            "bash", "-c", "echo task=$HQ_TASK_ID",
+        ]
+    )
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert sorted(out.strip().splitlines()) == [
+        "task=1", "task=2", "task=3", "task=4",
+    ]
+
+
+def test_resource_limit_respected(env):
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    # 2 cpus, tasks need 1 cpu each and hold it ~0.4s; 4 tasks => 2 waves
+    env.command(
+        ["submit", "--array", "1-4", "--cpus", "1", "--wait", "--",
+         "bash", "-c", "sleep 0.4"],
+        timeout=60,
+    )
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    assert jobs[0]["counters"]["finished"] == 4
+
+
+def test_cancel_running_job(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--", "sleep", "30"])
+
+    def running():
+        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        return jobs and jobs[0]["counters"]["running"] == 1
+
+    wait_until(running, message="task running")
+    env.command(["job", "cancel", "1"])
+
+    def canceled():
+        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        return jobs[0]["status"] == "canceled"
+
+    wait_until(canceled, message="job canceled")
+
+
+def test_worker_lost_task_requeued(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--", "sleep", "600"])
+
+    def running():
+        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        return jobs and jobs[0]["counters"]["running"] == 1
+
+    wait_until(running, message="task running")
+    env.kill_process("worker0")
+
+    def requeued():
+        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        return jobs[0]["counters"]["running"] == 0
+
+    wait_until(requeued, message="task requeued after worker loss")
+    # second worker picks it up again
+    env.start_worker()
+    wait_until(running, timeout=25, message="task running again")
+
+
+def test_stdin_and_placeholders(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--wait",
+         "--stdout", "%{SUBMIT_DIR}/out-%{JOB_ID}-%{TASK_ID}.txt",
+         "--", "bash", "-c", "echo j=$HQ_JOB_ID t=$HQ_TASK_ID"]
+    )
+    out_file = env.work_dir / "out-1-0.txt"
+    assert out_file.read_text().strip() == "j=1 t=0"
+
+
+def test_each_line_entries(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    data = env.work_dir / "lines.txt"
+    data.write_text("alpha\nbeta\n")
+    env.command(
+        ["submit", "--each-line", str(data), "--wait", "--",
+         "bash", "-c", "echo entry=$HQ_ENTRY"]
+    )
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert sorted(out.strip().splitlines()) == ["entry=alpha", "entry=beta"]
+
+
+def test_server_info_and_stop(env):
+    env.start_server()
+    info = json.loads(
+        env.command(["server", "info", "--output-mode", "json"])
+    )
+    assert info["n_workers"] == 0
+    env.command(["server", "stop"])
+    _, server = env.processes[0]
+    wait_until(
+        lambda: server.poll() is not None, message="server process exit"
+    )
+
+
+def test_worker_list_shows_resources(env):
+    env.start_server()
+    env.start_worker(cpus=8)
+    env.wait_workers(1)
+    workers = json.loads(
+        env.command(["worker", "list", "--output-mode", "json"])
+    )
+    assert workers[0]["resources"]["cpus"] == 8 * 10_000
+
+
+def test_open_job_multiple_submits(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    job_id = int(
+        env.command(["job", "open", "--output-mode", "quiet"]).strip()
+    )
+    env.command(["submit", "--job", str(job_id), "--wait", "--", "echo", "a"])
+    env.command(
+        ["submit", "--job", str(job_id), "--array", "1-2", "--wait", "--",
+         "echo", "b"]
+    )
+    env.command(["job", "close", str(job_id)])
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    assert jobs[0]["n_tasks"] == 3
+    assert jobs[0]["status"] == "finished"

@@ -1,0 +1,126 @@
+"""Journal + restore tests.
+
+Unit tier: round-trip, torn-tail tolerance, prune (reference
+event/journal/read.rs:109-235). E2e tier: server restart with --journal
+restores jobs and finishes pending work (reference tests/test_server.py,
+test_journal.py).
+"""
+
+import json
+
+import pytest
+
+from hyperqueue_tpu.events.journal import Journal
+
+from utils_e2e import HqEnv, wait_until
+
+
+def test_journal_roundtrip(tmp_path):
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"event": "a", "job": 1})
+    j.write({"event": "b", "job": 2, "data": b"\x00"})
+    j.close()
+    records = list(Journal.read_all(path))
+    assert records == [
+        {"event": "a", "job": 1},
+        {"event": "b", "job": 2, "data": b"\x00"},
+    ]
+
+
+def test_journal_torn_tail_truncated(tmp_path):
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"event": "a", "job": 1})
+    j.close()
+    size_after_one = path.stat().st_size
+    with open(path, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial garbage")
+    # read tolerates the tail
+    assert len(list(Journal.read_all(path))) == 1
+    # append truncates it and continues cleanly
+    j = Journal(path)
+    j.open_for_append()
+    assert path.stat().st_size == size_after_one
+    j.write({"event": "b", "job": 1})
+    j.close()
+    assert len(list(Journal.read_all(path))) == 2
+
+
+def test_journal_prune(tmp_path):
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"event": "job-submitted", "job": 1})
+    j.write({"event": "job-submitted", "job": 2})
+    j.write({"event": "task-finished", "job": 1, "task": 0})
+    j.write({"event": "worker-connected", "id": 1})
+    j.close()
+    kept = Journal.prune(path, keep_jobs={2})
+    assert kept == 1
+    records = list(Journal.read_all(path))
+    assert records == [{"event": "job-submitted", "job": 2}]
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_server_restore_resumes_pending_job(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    # no workers: submits stay pending
+    env.command(["submit", "--name", "pending", "--", "echo", "restored"])
+    env.command(["submit", "--name", "also-pending", "--array", "1-3", "--",
+                 "true"])
+    env.kill_process("server")
+
+    env.start_server("--journal", str(journal))
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    names = {j["name"] for j in jobs}
+    assert names == {"pending", "also-pending"}
+    # a worker arrives; the restored pending job must now run to completion
+    env.start_worker()
+    env.command(["job", "wait", "all"], timeout=40)
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    assert all(j["status"] == "finished" for j in jobs)
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert out.strip() == "restored"
+
+
+def test_finished_tasks_not_rerun_after_restore(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker()
+    env.wait_workers(1)
+    marker = env.work_dir / "ran_count.txt"
+    env.command(
+        ["submit", "--wait", "--", "bash", "-c",
+         f"echo x >> {marker}"]
+    )
+    assert marker.read_text().count("x") == 1
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    env.start_worker()
+    env.command(["job", "wait", "all"], timeout=30)
+    # the finished task must not execute again
+    assert marker.read_text().count("x") == 1
+
+
+def test_journal_stream_and_export(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    out = env.command(["journal", "stream", "--history"])
+    kinds = [json.loads(line)["event"] for line in out.splitlines()]
+    assert "job-submitted" in kinds
+    assert "task-finished" in kinds
+    env.command(["journal", "flush"])
+    out = env.command(["journal", "export", str(journal)])
+    assert "job-completed" in out

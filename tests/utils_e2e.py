@@ -1,0 +1,149 @@
+"""End-to-end test harness: drives real server/worker/CLI processes.
+
+Mirrors the reference tier-3 Python suite (reference tests/conftest.py Env /
+HqEnv fixtures): spawns `python -m hyperqueue_tpu` subprocesses with a temp
+server dir, captures logs, asserts liveness, and polls with wait_until.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# Subprocesses must never grab the real TPU during tests. Built per call so
+# tests that mutate os.environ (PATH mocks, HQ_ALLOC_ID) are picked up.
+def _env_base() -> dict:
+    return {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": f"{REPO_ROOT}:{os.environ.get('PYTHONPATH', '')}",
+    }
+
+
+def wait_until(predicate, timeout=15.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {message}")
+
+
+class HqEnv:
+    def __init__(self, tmp_path: Path):
+        self.tmp = Path(tmp_path)
+        self.server_dir = self.tmp / "server"
+        self.work_dir = self.tmp / "work"
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        self.processes: list[tuple[str, subprocess.Popen]] = []
+
+    def _spawn(self, name: str, args: list[str], cwd=None) -> subprocess.Popen:
+        log = open(self.tmp / f"{name}.log", "wb")
+        process = subprocess.Popen(
+            [sys.executable, "-m", "hyperqueue_tpu", *args],
+            env=_env_base(),
+            cwd=cwd or self.work_dir,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+        self.processes.append((name, process))
+        return process
+
+    def start_server(self, *extra: str) -> subprocess.Popen:
+        before = {
+            p.name for p in self.server_dir.iterdir() if p.name.isdigit()
+        } if self.server_dir.exists() else set()
+        n = sum(1 for name, _ in self.processes if name.startswith("server"))
+        process = self._spawn(
+            "server" if n == 0 else f"server{n}",
+            ["server", "start", "--server-dir", str(self.server_dir), *extra],
+        )
+
+        def new_instance_ready():
+            if process.poll() is not None:
+                return True
+            if not self.server_dir.exists():
+                return False
+            fresh = {
+                p.name for p in self.server_dir.iterdir() if p.name.isdigit()
+            } - before
+            return any(
+                (self.server_dir / d / "access.json").exists() for d in fresh
+            )
+
+        wait_until(new_instance_ready, message="server access file")
+        assert process.poll() is None, self.read_log(
+            "server" if n == 0 else f"server{n}"
+        )
+        return process
+
+    def start_worker(self, *extra: str, cpus: int | None = 4) -> subprocess.Popen:
+        args = ["worker", "start", "--server-dir", str(self.server_dir)]
+        if cpus is not None:
+            args += ["--cpus", str(cpus)]
+        args += list(extra)
+        n = sum(1 for name, _ in self.processes if name.startswith("worker"))
+        return self._spawn(f"worker{n}", args)
+
+    def command(
+        self, args: list[str], cwd=None, expect_fail=False, timeout=60.0
+    ) -> str:
+        result = subprocess.run(
+            [sys.executable, "-m", "hyperqueue_tpu", *args],
+            env={**_env_base(), "HQ_SERVER_DIR": str(self.server_dir)},
+            cwd=cwd or self.work_dir,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if expect_fail:
+            assert result.returncode != 0, (
+                f"expected failure, got: {result.stdout}"
+            )
+        else:
+            assert result.returncode == 0, (
+                f"command {args} failed:\n{result.stdout}\n{result.stderr}"
+            )
+        return result.stdout
+
+    def read_log(self, name: str) -> str:
+        path = self.tmp / f"{name}.log"
+        return path.read_text() if path.exists() else "<no log>"
+
+    def wait_workers(self, n: int, timeout=20.0):
+        def check():
+            out = self.command(["worker", "list", "--output-mode", "quiet"])
+            return len([l for l in out.splitlines() if l.strip()]) >= n
+
+        wait_until(check, timeout=timeout, message=f"{n} workers")
+
+    def kill_process(self, name: str) -> None:
+        for pname, process in self.processes:
+            if pname == name and process.poll() is None:
+                process.kill()
+                process.wait()
+                return
+        raise KeyError(name)
+
+    def close(self) -> None:
+        for _, process in reversed(self.processes):
+            if process.poll() is None:
+                process.terminate()
+        for _, process in self.processes:
+            try:
+                process.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                process.kill()
+                process.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

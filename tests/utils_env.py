@@ -52,6 +52,7 @@ class TestComm:
 @dataclass
 class TestEvents:
     started: list[int] = field(default_factory=list)
+    restarted: list[int] = field(default_factory=list)
     finished: list[int] = field(default_factory=list)
     failed: list[tuple[int, str]] = field(default_factory=list)
     canceled: list[int] = field(default_factory=list)
@@ -60,6 +61,9 @@ class TestEvents:
 
     def on_task_started(self, task_id, instance_id, worker_ids):
         self.started.append(task_id)
+
+    def on_task_restarted(self, task_id):
+        self.restarted.append(task_id)
 
     def on_task_finished(self, task_id):
         self.finished.append(task_id)
