@@ -1,0 +1,15 @@
+"""Python user API.
+
+Reference: crates/pyhq/python/hyperqueue — Client, Job (program + Python
+function tasks with dependencies), LocalCluster.
+"""
+
+from hyperqueue_tpu.api.client import (
+    Client,
+    FailedJobsException,
+    Job,
+    LocalCluster,
+    Task,
+)
+
+__all__ = ["Client", "FailedJobsException", "Job", "LocalCluster", "Task"]

@@ -283,6 +283,8 @@ def cmd_submit(args) -> None:
         "stderr": args.stderr,
         "submit_dir": submit_dir,
     }
+    if args.stream:
+        body_base["stream"] = os.path.abspath(args.stream)
     if args.stdin:
         body_base["stdin"] = sys.stdin.buffer.read()
     request = _build_request(args)
@@ -639,6 +641,43 @@ def cmd_journal_stream(args) -> None:
         pass
 
 
+# ---------------------------------------------------------------- output-log
+def cmd_output_log(args) -> None:
+    from hyperqueue_tpu.events.outputlog import STDERR, STDOUT, OutputLog
+
+    log = OutputLog(args.stream_dir)
+    out = make_output(args.output_mode)
+    if args.log_cmd == "summary":
+        out.record(log.summary())
+    elif args.log_cmd == "cat":
+        from hyperqueue_tpu.ids import task_id_task
+
+        channel = STDOUT if args.channel == "stdout" else STDERR
+        # stream records carry packed (job, task) ids; --tasks selects by the
+        # job-task part
+        wanted = set(parse_selector(args.tasks)) if args.tasks else None
+        for task_id in log.task_ids():
+            if wanted is None or task_id_task(task_id) in wanted:
+                sys.stdout.buffer.write(log.cat(task_id, channel))
+        sys.stdout.flush()
+    elif args.log_cmd == "show":
+        for rec in log.export():
+            for line in rec["data"].splitlines():
+                print(f"{rec['task']}:{rec['channel'][-3:]}> {line}")
+    elif args.log_cmd == "export":
+        for rec in log.export():
+            print(json.dumps(rec))
+
+
+def cmd_dashboard(args) -> None:
+    from hyperqueue_tpu.client.dashboard import run_dashboard
+
+    try:
+        run_dashboard(_server_dir(args), interval=args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
 # ---------------------------------------------------------------- task cmds
 def cmd_task_list(args) -> None:
     with _session(args) as session:
@@ -741,6 +780,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cwd", default=None)
     p.add_argument("--stdout", default=None)
     p.add_argument("--stderr", default=None)
+    p.add_argument("--stream", default=None,
+                   help="stream task output into this directory (.hqs files)")
     p.add_argument("--stdin", action="store_true")
     p.add_argument("--wait", action="store_true")
     p.add_argument("--job", type=int, default=None,
@@ -857,6 +898,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("job_id", type=int)
     p.add_argument("task_id", type=int)
     p.set_defaults(fn=cmd_task_explain)
+
+    # output-log
+    olog = sub.add_parser("output-log", help="read streamed task output")
+    osub = olog.add_subparsers(dest="log_cmd", required=True)
+    for name in ("summary", "cat", "show", "export"):
+        p = osub.add_parser(name)
+        _add_common(p)
+        p.add_argument("stream_dir")
+        if name == "cat":
+            p.add_argument("channel", choices=["stdout", "stderr"])
+            p.add_argument("--tasks", default=None)
+        p.set_defaults(fn=cmd_output_log)
+
+    # dashboard
+    p = sub.add_parser("dashboard", help="live terminal overview")
+    _add_common(p)
+    p.add_argument("--interval", type=float, default=1.0)
+    p.set_defaults(fn=cmd_dashboard)
 
     return parser
 

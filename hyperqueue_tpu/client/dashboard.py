@@ -1,0 +1,79 @@
+"""Live terminal dashboard.
+
+Reference: crates/hyperqueue/src/dashboard/ (ratatui TUI with cluster
+overview / worker detail / job screens fed by event replay + live stream).
+This implementation is a read-only ANSI terminal view over the same client
+ops + live event stream; screens cycle with the interval refresh.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+CSI = "\x1b["
+
+
+def _clear() -> str:
+    return CSI + "2J" + CSI + "H"
+
+
+def _bar(frac: float, width: int = 20) -> str:
+    filled = int(max(0.0, min(frac, 1.0)) * width)
+    return "[" + "#" * filled + "-" * (width - filled) + f"] {frac * 100:3.0f}%"
+
+
+def render(info: dict, workers: list[dict], jobs: list[dict],
+           events: list[dict]) -> str:
+    lines = []
+    lines.append(
+        f"HyperQueue-TPU server {info.get('server_uid', '')}  "
+        f"uptime {time.time() - info.get('started_at', time.time()):.0f}s  "
+        f"workers {info.get('n_workers', 0)}  jobs {info.get('n_jobs', 0)}"
+    )
+    lines.append("=" * 78)
+    lines.append("WORKERS")
+    if not workers:
+        lines.append("  (none connected)")
+    for w in workers[:16]:
+        res = " ".join(
+            f"{k}={v / 10_000:g}" for k, v in w.get("resources", {}).items()
+        )
+        lines.append(
+            f"  #{w['id']:<4} {w['hostname'][:24]:<24} group={w['group']:<10}"
+            f" running={w['n_running']:<4} {res}"
+        )
+    if len(workers) > 16:
+        lines.append(f"  ... and {len(workers) - 16} more")
+    lines.append("-" * 78)
+    lines.append("JOBS")
+    for j in sorted(jobs, key=lambda j: -j["id"])[:12]:
+        c = j["counters"]
+        total = j["n_tasks"] or 1
+        done = c["finished"] + c["failed"] + c["canceled"]
+        lines.append(
+            f"  #{j['id']:<4} {j['name'][:20]:<20} {j['status']:<9}"
+            f" {_bar(done / total)} run={c['running']} fail={c['failed']}"
+        )
+    lines.append("-" * 78)
+    lines.append("RECENT EVENTS")
+    for e in events[-8:]:
+        stamp = time.strftime("%H:%M:%S", time.localtime(e.get("time", 0)))
+        detail = {
+            k: v for k, v in e.items() if k not in ("time", "event")
+        }
+        lines.append(f"  {stamp} {e.get('event', '?'):<18} {detail}")
+    return _clear() + "\n".join(lines)
+
+
+def run_dashboard(server_dir, interval: float = 1.0) -> None:
+    from hyperqueue_tpu.client.connection import ClientSession
+
+    events: list[dict] = []
+    with ClientSession(server_dir) as session:
+        while True:
+            info = session.request({"op": "server_info"})
+            workers = session.request({"op": "worker_list"})["workers"]
+            jobs = session.request({"op": "job_list"})["jobs"]
+            print(render(info, workers, jobs, events), flush=True)
+            time.sleep(interval)

@@ -1,0 +1,131 @@
+// hqcore: native hot-path structures for the hyperqueue_tpu server.
+//
+// The reference implements its whole runtime in Rust; the equivalent hot
+// structures here are C++ behind a C ABI consumed via ctypes
+// (hyperqueue_tpu/utils/native.py). Currently:
+//
+//   * TaskQueue — per-request-class ready queue: priority-bucketed FIFO of
+//     packed u64 task ids with tombstone removal (mirrors
+//     hyperqueue_tpu/scheduler/queues.py, itself mirroring reference
+//     crates/tako/src/internal/scheduler/taskqueue.rs). At 1M ready tasks the
+//     queue operations (add/priority_sizes/take) bound the host side of the
+//     scheduling tick, which is why they get the native treatment first.
+//
+// Build: make -C hyperqueue_tpu/native   (produces libhqcore.so)
+
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <unordered_set>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using Priority = std::pair<int64_t, int64_t>;  // compared lexicographically
+
+struct TaskQueue {
+    // descending priority: std::map with reverse comparator
+    std::map<Priority, std::deque<uint64_t>, std::greater<Priority>> levels;
+    std::unordered_set<uint64_t> tombstones;
+    int64_t size = 0;
+
+    void compact_level(std::deque<uint64_t>& level) {
+        if (tombstones.empty()) return;
+        std::deque<uint64_t> kept;
+        for (uint64_t id : level) {
+            auto it = tombstones.find(id);
+            if (it != tombstones.end()) {
+                tombstones.erase(it);
+            } else {
+                kept.push_back(id);
+            }
+        }
+        level.swap(kept);
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hq_queue_new() { return new TaskQueue(); }
+
+void hq_queue_free(void* handle) { delete static_cast<TaskQueue*>(handle); }
+
+void hq_queue_add(void* handle, int64_t prio_user, int64_t prio_sched,
+                  uint64_t task_id) {
+    auto* q = static_cast<TaskQueue*>(handle);
+    q->levels[{prio_user, prio_sched}].push_back(task_id);
+    q->size += 1;
+}
+
+void hq_queue_remove(void* handle, uint64_t task_id) {
+    auto* q = static_cast<TaskQueue*>(handle);
+    q->tombstones.insert(task_id);
+    q->size -= 1;
+}
+
+int64_t hq_queue_len(void* handle) {
+    return static_cast<TaskQueue*>(handle)->size;
+}
+
+// Fill up to max_levels (priority_user, priority_sched, count) triples in
+// descending priority order, compacting tombstones on the way. Returns the
+// number of levels written.
+int64_t hq_queue_priority_sizes(void* handle, int64_t* out_prio_user,
+                                int64_t* out_prio_sched, int64_t* out_counts,
+                                int64_t max_levels) {
+    auto* q = static_cast<TaskQueue*>(handle);
+    int64_t n = 0;
+    for (auto it = q->levels.begin(); it != q->levels.end();) {
+        q->compact_level(it->second);
+        if (it->second.empty()) {
+            it = q->levels.erase(it);
+            continue;
+        }
+        if (n < max_levels) {
+            out_prio_user[n] = it->first.first;
+            out_prio_sched[n] = it->first.second;
+            out_counts[n] = static_cast<int64_t>(it->second.size());
+            ++n;
+        }
+        ++it;
+    }
+    return n;
+}
+
+// Pop up to `count` ids at the given priority level (FIFO). Returns the
+// number written to out_ids.
+int64_t hq_queue_take(void* handle, int64_t prio_user, int64_t prio_sched,
+                      int64_t count, uint64_t* out_ids) {
+    auto* q = static_cast<TaskQueue*>(handle);
+    auto it = q->levels.find({prio_user, prio_sched});
+    if (it == q->levels.end()) return 0;
+    q->compact_level(it->second);
+    int64_t n = 0;
+    while (!it->second.empty() && n < count) {
+        out_ids[n++] = it->second.front();
+        it->second.pop_front();
+    }
+    q->size -= n;
+    if (it->second.empty()) q->levels.erase(it);
+    return n;
+}
+
+// Drain every id (descending priority, FIFO within level) into out_ids
+// (caller sizes it via hq_queue_len). Used for debug dumps/restore.
+int64_t hq_queue_all(void* handle, uint64_t* out_ids, int64_t max) {
+    auto* q = static_cast<TaskQueue*>(handle);
+    int64_t n = 0;
+    for (auto& [prio, level] : q->levels) {
+        q->compact_level(level);
+        for (uint64_t id : level) {
+            if (n >= max) return n;
+            out_ids[n++] = id;
+        }
+    }
+    return n;
+}
+
+}  // extern "C"

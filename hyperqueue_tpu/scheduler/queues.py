@@ -90,7 +90,12 @@ class TaskQueue:
 
 
 class TaskQueues:
-    """rq-id -> TaskQueue, plus bookkeeping of total ready tasks."""
+    """rq-id -> TaskQueue, plus bookkeeping of total ready tasks.
+
+    Queues come from utils.native.make_task_queue: the C++ implementation
+    (native/hqcore.cpp) when available, else the Python TaskQueue above —
+    identical interfaces and semantics (tests/test_native.py pins parity).
+    """
 
     def __init__(self):
         self._queues: dict[int, TaskQueue] = {}
@@ -98,7 +103,9 @@ class TaskQueues:
     def queue(self, rq_id: int) -> TaskQueue:
         q = self._queues.get(rq_id)
         if q is None:
-            q = TaskQueue()
+            from hyperqueue_tpu.utils.native import make_task_queue
+
+            q = make_task_queue()
             self._queues[rq_id] = q
         return q
 
@@ -118,5 +125,5 @@ class TaskQueues:
 
     def sanity_check(self) -> None:
         for q in self._queues.values():
-            n = sum(len(q._compact_level(p)) for p in list(q._levels))
+            n = sum(count for _, count in q.priority_sizes())
             assert n == len(q), "queue length bookkeeping broken"

@@ -1,0 +1,142 @@
+"""ctypes loader for the native core (libhqcore.so).
+
+Builds lazily via make on first use if the shared library is missing; falls
+back silently to the pure-Python implementations when the toolchain is
+unavailable (the Python and native structures share their semantics and the
+test suite runs both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+LIB_PATH = NATIVE_DIR / "libhqcore.so"
+
+_lib = None
+_tried = False
+
+
+def load_native():
+    """Returns the ctypes lib or None."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("HQ_DISABLE_NATIVE"):
+        return None
+    if not LIB_PATH.exists():
+        try:
+            import fcntl
+
+            # concurrent processes (test server + workers) may race to build;
+            # serialize via flock and re-check afterwards
+            with open(NATIVE_DIR / ".build.lock", "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                if not LIB_PATH.exists():
+                    subprocess.run(
+                        ["make", "-C", str(NATIVE_DIR)],
+                        capture_output=True,
+                        timeout=120,
+                        check=True,
+                    )
+        except (OSError, subprocess.CalledProcessError,
+                subprocess.TimeoutExpired) as e:
+            logger.debug("native build unavailable: %s", e)
+            return None
+    try:
+        lib = ctypes.CDLL(str(LIB_PATH))
+    except OSError as e:
+        logger.debug("native load failed: %s", e)
+        return None
+
+    lib.hq_queue_new.restype = ctypes.c_void_p
+    lib.hq_queue_free.argtypes = [ctypes.c_void_p]
+    lib.hq_queue_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+    ]
+    lib.hq_queue_remove.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.hq_queue_len.argtypes = [ctypes.c_void_p]
+    lib.hq_queue_len.restype = ctypes.c_int64
+    lib.hq_queue_priority_sizes.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.hq_queue_priority_sizes.restype = ctypes.c_int64
+    lib.hq_queue_take.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.hq_queue_take.restype = ctypes.c_int64
+    lib.hq_queue_all.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ]
+    lib.hq_queue_all.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+class NativeTaskQueue:
+    """Same interface as scheduler.queues.TaskQueue, backed by C++."""
+
+    __slots__ = ("_lib", "_handle")
+
+    MAX_LEVELS = 4096
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.hq_queue_new())
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.hq_queue_free(self._handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        return self._lib.hq_queue_len(self._handle)
+
+    def add(self, priority, task_id: int) -> None:
+        self._lib.hq_queue_add(self._handle, priority[0], priority[1], task_id)
+
+    def remove(self, task_id: int) -> None:
+        self._lib.hq_queue_remove(self._handle, task_id)
+
+    def priority_sizes(self):
+        n = self.MAX_LEVELS
+        pu = (ctypes.c_int64 * n)()
+        ps = (ctypes.c_int64 * n)()
+        counts = (ctypes.c_int64 * n)()
+        got = self._lib.hq_queue_priority_sizes(self._handle, pu, ps, counts, n)
+        return [((pu[i], ps[i]), counts[i]) for i in range(got)]
+
+    def take(self, priority, count: int):
+        out = (ctypes.c_uint64 * count)()
+        got = self._lib.hq_queue_take(
+            self._handle, priority[0], priority[1], count, out
+        )
+        return [out[i] for i in range(got)]
+
+    def all_tasks(self):
+        n = len(self)
+        out = (ctypes.c_uint64 * max(n, 1))()
+        got = self._lib.hq_queue_all(self._handle, out, n)
+        return [out[i] for i in range(got)]
+
+
+def make_task_queue():
+    """Factory: native queue if available, else the Python TaskQueue."""
+    lib = load_native()
+    if lib is not None:
+        return NativeTaskQueue(lib)
+    from hyperqueue_tpu.scheduler.queues import TaskQueue
+
+    return TaskQueue()

@@ -25,11 +25,14 @@ class LaunchedTask:
     process: asyncio.subprocess.Process | None
     stdout_path: str | None
     stderr_path: str | None
+    pumps: tuple = ()  # stream-mode output pump tasks
 
     async def wait(self) -> tuple[int, str]:
         """Returns (exit_code, error_detail)."""
         if self.process is None:  # zero-worker mode
             return 0, ""
+        if self.pumps:
+            await asyncio.gather(*self.pumps, return_exceptions=True)
         code = await self.process.wait()
         detail = ""
         if code != 0 and self.stderr_path and os.path.exists(self.stderr_path):
@@ -58,6 +61,7 @@ async def launch_task(
     server_uid: str,
     worker_id: int,
     zero_worker: bool = False,
+    streamer=None,  # events.outputlog.StreamWriter when body["stream"] set
 ) -> LaunchedTask:
     """Spawn the task process described by a compute message.
 
@@ -122,7 +126,11 @@ async def launch_task(
         env["HQ_HOST_FILE"] = str(node_file)
         env["HQ_NUM_NODES"] = str(len(node_hostnames))
 
+    stream_mode = streamer is not None and body.get("stream")
+
     def open_stdio(key: str):
+        if stream_mode:
+            return asyncio.subprocess.PIPE, None
         spec = body.get(key)
         if spec == "none":
             return asyncio.subprocess.DEVNULL, None
@@ -154,6 +162,27 @@ async def launch_task(
     if stdin_data:
         process.stdin.write(stdin_data)
         process.stdin.write_eof()
+
+    pumps = ()
+    if stream_mode:
+        from hyperqueue_tpu.events.outputlog import STDERR, STDOUT
+
+        instance = task_msg.get("instance", 0)
+
+        async def pump(reader, channel):
+            while True:
+                chunk = await reader.read(64 * 1024)
+                if not chunk:
+                    break
+                streamer.write_chunk(task_id, instance, channel, chunk)
+
+        pumps = (
+            asyncio.create_task(pump(process.stdout, STDOUT)),
+            asyncio.create_task(pump(process.stderr, STDERR)),
+        )
     return LaunchedTask(
-        process=process, stdout_path=stdout_path, stderr_path=stderr_path
+        process=process,
+        stdout_path=stdout_path,
+        stderr_path=stderr_path,
+        pumps=pumps,
     )

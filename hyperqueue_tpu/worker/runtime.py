@@ -55,6 +55,7 @@ class WorkerRuntime:
         self.server_uid = ""
         self.running: dict[int, RunningTask] = {}
         self.blocked: list[dict] = []
+        self._streamers: dict[str, object] = {}  # stream dir -> StreamWriter
         self.last_task_time = time.monotonic()
         self.started_at = time.monotonic()
         self._conn: Connection | None = None
@@ -142,12 +143,24 @@ class WorkerRuntime:
         task_id = task_msg["id"]
         instance = task_msg.get("instance", 0)
         try:
+            streamer = None
+            stream_dir = (task_msg.get("body") or {}).get("stream")
+            if stream_dir:
+                streamer = self._streamers.get(stream_dir)
+                if streamer is None:
+                    from hyperqueue_tpu.events.outputlog import StreamWriter
+
+                    streamer = StreamWriter(
+                        stream_dir, self.worker_id, self.server_uid
+                    )
+                    self._streamers[stream_dir] = streamer
             launched = await launch_task(
                 task_msg,
                 allocation,
                 server_uid=self.server_uid,
                 worker_id=self.worker_id,
                 zero_worker=self.zero_worker,
+                streamer=streamer,
             )
             rt = self.running.get(task_id)
             if rt is not None:
@@ -156,6 +169,8 @@ class WorkerRuntime:
                 {"op": "task_running", "id": task_id, "instance": instance}
             )
             code, detail = await launched.wait()
+            if streamer is not None:
+                streamer.close_task(task_id, instance)
             if code == 0:
                 await self._send(
                     {"op": "task_finished", "id": task_id, "instance": instance}

@@ -1,0 +1,70 @@
+"""Native (C++) task queue: availability and parity with the Python queue."""
+
+import random
+
+import pytest
+
+from hyperqueue_tpu.scheduler.queues import TaskQueue
+from hyperqueue_tpu.utils.native import NativeTaskQueue, load_native
+
+
+@pytest.fixture
+def native_lib():
+    lib = load_native()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    return lib
+
+
+def test_native_builds_and_loads(native_lib):
+    q = NativeTaskQueue(native_lib)
+    assert len(q) == 0
+
+
+def test_native_basic_semantics(native_lib):
+    q = NativeTaskQueue(native_lib)
+    q.add((0, 0), 10)
+    q.add((5, 0), 11)
+    q.add((5, 0), 12)
+    q.add((0, -3), 13)
+    assert len(q) == 4
+    sizes = q.priority_sizes()
+    assert sizes == [((5, 0), 2), ((0, 0), 1), ((0, -3), 1)]
+    assert q.take((5, 0), 1) == [11]  # FIFO within level
+    q.remove(13)
+    assert len(q) == 2
+    assert q.priority_sizes() == [((5, 0), 1), ((0, 0), 1)]
+    assert q.take((5, 0), 5) == [12]
+    assert q.all_tasks() == [10]
+
+
+def test_native_python_parity_randomized(native_lib):
+    rng = random.Random(42)
+    for trial in range(10):
+        nq = NativeTaskQueue(native_lib)
+        pq = TaskQueue()
+        live = []
+        for step in range(300):
+            op = rng.random()
+            if op < 0.5 or not live:
+                prio = (rng.randint(-3, 3), rng.randint(-3, 3))
+                task_id = trial * 100000 + step
+                nq.add(prio, task_id)
+                pq.add(prio, task_id)
+                live.append((prio, task_id))
+            elif op < 0.7:
+                prio, task_id = live.pop(rng.randrange(len(live)))
+                nq.remove(task_id)
+                pq.remove(task_id)
+            else:
+                sizes = pq.priority_sizes()
+                if sizes:
+                    prio, count = sizes[rng.randrange(len(sizes))]
+                    k = rng.randint(1, count)
+                    got_n = nq.take(prio, k)
+                    got_p = pq.take(prio, k)
+                    assert got_n == got_p
+                    taken = set(got_n)
+                    live = [x for x in live if x[1] not in taken]
+            assert len(nq) == len(pq)
+            assert nq.priority_sizes() == pq.priority_sizes()

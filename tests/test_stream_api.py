@@ -1,0 +1,105 @@
+"""Output streaming + Python API tests.
+
+Reference: tests/test_stream.py (stream files, reader CLI) and tests/pyapi/
+(Client/Job/LocalCluster, function tasks).
+"""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_stream_roundtrip(env, tmp_path):
+    stream_dir = tmp_path / "stream"
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--array", "1-3", "--stream", str(stream_dir), "--wait",
+         "--", "bash", "-c", "echo out-$HQ_TASK_ID; echo err-$HQ_TASK_ID >&2"]
+    )
+    summary = json.loads(
+        env.command(
+            ["output-log", "summary", str(stream_dir), "--output-mode", "json"]
+        )
+    )
+    assert summary["tasks"] == 3
+    assert summary["closed_streams"] == 3
+    cat = env.command(["output-log", "cat", str(stream_dir), "stdout"])
+    assert sorted(cat.strip().splitlines()) == ["out-1", "out-2", "out-3"]
+    cat_err = env.command(
+        ["output-log", "cat", str(stream_dir), "stderr", "--tasks", "2"]
+    )
+    assert cat_err.strip() == "err-2"
+    export = env.command(["output-log", "export", str(stream_dir)])
+    records = [json.loads(line) for line in export.strip().splitlines()]
+    assert {r["channel"] for r in records} == {"stdout", "stderr"}
+
+
+def test_python_api_program_and_function(tmp_path):
+    import os
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from hyperqueue_tpu.api import Client, FailedJobsException, Job, LocalCluster
+
+    with LocalCluster(n_workers=1, cpus_per_worker=2,
+                      server_dir=str(tmp_path / "cluster")) as cluster:
+        with cluster.client() as client:
+            marker = tmp_path / "fn_ran.txt"
+
+            job = Job(name="api-job")
+            first = job.program(
+                ["bash", "-c", f"echo prog > {tmp_path}/prog.txt"]
+            )
+
+            def write_marker(path, content):
+                with open(path, "w") as f:
+                    f.write(content)
+                return 42
+
+            job.function(
+                write_marker,
+                args=(str(marker), "hello-from-fn"),
+                deps=[first],
+            )
+            job_id = client.submit(job)
+            client.wait_for_jobs([job_id])
+            assert (tmp_path / "prog.txt").read_text().strip() == "prog"
+            assert marker.read_text() == "hello-from-fn"
+
+            # failing function surfaces as FailedJobsException with traceback
+            bad = Job(name="api-bad")
+            def boom():
+                raise RuntimeError("deliberate failure")
+            bad.function(boom)
+            bad_id = client.submit(bad)
+            with pytest.raises(FailedJobsException) as excinfo:
+                client.wait_for_jobs([bad_id])
+            (task_errors,) = excinfo.value.failed.values()
+            assert "deliberate failure" in list(task_errors.values())[0]
+
+
+def test_dashboard_renders():
+    from hyperqueue_tpu.client.dashboard import render
+
+    out = render(
+        {"server_uid": "abc", "started_at": 0, "n_workers": 1, "n_jobs": 1},
+        [{"id": 1, "hostname": "node", "group": "default", "n_running": 2,
+          "resources": {"cpus": 40000}}],
+        [{"id": 1, "name": "j", "status": "running", "n_tasks": 4,
+          "counters": {"running": 2, "finished": 1, "failed": 0,
+                       "canceled": 0}}],
+        [{"time": 0, "event": "worker-connected", "id": 1}],
+    )
+    assert "WORKERS" in out and "JOBS" in out
+    assert "node" in out
