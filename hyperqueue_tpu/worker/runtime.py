@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from pathlib import Path
 
 from hyperqueue_tpu.server.worker import WorkerConfiguration
 from hyperqueue_tpu.transport.auth import (
@@ -61,6 +62,7 @@ class WorkerRuntime:
         self._conn: Connection | None = None
         self._send_lock = asyncio.Lock()
         self._stop = asyncio.Event()
+        self.localcomm = None
 
     async def _send(self, msg: dict) -> None:
         async with self._send_lock:
@@ -81,11 +83,20 @@ class WorkerRuntime:
         self.server_uid = registered.get("server_uid", "")
         logger.info("registered as worker %d", self.worker_id)
 
+        import tempfile
+
+        from hyperqueue_tpu.worker.localcomm import LocalCommListener
+
+        self.localcomm = LocalCommListener(self, Path(tempfile.gettempdir()))
+        await self.localcomm.start()
+
         tasks = [
             asyncio.create_task(self._message_loop()),
             asyncio.create_task(self._heartbeat_loop()),
             asyncio.create_task(self._limits_loop()),
         ]
+        if self.configuration.overview_interval_secs > 0:
+            tasks.append(asyncio.create_task(self._overview_loop()))
         stop_wait = asyncio.create_task(self._stop.wait())
         try:
             done, pending = await asyncio.wait(
@@ -104,7 +115,10 @@ class WorkerRuntime:
             for t in tasks + [stop_wait]:
                 t.cancel()
             for rt in self.running.values():
-                rt.launched.kill()
+                if rt.launched is not None:
+                    rt.launched.kill()
+            if self.localcomm is not None:
+                self.localcomm.close()
             if self._conn:
                 self._conn.close()
 
@@ -154,6 +168,10 @@ class WorkerRuntime:
                         stream_dir, self.worker_id, self.server_uid
                     )
                     self._streamers[stream_dir] = streamer
+            extra_env = {}
+            if self.localcomm is not None:
+                extra_env["HQ_LOCAL_SOCKET"] = self.localcomm.socket_path
+                extra_env["HQ_TOKEN"] = self.localcomm.register_task(task_id)
             launched = await launch_task(
                 task_msg,
                 allocation,
@@ -161,6 +179,7 @@ class WorkerRuntime:
                 worker_id=self.worker_id,
                 zero_worker=self.zero_worker,
                 streamer=streamer,
+                extra_env=extra_env,
             )
             rt = self.running.get(task_id)
             if rt is not None:
@@ -204,6 +223,8 @@ class WorkerRuntime:
                 pass
         finally:
             self.last_task_time = time.monotonic()
+            if self.localcomm is not None:
+                self.localcomm.unregister_task(task_id)
             rt = self.running.pop(task_id, None)
             if rt is not None and rt.allocation is not None:
                 self.allocator.release(rt.allocation)
@@ -222,6 +243,21 @@ class WorkerRuntime:
                 rt.launched.kill()
             else:
                 rt.future.cancel()
+
+    async def _overview_loop(self) -> None:
+        from hyperqueue_tpu.worker.hwmonitor import HwSampler
+
+        sampler = HwSampler()
+        interval = self.configuration.overview_interval_secs
+        while True:
+            await asyncio.sleep(interval)
+            await self._send(
+                {
+                    "op": "overview",
+                    "hw": sampler.sample(),
+                    "n_running": len(self.running),
+                }
+            )
 
     async def _heartbeat_loop(self) -> None:
         interval = max(self.configuration.heartbeat_secs, 0.5)

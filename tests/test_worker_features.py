@@ -1,0 +1,73 @@
+"""Worker feature e2e: notify, overview, worker info, debug dump, pinning
+env, task dirs (reference tests: test_cpus.py, test_task_cleanup.py, notify
+paths in tako localcomm tests)."""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_task_notify_reaches_event_stream(env, tmp_path):
+    journal = tmp_path / "j.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--wait", "--", "bash", "-c",
+         "python -m hyperqueue_tpu task notify 'progress 50%'"]
+    )
+    out = env.command(["journal", "stream", "--history"])
+    notifications = [
+        json.loads(line) for line in out.splitlines()
+        if json.loads(line)["event"] == "task-notify"
+    ]
+    assert notifications
+    assert notifications[0]["payload"] == "progress 50%"
+
+
+def test_worker_overview_and_info(env):
+    env.start_server()
+    env.start_worker("--overview-interval", "0.3")
+    env.wait_workers(1)
+
+    def has_overview():
+        info = json.loads(
+            env.command(["worker", "info", "1", "--output-mode", "json"])
+        )
+        return info.get("overview", {}).get("hw", {}).get("mem_total_bytes", 0) > 0
+
+    wait_until(has_overview, timeout=20, message="hardware overview arrived")
+
+
+def test_server_debug_dump(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    dump = json.loads(env.command(["server", "debug-dump"]))
+    assert dump["tasks"]["total"] == 1
+    assert dump["tasks"]["by_state"] == {"finished": 1}
+    assert len(dump["workers"]) == 1
+    assert "cpus" in dump["resources"]
+
+
+def test_pinning_env_and_task_dir(env):
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--cpus", "2", "--pin", "omp", "--task-dir", "--wait",
+         "--", "bash", "-c",
+         "echo places=$OMP_PLACES dir=$HQ_TASK_DIR"]
+    )
+    out = env.command(["job", "cat", "1", "stdout"]).strip()
+    assert "places={0},{1}" in out
+    assert ".hq-task-dir-1-0-" in out
