@@ -167,14 +167,24 @@ def cmd_worker_start(args) -> None:
         n_cpus=args.cpus,
         no_hyper_threading=args.no_hyper_threading,
     )
-    if args.resource:
-        from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+    if args.resource or args.coupling:
+        from hyperqueue_tpu.resources.descriptor import (
+            ResourceDescriptor,
+            ResourceDescriptorCoupling,
+        )
 
         items = {item.name: item for item in descriptor.items}
-        for spec in args.resource:
+        for spec in args.resource or []:
             item = parse_resource_definition(spec)
             items[item.name] = item
-        descriptor = ResourceDescriptor(items=tuple(items.values()))
+        coupling = None
+        if args.coupling:
+            coupling = ResourceDescriptorCoupling(
+                names=tuple(n.strip() for n in args.coupling.split(","))
+            )
+        descriptor = ResourceDescriptor(
+            items=tuple(items.values()), coupling=coupling
+        )
     descriptor.validate()
     time_limit = args.time_limit or 0.0
     if not time_limit and manager_info.remaining_secs:
@@ -534,6 +544,64 @@ def cmd_job_cat(args) -> None:
     sys.stdout.flush()
 
 
+def cmd_job_progress(args) -> None:
+    """Live progress display while jobs run (reference `hq job progress`)."""
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        while True:
+            jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+            parts = []
+            all_done = True
+            for j in jobs:
+                c = j["counters"]
+                done = c["finished"] + c["failed"] + c["canceled"]
+                total = j["n_tasks"] or 1
+                parts.append(
+                    f"job {j['id']}: {done}/{j['n_tasks']} "
+                    f"(run {c['running']}, fail {c['failed']})"
+                )
+                if done < j["n_tasks"] or j["status"] == "running":
+                    all_done = False
+            print("\r" + " | ".join(parts) + " " * 8, end="", flush=True)
+            if all_done:
+                print()
+                return
+            time.sleep(0.5)
+
+
+def cmd_doc(args) -> None:
+    docs_root = Path(__file__).resolve().parent.parent.parent / "docs"
+    topic = args.topic or "index"
+    for candidate in (
+        docs_root / f"{topic}.md",
+        docs_root / "jobs" / f"{topic}.md",
+        docs_root / "deployment" / f"{topic}.md",
+    ):
+        if candidate.exists():
+            print(candidate.read_text())
+            return
+    available = sorted(p.stem for p in docs_root.rglob("*.md"))
+    fail(f"unknown topic {topic!r}; available: {', '.join(available)}")
+
+
+def cmd_generate_completion(args) -> None:
+    """Emit a bash completion script for the hq CLI."""
+    parser = build_parser()
+    subs = [a for a in parser._actions
+            if isinstance(a, argparse._SubParsersAction)]
+    top = " ".join(subs[0].choices) if subs else ""
+    print(
+        f"""_hq_complete() {{
+  local cur=${{COMP_WORDS[COMP_CWORD]}}
+  if [ $COMP_CWORD -eq 1 ]; then
+    COMPREPLY=( $(compgen -W "{top}" -- "$cur") )
+  fi
+}}
+complete -F _hq_complete hq
+complete -F _hq_complete "python -m hyperqueue_tpu" 2>/dev/null || true"""
+    )
+
+
 def cmd_job_open(args) -> None:
     with _session(args) as session:
         response = session.request(
@@ -673,6 +741,22 @@ def cmd_journal_prune(args) -> None:
         f"journal pruned: kept {result['kept_records']} records "
         f"for live jobs {result['live_jobs']}"
     )
+
+
+def cmd_journal_report(args) -> None:
+    from hyperqueue_tpu.client.report import build_report
+
+    html_text = build_report(args.journal_file)
+    output = args.output or "hq-report.html"
+    with open(output, "w") as f:
+        f.write(html_text)
+    make_output(args.output_mode).message(f"report written to {output}")
+
+
+def cmd_journal_replay(args) -> None:
+    """Offline NDJSON replay (alias of export; reference `journal replay`
+    streams through a server — the journal format is identical)."""
+    cmd_journal_export(args)
 
 
 def cmd_journal_stream(args) -> None:
@@ -816,6 +900,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cpus", type=int, default=None)
     p.add_argument("--resource", action="append", default=None,
                    help='e.g. "gpus=[0,1]", "mem=sum(1024)", "x=range(1-5)"')
+    p.add_argument("--coupling", default=None,
+                   help='comma-separated group resources allocated together, '
+                        'e.g. "cpus,gpus"')
     p.add_argument("--group", default="default")
     p.add_argument("--no-hyper-threading", action="store_true")
     p.add_argument("--heartbeat", type=float, default=8.0)
@@ -892,6 +979,7 @@ def build_parser() -> argparse.ArgumentParser:
     for name, fn, extra in [
         ("info", cmd_job_info, ()),
         ("wait", cmd_job_wait, ()),
+        ("progress", cmd_job_progress, ()),
         ("cancel", cmd_job_cancel, ()),
         ("forget", cmd_job_forget, ()),
         ("close", cmd_job_close, ()),
@@ -961,6 +1049,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("journal_file")
     p.set_defaults(fn=cmd_journal_export)
+    p = josub.add_parser("replay", help="replay a journal file as NDJSON")
+    _add_common(p)
+    p.add_argument("journal_file")
+    p.set_defaults(fn=cmd_journal_replay)
+    p = josub.add_parser("report", help="static HTML analytics report")
+    _add_common(p)
+    p.add_argument("journal_file")
+    p.add_argument("--output", default=None)
+    p.set_defaults(fn=cmd_journal_report)
     p = josub.add_parser("flush")
     _add_common(p)
     p.set_defaults(fn=cmd_journal_flush)
@@ -1012,6 +1109,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("--interval", type=float, default=1.0)
     p.set_defaults(fn=cmd_dashboard)
+
+    # doc + completion
+    p = sub.add_parser("doc", help="show documentation topics")
+    _add_common(p)
+    p.add_argument("topic", nargs="?", default=None)
+    p.set_defaults(fn=cmd_doc)
+    p = sub.add_parser("generate-completion", help="bash completion script")
+    _add_common(p)
+    p.set_defaults(fn=cmd_generate_completion)
 
     return parser
 

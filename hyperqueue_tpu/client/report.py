@@ -1,0 +1,117 @@
+"""`hq journal report` — static HTML analytics from a journal file.
+
+Reference: crates/hyperqueue/src/client/commands/journal/report.rs (856 LoC
+HTML stats page). Generates a single self-contained HTML file: job table,
+task state totals, worker connect/disconnect timeline, throughput per minute.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+from collections import Counter, defaultdict
+from pathlib import Path
+
+from hyperqueue_tpu.events.journal import Journal
+
+
+def build_report(journal_path: str | Path) -> str:
+    jobs: dict[int, dict] = {}
+    task_states = Counter()
+    per_minute = Counter()
+    workers: list[tuple[float, str, str]] = []
+    first_ts = last_ts = None
+
+    for rec in Journal.read_all(Path(journal_path)):
+        ts = rec.get("time", 0.0)
+        if first_ts is None:
+            first_ts = ts
+        last_ts = ts
+        kind = rec.get("event", "")
+        job_id = rec.get("job")
+        if kind == "job-submitted":
+            desc = rec.get("desc") or {}
+            jobs[job_id] = {
+                "name": desc.get("name", "?"),
+                "n_tasks": rec.get("n_tasks", len(desc.get("tasks", []))),
+                "submitted": ts,
+                "completed": None,
+                "status": "running",
+            }
+        elif kind == "job-completed" and job_id in jobs:
+            jobs[job_id]["completed"] = ts
+            jobs[job_id]["status"] = rec.get("status", "finished")
+        elif kind.startswith("task-") and kind != "task-notify":
+            task_states[kind.removeprefix("task-")] += 1
+            if kind == "task-finished":
+                per_minute[int(ts // 60)] += 1
+        elif kind == "worker-connected":
+            workers.append((ts, "connect", str(rec.get("id"))))
+        elif kind == "worker-lost":
+            workers.append((ts, "lost", str(rec.get("id"))))
+
+    def fmt(ts):
+        return (
+            time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+            if ts
+            else "-"
+        )
+
+    rows = "".join(
+        f"<tr><td>{jid}</td><td>{html.escape(j['name'])}</td>"
+        f"<td>{j['n_tasks']}</td><td>{j['status']}</td>"
+        f"<td>{fmt(j['submitted'])}</td><td>{fmt(j['completed'])}</td>"
+        f"<td>{(j['completed'] - j['submitted']):.1f}s</td></tr>"
+        if j["completed"]
+        else f"<tr><td>{jid}</td><td>{html.escape(j['name'])}</td>"
+        f"<td>{j['n_tasks']}</td><td>{j['status']}</td>"
+        f"<td>{fmt(j['submitted'])}</td><td>-</td><td>-</td></tr>"
+        for jid, j in sorted(jobs.items())
+    )
+    state_rows = "".join(
+        f"<tr><td>{s}</td><td>{n}</td></tr>"
+        for s, n in task_states.most_common()
+    )
+    worker_rows = "".join(
+        f"<tr><td>{fmt(ts)}</td><td>{ev}</td><td>{wid}</td></tr>"
+        for ts, ev, wid in workers
+    )
+    minutes = sorted(per_minute)
+    throughput = (
+        json.dumps([[m * 60, per_minute[m]] for m in minutes])
+        if minutes
+        else "[]"
+    )
+    span = (last_ts - first_ts) if (first_ts and last_ts) else 0.0
+
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>HyperQueue-TPU report</title>
+<style>
+body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+table {{ border-collapse: collapse; margin: 1rem 0; }}
+td, th {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left; }}
+h2 {{ margin-top: 2rem; }}
+.bar {{ background: #4a7; display: inline-block; height: 12px; }}
+</style></head><body>
+<h1>HyperQueue-TPU journal report</h1>
+<p>{len(jobs)} job(s), {sum(task_states.values())} task events over
+{span:.0f}s ({html.escape(str(journal_path))})</p>
+<h2>Jobs</h2>
+<table><tr><th>id</th><th>name</th><th>tasks</th><th>status</th>
+<th>submitted</th><th>completed</th><th>makespan</th></tr>{rows}</table>
+<h2>Task events</h2>
+<table><tr><th>state</th><th>count</th></tr>{state_rows}</table>
+<h2>Workers</h2>
+<table><tr><th>time</th><th>event</th><th>worker</th></tr>{worker_rows}</table>
+<h2>Throughput (finished tasks per minute)</h2>
+<div id="chart"></div>
+<script>
+const data = {throughput};
+const max = Math.max(1, ...data.map(d => d[1]));
+document.getElementById("chart").innerHTML = data.map(d =>
+  `<div>${{new Date(d[0] * 1000).toLocaleTimeString()}} ` +
+  `<span class="bar" style="width:${{d[1] / max * 400}}px"></span> ${{d[1]}}</div>`
+).join("");
+</script>
+</body></html>"""

@@ -83,9 +83,24 @@ class _IndexPool:
             counts[self.group_of[label]] += 1
         return counts
 
-    def _ordered_free(self, policy: AllocationPolicy, n_units: int) -> list[str]:
-        """Free indices ordered so the first n_units match the policy."""
+    def _ordered_free(
+        self,
+        policy: AllocationPolicy,
+        n_units: int,
+        preferred_groups: set[int] | None = None,
+    ) -> list[str]:
+        """Free indices ordered so the first n_units match the policy.
+
+        preferred_groups (coupling, reference descriptor.rs:249-295 +
+        worker groups.rs): groups already used by coupled resources of the
+        same allocation sort first, so e.g. the claimed cpus land on the NUMA
+        node of the claimed gpu.
+        """
         counts = self._group_free_count()
+        if preferred_groups:
+            pref = lambda l: 0 if self.group_of[l] in preferred_groups else 1  # noqa: E731
+        else:
+            pref = lambda l: 0  # noqa: E731
         if policy in (AllocationPolicy.SCATTER,):
             # round-robin across groups
             by_group: dict[int, list[str]] = {}
@@ -103,16 +118,24 @@ class _IndexPool:
         ):
             # prefer groups with the FEWEST free indices (fill them up)
             return sorted(
-                self.free, key=lambda l: (counts[self.group_of[l]], self.group_of[l], l)
+                self.free,
+                key=lambda l: (pref(l), counts[self.group_of[l]],
+                               self.group_of[l], l),
             )
         # compact/default: prefer groups with the MOST free indices so the
         # allocation lands in as few groups as possible
         return sorted(
             self.free,
-            key=lambda l: (-counts[self.group_of[l]], self.group_of[l], l),
+            key=lambda l: (pref(l), -counts[self.group_of[l]],
+                           self.group_of[l], l),
         )
 
-    def allocate(self, amount: int, policy: AllocationPolicy) -> ResourceClaim | None:
+    def allocate(
+        self,
+        amount: int,
+        policy: AllocationPolicy,
+        preferred_groups: set[int] | None = None,
+    ) -> ResourceClaim | None:
         if policy is AllocationPolicy.ALL:
             if self.partial or not self.free:
                 return None
@@ -128,7 +151,7 @@ class _IndexPool:
             and not any(f >= fraction for f in self.partial.values())
         ):
             return None
-        ordered = self._ordered_free(policy, units)
+        ordered = self._ordered_free(policy, units, preferred_groups)
         if policy in (AllocationPolicy.FORCE_COMPACT,):
             # all units must come from the minimal number of groups
             counts = self._group_free_count()
@@ -218,6 +241,9 @@ class ResourceAllocator:
 
     def __init__(self, descriptor: ResourceDescriptor):
         self.pools: dict[str, _IndexPool | _SumPool] = {}
+        self.coupled: set[str] = set(
+            descriptor.coupling.names if descriptor.coupling else ()
+        )
         for item in descriptor.items:
             if item.kind is DescriptorKind.SUM:
                 self.pools[item.name] = _SumPool(item.sum_size)
@@ -225,20 +251,46 @@ class ResourceAllocator:
                 self.pools[item.name] = _IndexPool(item.index_groups())
 
     def try_allocate(self, entries: list[dict]) -> Allocation | None:
-        """entries: [{name, amount, policy}] from the compute message."""
+        """entries: [{name, amount, policy}] from the compute message.
+
+        Coupled resources (descriptor coupling) are allocated first and their
+        groups steer later coupled claims onto the same groups — the
+        lightweight equivalent of the reference's worker-side group MILP
+        (reference worker/resources/groups.rs:19-61).
+        """
         allocation = Allocation()
-        for entry in entries:
+        used_groups: set[int] = set()
+        # scarcest coupled resource first so it anchors the group choice
+        def order_key(entry):
+            if entry["name"] not in self.coupled:
+                return (1, 0)
+            pool = self.pools.get(entry["name"])
+            return (0, pool.total_free() if pool else 0)
+
+        for entry in sorted(entries, key=order_key):
             pool = self.pools.get(entry["name"])
             policy = AllocationPolicy.parse(entry.get("policy", "compact"))
             if pool is None:
                 self._rollback(allocation)
                 return None
-            claim = pool.allocate(int(entry["amount"]), policy)
+            coupled = entry["name"] in self.coupled
+            claim = pool.allocate(
+                int(entry["amount"]),
+                policy,
+                preferred_groups=used_groups if coupled else None,
+            ) if isinstance(pool, _IndexPool) else pool.allocate(
+                int(entry["amount"]), policy
+            )
             if claim is None:
                 self._rollback(allocation)
                 return None
             claim.resource = entry["name"]
             allocation.claims.append(claim)
+            if coupled and isinstance(pool, _IndexPool):
+                for label in claim.indices:
+                    used_groups.add(pool.group_of[label])
+                if claim.fraction_index is not None:
+                    used_groups.add(pool.group_of[claim.fraction_index])
         return allocation
 
     def _rollback(self, allocation: Allocation) -> None:

@@ -1,0 +1,84 @@
+"""Coupling allocator, DAG visualization, journal report, doc/completion."""
+
+import json
+
+from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT as U
+from hyperqueue_tpu.resources.descriptor import (
+    ResourceDescriptor,
+    ResourceDescriptorCoupling,
+    ResourceDescriptorItem,
+)
+from hyperqueue_tpu.worker.allocator import ResourceAllocator
+
+
+def test_coupled_allocation_aligns_groups():
+    # cpus and gpus both split into 2 NUMA groups; coupling declared
+    desc = ResourceDescriptor(
+        items=(
+            ResourceDescriptorItem.group_list(
+                "cpus", [["0", "1", "2", "3"], ["4", "5", "6", "7"]]
+            ),
+            ResourceDescriptorItem.group_list("gpus", [["0"], ["1"]]),
+        ),
+        coupling=ResourceDescriptorCoupling(names=("cpus", "gpus")),
+    )
+    alloc = ResourceAllocator(desc)
+    # occupy gpu group 0 so the next gpu comes from group 1
+    first = alloc.try_allocate([{"name": "gpus", "amount": U}])
+    a = alloc.try_allocate(
+        [{"name": "cpus", "amount": 2 * U}, {"name": "gpus", "amount": U}]
+    )
+    gpu_claim = a.claim_for("gpus")
+    cpu_claim = a.claim_for("cpus")
+    gpu_group = alloc.pools["gpus"].group_of[gpu_claim.indices[0]]
+    cpu_groups = {
+        alloc.pools["cpus"].group_of[i] for i in cpu_claim.indices
+    }
+    # the cpus follow the gpu onto its NUMA group
+    assert cpu_groups == {gpu_group}
+
+
+def test_visualization_dot_and_text():
+    from hyperqueue_tpu.api import Job
+    from hyperqueue_tpu.api.visualization import job_to_dot, job_to_text
+
+    job = Job(name="viz")
+    a = job.program(["echo", "a"])
+    job.program(["echo", "b"], deps=[a])
+    dot = job_to_dot(job)
+    assert "digraph" in dot and "t0 -> t1" in dot
+    text = job_to_text(job)
+    assert "[1] echo b <- [0]" in text
+
+
+def test_journal_report_html(tmp_path):
+    from hyperqueue_tpu.client.report import build_report
+    from hyperqueue_tpu.events.journal import Journal
+
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"time": 100.0, "event": "job-submitted", "job": 1,
+             "desc": {"name": "rep", "tasks": [{"id": 0}]}, "n_tasks": 1})
+    j.write({"time": 101.0, "event": "task-started", "job": 1, "task": 0})
+    j.write({"time": 105.0, "event": "task-finished", "job": 1, "task": 0})
+    j.write({"time": 105.0, "event": "job-completed", "job": 1,
+             "status": "finished"})
+    j.write({"time": 102.0, "event": "worker-connected", "id": 1})
+    j.close()
+    html_text = build_report(path)
+    assert "rep" in html_text
+    assert "finished" in html_text
+    assert "5.0s" in html_text  # makespan
+
+
+def test_doc_and_completion_cli(capsys):
+    from hyperqueue_tpu.client.cli import main
+
+    main(["doc", "scheduler"])
+    out = capsys.readouterr().out
+    assert "dense" in out.lower()
+    main(["generate-completion"])
+    out = capsys.readouterr().out
+    assert "_hq_complete" in out
+    assert "submit" in out
