@@ -74,3 +74,10 @@ class Core:
             for task_id in worker.assigned_tasks:
                 task = self.tasks.get(task_id)
                 assert task is not None and task.assigned_worker == worker.worker_id
+            for task_id in worker.prefilled_tasks:
+                task = self.tasks.get(task_id)
+                assert (
+                    task is not None
+                    and task.prefilled
+                    and task.assigned_worker == worker.worker_id
+                ), task_id

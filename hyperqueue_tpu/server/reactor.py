@@ -20,6 +20,12 @@ from hyperqueue_tpu.server.worker import Worker
 
 logger = logging.getLogger(__name__)
 
+# max tasks queued on a worker beyond its current capacity. The reference
+# uses 40 (scheduler/state.rs:4-21) with its own tick cadence; ours is sized
+# so that prefill_max / schedule_min_delay comfortably exceeds the reference's
+# per-worker throughput target (<0.1 ms/task per node on short tasks).
+PREFILL_MAX = 150
+
 
 class Comm(Protocol):
     def send_compute(self, worker_id: int, tasks: list[dict]) -> None: ...
@@ -94,6 +100,15 @@ def on_remove_worker(
     if worker is None:
         return
     events.on_worker_lost(worker_id, reason)
+    for task_id in list(worker.prefilled_tasks):
+        task = core.tasks.get(task_id)
+        if task is None or task.is_done:
+            continue
+        task.prefilled = False
+        task.assigned_worker = 0
+        task.increment_instance()
+        task.state = TaskState.WAITING
+        _make_ready(core, task)
     for task_id in list(worker.assigned_tasks):
         task = core.tasks.get(task_id)
         if task is None or task.is_done:
@@ -145,6 +160,16 @@ def on_task_running(
     if task is None or task.instance_id != instance_id or task.is_done:
         return  # stale message from a previous incarnation
     if task.state is TaskState.ASSIGNED:
+        if task.prefilled:
+            # the prefilled task actually started: account its resources now
+            worker = core.workers.get(task.assigned_worker)
+            if worker is not None:
+                worker.prefilled_tasks.discard(task_id)
+                worker.assign(
+                    task_id,
+                    core.variant_amounts(task.rq_id, task.assigned_variant),
+                )
+            task.prefilled = False
         task.state = TaskState.RUNNING
         workers = list(task.mn_workers) or [task.assigned_worker]
         events.on_task_started(task_id, instance_id, workers)
@@ -252,17 +277,25 @@ def _release_task_resources(core: Core, task: Task) -> None:
         task.mn_workers = ()
         return
     worker = core.workers.get(task.assigned_worker)
-    if worker is not None and task.task_id in worker.assigned_tasks:
-        amounts = core.variant_amounts(task.rq_id, task.assigned_variant)
-        worker.unassign(task.task_id, amounts)
+    if worker is not None:
+        if task.prefilled:
+            worker.prefilled_tasks.discard(task.task_id)
+            task.prefilled = False
+        elif task.task_id in worker.assigned_tasks:
+            amounts = core.variant_amounts(task.rq_id, task.assigned_variant)
+            worker.unassign(task.task_id, amounts)
     task.assigned_worker = 0
 
 
-def schedule(core: Core, comm: Comm, events: EventSink, model) -> int:
+def schedule(
+    core: Core, comm: Comm, events: EventSink, model, prefill: bool = True
+) -> int:
     """Run one scheduling tick: gangs first (host-side), then the dense solve.
 
-    Returns the number of tasks assigned. Reference scheduler/main.rs:48
-    (run_scheduling = batches -> solver -> mapping -> send).
+    Returns the number of tasks assigned (prefilled tasks not counted).
+    Reference scheduler/main.rs:48 (run_scheduling = batches -> solver ->
+    mapping -> send). `prefill=False` disables proactive filling (used by
+    deterministic scheduler tests).
     """
     assigned = 0
     per_worker_msgs: dict[int, list[dict]] = {}
@@ -320,6 +353,47 @@ def schedule(core: Core, comm: Comm, events: EventSink, model) -> int:
                 _compute_message(core, task, a.variant)
             )
             assigned += 1
+
+    # --- proactive prefilling: push extra top-priority tasks to busy
+    # workers so short tasks pipeline without a server round-trip per task
+    # (reference mapping.rs:159 process_proactive_filling, max 40/worker) ---
+    if prefill and core.queues.total_ready():
+        for worker in core.workers.values():
+            if worker.mn_task or (
+                not worker.assigned_tasks and not worker.prefilled_tasks
+            ):
+                continue
+            budget = PREFILL_MAX - len(worker.prefilled_tasks)
+            if budget <= 0:
+                continue
+            for rq_id, queue in core.queues.items():
+                if budget <= 0:
+                    break
+                rqv = core.rq_map.get_variants(rq_id)
+                variant = next(
+                    (
+                        i
+                        for i, v in enumerate(rqv.variants)
+                        if worker.resources.is_capable_of(v)
+                    ),
+                    None,
+                )
+                if variant is None:
+                    continue
+                for priority, count in queue.priority_sizes():
+                    if budget <= 0:
+                        break
+                    for task_id in queue.take(priority, min(count, budget)):
+                        task = core.tasks[task_id]
+                        task.state = TaskState.ASSIGNED
+                        task.assigned_worker = worker.worker_id
+                        task.assigned_variant = variant
+                        task.prefilled = True
+                        worker.prefilled_tasks.add(task_id)
+                        budget -= 1
+                        per_worker_msgs.setdefault(
+                            worker.worker_id, []
+                        ).append(_compute_message(core, task, variant))
 
     for worker_id, msgs in per_worker_msgs.items():
         comm.send_compute(worker_id, msgs)

@@ -44,6 +44,9 @@ class Task:
     crash_counter: int = 0
     assigned_worker: int = 0  # 0 = none
     assigned_variant: int = 0
+    # assigned beyond current capacity: queued on the worker, resources not
+    # yet accounted (reference mapping.rs proactive prefilling)
+    prefilled: bool = False
     # multi-node gangs: workers allocated to this task (root first)
     mn_workers: tuple[int, ...] = ()
 

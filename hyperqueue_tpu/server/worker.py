@@ -78,6 +78,9 @@ class Worker:
     free: list[int] = field(default_factory=list)
     nt_free: int = 0
     assigned_tasks: set[int] = field(default_factory=set)
+    # tasks pushed beyond current capacity (queue on the worker; no resource
+    # accounting until they report running)
+    prefilled_tasks: set[int] = field(default_factory=set)
     # multi-node: task id this worker is reserved for (0 = none)
     mn_task: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
@@ -129,4 +132,8 @@ class Worker:
         self.nt_free += 1
 
     def is_idle(self) -> bool:
-        return not self.assigned_tasks and self.mn_task == 0
+        return (
+            not self.assigned_tasks
+            and not self.prefilled_tasks
+            and self.mn_task == 0
+        )

@@ -1,0 +1,88 @@
+"""Proactive prefilling semantics (reference mapping.rs:159,
+state.rs:4-21)."""
+
+from hyperqueue_tpu.server import reactor
+from hyperqueue_tpu.server.task import TaskState
+
+from utils_env import TestEnv
+
+
+def test_prefill_queues_extra_tasks_on_busy_worker():
+    env = TestEnv()
+    w = env.worker(cpus=2)
+    ids = env.submit(n=10)
+    env.schedule(prefill=True)
+    worker = env.core.workers[w.worker_id]
+    # 2 run now (resource-accounted), the rest queue as prefilled
+    assert len(worker.assigned_tasks) == 2
+    assert len(worker.prefilled_tasks) == 8
+    assert all(
+        env.core.tasks[t].state is TaskState.ASSIGNED for t in ids
+    )
+    # prefilled tasks hold no resources yet
+    assert worker.free[0] == 0  # the 2 real assignments took both cpus
+    assert worker.nt_free == worker.resources.task_max_count() - 2
+
+
+def test_prefilled_task_accounts_resources_when_running():
+    env = TestEnv()
+    w = env.worker(cpus=1)
+    a, b = env.submit(n=2)
+    env.schedule(prefill=True)
+    worker = env.core.workers[w.worker_id]
+    assert worker.prefilled_tasks == {b}
+    env.start_all_assigned()  # both report running (worker-side ordering)
+    # b transitioned: resources now accounted, no longer prefilled
+    assert not worker.prefilled_tasks
+    assert worker.assigned_tasks == {a, b}
+    env.finish(a)
+    env.finish(b)
+    assert worker.free == worker.resources.amounts
+
+
+def test_prefill_cap_respected():
+    env = TestEnv()
+    env.worker(cpus=1)
+    n = reactor.PREFILL_MAX + 60
+    env.submit(n=n)
+    env.schedule(prefill=True)
+    worker = next(iter(env.core.workers.values()))
+    assert len(worker.prefilled_tasks) == reactor.PREFILL_MAX
+    # 1 assigned + PREFILL_MAX prefilled; the rest stay ready
+    assert env.core.queues.total_ready() == n - 1 - reactor.PREFILL_MAX
+
+
+def test_prefill_lost_worker_requeues_without_crash():
+    env = TestEnv()
+    w = env.worker(cpus=1)
+    a, b = env.submit(n=2)
+    env.schedule(prefill=True)
+    env.lose_worker(w.worker_id)
+    assert env.state(a) is TaskState.READY
+    assert env.state(b) is TaskState.READY
+    assert env.core.tasks[b].crash_counter == 0
+    assert not env.core.tasks[b].prefilled
+
+
+def test_prefill_only_capable_classes():
+    env = TestEnv()
+    w = env.worker(cpus=2)  # no gpus
+    env.submit(n=1)  # keeps the worker busy after schedule
+    gpu_ids = env.submit(n=5, rqv=env.rqv(gpus=1))
+    env.schedule(prefill=True)
+    worker = env.core.workers[w.worker_id]
+    assert not any(t in worker.prefilled_tasks for t in gpu_ids)
+    assert all(env.state(t) is TaskState.READY for t in gpu_ids)
+
+
+def test_prefill_cancel_releases_cleanly():
+    env = TestEnv()
+    w = env.worker(cpus=1)
+    a, b = env.submit(n=2)
+    env.schedule(prefill=True)
+    env.cancel([b])
+    worker = env.core.workers[w.worker_id]
+    assert not worker.prefilled_tasks
+    assert env.state(b) is TaskState.CANCELED
+    # cancel message went to the worker holding the prefilled task
+    assert any(b in tids for _, tids in env.comm.cancels)

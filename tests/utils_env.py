@@ -155,8 +155,12 @@ class TestEnv:
         return [t.task_id for t in tasks]
 
     # --- actions ------------------------------------------------------
-    def schedule(self) -> int:
-        n = reactor.schedule(self.core, self.comm, self.events, self.model)
+    def schedule(self, prefill: bool = False) -> int:
+        """Prefill defaults OFF for deterministic assignment assertions;
+        dedicated prefill tests pass True (the real server always prefills)."""
+        n = reactor.schedule(
+            self.core, self.comm, self.events, self.model, prefill=prefill
+        )
         self.core.sanity_check()
         return n
 
