@@ -72,6 +72,9 @@ class CommSender:
     def send_cancel(self, worker_id: int, task_ids: list[int]) -> None:
         self._send(worker_id, {"op": "cancel", "task_ids": task_ids})
 
+    def send_retract(self, worker_id: int, task_ids: list[int]) -> None:
+        self._send(worker_id, {"op": "retract", "task_ids": task_ids})
+
     def send_stop(self, worker_id: int) -> None:
         self._send(worker_id, {"op": "stop"})
 
@@ -382,6 +385,10 @@ class Server:
                     msg["id"],
                     msg["instance"],
                     msg.get("error", "task failed"),
+                )
+            elif op == "retract_response":
+                reactor.on_retract_response(
+                    self.core, self.comm, msg["id"], msg.get("ok", False)
                 )
             elif op == "heartbeat":
                 pass

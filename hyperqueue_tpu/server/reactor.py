@@ -30,6 +30,7 @@ PREFILL_MAX = 150
 class Comm(Protocol):
     def send_compute(self, worker_id: int, tasks: list[dict]) -> None: ...
     def send_cancel(self, worker_id: int, task_ids: list[int]) -> None: ...
+    def send_retract(self, worker_id: int, task_ids: list[int]) -> None: ...
     def ask_for_scheduling(self) -> None: ...
 
 
@@ -395,9 +396,54 @@ def schedule(
                             worker.worker_id, []
                         ).append(_compute_message(core, task, variant))
 
+    # --- retract: steal prefilled backlog back from loaded workers when
+    # other workers sit idle with nothing ready to schedule (reference
+    # RetractTasks / on_retract_response, reactor.rs:462) ---
+    if prefill and not core.queues.total_ready():
+        idle = [
+            w for w in core.workers.values()
+            if w.is_idle() and w.worker_id not in per_worker_msgs
+        ]
+        if idle:
+            donors = sorted(
+                (w for w in core.workers.values() if w.prefilled_tasks),
+                key=lambda w: -len(w.prefilled_tasks),
+            )
+            want = sum(w.nt_free for w in idle)
+            for donor in donors:
+                if want <= 0:
+                    break
+                take = min(len(donor.prefilled_tasks) // 2, want)
+                if take <= 0:
+                    continue
+                victims = sorted(donor.prefilled_tasks)[-take:]
+                comm.send_retract(donor.worker_id, victims)
+                want -= take
+
     for worker_id, msgs in per_worker_msgs.items():
         comm.send_compute(worker_id, msgs)
     return assigned
+
+
+def on_retract_response(
+    core: Core, comm: Comm, task_id: int, ok: bool
+) -> None:
+    """Worker answered a retract: ok=True means the task had not started and
+    is back in our hands; requeue it for the next tick."""
+    task = core.tasks.get(task_id)
+    if task is None or task.is_done or not task.prefilled:
+        return
+    if not ok:
+        return  # it started racing; task_running accounting takes over
+    worker = core.workers.get(task.assigned_worker)
+    if worker is not None:
+        worker.prefilled_tasks.discard(task_id)
+    task.prefilled = False
+    task.assigned_worker = 0
+    task.increment_instance()
+    task.state = TaskState.WAITING
+    _make_ready(core, task)
+    comm.ask_for_scheduling()
 
 
 def _compute_message(core: Core, task: Task, variant: int) -> dict:

@@ -156,6 +156,19 @@ class WorkerRuntime:
             elif op == "cancel":
                 for task_id in msg["task_ids"]:
                     self._cancel_task(task_id)
+            elif op == "retract":
+                for task_id in msg["task_ids"]:
+                    before = len(self.blocked)
+                    self.blocked = [
+                        t for t in self.blocked if t["id"] != task_id
+                    ]
+                    await self._send(
+                        {
+                            "op": "retract_response",
+                            "id": task_id,
+                            "ok": len(self.blocked) < before,
+                        }
+                    )
             elif op == "stop":
                 self._stop.set()
                 return

@@ -86,3 +86,34 @@ def test_prefill_cancel_releases_cleanly():
     assert env.state(b) is TaskState.CANCELED
     # cancel message went to the worker holding the prefilled task
     assert any(b in tids for _, tids in env.comm.cancels)
+
+
+def test_retract_rebalances_to_idle_worker():
+    env = TestEnv()
+    w1 = env.worker(cpus=1)
+    env.submit(n=20)
+    env.schedule(prefill=True)  # all 20 land on w1 (1 running, 19 prefilled)
+    w2 = env.worker(cpus=1)
+    env.schedule(prefill=True)
+    # nothing ready, w2 idle -> server retracts part of w1's backlog
+    assert env.comm.retracts
+    donor_id, victims = env.comm.retracts[0]
+    assert donor_id == w1.worker_id
+    assert len(victims) >= 1
+    # worker acks: tasks come back and get scheduled to w2
+    for t in victims:
+        reactor.on_retract_response(env.core, env.comm, t, True)
+    env.core.sanity_check()
+    env.schedule(prefill=True)
+    assert env.core.workers[w2.worker_id].assigned_tasks
+
+
+def test_retract_response_not_ok_keeps_task():
+    env = TestEnv()
+    w1 = env.worker(cpus=1)
+    a, b = env.submit(n=2)
+    env.schedule(prefill=True)
+    # worker says b already started: server keeps the prefilled bookkeeping
+    reactor.on_retract_response(env.core, env.comm, b, False)
+    assert env.core.tasks[b].prefilled
+    assert b in env.core.workers[w1.worker_id].prefilled_tasks

@@ -31,6 +31,7 @@ from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
 class TestComm:
     compute: list[tuple[int, list[dict]]] = field(default_factory=list)
     cancels: list[tuple[int, list[int]]] = field(default_factory=list)
+    retracts: list[tuple[int, list[int]]] = field(default_factory=list)
     scheduling_asked: int = 0
 
     def send_compute(self, worker_id, tasks):
@@ -38,6 +39,9 @@ class TestComm:
 
     def send_cancel(self, worker_id, task_ids):
         self.cancels.append((worker_id, task_ids))
+
+    def send_retract(self, worker_id, task_ids):
+        self.retracts.append((worker_id, task_ids))
 
     def ask_for_scheduling(self):
         self.scheduling_asked += 1
