@@ -222,6 +222,7 @@ class Server:
         self.autoalloc = AutoAllocService(self, instance_dir / "autoalloc")
         self.autoalloc.start()
         self._tasks.append(asyncio.create_task(self._scheduler_loop()))
+        self._tasks.append(asyncio.create_task(self._heartbeat_reaper()))
         logger.info(
             "server started uid=%s client=%s:%d worker=%s:%d",
             self.access.server_uid,
@@ -296,6 +297,32 @@ class Server:
                     n,
                     (time.perf_counter() - t0) * 1e3,
                 )
+
+    async def _heartbeat_reaper(self) -> None:
+        """Drop workers whose heartbeats stopped (beyond TCP-close detection;
+        reference server/rpc.rs per-connection heartbeat timeout)."""
+        while True:
+            await asyncio.sleep(2.0)
+            now = time.monotonic()
+            for worker in list(self.core.workers.values()):
+                limit = max(worker.configuration.heartbeat_secs * 4, 10.0)
+                if now - worker.last_heartbeat > limit:
+                    logger.warning(
+                        "worker %d heartbeat timeout (%.0fs)",
+                        worker.worker_id,
+                        now - worker.last_heartbeat,
+                    )
+                    conn = self._worker_conns.pop(worker.worker_id, None)
+                    if conn is not None:
+                        conn.close()
+                    self.comm.unregister_worker(worker.worker_id)
+                    reactor.on_remove_worker(
+                        self.core,
+                        self.comm,
+                        self.events,
+                        worker.worker_id,
+                        "heartbeat timeout",
+                    )
 
     # --- worker plane ---------------------------------------------------
     async def _handle_worker_conn(self, reader, writer) -> None:

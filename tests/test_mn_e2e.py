@@ -1,0 +1,50 @@
+"""Multi-node gang e2e (reference tests/test_job_mn.py: N local workers in
+one group emulate a multi-node allocation)."""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_multinode_gang_e2e(env):
+    env.start_server()
+    for _ in range(3):
+        env.start_worker(cpus=2)
+    env.wait_workers(3)
+    env.command(
+        ["submit", "--nodes", "2", "--wait", "--", "bash", "-c",
+         "echo nodes=$HQ_NUM_NODES lines=$(wc -l < $HQ_NODE_FILE)"]
+    )
+    out = env.command(["job", "cat", "1", "stdout"]).strip()
+    assert out == "nodes=2 lines=2"
+    # the gang released its workers afterwards
+    dump = json.loads(env.command(["server", "debug-dump"]))
+    assert all(w["mn_task"] == 0 for w in dump["workers"])
+
+
+def test_multinode_waits_for_group_capacity(env):
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--nodes", "2", "--", "true"])
+    # only 1 worker: task stays waiting; explain names the group shortfall
+    out = json.loads(
+        env.command(["task", "explain", "1", "0", "--output-mode", "json"])
+    )
+    assert out["state"] in ("ready", "waiting")
+    assert any(
+        "group" in reason
+        for w in out["workers"]
+        for v in w["variants"]
+        for reason in v["blocked"]
+    )
+    env.start_worker(cpus=2)
+    env.command(["job", "wait", "1"], timeout=40)
