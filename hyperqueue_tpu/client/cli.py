@@ -1197,7 +1197,11 @@ def main(argv: list[str] | None = None) -> None:
                     args.command = args.command[1:]
     try:
         args.fn(args)
-    except ClientError as e:
+    except (ClientError, ValueError) as e:
+        # user-input errors (bad amounts, selectors, resource defs) must be
+        # one clean line, not a traceback
+        fail(str(e))
+    except FileNotFoundError as e:
         fail(str(e))
     except KeyboardInterrupt:
         raise SystemExit(130)

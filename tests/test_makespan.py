@@ -19,16 +19,22 @@ from hyperqueue_tpu.server.task import TaskState
 from utils_env import TestEnv
 
 
-def simulate(env, durations, prefill=False):
-    """Event-driven simulation; returns makespan in simulated seconds."""
+def simulate(env, durations):
+    """Event-driven simulation; returns makespan in simulated seconds.
+
+    Prefill is deliberately off: the simulation models capacity-bounded
+    execution, and prefilled-beyond-capacity tasks would start impossibly
+    concurrently here.
+    """
     clock = 0.0
     running: list[tuple[float, int]] = []  # (finish_time, task_id)
-    started: set[int] = set()
+    n_started = 0
 
     def start_assigned():
+        nonlocal n_started
         for task in env.core.tasks.values():
-            if task.state is TaskState.ASSIGNED and task.task_id not in started:
-                started.add(task.task_id)
+            if task.state is TaskState.ASSIGNED:
+                n_started += 1
                 reactor.on_task_running(
                     env.core, env.events, task.task_id, task.instance_id
                 )
@@ -36,15 +42,18 @@ def simulate(env, durations, prefill=False):
                     running, (clock + durations[task.task_id], task.task_id)
                 )
 
-    env.schedule(prefill=prefill)
+    env.schedule()
     start_assigned()
     while running:
         clock, task_id = heapq.heappop(running)
-        reactor.on_task_finished(
-            env.core, env.comm, env.events, task_id, env.core.tasks[task_id].instance_id
-        )
-        env.schedule(prefill=prefill)
+        env.finish(task_id)
+        env.schedule()
         start_assigned()
+    # a scheduler that strands tasks must fail loudly, not produce a small
+    # vacuous makespan
+    assert n_started == len(durations), (
+        f"only {n_started}/{len(durations)} tasks ever ran"
+    )
     return clock
 
 
