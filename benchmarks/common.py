@@ -1,0 +1,103 @@
+"""Shared harness for benchmark experiments.
+
+Reference: benchmarks/src/ — a framework spawning server/worker processes and
+recording results. Each experiment here prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class Cluster:
+    def __init__(self, n_workers=1, cpus=4, zero_worker=True, extra_server=(),
+                 extra_worker=()):
+        self.dir = Path(tempfile.mkdtemp(prefix="hq-bench-"))
+        self.env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}",
+            "HQ_SERVER_DIR": str(self.dir / "sd"),
+        }
+        self.procs = []
+        self._spawn("server", ["server", "start", *extra_server])
+        deadline = time.time() + 30
+        access = self.dir / "sd" / "hq-current" / "access.json"
+        while not access.exists():
+            if time.time() > deadline:
+                raise TimeoutError("server did not start")
+            time.sleep(0.05)
+        worker_args = ["worker", "start", "--cpus", str(cpus), *extra_worker]
+        if zero_worker:
+            worker_args.append("--zero-worker")
+        for i in range(n_workers):
+            self._spawn(f"worker{i}", worker_args)
+        time.sleep(2.5)
+
+    def _spawn(self, name, args):
+        self.procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "hyperqueue_tpu", *args],
+                env=self.env,
+                cwd=self.dir,
+                stdout=open(self.dir / f"{name}.log", "wb"),
+                stderr=subprocess.STDOUT,
+            )
+        )
+
+    def hq(self, args, timeout=600):
+        result = subprocess.run(
+            [sys.executable, "-m", "hyperqueue_tpu", *args],
+            env=self.env,
+            cwd=self.dir,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if result.returncode != 0:
+            raise RuntimeError(f"hq {args} failed: {result.stdout}\n{result.stderr}")
+        return result.stdout
+
+    def close(self):
+        for p in reversed(self.procs):
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def measure_submit_wait(cluster, n_tasks, calibrate=True, extra=()):
+    """Returns (wall_seconds, marginal_per_task_ms)."""
+    cal = 0.0
+    if calibrate:
+        t0 = time.perf_counter()
+        cluster.hq(["submit", "--wait", *extra, "--", "true"])
+        cal = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cluster.hq(
+        ["submit", "--array", f"1-{n_tasks}", "--wait", *extra, "--", "true"]
+    )
+    wall = time.perf_counter() - t0
+    per_task = (wall - cal) / max(n_tasks - 1, 1) * 1000
+    return wall, per_task
+
+
+def emit(record: dict) -> None:
+    print(json.dumps(record), flush=True)

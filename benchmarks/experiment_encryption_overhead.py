@@ -1,0 +1,52 @@
+"""Encryption overhead: identical workload with and without the
+authenticated-encryption planes.
+
+Reference: benchmarks/experiment-encryption-overhead.py.
+"""
+
+import time
+
+from common import Cluster, emit
+
+N = 30_000
+REPEATS = 3
+
+
+def run(disable: bool) -> float:
+    """Best-of-repeats throughput (tasks/s) to squeeze out startup noise."""
+    extra = (
+        ["--disable-client-authentication", "--disable-worker-authentication"]
+        if disable
+        else []
+    )
+    with Cluster(n_workers=1, cpus=4, zero_worker=True,
+                 extra_server=extra) as cluster:
+        cluster.hq(["submit", "--array", "1-100", "--wait", "--", "true"])
+        best = 0.0
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            cluster.hq(
+                ["submit", "--array", f"1-{N}", "--wait", "--", "true"]
+            )
+            best = max(best, N / (time.perf_counter() - t0))
+        return best
+
+
+def main():
+    encrypted = run(disable=False)
+    plaintext = run(disable=True)
+    emit(
+        {
+            "experiment": "encryption-overhead",
+            "n_tasks": N,
+            "encrypted_tasks_per_s": round(encrypted, 1),
+            "plaintext_tasks_per_s": round(plaintext, 1),
+            "overhead_percent": round(
+                (plaintext - encrypted) / max(plaintext, 1e-9) * 100, 1
+            ),
+        }
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Per-task overhead: N zero-worker tasks through the full stack.
+
+Reference: benchmarks/experiment-per-task-overhead.py (10k-1M sleep-0 tasks,
+zero-worker build). Target: < 0.1 ms marginal overhead per task.
+"""
+
+import sys
+
+from common import Cluster, emit, measure_submit_wait
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    n_workers = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    with Cluster(n_workers=n_workers, cpus=4, zero_worker=True) as cluster:
+        wall, per_task = measure_submit_wait(cluster, n_tasks)
+        emit(
+            {
+                "experiment": "per-task-overhead",
+                "n_tasks": n_tasks,
+                "n_workers": n_workers,
+                "wall_s": round(wall, 3),
+                "per_task_ms": round(per_task, 4),
+                "reference_claim_ms": 0.1,
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

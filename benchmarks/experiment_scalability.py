@@ -1,0 +1,29 @@
+"""Strong scalability: fixed workload over 1..N workers.
+
+Reference: benchmarks/experiment-scalability.py (fixed makespan workload,
+task durations x worker counts).
+"""
+
+import sys
+
+from common import Cluster, emit, measure_submit_wait
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    for n_workers in (1, 2, 4):
+        with Cluster(n_workers=n_workers, cpus=4, zero_worker=True) as cluster:
+            wall, per_task = measure_submit_wait(cluster, n_tasks)
+            emit(
+                {
+                    "experiment": "scalability",
+                    "n_tasks": n_tasks,
+                    "n_workers": n_workers,
+                    "wall_s": round(wall, 3),
+                    "tasks_per_s": round(n_tasks / wall, 1),
+                }
+            )
+
+
+if __name__ == "__main__":
+    main()
