@@ -385,36 +385,32 @@ def cmd_submit(args) -> None:
             fail("--from-json expects a JSON array")
         entry_values = [json.dumps(v) for v in data]
 
-    tasks = []
-    if entry_values is not None:
-        ids = task_ids or list(range(len(entry_values)))
-        if len(ids) != len(entry_values):
-            fail("--array size does not match number of entries")
-        for tid, entry in zip(ids, entry_values):
-            body = dict(body_base)
-            body["entry"] = entry
-            tasks.append(
-                {"id": tid, "body": body, "request": request,
-                 "priority": args.priority, "crash_limit": args.crash_limit}
-            )
-    elif task_ids is not None:
-        for tid in task_ids:
-            tasks.append(
-                {"id": tid, "body": dict(body_base), "request": request,
-                 "priority": args.priority, "crash_limit": args.crash_limit}
-            )
-    else:
-        tasks.append(
-            {"id": 0, "body": dict(body_base), "request": request,
-             "priority": args.priority, "crash_limit": args.crash_limit}
-        )
-
+    # arrays go compressed: one shared body/request + ids (+ entries) — a
+    # million-task array must not serialize a million bodies
     job_desc = {
         "name": args.name or Path(args.command[0]).name,
         "submit_dir": submit_dir,
         "max_fails": args.max_fails,
-        "tasks": tasks,
     }
+    if entry_values is not None:
+        ids = task_ids or list(range(len(entry_values)))
+        if len(ids) != len(entry_values):
+            fail("--array size does not match number of entries")
+        job_desc["array"] = {
+            "ids": ids, "entries": entry_values, "body": body_base,
+            "request": request, "priority": args.priority,
+            "crash_limit": args.crash_limit,
+        }
+    elif task_ids is not None:
+        job_desc["array"] = {
+            "ids": task_ids, "body": body_base, "request": request,
+            "priority": args.priority, "crash_limit": args.crash_limit,
+        }
+    else:
+        job_desc["tasks"] = [
+            {"id": 0, "body": body_base, "request": request,
+             "priority": args.priority, "crash_limit": args.crash_limit}
+        ]
     if args.job is not None:
         job_desc["job_id"] = args.job
 

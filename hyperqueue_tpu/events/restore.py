@@ -14,7 +14,7 @@ import logging
 from hyperqueue_tpu.events.journal import Journal
 from hyperqueue_tpu.ids import make_task_id
 from hyperqueue_tpu.server import reactor
-from hyperqueue_tpu.server.protocol import rqv_from_wire
+from hyperqueue_tpu.server.protocol import expand_desc_tasks, rqv_from_wire
 from hyperqueue_tpu.server.task import Task
 
 logger = logging.getLogger("hq.restore")
@@ -45,9 +45,10 @@ def restore_from_journal(server) -> None:
                     is_open=desc.get("open", False),
                     job_id=job_id,
                 )
-            for t in desc.get("tasks", []):
+            expanded = expand_desc_tasks(desc)
+            for t in expanded:
                 server.jobs.attach_task(job, t.get("id", 0), t)
-            job_descs.setdefault(job_id, []).extend(desc.get("tasks", []))
+            job_descs.setdefault(job_id, []).extend(expanded)
         elif kind == "job-opened":
             if job_id not in server.jobs.jobs:
                 server.jobs.create_job(

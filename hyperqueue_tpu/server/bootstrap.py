@@ -22,7 +22,7 @@ from hyperqueue_tpu.ids import task_id_job, task_id_task, make_task_id
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
 from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.core import Core
-from hyperqueue_tpu.server.jobs import JobManager
+from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
 from hyperqueue_tpu.server.protocol import rqv_from_wire
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
@@ -257,6 +257,8 @@ class Server:
 
     # --- events out ----------------------------------------------------
     def emit_event(self, kind: str, payload: dict) -> None:
+        if self.journal is None and not self._event_listeners:
+            return  # nobody consumes events; skip record construction
         record = {"time": time.time(), "event": kind, **payload}
         if self.journal is not None:
             self.journal.write(record)
@@ -525,9 +527,50 @@ class Server:
         """Convert a submit description into core tasks.
 
         Reference: server/client/submit.rs build_tasks_array/build_tasks_graph.
+        Arrays arrive in compressed form — ONE shared body/request plus ids
+        (and optional per-task entries) — mirroring the reference's
+        JobTaskDescription::Array and the shared/separate wire split
+        (messages/worker.rs:28-54); a million-task array must not ship a
+        million copies of its body.
         """
         new_tasks: list[Task] = []
         used = set(job.tasks)
+        array = job_desc.get("array")
+        if array:
+            rqv = rqv_from_wire(
+                array.get("request") or {}, self.core.resource_map
+            )
+            rq_id = self.core.intern_rqv(rqv)
+            shared_body = array.get("body", {})
+            entries = array.get("entries")
+            priority = int(array.get("priority", 0))
+            crash_limit = int(array.get("crash_limit", 5))
+            job.task_descriptions["__array__"] = {
+                "body": shared_body,
+                "request": array.get("request") or {},
+                "priority": priority,
+                "crash_limit": crash_limit,
+            }
+            for i, job_task_id in enumerate(array["ids"]):
+                if job_task_id in used:
+                    raise ValueError(f"duplicate task id {job_task_id}")
+                used.add(job_task_id)
+                body = shared_body
+                if entries is not None:
+                    body = dict(shared_body)
+                    body["entry"] = entries[i]
+                job.tasks[job_task_id] = JobTaskInfo(job_task_id=job_task_id)
+                task_id = make_task_id(job.job_id, job_task_id)
+                new_tasks.append(
+                    Task(
+                        task_id=task_id,
+                        rq_id=rq_id,
+                        priority=(priority, -job.job_id),
+                        body=body,
+                        crash_limit=crash_limit,
+                    )
+                )
+            return new_tasks
         for t in job_desc.get("tasks", []):
             job_task_id = t.get("id")
             if job_task_id is None:

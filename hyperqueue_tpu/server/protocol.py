@@ -17,6 +17,34 @@ from hyperqueue_tpu.resources.request import (
 )
 
 
+def expand_desc_tasks(job_desc: dict) -> list[dict]:
+    """Expand a submit description into per-task dicts (array or graph form).
+
+    Used where per-task iteration is needed anyway (journal restore, detail
+    queries); the live submit path keeps the compressed array form.
+    """
+    array = job_desc.get("array")
+    if not array:
+        return list(job_desc.get("tasks", []))
+    out = []
+    entries = array.get("entries")
+    for i, task_id in enumerate(array["ids"]):
+        body = array.get("body", {})
+        if entries is not None:
+            body = dict(body)
+            body["entry"] = entries[i]
+        out.append(
+            {
+                "id": task_id,
+                "body": body,
+                "request": array.get("request") or {},
+                "priority": array.get("priority", 0),
+                "crash_limit": array.get("crash_limit", 5),
+            }
+        )
+    return out
+
+
 def rqv_to_wire(rqv: ResourceRequestVariants, resource_map: ResourceIdMap) -> dict:
     return {
         "variants": [

@@ -446,22 +446,33 @@ def on_retract_response(
     comm.ask_for_scheduling()
 
 
+_entries_cache: dict[tuple[int, int, int], tuple[list, int]] = {}
+
+
 def _compute_message(core: Core, task: Task, variant: int) -> dict:
-    rqv = core.rq_map.get_variants(task.rq_id)
-    request = rqv.variants[variant]
-    entries = [
-        {
-            "name": core.resource_map.name_of(e.resource_id),
-            "amount": e.amount,
-            "policy": e.policy.value,
-        }
-        for e in request.entries
-    ]
+    # entries/n_nodes depend only on (rq_map identity, rq_id, variant):
+    # cache them — at 100k-task arrays this is per-task hot path
+    key = (id(core.rq_map), task.rq_id, variant)
+    cached = _entries_cache.get(key)
+    if cached is None:
+        rqv = core.rq_map.get_variants(task.rq_id)
+        request = rqv.variants[variant]
+        entries = [
+            {
+                "name": core.resource_map.name_of(e.resource_id),
+                "amount": e.amount,
+                "policy": e.policy.value,
+            }
+            for e in request.entries
+        ]
+        cached = (entries, request.n_nodes)
+        _entries_cache[key] = cached
+    entries, n_nodes = cached
     return {
         "id": task.task_id,
         "instance": task.instance_id,
         "body": task.body,
         "entries": entries,
-        "n_nodes": request.n_nodes,
+        "n_nodes": n_nodes,
         "priority": list(task.priority),
     }
