@@ -101,6 +101,8 @@ def cmd_server_start(args) -> None:
 
     from hyperqueue_tpu.server.bootstrap import Server
 
+    profile_out = os.environ.get("HQ_PROFILE")
+
     async def go():
         server = Server(
             server_dir=_server_dir(args),
@@ -122,7 +124,13 @@ def cmd_server_start(args) -> None:
         )
         await server.run_until_stopped()
 
-    asyncio.run(go())
+    if profile_out:
+        import cProfile
+
+        cProfile.runctx("asyncio.run(go())", globals(), locals(),
+                        filename=profile_out + ".server")
+    else:
+        asyncio.run(go())
 
 
 def cmd_server_stop(args) -> None:
@@ -202,15 +210,22 @@ def cmd_worker_start(args) -> None:
         manager_job_id=manager_info.job_id,
         alloc_id=os.environ.get("HQ_ALLOC_ID", ""),
     )
-    asyncio.run(
-        run_worker(
-            access.host,
-            access.worker_port,
-            access.worker_key_bytes(),
-            config,
-            zero_worker=args.zero_worker,
-        )
+    profile_out = os.environ.get("HQ_PROFILE")
+    coro_args = (
+        access.host,
+        access.worker_port,
+        access.worker_key_bytes(),
+        config,
     )
+    if profile_out:
+        import cProfile
+
+        cProfile.runctx(
+            "asyncio.run(run_worker(*coro_args, zero_worker=args.zero_worker))",
+            globals(), locals(), filename=profile_out + ".worker",
+        )
+    else:
+        asyncio.run(run_worker(*coro_args, zero_worker=args.zero_worker))
 
 
 def cmd_worker_deploy_ssh(args) -> None:
