@@ -317,6 +317,47 @@ def cmd_task_notify(args) -> None:
     notify_from_task(args.payload or "")
 
 
+def cmd_worker_address(args) -> None:
+    with _session(args) as session:
+        worker = session.request(
+            {"op": "worker_info", "worker_id": args.worker_id}
+        )["worker"]
+    make_output(args.output_mode).value(worker["hostname"])
+
+
+def cmd_worker_wait(args) -> None:
+    """Block until N workers are connected (reference `hq worker wait`)."""
+    deadline = time.time() + args.timeout
+    with _session(args) as session:
+        while True:
+            workers = session.request({"op": "worker_list"})["workers"]
+            if len(workers) >= args.count:
+                make_output(args.output_mode).message(
+                    f"{len(workers)} worker(s) connected"
+                )
+                return
+            if time.time() > deadline:
+                fail(
+                    f"timed out: {len(workers)}/{args.count} workers connected"
+                )
+            time.sleep(0.25)
+
+
+def cmd_server_wait(args) -> None:
+    """Block until a server is reachable in the server dir."""
+    deadline = time.time() + args.timeout
+    while True:
+        try:
+            with ClientSession(_server_dir(args)) as session:
+                session.request({"op": "server_info"})
+            make_output(args.output_mode).message("server is running")
+            return
+        except (FileNotFoundError, ClientError, ConnectionError, OSError):
+            if time.time() > deadline:
+                fail("timed out waiting for the server")
+            time.sleep(0.25)
+
+
 def cmd_worker_stop(args) -> None:
     with _session(args) as session:
         ids = parse_selector(args.selector)
@@ -895,6 +936,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = ssub.add_parser("debug-dump", help="full server state as JSON")
     _add_common(p)
     p.set_defaults(fn=cmd_server_debug_dump)
+    p = ssub.add_parser("wait", help="wait until the server is reachable")
+    _add_common(p)
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_server_wait)
     p = ssub.add_parser("generate-access")
     _add_common(p)
     p.add_argument("access_file")
@@ -940,6 +985,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("worker_id", type=int)
     p.set_defaults(fn=cmd_worker_info)
+    p = wsub.add_parser("address")
+    _add_common(p)
+    p.add_argument("worker_id", type=int)
+    p.set_defaults(fn=cmd_worker_address)
+    p = wsub.add_parser("wait", help="wait until N workers are connected")
+    _add_common(p)
+    p.add_argument("count", type=int)
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_worker_wait)
     p = wsub.add_parser("deploy-ssh", help="start workers on hosts via ssh")
     _add_common(p)
     p.add_argument("hostfile", help="file with one hostname per line")
