@@ -13,7 +13,8 @@ import logging
 from typing import Protocol
 
 from hyperqueue_tpu.resources.request import AllocationPolicy
-from hyperqueue_tpu.scheduler.tick import run_tick
+from hyperqueue_tpu.scheduler.queues import Priority as Priority_t
+from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
@@ -359,18 +360,39 @@ def schedule(
     # workers so short tasks pipeline without a server round-trip per task
     # (reference mapping.rs:159 process_proactive_filling, max 40/worker) ---
     if prefill and core.queues.total_ready():
-        for worker in core.workers.values():
-            if worker.mn_task or (
-                not worker.assigned_tasks and not worker.prefilled_tasks
-            ):
-                continue
-            budget = PREFILL_MAX - len(worker.prefilled_tasks)
-            if budget <= 0:
-                continue
-            for rq_id, queue in core.queues.items():
-                if budget <= 0:
+        budgets = {
+            w.worker_id: PREFILL_MAX - len(w.prefilled_tasks)
+            for w in core.workers.values()
+            if not w.mn_task
+            and (w.assigned_tasks or w.prefilled_tasks)
+            and len(w.prefilled_tasks) < PREFILL_MAX
+        }
+        # starvation guard (reference reservation vars, solver.rs:479-518):
+        # each request class with leftover ready tasks reserves ONE capable
+        # worker where strictly-lower-priority tasks may not prefill, so a
+        # big task eventually sees a fully drained worker instead of losing
+        # every race against streams of small tasks.
+        reservations: dict[int, Priority_t] = {}
+        for batch in create_batches(core.queues):
+            rqv = core.rq_map.get_variants(batch.rq_id)
+            for w in sorted(core.workers.values(), key=lambda w: w.worker_id):
+                if w.mn_task or w.worker_id in reservations:
+                    continue
+                if w.resources.is_capable_of_rqv(rqv):
+                    reservations[w.worker_id] = batch.priority
                     break
-                rqv = core.rq_map.get_variants(rq_id)
+        # prefill in GLOBAL priority order (batches are priority-sorted), so
+        # high-priority classes claim worker budgets first
+        for batch in create_batches(core.queues):
+            queue = core.queues.queue(batch.rq_id)
+            rqv = core.rq_map.get_variants(batch.rq_id)
+            for worker in core.workers.values():
+                budget = budgets.get(worker.worker_id, 0)
+                if budget <= 0:
+                    continue
+                blocking = reservations.get(worker.worker_id)
+                if blocking is not None and batch.priority < blocking:
+                    continue
                 variant = next(
                     (
                         i
@@ -381,20 +403,17 @@ def schedule(
                 )
                 if variant is None:
                     continue
-                for priority, count in queue.priority_sizes():
-                    if budget <= 0:
-                        break
-                    for task_id in queue.take(priority, min(count, budget)):
-                        task = core.tasks[task_id]
-                        task.state = TaskState.ASSIGNED
-                        task.assigned_worker = worker.worker_id
-                        task.assigned_variant = variant
-                        task.prefilled = True
-                        worker.prefilled_tasks.add(task_id)
-                        budget -= 1
-                        per_worker_msgs.setdefault(
-                            worker.worker_id, []
-                        ).append(_compute_message(core, task, variant))
+                for task_id in queue.take(batch.priority, budget):
+                    task = core.tasks[task_id]
+                    task.state = TaskState.ASSIGNED
+                    task.assigned_worker = worker.worker_id
+                    task.assigned_variant = variant
+                    task.prefilled = True
+                    worker.prefilled_tasks.add(task_id)
+                    budgets[worker.worker_id] -= 1
+                    per_worker_msgs.setdefault(
+                        worker.worker_id, []
+                    ).append(_compute_message(core, task, variant))
 
     # --- retract: steal prefilled backlog back from loaded workers when
     # other workers sit idle with nothing ready to schedule (reference

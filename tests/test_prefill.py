@@ -31,11 +31,12 @@ def test_prefilled_task_accounts_resources_when_running():
     env.schedule(prefill=True)
     worker = env.core.workers[w.worker_id]
     assert worker.prefilled_tasks == {b}
-    env.start_all_assigned()  # both report running (worker-side ordering)
+    env.start_all_assigned()  # a runs; b stays queued on the worker
+    env.finish(a)             # cpu frees -> the worker starts b
+    env.start_all_assigned(include_prefilled=True)
     # b transitioned: resources now accounted, no longer prefilled
     assert not worker.prefilled_tasks
-    assert worker.assigned_tasks == {a, b}
-    env.finish(a)
+    assert worker.assigned_tasks == {b}
     env.finish(b)
     assert worker.free == worker.resources.amounts
 
@@ -117,3 +118,53 @@ def test_retract_response_not_ok_keeps_task():
     reactor.on_retract_response(env.core, env.comm, b, False)
     assert env.core.tasks[b].prefilled
     assert b in env.core.workers[w1.worker_id].prefilled_tasks
+
+
+def test_reservation_prevents_big_task_starvation():
+    env = TestEnv()
+    w = env.worker(cpus=16)
+    # a small task occupies the box first
+    (occupant,) = env.submit(rqv=env.rqv(cpus=1), priority=(0, 0))
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    # now a whole-box task at HIGH priority plus a stream of low-prio smalls
+    (big,) = env.submit(rqv=env.rqv(cpus=16), priority=(5, 0), job=2)
+    small = env.submit(n=30, rqv=env.rqv(cpus=1), priority=(0, 0), job=3)
+    env.schedule(prefill=True)
+    worker = env.core.workers[w.worker_id]
+    # gap relaxation: 15 smalls may USE the 15 free cpus right now (solver
+    # semantics, utilization first) — but the big task holds the prefill
+    # reservation, so no further lower-priority work stacks on the drain path
+    assert env.core.tasks[big].state is TaskState.ASSIGNED
+    assert env.core.tasks[big].assigned_worker == w.worker_id
+    assert worker.prefilled_tasks == {big}
+    assert env.core.queues.total_ready() == 15  # the rest stay off the box
+    env.start_all_assigned()
+    # drain everything currently holding cpus -> big must start next, ahead
+    # of the 15 still-ready smalls (bounded delay, no starvation)
+    env.finish(occupant)
+    running = [
+        t for t in small
+        if env.core.tasks[t].state is TaskState.RUNNING
+    ]
+    for t in running:
+        env.finish(t)
+    # box fully drained: the worker now starts the big task
+    env.start_all_assigned(include_prefilled=True)
+    assert env.core.tasks[big].state is TaskState.RUNNING
+    assert env.core.queues.total_ready() == 15
+
+
+def test_prefill_priority_order_across_classes():
+    env = TestEnv()
+    env.worker(cpus=1)
+    low = env.submit(n=50, rqv=env.rqv(cpus=1), priority=(0, 0))
+    high = env.submit(n=50, rqv=env.rqv(gpus=0, cpus=1), priority=(9, 0))
+    env.schedule(prefill=True)
+    # high-priority tasks must win the prefill budget
+    n_high_prefilled = sum(
+        1 for t in high if env.core.tasks[t].prefilled
+        or env.core.tasks[t].state is TaskState.ASSIGNED
+    )
+    n_low_prefilled = sum(1 for t in low if env.core.tasks[t].prefilled)
+    assert n_high_prefilled >= 50 - 1 or n_low_prefilled == 0

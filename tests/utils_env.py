@@ -168,12 +168,19 @@ class TestEnv:
         self.core.sanity_check()
         return n
 
-    def start_all_assigned(self):
-        """Worker acks: report every ASSIGNED task as running."""
+    def start_all_assigned(self, include_prefilled: bool = False):
+        """Worker acks: report ASSIGNED tasks as running.
+
+        Prefilled tasks are skipped by default — a real worker only starts
+        them once resources free up; reporting them running while the box is
+        full would simulate an impossible ordering.
+        """
         from hyperqueue_tpu.server.task import TaskState
 
         for task in list(self.core.tasks.values()):
-            if task.state is TaskState.ASSIGNED:
+            if task.state is TaskState.ASSIGNED and (
+                include_prefilled or not task.prefilled
+            ):
                 reactor.on_task_running(
                     self.core, self.events, task.task_id, task.instance_id
                 )
