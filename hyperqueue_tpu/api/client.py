@@ -143,14 +143,37 @@ class Client:
         )
         return response["job_id"]
 
-    def wait_for_jobs(self, job_ids: list[int], raise_on_fail: bool = True):
-        response = self._session.request(
-            {"op": "job_wait", "job_ids": list(job_ids)}
-        )
+    def wait_for_jobs(self, job_ids: list[int], raise_on_fail: bool = True,
+                      progress=None):
+        """progress: optional callback(done, total) polled while waiting
+        (reference pyhq wait_for_jobs progress callback)."""
+        if progress is None:
+            response = self._session.request(
+                {"op": "job_wait", "job_ids": list(job_ids)}
+            )
+            jobs = response["jobs"]
+        else:
+            while True:
+                jobs = self._session.request(
+                    {"op": "job_info", "job_ids": list(job_ids)}
+                )["jobs"]
+                total = sum(j["n_tasks"] for j in jobs)
+                done = sum(
+                    j["counters"]["finished"]
+                    + j["counters"]["failed"]
+                    + j["counters"]["canceled"]
+                    for j in jobs
+                )
+                progress(done, total)
+                if done >= total and all(
+                    not j["counters"]["running"] for j in jobs
+                ):
+                    break
+                time.sleep(0.25)
         failed = self.get_failed_tasks(job_ids)
         if failed and raise_on_fail:
             raise FailedJobsException(failed)
-        return response["jobs"]
+        return jobs
 
     def get_failed_tasks(self, job_ids: list[int]) -> dict:
         response = self._session.request(

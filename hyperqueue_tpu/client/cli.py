@@ -423,6 +423,8 @@ def cmd_submit(args) -> None:
         body_base["pin"] = args.pin
     if args.task_dir:
         body_base["task_dir"] = True
+    if args.time_limit:
+        body_base["time_limit"] = args.time_limit
     if args.stdin:
         body_base["stdin"] = sys.stdin.buffer.read()
     request = _build_request(args)
@@ -1009,6 +1011,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resource", dest="resource_request", action="append")
     p.add_argument("--nodes", type=int, default=None)
     p.add_argument("--time-request", type=float, default=None)
+    p.add_argument("--time-limit", type=float, default=None,
+                   help="kill a task after this many seconds")
     p.add_argument("--priority", type=int, default=0)
     p.add_argument("--max-fails", type=int, default=None)
     p.add_argument("--crash-limit", type=int, default=5)

@@ -234,7 +234,32 @@ class WorkerRuntime:
             await self._send(
                 {"op": "task_running", "id": task_id, "instance": instance}
             )
-            code, detail = await launched.wait()
+            # per-task time limit (reference: task futures carry stop
+            # reasons; program.rs timeout path): kill and fail on expiry
+            time_limit = (task_msg.get("body") or {}).get("time_limit")
+            timed_out = False
+            if time_limit:
+                try:
+                    code, detail = await asyncio.wait_for(
+                        launched.wait(), timeout=float(time_limit)
+                    )
+                except asyncio.TimeoutError:
+                    timed_out = True
+                    launched.kill()
+                    await launched.wait()
+                    code, detail = -1, ""
+            else:
+                code, detail = await launched.wait()
+            if timed_out:
+                await self._send(
+                    {
+                        "op": "task_failed",
+                        "id": task_id,
+                        "instance": instance,
+                        "error": f"time limit of {time_limit}s exceeded",
+                    }
+                )
+                return
             if streamer is not None:
                 streamer.close_task(task_id, instance)
             if code == 0:

@@ -71,3 +71,19 @@ def test_pinning_env_and_task_dir(env):
     out = env.command(["job", "cat", "1", "stdout"]).strip()
     assert "places={0},{1}" in out
     assert ".hq-task-dir-1-0-" in out
+
+
+def test_task_time_limit_kills_task(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--time-limit", "1", "--wait", "--", "sleep", "60"],
+        expect_fail=True, timeout=90,
+    )
+    tasks = json.loads(
+        env.command(["task", "list", "1", "--output-mode", "json"])
+    )
+    task = tasks[0]["tasks"][0]
+    assert task["status"] == "failed"
+    assert "time limit" in task["error"]
