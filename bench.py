@@ -123,25 +123,37 @@ def main() -> None:
 
     from hyperqueue_tpu.ops.assign import (
         greedy_cut_scan_impl,
+        greedy_cut_scan_numpy,
         host_visit_classes,
     )
 
     instance = build_instance(n_workers=args.workers, n_tasks=args.tasks)
     free, nt_free, lifetime, needs, sizes, min_time, scarcity = instance
-    fn = jax.jit(greedy_cut_scan_impl)
+    on_cpu = args.cpu or device_fallback or jax.default_backend() == "cpu"
     device = jax.devices()[0]
-    placed = [
-        jax.device_put(a, device)
-        for a in (free, nt_free, lifetime, needs, sizes, min_time)
-    ]
+    if on_cpu:
+        # the XLA while-loop is slower than numpy on CPU hosts; the
+        # production model makes the same choice (models/greedy.py backend)
+        def tick():
+            class_m, order_ids = host_visit_classes(free, needs, scarcity)
+            return greedy_cut_scan_numpy(
+                free, nt_free, lifetime, needs, sizes, min_time,
+                class_m, order_ids,
+            )
+    else:
+        fn = jax.jit(greedy_cut_scan_impl)
+        placed = [
+            jax.device_put(a, device)
+            for a in (free, nt_free, lifetime, needs, sizes, min_time)
+        ]
 
-    def tick():
-        # host part of the tick (mask dedup + class ranking) is timed too —
-        # it is real per-tick work, as is the small-table upload
-        class_m, order_ids = host_visit_classes(free, needs, scarcity)
-        out = fn(*placed, class_m, order_ids)
-        jax.block_until_ready(out)
-        return out
+        def tick():
+            # host part of the tick (mask dedup + class ranking) is timed
+            # too — real per-tick work, as is the small-table upload
+            class_m, order_ids = host_visit_classes(free, needs, scarcity)
+            out = fn(*placed, class_m, order_ids)
+            jax.block_until_ready(out)
+            return out
 
     out = tick()  # compile + warmup
 

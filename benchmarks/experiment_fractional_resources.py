@@ -1,0 +1,41 @@
+"""Fractional resources: tasks sharing GPUs at 0.25/0.5 shares.
+
+Reference: benchmarks/experiment-fractional-resources.py.
+"""
+
+import time
+
+from common import Cluster, emit
+
+N = 2000
+
+
+def main():
+    with Cluster(
+        n_workers=2,
+        cpus=8,
+        zero_worker=True,
+        extra_worker=["--resource", "gpus=[0,1,2,3]"],
+    ) as cluster:
+        cluster.hq(["submit", "--array", "1-50", "--wait", "--", "true"])
+        t0 = time.perf_counter()
+        cluster.hq(
+            [
+                "submit", "--array", f"1-{N}", "--cpus", "1",
+                "--resource", "gpus=0.25", "--wait", "--", "true",
+            ]
+        )
+        wall = time.perf_counter() - t0
+        emit(
+            {
+                "experiment": "fractional-resources",
+                "n_tasks": N,
+                "gpu_share": 0.25,
+                "wall_s": round(wall, 3),
+                "tasks_per_s": round(N / wall, 1),
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

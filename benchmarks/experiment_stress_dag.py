@@ -1,0 +1,52 @@
+"""Stress DAG: layered random dependency graph through the Python API.
+
+Reference: benchmarks/experiment-scalability-stress.py (random fan-in/out DAG).
+"""
+
+import random
+import sys
+import time
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+from common import REPO, Cluster, emit  # noqa: E402
+
+sys.path.insert(0, str(REPO))
+
+
+def main():
+    n_layers = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    rng = random.Random(42)
+    with Cluster(n_workers=2, cpus=8, zero_worker=True) as cluster:
+        from hyperqueue_tpu.api import Client, Job
+
+        client = Client(cluster.dir / "sd")
+        job = Job(name="stress-dag")
+        layers = []
+        for _ in range(n_layers):
+            prev = layers[-1] if layers else []
+            layer = []
+            for _ in range(width):
+                deps = rng.sample(prev, k=min(2, len(prev))) if prev else []
+                layer.append(job.program(["true"], deps=deps))
+            layers.append(layer)
+        n_tasks = n_layers * width
+        t0 = time.perf_counter()
+        jid = client.submit(job)
+        client.wait_for_jobs([jid])
+        wall = time.perf_counter() - t0
+        client.close()
+        emit(
+            {
+                "experiment": "stress-dag",
+                "n_tasks": n_tasks,
+                "n_layers": n_layers,
+                "width": width,
+                "wall_s": round(wall, 3),
+                "tasks_per_s": round(n_tasks / wall, 1),
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

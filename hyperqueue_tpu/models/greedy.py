@@ -18,6 +18,7 @@ import numpy as np
 
 from hyperqueue_tpu.ops.assign import (
     greedy_cut_scan,
+    greedy_cut_scan_numpy,
     host_visit_classes,
     scarcity_weights,
 )
@@ -32,7 +33,12 @@ def _bucket(n: int, floor: int) -> int:
 
 
 class GreedyCutScanModel:
-    """Stateless apart from jit's own compile cache."""
+    """Stateless apart from jit's own compile cache.
+
+    backend: "auto" uses the jitted kernel on an accelerator and the numpy
+    implementation on CPU hosts (identical semantics; the XLA while-loop is
+    slower than numpy on CPU); "jax"/"numpy" force a path.
+    """
 
     def __init__(
         self,
@@ -40,11 +46,23 @@ class GreedyCutScanModel:
         batch_floor: int = 8,
         resource_floor: int = 4,
         variant_floor: int = 1,
+        backend: str = "auto",
     ):
         self.worker_floor = worker_floor
         self.batch_floor = batch_floor
         self.resource_floor = resource_floor
         self.variant_floor = variant_floor
+        self.backend = backend
+        self._use_numpy: bool | None = (
+            None if backend == "auto" else (backend == "numpy")
+        )
+
+    def _numpy_path(self) -> bool:
+        if self._use_numpy is None:
+            import jax
+
+            self._use_numpy = jax.default_backend() == "cpu"
+        return self._use_numpy
 
     def solve(
         self,
@@ -90,7 +108,10 @@ class GreedyCutScanModel:
             pad = np.zeros((pm - class_m.shape[0], pw), dtype=np.int32)
             class_m = np.concatenate([class_m, pad], axis=0)
 
-        counts, _free_after, _nt_after = greedy_cut_scan(
+        solver = (
+            greedy_cut_scan_numpy if self._numpy_path() else greedy_cut_scan
+        )
+        counts, _free_after, _nt_after = solver(
             free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
         )
         return np.asarray(counts)[:n_b, :n_v, :n_w]

@@ -211,3 +211,29 @@ def test_feasibility_invariants_random():
         assert (used <= free).all()
         assert (counts.sum(axis=(0, 1)) <= nt_free).all()
         assert (counts.sum(axis=(1, 2)) <= sizes).all()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_numpy_backend_matches_jax(seed):
+    """The numpy CPU path and the jitted kernel are the same semantics."""
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+
+    rng = np.random.default_rng(seed + 100)
+    n_w, n_r, n_b, n_v = 6, 3, 5, 2
+    free = rng.integers(0, 8, size=(n_w, n_r)) * U
+    nt_free = rng.integers(0, 10, size=n_w)
+    lifetime = np.where(rng.random(n_w) < 0.2, 100, INF)
+    needs = rng.integers(0, 3, size=(n_b, n_v, n_r)) * (U // 2)
+    sizes = rng.integers(0, 12, size=n_b)
+    min_time = np.where(rng.random((n_b, n_v)) < 0.2, 3600, 0)
+    args = dict(
+        free=free.astype(np.int32),
+        nt_free=nt_free.astype(np.int32),
+        lifetime=lifetime.astype(np.int32),
+        needs=needs.astype(np.int32),
+        sizes=sizes.astype(np.int32),
+        min_time=min_time.astype(np.int32),
+    )
+    jax_counts = GreedyCutScanModel(backend="jax").solve(**args)
+    np_counts = GreedyCutScanModel(backend="numpy").solve(**args)
+    assert (jax_counts == np_counts).all()
