@@ -86,10 +86,12 @@ class Job:
     def status(self) -> str:
         if not self.is_terminated():
             return "opened" if self.is_open and not self.counters["running"] else "running"
-        if self.counters["canceled"]:
-            return "canceled"
+        # failures dominate: a max-fails abort cancels the remainder but the
+        # job's outcome is "failed"
         if self.counters["failed"]:
             return "failed"
+        if self.counters["canceled"]:
+            return "canceled"
         return "finished"
 
     def to_info(self) -> dict:

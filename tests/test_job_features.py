@@ -1,0 +1,91 @@
+"""Job-feature e2e: entries via JSON, stdin, max-fails abort, priorities
+(reference tests/test_entries.py, test_job.py max_fails paths)."""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_from_json_entries(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    data = env.work_dir / "items.json"
+    data.write_text(json.dumps([{"x": 1}, {"x": 2}]))
+    env.command(
+        ["submit", "--from-json", str(data), "--wait", "--",
+         "bash", "-c", "echo got=$HQ_ENTRY"]
+    )
+    out = env.command(["job", "cat", "1", "stdout"])
+    lines = sorted(out.strip().splitlines())
+    assert lines == ['got={"x": 1}', 'got={"x": 2}']
+
+
+def test_stdin_forwarding(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    import subprocess
+    import sys
+
+    from utils_e2e import _env_base
+
+    result = subprocess.run(
+        [sys.executable, "-m", "hyperqueue_tpu", "submit", "--stdin",
+         "--wait", "--", "wc", "-c"],
+        input=b"hello stdin!",
+        env={**_env_base(), "HQ_SERVER_DIR": str(env.server_dir)},
+        cwd=env.work_dir,
+        capture_output=True,
+        timeout=60,
+    )
+    assert result.returncode == 0, result.stdout
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert out.strip() == "12"
+
+
+def test_max_fails_aborts_job(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    # 20 tasks, every one fails; max-fails 2 must cancel the remainder
+    env.command(
+        ["submit", "--array", "1-20", "--max-fails", "2", "--", "false"]
+    )
+
+    def aborted():
+        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        c = jobs[0]["counters"]
+        done = c["finished"] + c["failed"] + c["canceled"]
+        return done == 20 and c["canceled"] > 0
+
+    wait_until(aborted, timeout=40, message="job aborted by max-fails")
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    c = jobs[0]["counters"]
+    assert c["failed"] >= 3  # a few may race in before the abort
+    assert c["failed"] + c["canceled"] == 20
+    assert jobs[0]["status"] == "failed"
+
+
+def test_priority_order_e2e(env):
+    env.start_server()
+    # no worker yet: submit both, then let one 1-cpu worker drain serially
+    env.command(
+        ["submit", "--name", "low", "--priority", "0", "--",
+         "bash", "-c", "echo low >> order.txt"]
+    )
+    env.command(
+        ["submit", "--name", "high", "--priority", "5", "--",
+         "bash", "-c", "echo high >> order.txt"]
+    )
+    env.start_worker(cpus=1)
+    env.command(["job", "wait", "all"], timeout=40)
+    assert (env.work_dir / "order.txt").read_text().splitlines()[0] == "high"
