@@ -10,7 +10,7 @@ from __future__ import annotations
 import html
 import json
 import time
-from collections import Counter, defaultdict
+from collections import Counter
 from pathlib import Path
 
 from hyperqueue_tpu.events.journal import Journal

@@ -13,7 +13,6 @@ length prefixes. A `close` record (c: 2) marks a task's stream complete.
 
 from __future__ import annotations
 
-import os
 import struct
 from pathlib import Path
 

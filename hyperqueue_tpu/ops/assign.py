@@ -45,7 +45,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from hyperqueue_tpu.utils.constants import INF_TIME  # noqa: E402
+# INF_TIME is re-exported here for kernel callers/tests
+from hyperqueue_tpu.utils.constants import INF_TIME  # noqa: F401
 # Quantization of the waste score into the integer sort key: key =
 # waste_q * W + worker_index, waste_q in [0, _WASTE_Q]. With W <= 16384 the
 # key stays well inside int32.

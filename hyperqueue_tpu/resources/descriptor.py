@@ -12,7 +12,7 @@ checks, which `summary()` provides.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT
 

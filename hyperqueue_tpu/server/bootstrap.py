@@ -851,8 +851,6 @@ class Server:
     async def _client_server_debug_dump(self, msg: dict) -> dict:
         """Full server state dump (reference control.rs:207-210 /
         core.rs:472-481 ServerDebugDump)."""
-        from hyperqueue_tpu.server.task import TaskState
-
         state_counts: dict[str, int] = {}
         for task in self.core.tasks.values():
             state_counts[task.state.value] = (

@@ -12,7 +12,6 @@ from __future__ import annotations
 import logging
 from typing import Protocol
 
-from hyperqueue_tpu.resources.request import AllocationPolicy
 from hyperqueue_tpu.scheduler.queues import Priority as Priority_t
 from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
 from hyperqueue_tpu.server.core import Core

@@ -23,7 +23,6 @@ from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT
 from hyperqueue_tpu.resources.descriptor import (
     DescriptorKind,
     ResourceDescriptor,
-    ResourceDescriptorItem,
 )
 from hyperqueue_tpu.resources.request import AllocationPolicy
 
