@@ -1,0 +1,83 @@
+"""Worker lifecycle e2e: idle timeout, time limit, server-lost policies
+(reference tests/test_worker.py idle/time-limit paths, worker/rpc.rs
+on_server_lost handling)."""
+
+import json
+import time
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_worker_idle_timeout_self_stops(env):
+    env.start_server()
+    process = env.start_worker("--idle-timeout", "6")
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    wait_until(
+        lambda: process.poll() is not None,
+        timeout=30,
+        message="worker exited on idle timeout",
+    )
+
+    def gone():
+        workers = json.loads(
+            env.command(["worker", "list", "--output-mode", "json"])
+        )
+        return not workers
+
+    wait_until(gone, timeout=30, message="server dropped the idle worker")
+
+
+def test_worker_time_limit_self_stops(env):
+    env.start_server()
+    process = env.start_worker("--time-limit", "3")
+    env.wait_workers(1)
+    wait_until(
+        lambda: process.poll() is not None,
+        timeout=30,
+        message="worker exited on time limit",
+    )
+
+
+def test_worker_finish_running_on_server_lost(env, tmp_path):
+    env.start_server()
+    marker = env.work_dir / "survived.txt"
+    process = env.start_worker("--on-server-lost", "finish-running")
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--", "bash", "-c", f"sleep 3 && echo done > {marker}"]
+    )
+
+    def running():
+        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        return jobs and jobs[0]["counters"]["running"] == 1
+
+    wait_until(running, timeout=30, message="task running")
+    env.kill_process("server")
+    # the worker must finish its running task before exiting
+    wait_until(
+        lambda: process.poll() is not None,
+        timeout=40,
+        message="worker exited after finishing",
+    )
+    assert marker.exists() and marker.read_text().strip() == "done"
+
+
+def test_worker_stop_on_server_lost(env):
+    env.start_server()
+    process = env.start_worker("--on-server-lost", "stop")
+    env.wait_workers(1)
+    env.kill_process("server")
+    wait_until(
+        lambda: process.poll() is not None,
+        timeout=30,
+        message="worker exited after server loss",
+    )
