@@ -47,7 +47,7 @@ def restore_from_journal(server) -> None:
                 )
             expanded = expand_desc_tasks(desc)
             for t in expanded:
-                server.jobs.attach_task(job, t.get("id", 0), t)
+                server.jobs.attach_task(job, t.get("id", 0))
             job_descs.setdefault(job_id, []).extend(expanded)
         elif kind == "job-opened":
             if job_id not in server.jobs.jobs:

@@ -545,12 +545,6 @@ class Server:
             entries = array.get("entries")
             priority = int(array.get("priority", 0))
             crash_limit = int(array.get("crash_limit", 5))
-            job.task_descriptions["__array__"] = {
-                "body": shared_body,
-                "request": array.get("request") or {},
-                "priority": priority,
-                "crash_limit": crash_limit,
-            }
             for i, job_task_id in enumerate(array["ids"]):
                 if job_task_id in used:
                     raise ValueError(f"duplicate task id {job_task_id}")
@@ -580,7 +574,7 @@ class Server:
             used.add(job_task_id)
             rqv = rqv_from_wire(t.get("request") or {}, self.core.resource_map)
             rq_id = self.core.intern_rqv(rqv)
-            task_id = self.jobs.attach_task(job, job_task_id, t)
+            task_id = self.jobs.attach_task(job, job_task_id)
             deps = tuple(
                 make_task_id(job.job_id, d) for d in t.get("deps", ())
             )

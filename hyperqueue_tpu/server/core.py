@@ -31,6 +31,9 @@ class Core:
     # multi-node gang tasks waiting for enough workers, in priority order
     mn_queue: list[int] = field(default_factory=list)
     scheduling_needed: bool = False
+    # (rq_id, variant) -> (wire entries, n_nodes); rq interning is
+    # append-only so entries never change within a Core
+    entries_cache: dict = field(default_factory=dict)
 
     def intern_rqv(self, rqv: ResourceRequestVariants) -> int:
         return self.rq_map.get_or_create(rqv)

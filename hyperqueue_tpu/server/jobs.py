@@ -54,8 +54,6 @@ class Job:
             "canceled": 0,
         }
     )
-    # wire descriptions kept for detail queries / journal replay
-    task_descriptions: dict[int, dict] = field(default_factory=dict)
 
     def n_tasks(self) -> int:
         return len(self.tasks)
@@ -152,9 +150,8 @@ class JobManager:
         self.jobs[job_id] = job
         return job
 
-    def attach_task(self, job: Job, job_task_id: int, description: dict) -> int:
+    def attach_task(self, job: Job, job_task_id: int) -> int:
         job.tasks[job_task_id] = JobTaskInfo(job_task_id=job_task_id)
-        job.task_descriptions[job_task_id] = description
         return make_task_id(job.job_id, job_task_id)
 
     # --- event handlers (called from the EventSink bridge) ---------------

@@ -464,14 +464,12 @@ def on_retract_response(
     comm.ask_for_scheduling()
 
 
-_entries_cache: dict[tuple[int, int, int], tuple[list, int]] = {}
-
-
 def _compute_message(core: Core, task: Task, variant: int) -> dict:
-    # entries/n_nodes depend only on (rq_map identity, rq_id, variant):
-    # cache them — at 100k-task arrays this is per-task hot path
-    key = (id(core.rq_map), task.rq_id, variant)
-    cached = _entries_cache.get(key)
+    # entries/n_nodes depend only on (rq_id, variant) within a Core (rq
+    # interning is append-only): cache on the Core instance — at 100k-task
+    # arrays this is per-task hot path
+    key = (task.rq_id, variant)
+    cached = core.entries_cache.get(key)
     if cached is None:
         rqv = core.rq_map.get_variants(task.rq_id)
         request = rqv.variants[variant]
@@ -484,7 +482,7 @@ def _compute_message(core: Core, task: Task, variant: int) -> dict:
             for e in request.entries
         ]
         cached = (entries, request.n_nodes)
-        _entries_cache[key] = cached
+        core.entries_cache[key] = cached
     entries, n_nodes = cached
     return {
         "id": task.task_id,

@@ -160,8 +160,11 @@ class WorkerRuntime:
                     self._cancel_task(task_id)
             elif op == "retract":
                 for task_id in msg["task_ids"]:
+                    # retract may only reclaim NOT-YET-STARTED tasks: remove
+                    # from the blocked queue, never touch running ones (the
+                    # server treats ok=False as "it started, leave it be")
                     before = self._n_blocked
-                    self._cancel_task(task_id)
+                    self._remove_blocked(task_id)
                     await self._send(
                         {
                             "op": "retract_response",
@@ -251,6 +254,8 @@ class WorkerRuntime:
             else:
                 code, detail = await launched.wait()
             if timed_out:
+                if streamer is not None:
+                    streamer.close_task(task_id, instance)
                 await self._send(
                     {
                         "op": "task_failed",
@@ -332,7 +337,7 @@ class WorkerRuntime:
             if not group:
                 self.blocked.pop(sig, None)
 
-    def _cancel_task(self, task_id: int) -> None:
+    def _remove_blocked(self, task_id: int) -> None:
         for sig, group in list(self.blocked.items()):
             kept = [t for t in group if t["id"] != task_id]
             self._n_blocked -= len(group) - len(kept)
@@ -340,6 +345,9 @@ class WorkerRuntime:
                 self.blocked[sig] = kept
             else:
                 self.blocked.pop(sig, None)
+
+    def _cancel_task(self, task_id: int) -> None:
+        self._remove_blocked(task_id)
         rt = self.running.get(task_id)
         if rt is not None:
             if rt.launched is not None:
