@@ -113,6 +113,7 @@ def cmd_server_start(args) -> None:
             disable_worker_auth=args.disable_worker_authentication,
             scheduler=args.scheduler,
             journal_path=Path(args.journal) if args.journal else None,
+            access_file=Path(args.access_file) if args.access_file else None,
         )
         access = await server.start()
         print(
@@ -928,6 +929,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-worker-authentication", action="store_true")
     p.add_argument("--scheduler", choices=["auto", "cpu", "tpu"], default="auto")
     p.add_argument("--journal", default=None)
+    p.add_argument("--access-file", default=None,
+                   help="start with pre-shared keys/ports from generate-access")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)

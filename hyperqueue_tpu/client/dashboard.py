@@ -39,9 +39,15 @@ def render(info: dict, workers: list[dict], jobs: list[dict],
         res = " ".join(
             f"{k}={v / 10_000:g}" for k, v in w.get("resources", {}).items()
         )
+        hw = (w.get("overview") or {}).get("hw") or {}
+        cpu = (
+            f" cpu={_bar(hw['cpu_usage_percent'] / 100, 10)}"
+            if "cpu_usage_percent" in hw
+            else ""
+        )
         lines.append(
             f"  #{w['id']:<4} {w['hostname'][:24]:<24} group={w['group']:<10}"
-            f" running={w['n_running']:<4} {res}"
+            f" running={w['n_running']:<4} {res}{cpu}"
         )
     if len(workers) > 16:
         lines.append(f"  ... and {len(workers) - 16} more")

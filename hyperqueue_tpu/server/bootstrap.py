@@ -160,6 +160,7 @@ class Server:
         schedule_min_delay: float = SCHEDULE_MIN_DELAY,
         journal_path: Path | None = None,
         idle_worker_stop: bool = False,
+        access_file: Path | None = None,
     ):
         self.server_dir = Path(server_dir)
         self.host = host or socket.gethostname()
@@ -167,6 +168,7 @@ class Server:
         self.worker_port = worker_port
         self.disable_client_auth = disable_client_auth
         self.disable_worker_auth = disable_worker_auth
+        self.access_file = access_file
         self.schedule_min_delay = schedule_min_delay
         self.core = Core()
         self.jobs = JobManager()
@@ -197,6 +199,18 @@ class Server:
                 restore_from_journal(self)
             self.journal.open_for_append()
 
+        # pre-shared deployment (reference generate-access + serverdir.rs):
+        # an access file pins ports and both plane keys so workers/clients on
+        # other sites can be configured before the server starts
+        preshared: serverdir.AccessRecord | None = None
+        if self.access_file is not None:
+            import json as _json
+
+            with open(self.access_file) as f:
+                preshared = serverdir.AccessRecord.from_json(_json.load(f))
+            self.client_port = preshared.client_port
+            self.worker_port = preshared.worker_port
+
         client_srv = await asyncio.start_server(
             self._handle_client_conn, "0.0.0.0", self.client_port
         )
@@ -208,13 +222,16 @@ class Server:
         self.worker_port = worker_srv.sockets[0].getsockname()[1]
 
         instance_dir = serverdir.create_instance_dir(self.server_dir)
-        self.access = serverdir.generate_access(
-            self.host,
-            self.client_port,
-            self.worker_port,
-            disable_client_auth=self.disable_client_auth,
-            disable_worker_auth=self.disable_worker_auth,
-        )
+        if preshared is not None:
+            self.access = preshared
+        else:
+            self.access = serverdir.generate_access(
+                self.host,
+                self.client_port,
+                self.worker_port,
+                disable_client_auth=self.disable_client_auth,
+                disable_worker_auth=self.disable_worker_auth,
+            )
         serverdir.store_access(instance_dir, self.access)
 
         from hyperqueue_tpu.autoalloc.service import AutoAllocService
@@ -809,6 +826,7 @@ class Server:
                         for i, amount in enumerate(w.resources.amounts)
                         if amount
                     },
+                    "overview": w.last_overview,
                 }
                 for w in self.core.workers.values()
             ],

@@ -171,3 +171,35 @@ def test_task_explain_e2e(env):
     w = out["workers"][0]
     assert not w["runnable"]
     assert "needs 8 cpus" in w["variants"][0]["blocked"][0]
+
+
+def test_preshared_access_file_e2e(env, tmp_path):
+    # generate-access -> server start --access-file: a worker configured
+    # from the same file (different server dir) connects with shared keys
+    access = tmp_path / "access.json"
+    env.command(
+        ["server", "generate-access", str(access), "--host", "127.0.0.1",
+         "--client-port", "0", "--worker-port", "0"],
+    )
+    import json as _json
+
+    data = _json.loads(access.read_text())
+    assert data["client"]["key"] and data["worker"]["key"]
+    # pin free ports into the file
+    import socket
+
+    socks = []
+    for plane in ("client", "worker"):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        data[plane]["port"] = s.getsockname()[1]
+        socks.append(s)
+    for s in socks:
+        s.close()
+    access.write_text(_json.dumps(data))
+    env.start_server("--access-file", str(access))
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "echo", "preshared-ok"])
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert out.strip() == "preshared-ok"
