@@ -32,6 +32,11 @@ def restore_from_journal(server) -> None:
 
     for record in Journal.read_all(server.journal_path):
         n_events += 1
+        # continue the event sequence where the journal left off so
+        # stream-with-history seq dedup stays monotonic across restarts
+        seq = record.get("seq")
+        if isinstance(seq, int) and seq >= server._event_seq:
+            server._event_seq = seq + 1
         kind = record.get("event")
         job_id = record.get("job")
         if kind == "job-submitted":

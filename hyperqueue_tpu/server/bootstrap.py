@@ -183,6 +183,7 @@ class Server:
         self._stop_event = asyncio.Event()
         self._job_waiters: dict[int, list[asyncio.Event]] = {}
         self._event_listeners: list[asyncio.Queue] = []
+        self._event_seq = 0
         self._worker_conns: dict[int, Connection] = {}
         self._tasks: list[asyncio.Task] = []
         self._servers: list[asyncio.base_events.Server] = []
@@ -276,7 +277,9 @@ class Server:
     def emit_event(self, kind: str, payload: dict) -> None:
         if self.journal is None and not self._event_listeners:
             return  # nobody consumes events; skip record construction
-        record = {"time": time.time(), "event": kind, **payload}
+        record = {"time": time.time(), "seq": self._event_seq,
+                  "event": kind, **payload}
+        self._event_seq += 1
         if self.journal is not None:
             self.journal.write(record)
             # flush to the OS on every event: a crashed server process then
@@ -490,6 +493,8 @@ class Server:
 
     async def _handle_client_message(self, msg: dict) -> dict | None:
         op = msg.get("op")
+        if not isinstance(op, str):
+            return {"op": "error", "message": f"malformed operation {op!r}"}
         handler = getattr(self, f"_client_{op.replace('-', '_')}", None)
         if handler is None:
             return {"op": "error", "message": f"unknown operation {op!r}"}
@@ -586,6 +591,11 @@ class Server:
             job_task_id = t.get("id")
             if job_task_id is None:
                 job_task_id = (max(used) + 1) if used else 0
+                # write the assigned id back into the desc: the desc is
+                # journaled verbatim by _client_submit, and restore replays
+                # it through this same path — without the id every such task
+                # would collapse to id 0 on replay
+                t["id"] = job_task_id
             if job_task_id in used:
                 raise ValueError(f"duplicate task id {job_task_id}")
             used.add(job_task_id)
@@ -916,18 +926,27 @@ class Server:
         """
         prefixes = tuple(msg.get("filter") or ())
         queue: asyncio.Queue = asyncio.Queue()
+        # register BEFORE the replay so no live event is missed, then use the
+        # record seq to drop events that were appended to the journal while
+        # the replay was await-ing sends (they arrive on both paths)
         self._event_listeners.append(queue)
+        replayed_seq = -1
         try:
             if msg.get("history") and self.journal_path is not None:
                 from hyperqueue_tpu.events.journal import Journal
 
                 self.journal.flush()
                 for record in Journal.read_all(self.journal_path):
+                    seq = record.get("seq")
+                    if isinstance(seq, int) and seq > replayed_seq:
+                        replayed_seq = seq
                     if not prefixes or record.get("event", "").startswith(prefixes):
                         await conn.send({"op": "event", "record": record})
             await conn.send({"op": "stream_live"})
             while True:
                 record = await queue.get()
+                if record.get("seq", -1) <= replayed_seq:
+                    continue  # already sent during the history replay
                 if not prefixes or record.get("event", "").startswith(prefixes):
                     await conn.send({"op": "event", "record": record})
         finally:

@@ -140,7 +140,14 @@ def _teardown_gang(
         w = core.workers.get(wid)
         if w is not None:
             w.mn_task = 0
-            if wid != lost_worker and task.state is TaskState.RUNNING:
+            # cancel on surviving workers for ASSIGNED too: the compute
+            # message may already be in flight to the root even though
+            # task_running has not come back yet; worker-side cancel of an
+            # unknown task id is a no-op, so this is always safe
+            if wid != lost_worker and task.state in (
+                TaskState.ASSIGNED,
+                TaskState.RUNNING,
+            ):
                 comm.send_cancel(wid, [task.task_id])
     task.mn_workers = ()
     task.increment_instance()

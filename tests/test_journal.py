@@ -124,3 +124,18 @@ def test_journal_stream_and_export(env, tmp_path):
     env.command(["journal", "flush"])
     out = env.command(["journal", "export", str(journal)])
     assert "job-completed" in out
+
+
+def test_graph_submit_without_ids_journals_assigned_ids(tmp_path):
+    """Graph tasks submitted without explicit 'id' get ids assigned by
+    _build_tasks; the journaled desc must carry those ids or replay would
+    collapse every such task to id 0 (corrupting restored state)."""
+    from hyperqueue_tpu.server.bootstrap import Server
+    from hyperqueue_tpu.server.protocol import expand_desc_tasks
+
+    server = Server(server_dir=tmp_path)
+    job = server.jobs.create_job(name="g", submit_dir=str(tmp_path))
+    desc = {"tasks": [{"body": {"n": i}} for i in range(3)]}
+    server._build_tasks(job, desc)
+    ids = [t.get("id") for t in expand_desc_tasks(desc)]
+    assert sorted(ids) == [0, 1, 2]

@@ -200,3 +200,21 @@ def test_worker_added_after_submit_triggers_assignment():
     env.worker(cpus=4)
     assert env.schedule() == 3
     assert all(env.state(i) is TaskState.ASSIGNED for i in ids)
+
+
+def test_gang_assigned_teardown_cancels_survivors():
+    """Losing a non-root member while the gang is still ASSIGNED (compute
+    message in flight to the root) must cancel on the surviving workers —
+    otherwise the root launches a stale instance alongside the requeued one."""
+    env = TestEnv()
+    [env.worker(cpus=2, group="g1") for _ in range(3)]
+    (t,) = env.submit(rqv=env.rqv(n_nodes=3))
+    env.schedule()
+    task = env.core.tasks[t]
+    assert env.state(t) is TaskState.ASSIGNED
+    root, mid, last = task.mn_workers
+    env.lose_worker(mid)
+    assert env.state(t) is TaskState.READY
+    canceled_on = {wid for wid, _ in env.comm.cancels}
+    assert root in canceled_on and last in canceled_on
+    assert mid not in canceled_on
