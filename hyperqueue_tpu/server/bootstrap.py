@@ -889,10 +889,12 @@ class Server:
             "workers": [
                 {
                     "id": w.worker_id,
+                    "group": w.group,
                     "free": list(w.free),
                     "nt_free": w.nt_free,
                     "assigned": len(w.assigned_tasks),
                     "mn_task": w.mn_task,
+                    "mn_reserved": w.mn_reserved,
                 }
                 for w in self.core.workers.values()
             ],

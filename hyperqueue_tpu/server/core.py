@@ -48,7 +48,7 @@ class Core:
                 lifetime_secs=w.lifetime_secs(),
             )
             for w in self.workers.values()
-            if w.mn_task == 0
+            if w.mn_task == 0 and w.mn_reserved == 0
         ]
 
     def variant_amounts(self, rq_id: int, variant: int) -> list[tuple[int, int]]:

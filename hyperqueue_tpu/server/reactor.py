@@ -258,6 +258,7 @@ def on_cancel_tasks(
             if rqv.is_multi_node:
                 if tid in core.mn_queue:
                     core.mn_queue.remove(tid)
+                _clear_mn_reservations(core, tid)
             else:
                 core.queues.remove(task.rq_id, tid)
         elif task.state in (TaskState.ASSIGNED, TaskState.RUNNING):
@@ -295,6 +296,44 @@ def _release_task_resources(core: Core, task: Task) -> None:
     task.assigned_worker = 0
 
 
+def _mn_member_eligible(worker: Worker, req) -> bool:
+    """Can this worker serve as a gang member for `req`?
+
+    Reference worker.rs:273-344 (is_capable_to_run): remaining lifetime must
+    cover the request's min_time; resource entries (absent on reference mn
+    requests, permitted here) must fit the empty worker.
+    """
+    if worker.lifetime_secs() < req.min_time_secs:
+        return False
+    for entry in req.entries:
+        if worker.resources.amount(entry.resource_id) < entry.amount:
+            return False
+    return True
+
+
+def _top_sn_priority(core: Core) -> Priority_t | None:
+    """Highest priority among ready single-node tasks that at least one
+    worker is capable of running (an unschedulable high-priority task must
+    not suppress gang reservations forever)."""
+    best: Priority_t | None = None
+    for rq_id, queue in core.queues.items():
+        sizes = queue.priority_sizes()
+        if not sizes or (best is not None and sizes[0][0] <= best):
+            continue
+        rqv = core.rq_map.get_variants(rq_id)
+        if any(
+            w.resources.is_capable_of_rqv(rqv) for w in core.workers.values()
+        ):
+            best = sizes[0][0]
+    return best
+
+
+def _clear_mn_reservations(core: Core, task_id: int) -> None:
+    for w in core.workers.values():
+        if w.mn_reserved == task_id:
+            w.mn_reserved = 0
+
+
 def schedule(
     core: Core, comm: Comm, events: EventSink, model, prefill: bool = True
 ) -> int:
@@ -308,27 +347,85 @@ def schedule(
     assigned = 0
     per_worker_msgs: dict[int, list[dict]] = {}
 
-    # --- multi-node gangs: all-or-nothing N idle workers from one group ---
+    # --- multi-node gangs: all-or-nothing N eligible workers from one
+    # group.  Per-member eligibility matches the reference's
+    # is_capable_to_run_rqv (worker.rs:273-344): enough remaining lifetime
+    # for the request's min_time (mn entries are ignored by design, like the
+    # reference; if present they are checked too).  A gang that cannot be
+    # placed yet RESERVES workers so they drain (see Worker.mn_reserved) —
+    # unless strictly-higher-priority sn work is still pending, which keeps
+    # the reference's priority interleaving (the MILP schedules higher
+    # classes first and only blocks lower ones, solver.rs:479-518). ---
     if core.mn_queue:
+        top_sn = _top_sn_priority(core)
         remaining_mn = []
         for task_id in core.mn_queue:
             task = core.tasks.get(task_id)
             if task is None or task.is_done:
+                _clear_mn_reservations(core, task_id)
                 continue
             rqv = core.rq_map.get_variants(task.rq_id)
-            n_nodes = rqv.variants[0].n_nodes
+            req = rqv.variants[0]
+            n_nodes = req.n_nodes
             groups: dict[str, list[Worker]] = {}
             for w in core.workers.values():
-                if w.mn_task == 0 and w.is_idle():
-                    groups.setdefault(w.group, []).append(w)
+                if w.mn_task or w.mn_reserved not in (0, task_id):
+                    continue
+                if not _mn_member_eligible(w, req):
+                    continue
+                groups.setdefault(w.group, []).append(w)
             chosen: list[Worker] | None = None
             for members in groups.values():
-                if len(members) >= n_nodes:
-                    chosen = sorted(members, key=lambda w: w.worker_id)[:n_nodes]
+                idle = [w for w in members if w.is_idle()]
+                if len(idle) >= n_nodes:
+                    # prefer the workers already drained for this gang so
+                    # other reservations lift as soon as possible
+                    idle.sort(
+                        key=lambda w: (w.mn_reserved != task_id, w.worker_id)
+                    )
+                    chosen = idle[:n_nodes]
                     break
             if chosen is None:
                 remaining_mn.append(task_id)
+                # user-priority comparison only: the scheduler component of
+                # the tuple is -job_id, and an older sn job must not
+                # strictly outrank a same-user-priority gang forever
+                if top_sn is not None and top_sn[0] > task.priority[0]:
+                    # higher-priority sn work outranks this gang; do not
+                    # hold workers hostage for it yet
+                    _clear_mn_reservations(core, task_id)
+                    continue
+                # reserve (and start draining) n_nodes eligible workers in
+                # the group closest to satisfying the gang
+                best = max(groups.values(), key=len, default=None)
+                if best is None or len(best) < n_nodes:
+                    # no group can currently host the gang at all; release
+                    # any stale reservations rather than wedging workers
+                    _clear_mn_reservations(core, task_id)
+                    continue
+                best.sort(
+                    key=lambda w: (
+                        not w.is_idle(),
+                        len(w.assigned_tasks) + len(w.prefilled_tasks),
+                        w.worker_id,
+                    )
+                )
+                target = {w.worker_id for w in best[:n_nodes]}
+                for w in core.workers.values():
+                    if w.mn_reserved == task_id and w.worker_id not in target:
+                        w.mn_reserved = 0
+                for w in best[:n_nodes]:
+                    newly_reserved = w.mn_reserved != task_id
+                    w.mn_reserved = task_id
+                    if newly_reserved and w.prefilled_tasks:
+                        # steal the queued backlog back so the drain is
+                        # bounded by the currently-running tasks only (sent
+                        # once per reservation, not per tick)
+                        comm.send_retract(
+                            w.worker_id, sorted(w.prefilled_tasks)
+                        )
                 continue
+            _clear_mn_reservations(core, task_id)
             for w in chosen:
                 w.mn_task = task_id
             task.mn_workers = tuple(w.worker_id for w in chosen)
@@ -370,6 +467,7 @@ def schedule(
             w.worker_id: PREFILL_MAX - len(w.prefilled_tasks)
             for w in core.workers.values()
             if not w.mn_task
+            and not w.mn_reserved
             and (w.assigned_tasks or w.prefilled_tasks)
             and len(w.prefilled_tasks) < PREFILL_MAX
         }
@@ -382,7 +480,7 @@ def schedule(
         for batch in create_batches(core.queues):
             rqv = core.rq_map.get_variants(batch.rq_id)
             for w in sorted(core.workers.values(), key=lambda w: w.worker_id):
-                if w.mn_task or w.worker_id in reservations:
+                if w.mn_task or w.mn_reserved or w.worker_id in reservations:
                     continue
                 if w.resources.is_capable_of_rqv(rqv):
                     reservations[w.worker_id] = batch.priority
@@ -427,7 +525,9 @@ def schedule(
     if prefill and not core.queues.total_ready():
         idle = [
             w for w in core.workers.values()
-            if w.is_idle() and w.worker_id not in per_worker_msgs
+            if w.is_idle()
+            and not w.mn_reserved
+            and w.worker_id not in per_worker_msgs
         ]
         if idle:
             donors = sorted(

@@ -81,8 +81,15 @@ class Worker:
     # tasks pushed beyond current capacity (queue on the worker; no resource
     # accounting until they report running)
     prefilled_tasks: set[int] = field(default_factory=set)
-    # multi-node: task id this worker is reserved for (0 = none)
+    # multi-node: task id this worker is running a gang for (0 = none)
     mn_task: int = 0
+    # multi-node: pending gang task this worker is DRAINING for (0 = none).
+    # A reserved worker takes no new sn work (excluded from the dense solve
+    # and prefill) so it converges to idle and the gang can eventually claim
+    # it even under a continuous stream of small tasks (anti-starvation; the
+    # reference achieves this inside one MILP via per-group count variables
+    # plus blocking variables, solver.rs:177-209,479-518).
+    mn_reserved: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     last_overview: dict = field(default_factory=dict)
 

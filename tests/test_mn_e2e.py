@@ -48,3 +48,46 @@ def test_multinode_waits_for_group_capacity(env):
     )
     env.start_worker(cpus=2)
     env.command(["job", "wait", "1"], timeout=40)
+
+
+def test_gang_wins_workers_under_sn_stream_e2e(env):
+    """A gang submitted into a cluster saturated with a stream of small sn
+    tasks must still run: reserved workers drain and the gang claims them."""
+    env.start_server()
+    for _ in range(2):
+        env.start_worker(cpus=1)
+    env.wait_workers(2)
+    # a stream of small tasks large enough to keep both 1-cpu workers busy
+    # far longer than the test timeout if the gang never got priority
+    env.command(
+        ["submit", "--array", "0-199", "--", "bash", "-c", "sleep 0.05"]
+    )
+    env.command(["submit", "--nodes", "2", "--", "bash", "-c",
+                 "echo gang-ran nodes=$HQ_NUM_NODES"])
+    env.command(["job", "wait", "2"], timeout=60)
+    out = env.command(["job", "cat", "2", "stdout"]).strip()
+    assert out == "gang-ran nodes=2"
+
+
+def test_gang_skips_short_lifetime_workers_e2e(env):
+    """Workers whose remaining lifetime cannot cover the gang's --time-request
+    are never chosen as members."""
+    env.start_server()
+    # short-lived pair in their own group: ineligible for a 10-minute gang
+    env.start_worker("--time-limit", "30", "--group", "brief", cpus=1)
+    env.start_worker("--time-limit", "30", "--group", "brief", cpus=1)
+    # long-lived pair
+    env.start_worker(cpus=1)
+    env.start_worker(cpus=1)
+    env.wait_workers(4)
+    dump = json.loads(env.command(["server", "debug-dump"]))
+    brief = {w["id"] for w in dump["workers"] if w["group"] == "brief"}
+    assert len(brief) == 2
+    env.command(["submit", "--nodes", "2", "--time-request", "600",
+                 "--wait", "--", "hostname"])
+    info = json.loads(
+        env.command(["job", "info", "1", "--output-mode", "json"])
+    )
+    workers_used = info[0]["tasks"][0]["workers"]
+    assert len(workers_used) == 2
+    assert not (set(workers_used) & brief), (workers_used, brief)

@@ -218,3 +218,184 @@ def test_gang_assigned_teardown_cancels_survivors():
     canceled_on = {wid for wid, _ in env.comm.cancels}
     assert root in canceled_on and last in canceled_on
     assert mid not in canceled_on
+
+
+def test_gang_ineligible_short_lifetime_workers_never_chosen():
+    """Workers without enough remaining lifetime for the gang's min_time are
+    never picked as members (reference worker.rs is_capable_to_run)."""
+    env = TestEnv()
+    # group g1: enough workers but all about to expire
+    [env.worker(cpus=2, group="g1", time_limit=5.0) for _ in range(3)]
+    # group g2: long-lived workers
+    long_lived = [env.worker(cpus=2, group="g2") for _ in range(3)]
+    (t,) = env.submit(rqv=env.rqv(n_nodes=3, min_time=60.0))
+    env.schedule()
+    task = env.core.tasks[t]
+    assert env.state(t) is TaskState.ASSIGNED
+    assert set(task.mn_workers) == {w.worker_id for w in long_lived}
+
+
+def test_gang_under_resourced_group_stays_pending():
+    env = TestEnv()
+    [env.worker(cpus=2, group="g1", time_limit=5.0) for _ in range(3)]
+    (t,) = env.submit(rqv=env.rqv(n_nodes=3, min_time=60.0))
+    env.schedule()
+    assert env.state(t) is TaskState.READY
+    # expiring workers must not be reserved for a gang they can never host
+    assert all(w.mn_reserved == 0 for w in env.core.workers.values())
+
+
+def test_gang_wins_workers_under_sn_stream():
+    """A pending gang reserves draining workers and eventually claims them,
+    even though same-priority sn tasks keep arriving (anti-starvation)."""
+    env = TestEnv()
+    workers = [env.worker(cpus=1, group="g1") for _ in range(2)]
+    # saturate both workers with running sn tasks
+    busy = env.submit(n=2)
+    env.schedule()
+    env.start_all_assigned()
+    assert all(env.state(i) is TaskState.RUNNING for i in busy)
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    for round_no in range(20):
+        # continuous stream: one new small task per tick
+        env.submit(n=1)
+        env.schedule(prefill=True)
+        if env.state(g) is TaskState.ASSIGNED:
+            break
+        # both workers must be draining for the gang from the first tick
+        assert all(w.mn_reserved == g for w in workers), round_no
+        # finish whatever is running, freeing capacity for the next tick
+        for task in list(env.core.tasks.values()):
+            if task.state is TaskState.RUNNING:
+                env.finish(task.task_id)
+    assert env.state(g) is TaskState.ASSIGNED
+    assert all(w.mn_task == g for w in workers)
+    assert all(w.mn_reserved == 0 for w in workers)
+
+
+def test_gang_defers_to_higher_priority_sn():
+    """Reservation must not hold workers while strictly-higher-priority sn
+    work is pending (priority interleaving, reference solver.rs:479-518)."""
+    env = TestEnv()
+    [env.worker(cpus=1, group="g1") for _ in range(2)]
+    busy = env.submit(n=2)
+    env.schedule()
+    env.start_all_assigned()
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2), priority=(0, 0))
+    env.submit(n=4, priority=(5, 0))
+    env.schedule()
+    assert all(w.mn_reserved == 0 for w in env.core.workers.values())
+    # once the high-priority stream is gone, the gang reserves again
+    for task in list(env.core.tasks.values()):
+        if task.state is TaskState.RUNNING:
+            env.finish(task.task_id)
+    for _ in range(10):
+        env.schedule()
+        for task in list(env.core.tasks.values()):
+            if task.state is TaskState.RUNNING:
+                env.finish(task.task_id)
+            elif task.state is TaskState.ASSIGNED and not task.prefilled:
+                from hyperqueue_tpu.server import reactor as _r
+                _r.on_task_running(
+                    env.core, env.events, task.task_id, task.instance_id
+                )
+        if env.state(g) in (TaskState.ASSIGNED, TaskState.FINISHED):
+            break
+    assert env.state(g) in (
+        TaskState.ASSIGNED,
+        TaskState.RUNNING,
+        TaskState.FINISHED,
+    )
+
+
+def test_gang_cancel_clears_reservations():
+    env = TestEnv()
+    workers = [env.worker(cpus=1, group="g1") for _ in range(2)]
+    busy = env.submit(n=2)
+    env.schedule()
+    env.start_all_assigned()
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    env.schedule()
+    assert all(w.mn_reserved == g for w in workers)
+    env.cancel([g])
+    assert env.state(g) is TaskState.CANCELED
+    assert all(w.mn_reserved == 0 for w in workers)
+    # workers accept sn work again
+    ids = env.submit(n=2)
+    for t in busy:
+        env.finish(t)
+    env.schedule()
+    assert all(env.state(i) is TaskState.ASSIGNED for i in ids)
+
+
+def test_gang_reserves_despite_older_same_priority_job():
+    """Production priorities are (user_priority, -job_id); an older sn job's
+    tuple strictly outranks a newer gang's, but only the USER priority may
+    suppress reservation."""
+    env = TestEnv()
+    workers = [env.worker(cpus=1, group="g1") for _ in range(2)]
+    busy = env.submit(n=2, priority=(0, -1), job=1)
+    env.schedule()
+    env.start_all_assigned()
+    env.submit(n=6, priority=(0, -1), job=1)  # pending sn stream, job 1
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2), priority=(0, -2), job=2)
+    env.schedule()
+    assert all(w.mn_reserved == g for w in workers)
+
+
+def test_unschedulable_high_priority_sn_does_not_block_gang():
+    """A ready sn task no worker can ever run must not suppress gang
+    reservations, no matter its priority."""
+    env = TestEnv()
+    workers = [env.worker(cpus=1, group="g1") for _ in range(2)]
+    busy = env.submit(n=2)
+    env.schedule()
+    env.start_all_assigned()
+    env.submit(n=1, rqv=env.rqv(cpus=64), priority=(9, 0))  # impossible
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    env.schedule()
+    assert all(w.mn_reserved == g for w in workers)
+
+
+def test_gang_reservation_released_when_group_shrinks():
+    """If the reserved group loses eligibility (a member dies), the surviving
+    reservations must lift so those workers rejoin sn scheduling."""
+    env = TestEnv()
+    w1 = env.worker(cpus=1, group="g1")
+    w2 = env.worker(cpus=1, group="g1")
+    busy = env.submit(n=2)
+    env.schedule()
+    env.start_all_assigned()
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    env.schedule()
+    assert w1.mn_reserved == g and w2.mn_reserved == g
+    env.lose_worker(w2.worker_id)
+    env.schedule()
+    assert w1.mn_reserved == 0
+    # w1 accepts sn work again (w2's requeued task or the new one)
+    ids = env.submit(n=1)
+    for t in busy:
+        task = env.core.tasks[t]
+        if task.state is TaskState.RUNNING and task.assigned_worker == w1.worker_id:
+            env.finish(t)
+    env.schedule()
+    assert w1.assigned_tasks, "released worker must accept sn work again"
+
+
+def test_gang_reservation_retract_sent_once():
+    env = TestEnv()
+    workers = [env.worker(cpus=1, group="g1") for _ in range(2)]
+    busy = env.submit(n=2)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    env.submit(n=10)
+    env.schedule(prefill=True)  # builds prefilled backlog on the workers
+    assert any(w.prefilled_tasks for w in workers)
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    before = len(env.comm.retracts)
+    env.schedule(prefill=True)
+    after_first = len(env.comm.retracts)
+    assert after_first > before  # backlog stolen back at reservation time
+    env.schedule(prefill=True)
+    env.schedule(prefill=True)
+    assert len(env.comm.retracts) == after_first  # not re-sent every tick
