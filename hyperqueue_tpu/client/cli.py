@@ -177,10 +177,8 @@ def cmd_worker_start(args) -> None:
         no_hyper_threading=args.no_hyper_threading,
     )
     if args.resource or args.coupling:
-        from hyperqueue_tpu.resources.descriptor import (
-            ResourceDescriptor,
-            ResourceDescriptorCoupling,
-        )
+        from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+        from hyperqueue_tpu.worker.parser import parse_resource_coupling
 
         items = {item.name: item for item in descriptor.items}
         for spec in args.resource or []:
@@ -188,9 +186,7 @@ def cmd_worker_start(args) -> None:
             items[item.name] = item
         coupling = None
         if args.coupling:
-            coupling = ResourceDescriptorCoupling(
-                names=tuple(n.strip() for n in args.coupling.split(","))
-            )
+            coupling = parse_resource_coupling(args.coupling)
         descriptor = ResourceDescriptor(
             items=tuple(items.values()), coupling=coupling
         )

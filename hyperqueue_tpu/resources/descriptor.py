@@ -95,12 +95,52 @@ class ResourceDescriptorItem:
 
 
 @dataclass(frozen=True, slots=True)
+class CouplingWeight:
+    """Affinity weight between group `group1` of `resource1` and group
+    `group2` of `resource2` (reference descriptor.rs:249-265
+    ResourceDescriptorCouplingItem; weights referenced by name here instead
+    of positional index for wire robustness)."""
+
+    resource1: str
+    group1: int
+    resource2: str
+    group2: int
+    weight: int = 256
+
+    def normalized(self) -> "CouplingWeight":
+        if self.resource1 > self.resource2:
+            return CouplingWeight(
+                self.resource2, self.group2, self.resource1, self.group1,
+                self.weight,
+            )
+        return self
+
+
+@dataclass(frozen=True, slots=True)
 class ResourceDescriptorCoupling:
-    """Declares that the listed group-structured resources are coupled (e.g.
-    cpus and gpus attached to the same NUMA node); the worker allocator then
-    prefers allocations whose groups align. Reference descriptor.rs:249-295."""
+    """Declares coupled group-structured resources (e.g. cpus and gpus
+    attached to the same NUMA node); the worker's group solver then prefers
+    allocations whose groups align. Either explicit per-group-pair `weights`
+    (reference descriptor.rs:249-295) or a plain `names` list, which expands
+    to same-index group pairs at default weight 256 (the physical meaning of
+    "socket j of cpus is socket j of gpus")."""
 
     names: tuple[str, ...] = ()
+    weights: tuple[CouplingWeight, ...] = ()
+
+    def expand_weights(
+        self, n_groups_of: dict[str, int]
+    ) -> list[CouplingWeight]:
+        """Concrete weight list; names expand against actual group counts."""
+        if self.weights:
+            return [w.normalized() for w in self.weights]
+        out: list[CouplingWeight] = []
+        names = [n for n in self.names if n in n_groups_of]
+        for i, r1 in enumerate(names):
+            for r2 in names[i + 1:]:
+                for g in range(min(n_groups_of[r1], n_groups_of[r2])):
+                    out.append(CouplingWeight(r1, g, r2, g).normalized())
+        return out
 
 
 @dataclass(frozen=True, slots=True)
@@ -118,6 +158,19 @@ class ResourceDescriptor:
             for name in self.coupling.names:
                 if name not in names:
                     raise ValueError(f"coupling references unknown resource {name!r}")
+            for w in self.coupling.weights:
+                for rname, group in ((w.resource1, w.group1),
+                                     (w.resource2, w.group2)):
+                    it = self.item(rname)
+                    if it is None:
+                        raise ValueError(
+                            f"coupling references unknown resource {rname!r}"
+                        )
+                    if group >= it.n_groups():
+                        raise ValueError(
+                            f"coupling references group {group} of "
+                            f"{rname!r} which has {it.n_groups()} groups"
+                        )
 
     def item(self, name: str) -> ResourceDescriptorItem | None:
         for it in self.items:
@@ -146,8 +199,17 @@ class ResourceDescriptor:
                 )
             )
         coupling = None
-        if data.get("coupling"):
-            coupling = ResourceDescriptorCoupling(names=tuple(data["coupling"]))
+        raw = data.get("coupling")
+        if raw:
+            if isinstance(raw, dict):
+                coupling = ResourceDescriptorCoupling(
+                    names=tuple(raw.get("names") or ()),
+                    weights=tuple(
+                        CouplingWeight(*w) for w in raw.get("weights") or ()
+                    ),
+                )
+            else:  # legacy plain name list
+                coupling = ResourceDescriptorCoupling(names=tuple(raw))
         return cls(items=tuple(items), coupling=coupling)
 
     def to_dict(self) -> dict:
@@ -163,5 +225,13 @@ class ResourceDescriptor:
                 }
                 for it in self.items
             ],
-            "coupling": list(self.coupling.names) if self.coupling else None,
+            "coupling": {
+                "names": list(self.coupling.names),
+                "weights": [
+                    [w.resource1, w.group1, w.resource2, w.group2, w.weight]
+                    for w in self.coupling.weights
+                ],
+            }
+            if self.coupling
+            else None,
         }

@@ -26,6 +26,138 @@ from hyperqueue_tpu.resources.descriptor import (
 )
 from hyperqueue_tpu.resources.request import AllocationPolicy
 
+# Policies whose group choice participates in the joint group solve
+# (reference request.rs:64 is_relevant_for_coupling: scatter and all are not).
+_COUPLING_POLICIES = frozenset(
+    {
+        AllocationPolicy.COMPACT,
+        AllocationPolicy.FORCE_COMPACT,
+        AllocationPolicy.TIGHT,
+        AllocationPolicy.FORCE_TIGHT,
+    }
+)
+_FORCED_POLICIES = frozenset(
+    {AllocationPolicy.FORCE_COMPACT, AllocationPolicy.FORCE_TIGHT}
+)
+
+# Keep the exhaustive subset enumeration bounded (reference caps the fast
+# path at FAST_MAX_GROUPS=8 groups and 3 coupled resources, pool.rs:57-58).
+_MAX_SOLVER_GROUPS = 12
+
+
+def group_solver(
+    states: list[list[tuple[int, int]]],
+    requests: list[tuple[int, int]],
+    weights: list[tuple[int, int, int, int, float]],
+) -> tuple[list[list[int]], float] | None:
+    """Exact NUMA group selection: which groups each coupled resource draws
+    from, maximizing the reference's MILP objective via depth-first
+    branch-and-bound (the reference solves the identical model with an LP
+    solver, worker/resources/groups.rs:19-61).
+
+    states[i]   per group j of resource i: (whole_free_units f_ij,
+                max_partial_fraction g_ij)
+    requests[i] (whole_units r_i, fraction z_i) requested of resource i
+    weights     (i1, j1, i2, j2, w): affinity bonus if group j1 of resource
+                i1 AND group j2 of resource i2 are both selected
+
+    Objective per selected group (groups.rs:59-62): -1024 tax per group (so
+    group count is minimized first), minus f/32 for whole-unit requests
+    (prefer emptier-tail groups), plus g/(U/16) when the group holds a
+    partial index large enough to donate the fractional part; plus the
+    coupling weights of co-selected pairs.
+
+    Returns (selected group indices per resource, objective) or None if
+    infeasible / too large for exact search.
+    """
+    n = len(states)
+    subsets: list[list[tuple[float, int]]] = []  # per resource: (value, mask)
+    for state, (units, fraction) in zip(states, requests):
+        n_groups = len(state)
+        if n_groups > _MAX_SOLVER_GROUPS:
+            return None
+        vals = []
+        for f, g in state:
+            if fraction == 0:
+                vals.append(-1024.0 - f / 32.0)
+            elif g >= fraction:
+                vals.append(-1024.0 + g / (FRACTIONS_PER_UNIT / 16.0))
+            else:
+                vals.append(-1024.0)
+        feasible: list[tuple[float, int]] = []
+        for mask in range(1, 1 << n_groups):
+            whole = 0
+            eff = 0  # whole units + donor bonus (groups.rs:105-112)
+            value = 0.0
+            for j in range(n_groups):
+                if mask >> j & 1:
+                    f, g = state[j]
+                    whole += f
+                    eff += f + (1 if fraction and g >= fraction else 0)
+                    value += vals[j]
+            if fraction == 0:
+                ok = whole >= units
+            else:
+                ok = eff >= units + 1 and whole >= units
+            if ok:
+                feasible.append((value, mask))
+        if not feasible:
+            return None
+        # best value first; ties broken toward lower group indices
+        feasible.sort(key=lambda t: (-t[0], t[1]))
+        subsets.append(feasible)
+
+    # bound: best subset value for the remaining resources plus every weight
+    # that could still apply
+    best_tail = [0.0] * (n + 1)
+    for i in range(n - 1, -1, -1):
+        best_tail[i] = best_tail[i + 1] + subsets[i][0][0]
+    weight_by_hi = [0.0] * n  # weights whose higher resource index is i
+    for i1, _j1, i2, _j2, w in weights:
+        if w > 0:
+            weight_by_hi[max(i1, i2)] += w
+    weight_tail = [0.0] * (n + 1)
+    for i in range(n - 1, -1, -1):
+        weight_tail[i] = weight_tail[i + 1] + weight_by_hi[i]
+
+    best_obj = -float("inf")
+    best_masks: list[int] | None = None
+    chosen = [0] * n
+
+    def dfs(i: int, acc: float) -> None:
+        nonlocal best_obj, best_masks
+        if i == n:
+            if acc > best_obj:
+                best_obj = acc
+                best_masks = chosen[:]
+            return
+        if acc + best_tail[i] + weight_tail[i] <= best_obj:
+            return
+        for value, mask in subsets[i]:
+            bonus = 0.0
+            for i1, j1, i2, j2, w in weights:
+                hi, lo = (i1, i2) if i1 > i2 else (i2, i1)
+                hj, lj = (j1, j2) if i1 > i2 else (j2, j1)
+                if hi == i and lo <= i:
+                    sel_lo = mask if lo == i else chosen[lo]
+                    sel_hi = mask
+                    if sel_hi >> hj & 1 and sel_lo >> lj & 1:
+                        bonus += w
+            chosen[i] = mask
+            dfs(i + 1, acc + value + bonus)
+        chosen[i] = 0
+
+    dfs(0, 0.0)
+    if best_masks is None:
+        return None
+    return (
+        [
+            [j for j in range(len(states[i])) if best_masks[i] >> j & 1]
+            for i in range(n)
+        ],
+        best_obj,
+    )
+
 
 @dataclass
 class ResourceClaim:
@@ -75,6 +207,24 @@ class _IndexPool:
 
     def total_free(self) -> int:
         return len(self.free) * FRACTIONS_PER_UNIT + sum(self.partial.values())
+
+    def group_free_state(self) -> list[tuple[int, int]]:
+        """(whole_free_units, max_partial_fraction) per group — the f/g
+        columns of the group solver (reference concise.rs amount_max_per_group)."""
+        whole = [0] * len(self.groups)
+        for label in self.free:
+            whole[self.group_of[label]] += 1
+        frac = [0] * len(self.groups)
+        for label, remaining in self.partial.items():
+            gi = self.group_of[label]
+            if remaining > frac[gi]:
+                frac[gi] = remaining
+        return list(zip(whole, frac))
+
+    def group_full_state(self) -> list[tuple[int, int]]:
+        """Group state of a completely empty worker (for the forced-policy
+        optimality baseline, reference allocator.rs:152-166)."""
+        return [(len(g), 0) for g in self.groups]
 
     def _group_free_count(self) -> dict[int, int]:
         counts = {gi: 0 for gi in range(len(self.groups))}
@@ -134,7 +284,11 @@ class _IndexPool:
         amount: int,
         policy: AllocationPolicy,
         preferred_groups: set[int] | None = None,
+        group_mask: set[int] | None = None,
     ) -> ResourceClaim | None:
+        """group_mask: restrict the claim to these groups — the group solver
+        already made the group decision (reference pool.rs
+        claim_resources_with_group_mask)."""
         if policy is AllocationPolicy.ALL:
             if self.partial or not self.free:
                 return None
@@ -142,28 +296,35 @@ class _IndexPool:
             self.free.clear()
             return claim
         units, fraction = divmod(amount, FRACTIONS_PER_UNIT)
-        if self.total_free() < amount:
+        if group_mask is not None:
+            in_mask = lambda l: self.group_of[l] in group_mask  # noqa: E731
+        else:
+            in_mask = lambda l: True  # noqa: E731
+        has_partial_donor = bool(fraction) and any(
+            f >= fraction for l, f in self.partial.items() if in_mask(l)
+        )
+        need = units + (1 if fraction and not has_partial_donor else 0)
+        if sum(1 for l in self.free if in_mask(l)) < need:
             return None
-        if len(self.free) < units or (
-            fraction
-            and len(self.free) == units
-            and not any(f >= fraction for f in self.partial.values())
-        ):
-            return None
-        ordered = self._ordered_free(policy, units, preferred_groups)
-        if policy in (AllocationPolicy.FORCE_COMPACT,):
-            # all units must come from the minimal number of groups
+        ordered = [
+            l
+            for l in self._ordered_free(policy, units, preferred_groups)
+            if in_mask(l)
+        ]
+        if group_mask is None and policy is AllocationPolicy.FORCE_COMPACT:
+            # all units must come from the minimal number of groups (the
+            # masked path skips this: the group solver already enforced it)
             counts = self._group_free_count()
-            need = units + (1 if fraction else 0)
+            fc_need = units + (1 if fraction else 0)
             best = sorted(counts.values(), reverse=True)
             got, n_groups = 0, 0
             for c in best:
-                if got >= need:
+                if got >= fc_need:
                     break
                 got += c
                 n_groups += 1
             # verify the ordered prefix uses exactly n_groups groups
-            prefix = ordered[:need]
+            prefix = ordered[:fc_need]
             if len({self.group_of[l] for l in prefix}) > max(n_groups, 1):
                 return None
         taken = ordered[:units]
@@ -174,7 +335,7 @@ class _IndexPool:
             # prefer an already-partial index with enough remaining
             donor = None
             for label, remaining in sorted(self.partial.items()):
-                if remaining >= fraction:
+                if in_mask(label) and remaining >= fraction:
                     donor = label
                     break
             if donor is not None:
@@ -240,56 +401,134 @@ class ResourceAllocator:
 
     def __init__(self, descriptor: ResourceDescriptor):
         self.pools: dict[str, _IndexPool | _SumPool] = {}
-        self.coupled: set[str] = set(
-            descriptor.coupling.names if descriptor.coupling else ()
-        )
         for item in descriptor.items:
             if item.kind is DescriptorKind.SUM:
                 self.pools[item.name] = _SumPool(item.sum_size)
             else:
                 self.pools[item.name] = _IndexPool(item.index_groups())
+        n_groups_of = {
+            name: len(pool.groups)
+            for name, pool in self.pools.items()
+            if isinstance(pool, _IndexPool)
+        }
+        self.coupling_weights = (
+            descriptor.coupling.expand_weights(n_groups_of)
+            if descriptor.coupling
+            else []
+        )
+        # forced-policy optimality baseline: objective achievable on an
+        # EMPTY worker, cached per request shape (reference allocator.rs
+        # optional_objectives)
+        self._optimal_cache: dict[tuple, float] = {}
+
+    def _solve_groups(
+        self, coupled: list[tuple[dict, "_IndexPool"]], empty: bool
+    ) -> tuple[list[list[int]], float] | None:
+        states = []
+        requests = []
+        index_of = {entry["name"]: i for i, (entry, _) in enumerate(coupled)}
+        for entry, pool in coupled:
+            states.append(
+                pool.group_full_state() if empty else pool.group_free_state()
+            )
+            requests.append(divmod(int(entry["amount"]), FRACTIONS_PER_UNIT))
+        weights = [
+            (
+                index_of[w.resource1],
+                w.group1,
+                index_of[w.resource2],
+                w.group2,
+                float(w.weight),
+            )
+            for w in self.coupling_weights
+            if w.resource1 in index_of and w.resource2 in index_of
+        ]
+        return group_solver(states, requests, weights)
 
     def try_allocate(self, entries: list[dict]) -> Allocation | None:
         """entries: [{name, amount, policy}] from the compute message.
 
-        Coupled resources (descriptor coupling) are allocated first and their
-        groups steer later coupled claims onto the same groups — the
-        lightweight equivalent of the reference's worker-side group MILP
-        (reference worker/resources/groups.rs:19-61).
-        """
-        allocation = Allocation()
-        used_groups: set[int] = set()
-        # scarcest coupled resource first so it anchors the group choice
-        def order_key(entry):
-            if entry["name"] not in self.coupled:
-                return (1, 0)
+        Multi-group (NUMA) resources with coupling-relevant policies are
+        group-decided JOINTLY by the exact group solver — minimal group
+        count, maximal coupling weight — and then claimed within the chosen
+        group masks (reference allocator.rs:115-205 has_resources_for_request
+        + claim_resources). Forced policies additionally require the solve to
+        be as good as on an empty worker, else the task waits."""
+        coupled: list[tuple[dict, _IndexPool]] = []
+        any_forced = False
+        for entry in entries:
             pool = self.pools.get(entry["name"])
-            return (0, pool.total_free() if pool else 0)
-
-        for entry in sorted(entries, key=order_key):
-            pool = self.pools.get(entry["name"])
-            policy = AllocationPolicy.parse(entry.get("policy", "compact"))
             if pool is None:
-                self._rollback(allocation)
                 return None
-            coupled = entry["name"] in self.coupled
-            claim = pool.allocate(
-                int(entry["amount"]),
-                policy,
-                preferred_groups=used_groups if coupled else None,
-            ) if isinstance(pool, _IndexPool) else pool.allocate(
-                int(entry["amount"]), policy
-            )
+            policy = AllocationPolicy.parse(entry.get("policy", "compact"))
+            if (
+                isinstance(pool, _IndexPool)
+                and 1 < len(pool.groups) <= _MAX_SOLVER_GROUPS
+                and policy in _COUPLING_POLICIES
+            ):
+                coupled.append((entry, pool))
+                any_forced = any_forced or policy in _FORCED_POLICIES
+        # run the solver only when it can change the outcome: a forced
+        # policy needs the optimality check, or coupling weights tie at
+        # least two of the requested resources together; plain compact/tight
+        # without weights is served by the cheap per-pool ordering (the
+        # solver's per-group objective agrees with it)
+        names = {e["name"] for e, _ in coupled}
+        weights_apply = any(
+            w.resource1 in names and w.resource2 in names
+            for w in self.coupling_weights
+        )
+        if not any_forced and not weights_apply:
+            coupled = []
+
+        masks: dict[str, set[int]] = {}
+        if coupled:
+            solved = self._solve_groups(coupled, empty=False)
+            if solved is None:
+                # genuinely infeasible right now (pools over the size guard
+                # were never admitted into `coupled`)
+                if any_forced:
+                    return None
+                # non-forced: fall through, unmasked claims will fail cleanly
+            else:
+                groups_sel, objective = solved
+                if any_forced:
+                    key = tuple(
+                        (e["name"], int(e["amount"])) for e, _ in coupled
+                    )
+                    optimal = self._optimal_cache.get(key)
+                    if optimal is None:
+                        empty_solved = self._solve_groups(coupled, empty=True)
+                        if empty_solved is None:
+                            return None
+                        optimal = empty_solved[1] - 0.1
+                        if len(self._optimal_cache) >= 1024:
+                            self._optimal_cache.pop(
+                                next(iter(self._optimal_cache))
+                            )
+                        self._optimal_cache[key] = optimal
+                    if objective < optimal:
+                        return None  # a better-shaped moment will come
+                for (entry, _pool), sel in zip(coupled, groups_sel):
+                    masks[entry["name"]] = set(sel)
+
+        allocation = Allocation()
+        for entry in entries:
+            pool = self.pools[entry["name"]]
+            policy = AllocationPolicy.parse(entry.get("policy", "compact"))
+            if isinstance(pool, _IndexPool):
+                claim = pool.allocate(
+                    int(entry["amount"]),
+                    policy,
+                    group_mask=masks.get(entry["name"]),
+                )
+            else:
+                claim = pool.allocate(int(entry["amount"]), policy)
             if claim is None:
                 self._rollback(allocation)
                 return None
             claim.resource = entry["name"]
             allocation.claims.append(claim)
-            if coupled and isinstance(pool, _IndexPool):
-                for label in claim.indices:
-                    used_groups.add(pool.group_of[label])
-                if claim.fraction_index is not None:
-                    used_groups.add(pool.group_of[claim.fraction_index])
         return allocation
 
     def _rollback(self, allocation: Allocation) -> None:

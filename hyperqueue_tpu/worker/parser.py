@@ -88,3 +88,40 @@ def _parse_nested_list(value: str, spec: str) -> list[list[str]]:
     if not groups:
         raise ResourceParseError(f"empty group list in {spec!r}")
     return groups
+
+
+def parse_resource_coupling(text: str):
+    """Parse a --coupling value into a ResourceDescriptorCoupling.
+
+    Two forms (reference parser.rs:229 parse_resource_coupling):
+      "cpus,gpus"                        — plain names: same-index groups of
+                                           the listed resources couple at the
+                                           default weight 256
+      "cpus[0]:gpus[0]=256,cpus[1]:gpus[1]" — explicit weighted group pairs
+                                           (weight defaults to 256)
+    """
+    from hyperqueue_tpu.resources.descriptor import (
+        CouplingWeight,
+        ResourceDescriptorCoupling,
+    )
+
+    text = text.strip()
+    if "[" not in text:
+        return ResourceDescriptorCoupling(
+            names=tuple(n.strip() for n in text.split(",") if n.strip())
+        )
+    item_re = re.compile(
+        r"^\s*(\w+)\[(\d+)\]\s*:\s*(\w+)\[(\d+)\]\s*(?:=\s*(\d+))?\s*$"
+    )
+    weights = []
+    for part in text.split(","):
+        m = item_re.match(part)
+        if m is None:
+            raise ResourceParseError(f"invalid coupling item {part.strip()!r}")
+        r1, g1, r2, g2, w = m.groups()
+        weights.append(
+            CouplingWeight(
+                r1, int(g1), r2, int(g2), int(w) if w else 256
+            ).normalized()
+        )
+    return ResourceDescriptorCoupling(weights=tuple(weights))

@@ -142,3 +142,214 @@ def test_multi_resource_rollback():
 def test_unknown_resource_fails():
     alloc = make_allocator()
     assert alloc.try_allocate([entry("fpgas", U)]) is None
+
+
+# ---------------------------------------------------------------------------
+# Coupling (NUMA) group-solver tests, transliterated from the reference
+# worker/resources/test_allocator.rs test_coupling1/2/3, test_complex_coupling1/2,
+# test_coupling_force2/3. `sockets(n, k)` builds n groups of k indices with
+# global sequential labels, like the reference's regular_sockets.
+# ---------------------------------------------------------------------------
+
+from hyperqueue_tpu.resources.descriptor import (  # noqa: E402
+    CouplingWeight,
+    ResourceDescriptorCoupling,
+)
+
+
+def sockets(n, k):
+    return [[str(n_ * k + i) for i in range(k)] for n_ in range(n)]
+
+
+def coupled_allocator(items, weights):
+    desc = ResourceDescriptor(
+        items=tuple(items),
+        coupling=ResourceDescriptorCoupling(
+            weights=tuple(CouplingWeight(*w) for w in weights)
+        ),
+    )
+    desc.validate()
+    return ResourceAllocator(desc)
+
+
+def claim_groups(alloc, allocation, name):
+    """group index -> count of claimed indices (incl. the fraction donor),
+    like the reference Allocation::get_groups."""
+    claim = allocation.claim_for(name)
+    pool = alloc.pools[name]
+    out = {}
+    labels = list(claim.indices)
+    if claim.fraction_index is not None:
+        labels.append(claim.fraction_index)
+    for label in labels:
+        gi = pool.group_of[label]
+        out[gi] = out.get(gi, 0) + 1
+    return out
+
+
+def force_claim(alloc, name, group, n_units):
+    """Claim n whole indices from one group directly (reference
+    force_claim_from_groups test helper)."""
+    pool = alloc.pools[name]
+    victims = [l for l in pool.free if pool.group_of[l] == group][:n_units]
+    assert len(victims) == n_units
+    for label in victims:
+        pool.free.remove(label)
+
+
+def test_coupling1():
+    for i in range(3):
+        items = [
+            ResourceDescriptorItem.group_list("cpus", sockets(4, 3)),
+            ResourceDescriptorItem.group_list("foo", sockets(4, 1)),
+            ResourceDescriptorItem.group_list("gpus", sockets(4, 4)),
+        ]
+        weights = [("cpus", j, "gpus", j, 256) for j in range(4)]
+        alloc = coupled_allocator(items, weights)
+        for _ in range(i):
+            assert alloc.try_allocate([entry("cpus", 2 * U)]) is not None
+        a = alloc.try_allocate([entry("cpus", 2 * U), entry("gpus", 2 * U)])
+        assert a is not None
+        g_cpus = claim_groups(alloc, a, "cpus")
+        g_gpus = claim_groups(alloc, a, "gpus")
+        assert len(g_cpus) == 1
+        assert set(g_cpus) == set(g_gpus)
+        assert len(a.claim_for("cpus").indices) == 2
+        assert len(a.claim_for("gpus").indices) == 2
+
+
+def cpus_gpus_allocator(n_sockets, k1, k2, coupled=True):
+    items = [
+        ResourceDescriptorItem.group_list("cpus", sockets(n_sockets, k1)),
+        ResourceDescriptorItem.group_list("gpus", sockets(n_sockets, k2)),
+    ]
+    weights = (
+        [("cpus", j, "gpus", j, 256) for j in range(n_sockets)]
+        if coupled
+        else []
+    )
+    return coupled_allocator(items, weights)
+
+
+def test_coupling2():
+    alloc = cpus_gpus_allocator(4, 4, 2)
+    a = alloc.try_allocate([entry("cpus", 4 * U), entry("gpus", 3 * U)])
+    assert a is not None
+    g_cpus = claim_groups(alloc, a, "cpus")
+    g_gpus = claim_groups(alloc, a, "gpus")
+    assert len(g_cpus) == 1
+    assert len(g_gpus) == 2
+    assert set(g_cpus) & set(g_gpus)  # one gpu socket is the cpu socket
+    assert list(g_cpus.values()) == [4]
+    assert sorted(g_gpus.values()) == [1, 2]
+
+
+def test_coupling3():
+    alloc = cpus_gpus_allocator(4, 4, 2)
+    a = alloc.try_allocate(
+        [entry("cpus", 1000), entry("gpus", 5000)]
+    )
+    assert a is not None
+    g_cpus = claim_groups(alloc, a, "cpus")
+    g_gpus = claim_groups(alloc, a, "gpus")
+    assert len(g_cpus) == 1
+    assert g_cpus == g_gpus
+
+
+def test_complex_coupling1():
+    items = [
+        ResourceDescriptorItem.group_list("cpus", sockets(6, 2)),
+        ResourceDescriptorItem.group_list("gpus", sockets(3, 1)),
+        ResourceDescriptorItem.group_list("foo", sockets(6, 3)),
+    ]
+    weights = []
+    for i in range(6):
+        weights.append(("cpus", i, "gpus", i // 2, 256))
+        weights.append(("gpus", i // 2, "foo", i, 128))
+    alloc = coupled_allocator(items, weights)
+    force_claim(alloc, "cpus", 0, 1)
+    force_claim(alloc, "foo", 5, 2)
+    a = alloc.try_allocate(
+        [
+            entry("cpus", 4 * U, "compact!"),
+            entry("gpus", 1 * U, "compact!"),
+            entry("foo", 5 * U, "compact!"),
+        ]
+    )
+    assert a is not None
+    g = claim_groups(alloc, a, "cpus")
+    assert sorted(g) == [2, 3]
+    assert sorted(g.values()) == [2, 2]
+    g = claim_groups(alloc, a, "gpus")
+    assert sorted(g) == [1]
+    assert list(g.values()) == [1]
+    g = claim_groups(alloc, a, "foo")
+    assert sorted(g) == [2, 3]
+    assert sorted(g.values()) == [2, 3]
+
+
+def test_complex_coupling2():
+    items = [
+        ResourceDescriptorItem.group_list("cpus", sockets(3, 1)),
+        ResourceDescriptorItem.group_list("gpus", sockets(3, 1)),
+        ResourceDescriptorItem.group_list("foo", sockets(3, 1)),
+    ]
+    weights = [
+        ("cpus", 2, "gpus", 1, 256),
+        ("cpus", 0, "gpus", 1, 128),
+        ("gpus", 1, "foo", 0, 256),
+    ]
+    alloc = coupled_allocator(items, weights)
+    a = alloc.try_allocate(
+        [
+            entry("cpus", 1 * U, "compact!"),
+            entry("gpus", 1 * U, "compact!"),
+            entry("foo", 1 * U, "compact!"),
+        ]
+    )
+    assert a is not None
+    assert a.claim_for("cpus").indices == ["2"]
+    assert a.claim_for("gpus").indices == ["1"]
+    assert a.claim_for("foo").indices == ["0"]
+
+
+def test_coupling_force2():
+    for coupled in (True, False):
+        alloc = cpus_gpus_allocator(3, 2, 2, coupled=coupled)
+        for g in (0, 1):
+            force_claim(alloc, "cpus", g, 2)
+        for g in (1, 2):
+            force_claim(alloc, "gpus", g, 2)
+        a = alloc.try_allocate(
+            [entry("cpus", 1 * U, "compact!"), entry("gpus", 1 * U, "compact!")]
+        )
+        # with coupling the only feasible placement (cpus@2, gpus@0) loses
+        # the weight an empty worker would get -> forced request must wait
+        assert (a is None) == coupled
+
+
+def test_coupling_force3():
+    alloc = cpus_gpus_allocator(4, 2, 2)
+    for g in (0, 1):
+        force_claim(alloc, "cpus", g, 2)
+    for g in (1, 3):
+        force_claim(alloc, "gpus", g, 1)
+    a = alloc.try_allocate(
+        [entry("cpus", 3 * U, "compact!"), entry("gpus", 3 * U, "compact!")]
+    )
+    assert a is not None
+    g0 = claim_groups(alloc, a, "cpus")
+    assert sorted(g0) == [2, 3]
+    g1 = claim_groups(alloc, a, "gpus")
+    assert sorted(g1) == [2, 3]
+
+
+def test_force_compact_large_group_count_no_starvation():
+    """compact! on a resource with more groups than the exact solver admits
+    must fall back to the legacy minimal-group check, not block forever."""
+    groups = [[str(g * 2), str(g * 2 + 1)] for g in range(16)]
+    alloc = make_allocator(groups=groups)
+    a = alloc.try_allocate([entry("cpus", 2 * U, "compact!")])
+    assert a is not None
+    assert len({alloc.pools["cpus"].group_of[i]
+                for i in a.claim_for("cpus").indices}) == 1

@@ -175,3 +175,79 @@ def test_worker_resources():
         ResourceRequestVariants(variants=(too_big, ok))
     )
     assert wr.to_dense_row(4) == [160000, 20000, 0, 0]
+
+
+def test_parse_resource_coupling():
+    """Reference parser.rs:654 test_parse_resource_coupling equivalent."""
+    from hyperqueue_tpu.worker.parser import parse_resource_coupling
+
+    c = parse_resource_coupling("cpus,gpus")
+    assert c.names == ("cpus", "gpus") and not c.weights
+
+    c = parse_resource_coupling("cpus[0]:gpus[0]=512, cpus[1]:gpus[1]")
+    assert not c.names
+    assert len(c.weights) == 2
+    assert c.weights[0].weight == 512
+    assert c.weights[1].weight == 256
+    # normalization orders resources alphabetically within an item
+    c = parse_resource_coupling("gpus[1]:cpus[0]=64")
+    (w,) = c.weights
+    assert (w.resource1, w.group1, w.resource2, w.group2) == ("cpus", 0, "gpus", 1)
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        parse_resource_coupling("cpus[0]gpus[0]")
+
+
+def test_coupling_descriptor_roundtrip():
+    from hyperqueue_tpu.resources.descriptor import (
+        CouplingWeight,
+        ResourceDescriptor,
+        ResourceDescriptorCoupling,
+        ResourceDescriptorItem,
+    )
+
+    desc = ResourceDescriptor(
+        items=(
+            ResourceDescriptorItem.group_list(
+                "cpus", [["0", "1"], ["2", "3"]]
+            ),
+            ResourceDescriptorItem.group_list("gpus", [["a"], ["b"]]),
+        ),
+        coupling=ResourceDescriptorCoupling(
+            weights=(CouplingWeight("cpus", 0, "gpus", 0, 256),)
+        ),
+    )
+    desc.validate()
+    back = ResourceDescriptor.from_dict(desc.to_dict())
+    assert back == desc
+    # legacy wire form (plain name list) still decodes
+    legacy = dict(desc.to_dict(), coupling=["cpus", "gpus"])
+    d2 = ResourceDescriptor.from_dict(legacy)
+    assert d2.coupling.names == ("cpus", "gpus")
+    # names expand to same-index pairs against group counts
+    ws = d2.coupling.expand_weights({"cpus": 2, "gpus": 2})
+    assert len(ws) == 2 and all(w.weight == 256 for w in ws)
+
+
+def test_coupling_validate_rejects_bad_group():
+    from hyperqueue_tpu.resources.descriptor import (
+        CouplingWeight,
+        ResourceDescriptor,
+        ResourceDescriptorCoupling,
+        ResourceDescriptorItem,
+    )
+
+    desc = ResourceDescriptor(
+        items=(
+            ResourceDescriptorItem.group_list("cpus", [["0"], ["1"]]),
+        ),
+        coupling=ResourceDescriptorCoupling(
+            weights=(CouplingWeight("cpus", 0, "cpus", 7),)
+        ),
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        desc.validate()
