@@ -27,14 +27,14 @@ MAX_CUTS_PER_QUEUE = 32
 MAX_SAFE_AMOUNT = 2**23
 
 
-@dataclass
+@dataclass(slots=True)
 class Batch:
     rq_id: int
     priority: Priority
     size: int
 
 
-@dataclass
+@dataclass(slots=True)
 class WorkerRow:
     worker_id: int
     free: list[int]       # dense fractions, aligned to ResourceIdMap
@@ -42,7 +42,7 @@ class WorkerRow:
     lifetime_secs: int    # INF_TIME if unlimited
 
 
-@dataclass
+@dataclass(slots=True)
 class Assignment:
     task_id: int
     worker_id: int
@@ -145,24 +145,24 @@ def run_tick(
 
     assignments: list[Assignment] = []
     counts = np.asarray(counts)
-    for bi, batch in enumerate(batches):
-        per_worker = counts[bi]  # (V, W)
-        if per_worker.sum() == 0:
-            continue
-        queue = queues.queue(batch.rq_id)
-        variants = rq_map.get_variants(batch.rq_id).variants
-        for vi in range(len(variants)):
-            for wi in np.nonzero(per_worker[vi])[0]:
-                n = int(per_worker[vi][wi])
-                task_ids = queue.take(batch.priority, n)
-                row = workers[wi]
-                for task_id in task_ids:
-                    assignments.append(
-                        Assignment(
-                            task_id=task_id,
-                            worker_id=row.worker_id,
-                            rq_id=batch.rq_id,
-                            variant=vi,
-                        )
-                    )
+    # one global nonzero over (B, V, W): row-major order preserves the
+    # per-batch FIFO take semantics of the nested loop it replaces
+    bs, vs, ws = np.nonzero(counts)
+    vals = counts[bs, vs, ws]
+    append = assignments.append
+    cur_bi = -1
+    queue = rq_id = priority = None
+    for bi, vi, wi, n in zip(
+        bs.tolist(), vs.tolist(), ws.tolist(), vals.tolist()
+    ):
+        if bi != cur_bi:  # bs is sorted: hoist per-batch lookups per run
+            cur_bi = bi
+            batch = batches[bi]
+            rq_id = batch.rq_id
+            priority = batch.priority
+            queue = queues.queue(rq_id)
+        task_ids = queue.take(priority, n)
+        worker_id = workers[wi].worker_id
+        for task_id in task_ids:
+            append(Assignment(task_id, worker_id, rq_id, vi))
     return assignments
