@@ -120,6 +120,40 @@ def run_tick(
         nt_free[i] = max(row.nt_free, 0)
         lifetime[i] = row.lifetime_secs
 
+    # Most-constrained-first within a priority level: a class that can ONLY
+    # run on scarce resources is placed before same-priority classes with
+    # more options, so flexible work cannot strand the few workers carrying
+    # a scarce pool (the reference MILP reaches the same outcome by solving
+    # the level jointly, solver.rs; pinned by
+    # test_scheduler_golden.test_gap_filling2_exact_class_counts).
+    # Constrainedness is the MINIMUM over variants: a class with a
+    # commodity-resource fallback is flexible no matter how scarce its
+    # preferred variant is, and ordering it first would let its fallback
+    # spill eat the common pool ahead of cheaper classes.
+    # One scarcity notion for the whole solve (ops/assign.scarcity_weights,
+    # also used for worker visit order): zero-capacity resources weigh 0
+    # (an unschedulable class must not sort first), and free is clamped at 0
+    # — over-commit from prefill races can drive worker free negative, like
+    # the nt_free clamp above.
+    from hyperqueue_tpu.ops.assign import scarcity_weights
+
+    weights = scarcity_weights(np.maximum(free, 0).sum(axis=0))
+
+    def _scarcity(batch: Batch) -> float:
+        score = float("inf")
+        for variant in rq_map.get_variants(batch.rq_id).variants:
+            v_score = 0.0
+            for entry in variant.entries:
+                if entry.amount > 0 and entry.resource_id < n_r:
+                    s = float(weights[entry.resource_id])
+                    if s > v_score:
+                        v_score = s
+            if v_score < score:
+                score = v_score
+        return 0.0 if score == float("inf") else score
+
+    batches.sort(key=lambda b: (b.priority, _scarcity(b)), reverse=True)
+
     needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
     sizes = np.zeros(n_b, dtype=np.int32)
     min_time = np.zeros((n_b, n_v), dtype=np.int32)

@@ -1,0 +1,441 @@
+"""Golden scheduler tests transliterated from the reference tier-1 suites
+(crates/tako/src/internal/tests/test_scheduler_sn.rs, test_scheduler_mn.rs).
+
+The reference asserts exact task->worker placements of its MILP; this solver
+is a scarcity-ordered greedy water-fill, so each case is ported at the level
+the reference test actually pins down: WHICH classes get how many tasks
+scheduled under priorities/gaps/variants/time, and that no worker exceeds
+capacity. Placement-shape deviations that are intentional (spreading instead
+of packing) are documented inline at the cases that expose them.
+"""
+
+import numpy as np
+
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.server.task import TaskState
+
+from utils_env import TestEnv
+
+U = 10_000
+INF = int(INF_TIME)
+MODEL = GreedyCutScanModel()
+
+
+def schedule_case(workers, classes, nt_free=64, lifetimes=None):
+    """Drive the PRODUCTION tick path (TaskQueues -> create_batches ->
+    run_tick -> mapping) on a synthetic case.
+
+    workers: [cpus] or [(cpus, extra_resource_amounts...)]; classes:
+    [(priority, n_tasks, needs[, min_time_secs])] where needs is cpus or a
+    tuple per resource. Returns (per-class assigned counts, per-worker cpu
+    use, assignments)."""
+    from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.scheduler.queues import TaskQueues
+    from hyperqueue_tpu.scheduler.tick import WorkerRow, run_tick
+
+    n_r = 1
+    for w in workers:
+        if isinstance(w, tuple):
+            n_r = max(n_r, len(w))
+    for c in classes:
+        if isinstance(c[2], tuple):
+            n_r = max(n_r, len(c[2]))
+
+    resource_map = ResourceIdMap()
+    for r in range(n_r):
+        resource_map.get_or_create(f"r{r}")
+    rq_map = ResourceRqMap()
+    queues = TaskQueues()
+    class_of = {}
+    class_rq = []
+    next_id = 1
+    for ci, cls in enumerate(classes):
+        req = cls[2] if isinstance(cls[2], tuple) else (cls[2],)
+        entries = tuple(
+            ResourceRequestEntry(r, int(a * U))
+            for r, a in enumerate(req)
+            if a
+        )
+        min_time = float(cls[3]) if len(cls) > 3 else 0.0
+        rqv = ResourceRequestVariants.single(
+            ResourceRequest(entries=entries, min_time_secs=min_time)
+        )
+        rq_id = rq_map.get_or_create(rqv)
+        class_rq.append(rq_id)
+        for _ in range(cls[1]):
+            queues.add(rq_id, (cls[0], 0), next_id)
+            class_of[next_id] = ci
+            next_id += 1
+
+    rows = []
+    free = np.zeros((len(workers), n_r), dtype=np.int64)
+    for i, w in enumerate(workers):
+        amounts = w if isinstance(w, tuple) else (w,)
+        row_free = [0] * n_r
+        for r, a in enumerate(amounts):
+            row_free[r] = a * U
+            free[i, r] = a * U
+        life = lifetimes[i] if lifetimes is not None else INF
+        rows.append(
+            WorkerRow(
+                worker_id=i + 1,
+                free=row_free,
+                nt_free=nt_free,
+                lifetime_secs=int(life),
+            )
+        )
+
+    assignments = run_tick(queues, rows, rq_map, resource_map, MODEL)
+
+    per_class = [0] * len(classes)
+    used = np.zeros((len(workers), n_r), dtype=np.int64)
+    for a in assignments:
+        per_class[class_of[a.task_id]] += 1
+        for e in rq_map.get_variants(a.rq_id).variants[a.variant].entries:
+            used[a.worker_id - 1, e.resource_id] += e.amount
+    assert (used <= free).all(), "capacity violated"
+    per_worker_cpu = (used[:, 0] // U).tolist()
+    return per_class, per_worker_cpu, assignments
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:157 test_schedule_no_priorities
+# ---------------------------------------------------------------------------
+
+def test_no_priorities_single_fits():
+    got, _, _ = schedule_case([3], [(0, 1, 3)])
+    assert got == [1]
+
+
+def test_no_priorities_spread_two_workers():
+    # [2,2,2] cpus on two w4: all three run (2 + 1)
+    got, per_w, _ = schedule_case([4, 4], [(0, 3, 2)])
+    assert got == [3]
+    assert sorted(per_w) == [2, 4]
+
+
+def test_no_priorities_five_tasks_two_w4():
+    got, _, _ = schedule_case([4, 4], [(0, 5, 2)])
+    assert got == [4]  # 2 + 2 fit, the fifth waits
+
+
+def test_no_priorities_unschedulable_class_not_counted():
+    # 5-cpu tasks cannot run on w4 boxes; all five 1-cpu tasks do
+    got, _, _ = schedule_case([4, 4], [(0, 2, 5), (0, 5, 1)])
+    assert got == [0, 5]
+
+
+def test_no_priorities_mixed_sizes():
+    # [2,3] on one w4: only one of them fits (either), ref picks the 3
+    got, _, _ = schedule_case([4], [(0, 1, 2), (0, 1, 3)])
+    assert sum(got) == 1
+
+
+def test_no_priorities_three_sizes_two_w4():
+    # [3,4,2] over 2x w4: max two tasks are placeable
+    got, _, _ = schedule_case([4, 4], [(0, 1, 3), (0, 1, 4), (0, 1, 2)])
+    assert sum(got) == 2
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:227 test_schedule_priorities
+# ---------------------------------------------------------------------------
+
+def test_priorities_higher_class_first():
+    # [(0,4)x2, (1,2), (2,3)] on 2x w4: prio2 and prio1 run, prio0 blocked
+    got, _, _ = schedule_case(
+        [4, 4], [(0, 2, 4), (1, 1, 2), (2, 1, 3)]
+    )
+    assert got == [0, 1, 1]
+
+
+def test_priorities_same_user_prio_both_run():
+    got, _, _ = schedule_case([4, 4], [(1, 2, 2)])
+    assert got == [2]
+    # NOTE deviation: the reference packs both onto one worker; this solver
+    # water-fills across workers by design (spreading improves retract and
+    # failure blast radius; packing is the reference MILP's weight choice).
+
+
+def test_priorities_cumsum_cut():
+    # test_scheduler_sn.rs:269 cumsum case on w10: classes by priority
+    # 9..2, sizes [2,1,2,1,2,1,2,1] cpus=1 each? No: (prio, cpus): each
+    # entry is ONE task with that cpu count; first six tasks fit (9 cpus).
+    classes = [
+        (9, 1, 2), (8, 1, 1), (7, 1, 2), (6, 1, 1),
+        (5, 1, 2), (4, 1, 1), (3, 1, 2), (2, 1, 1),
+    ]
+    got, _, _ = schedule_case([10], classes)
+    assert got[:6] == [1] * 6
+    assert got[6] == 0  # (3,2) does not fit in the 1-cpu gap
+    # NOTE deviation: the reference also leaves (2,1) unscheduled (its
+    # blocker reservation covers the tail); this solver gap-fills the final
+    # 1-cpu task into the remaining cpu — strictly higher utilization with
+    # the same priority cut.
+    assert got[7] == 1
+
+
+def test_priorities_high_prio_too_big_blocks_nothing_smaller():
+    # [(1,5), (0,4)] on w4: the prio-1 task can never run, prio-0 runs
+    got, _, _ = schedule_case([4], [(1, 1, 5), (0, 1, 4)])
+    assert got == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:310 test_schedule_no_irrelevant_blocking
+# ---------------------------------------------------------------------------
+
+def test_no_irrelevant_blocking_simple():
+    got, _, _ = schedule_case([3], [(10, 1, 5), (0, 1, 1)])
+    assert got == [0, 1]
+
+
+def test_no_irrelevant_blocking_two_big_classes():
+    got, _, _ = schedule_case([3, 5], [(10, 1, 5), (9, 1, 5), (0, 1, 1)])
+    # one 5-cpu task runs on the w5; the 1-cpu task runs on the w3
+    assert got[0] + got[1] == 1
+    assert got[2] == 1
+
+
+def test_no_irrelevant_blocking_partial():
+    got, _, _ = schedule_case(
+        [5, 3], [(10, 1, 3), (9, 1, 2), (8, 1, 5), (0, 1, 1)]
+    )
+    # prio 10+9 fit (3+2 on the w5 or split); prio-8 5-cpu no longer fits
+    assert got[0] == 1 and got[1] == 1
+    assert got[2] == 0
+    assert got[3] == 1
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:411 test_schedule_gap_filling
+# ---------------------------------------------------------------------------
+
+def test_gap_filling_low_prio_fills_remainder():
+    # [(1,8)x2, (0,4)] on w12: one 8 fits, gap 4 -> the prio-0 4-cpu fills
+    got, _, _ = schedule_case([12], [(1, 2, 8), (0, 1, 4)])
+    assert got == [1, 1]
+
+
+def test_gap_filling_blocked_when_gap_too_small():
+    # [(1,3)x3, (0,2)] on w6: two 3s fit, no gap -> prio-0 2-cpu waits
+    got, _, _ = schedule_case([6], [(1, 3, 3), (0, 1, 2)])
+    assert got == [2, 0]
+
+
+def test_gap_filling_two_small():
+    # [(1,3)x3, (0,1)x2] on w8: two 3s + both 1s
+    got, _, _ = schedule_case([8], [(1, 3, 3), (0, 2, 1)])
+    assert got == [2, 2]
+
+
+def test_gap_filling_highest_first_then_gap():
+    # [(2,1), (1,3)x3, (0,1)] on w8: prio2 first, two 3-cpu, then gap 1
+    got, _, _ = schedule_case([8], [(2, 1, 1), (1, 3, 3), (0, 1, 1)])
+    assert got == [1, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:333 test_schedule_some_tasks_running — capacity
+# already consumed (free < total) is exactly a smaller free row
+# ---------------------------------------------------------------------------
+
+def test_partially_used_worker():
+    got, _, _ = schedule_case([2], [(0, 3, 2)])  # 2 of 4 cpus already busy
+    assert got == [1]
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:636/689 test_schedule_multiple_resources
+# ---------------------------------------------------------------------------
+
+def test_multiple_resources_joint_fit():
+    # workers (cpus, foo); class needs both
+    got, _, _ = schedule_case(
+        [(4, 2), (4, 0)], [(0, 3, (2, 1))]
+    )
+    assert got == [2]  # only the foo-carrying worker can host, 2 fit
+
+
+def test_multiple_resources_disjoint_classes():
+    got, _, _ = schedule_case(
+        [(4, 2), (4, 0)],
+        [(0, 2, (2, 1)), (0, 2, (4, 0))],
+    )
+    # foo tasks land on w0, the pure-cpu task on w1
+    assert got[0] == 2
+    assert got[1] == 1
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:724/758 variants (via the V axis)
+# ---------------------------------------------------------------------------
+
+def test_variants_prefer_first_listed():
+    free = np.array([[4 * U, 1 * U]], dtype=np.int32)
+    needs = np.zeros((1, 2, 2), dtype=np.int32)
+    needs[0, 0] = (0, U)        # variant 0: 1 gpu
+    needs[0, 1] = (2 * U, 0)    # variant 1: 2 cpus
+    counts = MODEL.solve(
+        free=free,
+        nt_free=np.array([8], dtype=np.int32),
+        lifetime=np.array([INF], dtype=np.int32),
+        needs=needs,
+        sizes=np.array([3], dtype=np.int32),
+        min_time=np.zeros((1, 2), dtype=np.int32),
+    )
+    counts = np.asarray(counts)
+    assert counts[0, 0, 0] == 1  # gpu variant used while gpus last
+    assert counts[0, 1, 0] == 2  # remaining tasks fall back to cpus
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:874 test_resource_time_assign (min_time vs lifetime)
+# ---------------------------------------------------------------------------
+
+def test_time_request_selects_long_lived_worker():
+    got, per_w, _ = schedule_case(
+        [4, 4],
+        [(0, 2, 1, 600)],          # two 1-cpu tasks needing 600 s
+        lifetimes=[100, INF],
+    )
+    assert got == [2]
+    assert per_w[0] == 0 and per_w[1] == 2
+
+
+def test_time_request_unsatisfiable_everywhere():
+    got, _, _ = schedule_case(
+        [4, 4], [(0, 1, 1, 600)], lifetimes=[100, 100]
+    )
+    assert got == [0]
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:1131 test_many_cuts — >32 priority levels per queue
+# merge their tail (through the real queue/batch path)
+# ---------------------------------------------------------------------------
+
+def test_many_cuts_tail_merge():
+    from hyperqueue_tpu.scheduler.queues import TaskQueues
+    from hyperqueue_tpu.scheduler.tick import MAX_CUTS_PER_QUEUE, create_batches
+
+    queues = TaskQueues()
+    for p in range(50):
+        queues.add(1, (p, 0), 1000 + p)
+    batches = create_batches(queues)
+    assert len(batches) == MAX_CUTS_PER_QUEUE
+    assert sum(b.size for b in batches) == 50
+    # descending priority, merged tail carries the remainder
+    assert batches[0].priority == (49, 0)
+    assert batches[-1].size == 50 - (MAX_CUTS_PER_QUEUE - 1)
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_mn.rs transliterations (reactor-level gangs)
+# ---------------------------------------------------------------------------
+
+def test_mn_not_enough_then_wakeup_one_by_one():
+    # test_scheduler_mn.rs:213/236: a 4-node gang waits at 3 workers and
+    # fires exactly when the 4th appears
+    env = TestEnv()
+    for _ in range(3):
+        env.worker(cpus=2, group="g")
+    (t,) = env.submit(rqv=env.rqv(n_nodes=4))
+    env.schedule()
+    assert env.state(t) is TaskState.READY
+    env.worker(cpus=2, group="g")
+    env.schedule()
+    assert env.state(t) is TaskState.ASSIGNED
+    assert len(env.core.tasks[t].mn_workers) == 4
+
+
+def test_mn_schedule_on_groups():
+    # test_scheduler_mn.rs:273: gangs never span worker groups
+    env = TestEnv()
+    env.worker(cpus=2, group="a")
+    env.worker(cpus=2, group="a")
+    env.worker(cpus=2, group="b")
+    (t,) = env.submit(rqv=env.rqv(n_nodes=3))
+    env.schedule()
+    assert env.state(t) is TaskState.READY  # 2+1 across groups is not 3
+    env.worker(cpus=2, group="b")
+    env.worker(cpus=2, group="b")
+    env.schedule()
+    assert env.state(t) is TaskState.ASSIGNED
+    chosen_groups = {
+        env.core.workers[w].group for w in env.core.tasks[t].mn_workers
+    }
+    assert chosen_groups == {"b"}
+
+
+def test_mn_time_request():
+    # test_scheduler_mn.rs:286/304: gang min_time rejects short-lived groups
+    env = TestEnv()
+    env.worker(cpus=2, group="g", time_limit=30.0)
+    env.worker(cpus=2, group="g", time_limit=30.0)
+    (t,) = env.submit(rqv=env.rqv(n_nodes=2, min_time=600.0))
+    env.schedule()
+    assert env.state(t) is TaskState.READY
+    env.worker(cpus=2, group="g")
+    env.worker(cpus=2, group="g")
+    env.schedule()
+    assert env.state(t) is TaskState.ASSIGNED
+    lifetimes = [
+        env.core.workers[w].lifetime_secs()
+        for w in env.core.tasks[t].mn_workers
+    ]
+    assert all(life >= 600 for life in lifetimes)
+
+
+def test_mn_and_sn_mix():
+    # test_scheduler_mn.rs:315-348: sn work proceeds around a placed gang
+    env = TestEnv()
+    for _ in range(3):
+        env.worker(cpus=2, group="g")
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    sn = env.submit(n=2)
+    env.schedule()
+    assert env.state(g) is TaskState.ASSIGNED
+    # both sn tasks run on the one non-gang worker (2 cpus)
+    assert all(env.state(t) is TaskState.ASSIGNED for t in sn)
+    gang_workers = set(env.core.tasks[g].mn_workers)
+    for t in sn:
+        assert env.core.tasks[t].assigned_worker not in gang_workers
+
+
+def test_gap_filling2_exact_class_counts():
+    """test_scheduler_sn.rs:462: w8 + 3x(w4 with 1 foo); classes
+    ta=1cpu@prio1 x7, tb=3cpu@prio2 x3, tc=(4cpu+1foo)@prio2 x3.
+    The reference MILP assigns ta:2, tb:2, tc:3 — tc must win the foo
+    workers (scarcity) and tb must go to the big box, leaving a 2-cpu gap
+    for ta. Also run with low-priority extra classes appended
+    (extra=True in the reference) which must change nothing."""
+    for extra in (False, True):
+        classes = [
+            (1, 7, (1, 0)),      # ta
+            (2, 3, (3, 0)),      # tb
+            (2, 3, (4, 1)),      # tc
+        ]
+        if extra:
+            classes += [
+                (-1, 2, (3, 0)),
+                (-2, 3, (4, 1)),
+                (-3, 1, (1, 0)),
+                (-4, 2, (3, 0)),
+                (-5, 3, (4, 1)),
+                (-6, 1, (1, 0)),
+            ]
+        got, _, _ = schedule_case(
+            [(8, 0), (4, 1), (4, 1), (4, 1)], classes
+        )
+        assert got[0] == 2, (extra, got)
+        assert got[1] == 2, (extra, got)
+        assert got[2] == 3, (extra, got)
+        if extra:
+            assert got[3:] == [0] * 6, got
