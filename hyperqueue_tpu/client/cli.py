@@ -811,39 +811,18 @@ def cmd_journal_replay(args) -> None:
 
 
 def cmd_journal_stream(args) -> None:
-    import asyncio
+    from hyperqueue_tpu.client.connection import stream_events
 
-    from hyperqueue_tpu.transport.auth import (
-        ROLE_CLIENT,
-        ROLE_SERVER,
-        do_authentication,
-    )
-
-    access = serverdir.load_access(_server_dir(args))
-
-    async def go():
-        reader, writer = await asyncio.open_connection(
-            access.host, access.client_port
-        )
-        conn = await do_authentication(
-            reader, writer, ROLE_CLIENT, ROLE_SERVER, access.client_key_bytes()
-        )
-        await conn.send(
-            {
-                "op": "stream_events",
-                "history": args.history,
-                "filter": args.filter or [],
-            }
-        )
-        while True:
-            msg = await conn.recv()
+    try:
+        for msg in stream_events(
+            _server_dir(args),
+            history=args.history,
+            filters=args.filter or [],
+        ):
             if msg.get("op") == "event":
                 print(json.dumps(msg["record"], default=str), flush=True)
             elif msg.get("op") == "stream_live" and not args.follow:
                 return
-
-    try:
-        asyncio.run(go())
     except (ConnectionError, OSError, EOFError):
         pass
 
@@ -880,7 +859,11 @@ def cmd_dashboard(args) -> None:
     from hyperqueue_tpu.client.dashboard import run_dashboard
 
     try:
-        run_dashboard(_server_dir(args), interval=args.interval)
+        run_dashboard(
+            _server_dir(args) if not args.replay else None,
+            interval=args.interval,
+            replay=args.replay,
+        )
     except KeyboardInterrupt:
         pass
 
@@ -1176,6 +1159,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("dashboard", help="live terminal overview")
     _add_common(p)
     p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--replay", default=None, metavar="JOURNAL",
+                   help="replay a finished journal offline with time scrub")
     p.set_defaults(fn=cmd_dashboard)
 
     # doc + completion

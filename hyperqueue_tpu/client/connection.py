@@ -62,3 +62,38 @@ class ClientSession:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def stream_events(server_dir: Path, history: bool = False, filters=(),
+                  on_subscribed=None):
+    """Generator of event records from the server's client-plane stream.
+
+    Blocking-recv based (read_frame is not cancellation-safe, so no
+    wait_for timeouts may wrap it); shared by `hq journal stream` and the
+    dashboard. on_subscribed, when given, is called once the subscription
+    request is on the wire — before the first record is read."""
+
+    async def _connect():
+        access = serverdir.load_access(Path(server_dir))
+        reader, writer = await asyncio.open_connection(
+            access.host, access.client_port
+        )
+        conn = await do_authentication(
+            reader, writer, ROLE_CLIENT, ROLE_SERVER, access.client_key_bytes()
+        )
+        await conn.send(
+            {"op": "stream_events", "history": history,
+             "filter": list(filters)}
+        )
+        return conn
+
+    loop = asyncio.new_event_loop()
+    try:
+        conn = loop.run_until_complete(_connect())
+        if on_subscribed is not None:
+            on_subscribed()
+        while True:
+            msg = loop.run_until_complete(conn.recv())
+            yield msg
+    finally:
+        loop.close()

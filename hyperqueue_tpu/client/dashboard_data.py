@@ -1,0 +1,359 @@
+"""Event-sourced dashboard state with time-travel.
+
+Reference: crates/hyperqueue/src/dashboard/data/ — DashboardData holds
+per-worker / per-job / per-allocation timelines built purely from the event
+stream (live or journal replay), so the dashboard can replay a finished
+journal offline and scrub through time (data/timelines/*.rs).
+
+This mirror keeps every consumed record and rebuilds state `at(t)` by
+replaying the prefix — events are cheap dict updates, and a rebuild only
+happens on seek, so scrubbing a journal of tens of thousands of records is
+instant in practice.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+OVERVIEW_HISTORY = 512  # per-worker (t, cpu%) samples kept for the chart
+
+
+@dataclass
+class WorkerState:
+    worker_id: int
+    hostname: str = ""
+    group: str = "default"
+    connected_at: float = 0.0
+    lost_at: float = 0.0
+    lost_reason: str = ""
+    last_hw: dict = field(default_factory=dict)
+    cpu_history: deque = field(default_factory=lambda: deque(maxlen=OVERVIEW_HISTORY))
+    running: set = field(default_factory=set)  # (job, task)
+    tasks_done: int = 0
+
+    @property
+    def is_connected(self) -> bool:
+        return self.lost_at == 0.0
+
+
+@dataclass
+class TaskView:
+    status: str = "waiting"
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    workers: tuple = ()
+    error: str = ""
+
+
+@dataclass
+class JobState:
+    job_id: int
+    name: str = "job"
+    n_tasks: int = 0
+    submitted_at: float = 0.0
+    completed_at: float = 0.0
+    final_status: str = ""
+    is_open: bool = False
+    tasks: dict = field(default_factory=dict)  # task_id -> TaskView
+
+    def counters(self) -> dict:
+        out = {"waiting": 0, "running": 0, "finished": 0, "failed": 0,
+               "canceled": 0}
+        seen = 0
+        for t in self.tasks.values():
+            out[t.status] = out.get(t.status, 0) + 1
+            seen += 1
+        out["waiting"] += max(self.n_tasks - seen, 0)
+        return out
+
+    def status(self) -> str:
+        if self.final_status:
+            return self.final_status
+        c = self.counters()
+        if c["running"]:
+            return "running"
+        return "waiting"
+
+    def progress(self) -> float:
+        if not self.n_tasks:
+            return 0.0
+        c = self.counters()
+        return (c["finished"] + c["failed"] + c["canceled"]) / self.n_tasks
+
+
+@dataclass
+class AllocationView:
+    allocation_id: str
+    status: str = "queued"
+    queued_at: float = 0.0
+    started_at: float = 0.0
+    ended_at: float = 0.0
+
+
+@dataclass
+class QueueState:
+    queue_id: int
+    manager: str = ""
+    state: str = "active"
+    allocations: dict = field(default_factory=dict)
+
+
+class DashboardData:
+    """State reducer over the server event stream.
+
+    retain_events=False (live mode) keeps only the reduced state: the raw
+    record log exists for replay/time-travel and would grow without bound on
+    a long-lived live dashboard."""
+
+    def __init__(self, retain_events: bool = True):
+        self.retain_events = retain_events
+        self.workers: dict[int, WorkerState] = {}
+        self.jobs: dict[int, JobState] = {}
+        self.queues: dict[int, QueueState] = {}
+        self.events: list[dict] = []      # consumed records (replay mode)
+        # (t, n_connected); bounded — feeds a fixed-width sparkline
+        self.worker_series: deque = deque(maxlen=4096)
+        self.last_time: float = 0.0
+
+    # ------------------------------------------------------------------
+    def add_event(self, record: dict) -> None:
+        if self.retain_events:
+            self.events.append(record)
+        self._apply(record)
+
+    def _apply(self, record: dict) -> None:
+        kind = record.get("event", "")
+        t = float(record.get("time", 0.0))
+        if t > self.last_time:
+            self.last_time = t
+
+        if kind == "worker-connected":
+            wid = record.get("id", 0)
+            self.workers[wid] = WorkerState(
+                worker_id=wid,
+                hostname=record.get("hostname", ""),
+                group=record.get("group", "default"),
+                connected_at=t,
+            )
+            self._mark_worker_count(t)
+        elif kind == "worker-lost":
+            w = self.workers.get(record.get("id", 0))
+            if w is not None:
+                w.lost_at = t
+                w.lost_reason = record.get("reason", "")
+                w.running.clear()
+            self._mark_worker_count(t)
+        elif kind == "worker-overview":
+            w = self.workers.get(record.get("id", 0))
+            if w is not None:
+                w.last_hw = record.get("hw", {}) or {}
+                cpu = w.last_hw.get("cpu_usage_percent")
+                if cpu is not None:
+                    w.cpu_history.append((t, float(cpu)))
+        elif kind == "job-submitted":
+            job_id = record.get("job", 0)
+            desc = record.get("desc", {}) or {}
+            job = self.jobs.get(job_id)
+            if job is None:
+                job = self.jobs[job_id] = JobState(job_id=job_id)
+                job.submitted_at = t
+                job.name = desc.get("name", "job")
+            job.n_tasks += record.get("n_tasks", 0)
+            job.is_open = bool(desc.get("open", job.is_open))
+        elif kind == "job-opened":
+            job_id = record.get("job", 0)
+            job = self.jobs.setdefault(job_id, JobState(job_id=job_id))
+            job.name = record.get("name", job.name)
+            job.is_open = True
+            if not job.submitted_at:
+                job.submitted_at = t
+        elif kind == "job-closed":
+            job = self.jobs.get(record.get("job", 0))
+            if job is not None:
+                job.is_open = False
+        elif kind == "job-completed":
+            job = self.jobs.get(record.get("job", 0))
+            if job is not None:
+                job.completed_at = t
+                job.final_status = record.get("status", "finished")
+        elif kind == "task-started":
+            job = self.jobs.setdefault(
+                record.get("job", 0), JobState(job_id=record.get("job", 0))
+            )
+            task = job.tasks.setdefault(record.get("task", 0), TaskView())
+            task.status = "running"
+            task.started_at = t
+            task.workers = tuple(record.get("workers") or ())
+            for wid in task.workers:
+                w = self.workers.get(wid)
+                if w is not None:
+                    w.running.add((job.job_id, record.get("task", 0)))
+        elif kind == "task-restarted":
+            job = self.jobs.get(record.get("job", 0))
+            if job is not None:
+                task = job.tasks.setdefault(record.get("task", 0), TaskView())
+                self._release_task(job.job_id, record.get("task", 0), task)
+                task.status = "waiting"
+        elif kind in ("task-finished", "task-failed", "task-canceled"):
+            job = self.jobs.setdefault(
+                record.get("job", 0), JobState(job_id=record.get("job", 0))
+            )
+            task = job.tasks.setdefault(record.get("task", 0), TaskView())
+            self._release_task(job.job_id, record.get("task", 0), task,
+                               count_done=kind == "task-finished")
+            task.status = kind.removeprefix("task-")
+            task.finished_at = t
+            task.error = record.get("error", "")
+        elif kind == "alloc-queue-created":
+            qid = record.get("queue_id", 0)
+            self.queues[qid] = QueueState(
+                queue_id=qid, manager=record.get("manager", "")
+            )
+        elif kind == "alloc-queue-removed":
+            self.queues.pop(record.get("queue_id", 0), None)
+        elif kind == "alloc-queue-paused":
+            q = self.queues.get(record.get("queue_id", 0))
+            if q is not None:
+                q.state = "paused"
+        elif kind == "alloc-queued":
+            q = self.queues.setdefault(
+                record.get("queue_id", 0),
+                QueueState(queue_id=record.get("queue_id", 0)),
+            )
+            aid = record.get("alloc", "")
+            q.allocations[aid] = AllocationView(
+                allocation_id=aid, queued_at=t
+            )
+        elif kind in ("alloc-started", "alloc-finished", "alloc-failed"):
+            q = self.queues.get(record.get("queue_id", 0))
+            if q is not None:
+                a = q.allocations.setdefault(
+                    record.get("alloc", ""),
+                    AllocationView(allocation_id=record.get("alloc", "")),
+                )
+                status = kind.removeprefix("alloc-")
+                a.status = "running" if status == "started" else status
+                if status == "started":
+                    a.started_at = t
+                else:
+                    a.ended_at = t
+
+    def _release_task(self, job_id, task_id, task: TaskView,
+                      count_done: bool = False) -> None:
+        for wid in task.workers:
+            w = self.workers.get(wid)
+            if w is not None:
+                w.running.discard((job_id, task_id))
+                if count_done:
+                    w.tasks_done += 1
+
+    def _mark_worker_count(self, t: float) -> None:
+        n = sum(1 for w in self.workers.values() if w.is_connected)
+        self.worker_series.append((t, n))
+
+    # ------------------------------------------------------------------
+    def at(self, t: float) -> "DashboardData":
+        """State as of time t (inclusive) — rebuilt by prefix replay, the
+        time-travel primitive of replay mode."""
+        out = DashboardData()
+        for record in self.events:
+            if float(record.get("time", 0.0)) <= t:
+                out.add_event(record)
+        return out
+
+    def time_span(self) -> tuple[float, float]:
+        if not self.events:
+            return (0.0, 0.0)
+        return (
+            float(self.events[0].get("time", 0.0)),
+            float(self.events[-1].get("time", 0.0)),
+        )
+
+
+def seed_from_server(data: DashboardData, session) -> None:
+    """Seed live-mode state from a snapshot of the running server.
+
+    A server without a journal has no event history, so a dashboard that
+    connects late would render an empty cluster; the snapshot (worker list,
+    job details, allocation queues) establishes current state and the live
+    stream keeps it moving (the reference seeds the same way through its
+    initial overview fetch, dashboard/data/fetch.rs)."""
+    import time as _time
+
+    now = _time.time()
+    for w in session.request({"op": "worker_list"})["workers"]:
+        ws = WorkerState(
+            worker_id=w["id"],
+            hostname=w.get("hostname", ""),
+            group=w.get("group", "default"),
+            connected_at=now,
+        )
+        overview = w.get("overview") or {}
+        ws.last_hw = overview.get("hw", {}) or {}
+        data.workers[w["id"]] = ws
+    data.worker_series.append((now, len(data.workers)))
+
+    jobs = session.request({"op": "job_list"})["jobs"]
+    recent = sorted(jobs, key=lambda j: -j["id"])[:100]
+    if recent:
+        details = session.request(
+            {"op": "job_info", "job_ids": [j["id"] for j in recent]}
+        )["jobs"]
+        for detail in details:
+            job = JobState(
+                job_id=detail["id"],
+                name=detail.get("name", "job"),
+                n_tasks=detail.get("n_tasks", 0),
+                submitted_at=detail.get("submitted_at", 0.0),
+                is_open=detail.get("is_open", False),
+            )
+            status = detail.get("status", "")
+            if status in ("finished", "failed", "canceled"):
+                job.final_status = status
+            for t in detail.get("tasks", []):
+                tv = TaskView(
+                    status=t.get("status", "waiting"),
+                    started_at=t.get("started_at") or 0.0,
+                    finished_at=t.get("finished_at") or 0.0,
+                    workers=tuple(t.get("workers") or ()),
+                    error=t.get("error", "") or "",
+                )
+                job.tasks[t["id"]] = tv
+                if tv.status == "running":
+                    for wid in tv.workers:
+                        ws = data.workers.get(wid)
+                        if ws is not None:
+                            ws.running.add((job.job_id, t["id"]))
+            data.jobs[job.job_id] = job
+
+    try:
+        alloc = session.request({"op": "alloc_list"})
+    except Exception:  # noqa: BLE001 - autoalloc may be disabled
+        alloc = {}
+    for q in alloc.get("queues", []):
+        qs = QueueState(
+            queue_id=q.get("id", 0),
+            manager=(q.get("params") or {}).get("manager", ""),
+            state=q.get("state", "active"),
+        )
+        for a in q.get("allocations", []):
+            qs.allocations[a["id"]] = AllocationView(
+                allocation_id=a["id"],
+                status=a.get("status", "queued"),
+                queued_at=a.get("queued_at", 0.0),
+                started_at=a.get("started_at", 0.0),
+                ended_at=a.get("ended_at", 0.0),
+            )
+        data.queues[qs.queue_id] = qs
+    data.last_time = now
+
+
+def load_journal(path) -> DashboardData:
+    """Build DashboardData from a journal file (offline replay mode)."""
+    from hyperqueue_tpu.events.journal import Journal
+
+    data = DashboardData()
+    for record in Journal.read_all(path):
+        data.add_event(record)
+    return data

@@ -15,6 +15,7 @@ import time
 class HwSampler:
     def __init__(self):
         self._last_cpu = self._read_cpu_times()
+        self._last_per_cpu = self._read_per_cpu_times()
         self._last_time = time.monotonic()
 
     @staticmethod
@@ -28,6 +29,23 @@ class HwSampler:
         except (OSError, ValueError, IndexError):
             return (0, 0)
 
+    @staticmethod
+    def _read_per_cpu_times():
+        """[(total, idle)] per logical cpu (reference cpu_util_table.rs
+        shows a per-CPU utilization grid in the worker detail screen)."""
+        out = []
+        try:
+            with open("/proc/stat") as f:
+                for line in f:
+                    if not line.startswith("cpu") or line.startswith("cpu "):
+                        continue
+                    numbers = [int(x) for x in line.split()[1:]]
+                    idle = numbers[3] + (numbers[4] if len(numbers) > 4 else 0)
+                    out.append((sum(numbers), idle))
+        except (OSError, ValueError, IndexError):
+            pass
+        return out
+
     def sample(self) -> dict:
         total, idle = self._read_cpu_times()
         last_total, last_idle = self._last_cpu
@@ -37,6 +55,17 @@ class HwSampler:
         cpu_usage = 0.0
         if dt_total > 0:
             cpu_usage = 100.0 * (1.0 - dt_idle / dt_total)
+
+        per_cpu = self._read_per_cpu_times()
+        per_core = []
+        for i, (t, ii) in enumerate(per_cpu):
+            if i < len(self._last_per_cpu):
+                lt, li = self._last_per_cpu[i]
+                dt, di = t - lt, ii - li
+                per_core.append(
+                    round(100.0 * (1.0 - di / dt), 1) if dt > 0 else 0.0
+                )
+        self._last_per_cpu = per_cpu
 
         mem_total = mem_avail = 0
         try:
@@ -53,6 +82,7 @@ class HwSampler:
         return {
             "timestamp": time.time(),
             "cpu_usage_percent": round(cpu_usage, 1),
+            "cpu_per_core_percent": per_core,
             "mem_total_bytes": mem_total,
             "mem_available_bytes": mem_avail,
             "loadavg_1m": load[0],

@@ -1,0 +1,180 @@
+"""Dashboard data-layer and renderer tests (reference dashboard/data
+timelines + ui screens, exercised headless through the pure functions)."""
+
+import json
+
+from hyperqueue_tpu.client.dashboard import (
+    render_autoalloc,
+    render_cluster,
+    render_jobs,
+    render_screen,
+    render_worker_detail,
+)
+from hyperqueue_tpu.client.dashboard_data import DashboardData
+
+
+def feed(data, *records):
+    t = [100.0]
+    for rec in records:
+        rec.setdefault("time", t[0])
+        t[0] += 1.0
+        data.add_event(rec)
+    return data
+
+
+def sample_data():
+    data = DashboardData()
+    feed(
+        data,
+        {"event": "worker-connected", "id": 1, "hostname": "nodeA",
+         "group": "default"},
+        {"event": "worker-connected", "id": 2, "hostname": "nodeB",
+         "group": "default"},
+        {"event": "job-submitted", "job": 1,
+         "desc": {"name": "exp1"}, "n_tasks": 3},
+        {"event": "task-started", "job": 1, "task": 0, "workers": [1]},
+        {"event": "task-started", "job": 1, "task": 1, "workers": [2]},
+        {"event": "task-finished", "job": 1, "task": 0},
+        {"event": "worker-overview", "id": 1,
+         "hw": {"cpu_usage_percent": 50.0,
+                "cpu_per_core_percent": [10.0, 90.0],
+                "mem_total_bytes": 2 ** 30,
+                "mem_available_bytes": 2 ** 29}},
+        {"event": "task-failed", "job": 1, "task": 1, "error": "boom"},
+        {"event": "worker-lost", "id": 2, "reason": "heartbeat"},
+        {"event": "alloc-queue-created", "queue_id": 1, "manager": "pbs"},
+        {"event": "alloc-queued", "queue_id": 1, "alloc": "job.123"},
+        {"event": "alloc-started", "queue_id": 1, "alloc": "job.123"},
+    )
+    return data
+
+
+def test_data_worker_lifecycle():
+    data = sample_data()
+    assert data.workers[1].is_connected
+    assert not data.workers[2].is_connected
+    assert data.workers[2].lost_reason == "heartbeat"
+    assert data.workers[1].tasks_done == 1
+    assert data.workers[1].last_hw["cpu_usage_percent"] == 50.0
+    # worker count series saw 1 -> 2 -> 1
+    assert [n for _, n in data.worker_series] == [1, 2, 1]
+
+
+def test_data_job_counters_and_status():
+    data = sample_data()
+    job = data.jobs[1]
+    assert job.name == "exp1"
+    assert job.n_tasks == 3
+    c = job.counters()
+    assert c["finished"] == 1 and c["failed"] == 1 and c["waiting"] == 1
+    assert job.tasks[1].error == "boom"
+    assert 0.6 < job.progress() < 0.7
+
+
+def test_data_autoalloc():
+    data = sample_data()
+    q = data.queues[1]
+    assert q.manager == "pbs"
+    assert q.allocations["job.123"].status == "running"
+
+
+def test_time_travel_replay():
+    data = sample_data()
+    lo, hi = data.time_span()
+    assert lo == 100.0
+    # before the second worker connected
+    early = data.at(lo)
+    assert len(early.workers) == 1
+    # before the failure: task 1 still running
+    mid = data.at(106.0)
+    assert mid.jobs[1].tasks[1].status == "running"
+    assert mid.workers[2].is_connected
+    full = data.at(hi)
+    assert not full.workers[2].is_connected
+
+
+def test_render_screens_smoke():
+    data = sample_data()
+    cluster = "\n".join(render_cluster(data, 0))
+    assert "nodeA" in cluster and "lost" in cluster
+    jobs = "\n".join(render_jobs(data, 0))
+    assert "exp1" in jobs and "boom" in jobs
+    alloc = "\n".join(render_autoalloc(data, 0))
+    assert "pbs" in alloc and "job.123" in alloc
+    detail = "\n".join(render_worker_detail(data, 1))
+    assert "PER-CPU" in detail and "cpu0" in detail and "cpu1" in detail
+    frame = "\n".join(
+        render_screen(data, {"screen": "cluster", "mode": "replay",
+                             "now": 105.0, "span": data.time_span()})
+    )
+    assert "replay" in frame
+
+
+def test_dashboard_replay_from_journal(tmp_path):
+    """--replay drives the same reducer from a journal file."""
+    from hyperqueue_tpu.client.dashboard_data import load_journal
+    from hyperqueue_tpu.events.journal import Journal
+
+    journal = Journal(tmp_path / "j.bin")
+    journal.open_for_append()
+    for i, rec in enumerate(sample_data().events):
+        journal.write(dict(rec, seq=i))
+    journal.close()
+    data = load_journal(tmp_path / "j.bin")
+    assert len(data.events) == 12
+    assert data.jobs[1].counters()["finished"] == 1
+
+
+def test_dashboard_cli_replay_plain(tmp_path):
+    """hq dashboard --replay prints a frame when stdout is not a tty."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from hyperqueue_tpu.events.journal import Journal
+
+    journal_path = tmp_path / "j.bin"
+    journal = Journal(journal_path)
+    journal.open_for_append()
+    for i, rec in enumerate(sample_data().events):
+        journal.write(dict(rec, seq=i))
+    journal.close()
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "-m", "hyperqueue_tpu", "dashboard",
+         "--replay", str(journal_path)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={"PYTHONPATH": str(repo), "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "hq dashboard (replay)" in out.stdout
+    assert "nodeA" in out.stdout
+
+
+def test_dashboard_live_e2e(tmp_path):
+    """Live dashboard streams events (history + live) from a real server."""
+    from utils_e2e import HqEnv
+
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker(cpus=2)
+        env.wait_workers(1)
+        env.command(["submit", "--wait", "--", "bash", "-c", "echo hi"])
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "-m", "hyperqueue_tpu", "dashboard",
+             "--server-dir", str(env.server_dir), "--interval", "0.5"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": str(env.server_dir.parent.parent)},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "hq dashboard (live)" in out.stdout
+        assert "workers=1" in out.stdout

@@ -90,16 +90,19 @@ def test_python_api_program_and_function(tmp_path):
 
 
 def test_dashboard_renders():
-    from hyperqueue_tpu.client.dashboard import render
+    from hyperqueue_tpu.client.dashboard import render_screen
+    from hyperqueue_tpu.client.dashboard_data import DashboardData
 
-    out = render(
-        {"server_uid": "abc", "started_at": 0, "n_workers": 1, "n_jobs": 1},
-        [{"id": 1, "hostname": "node", "group": "default", "n_running": 2,
-          "resources": {"cpus": 40000}}],
-        [{"id": 1, "name": "j", "status": "running", "n_tasks": 4,
-          "counters": {"running": 2, "finished": 1, "failed": 0,
-                       "canceled": 0}}],
-        [{"time": 0, "event": "worker-connected", "id": 1}],
+    data = DashboardData()
+    data.add_event({"time": 1.0, "event": "worker-connected", "id": 1,
+                    "hostname": "node", "group": "default"})
+    data.add_event({"time": 2.0, "event": "job-submitted", "job": 1,
+                    "desc": {"name": "j"}, "n_tasks": 4})
+    out = "\n".join(
+        render_screen(data, {"screen": "cluster", "mode": "live", "now": 2.0})
     )
-    assert "WORKERS" in out and "JOBS" in out
-    assert "node" in out
+    assert "WORKERS" in out and "node" in out
+    out = "\n".join(
+        render_screen(data, {"screen": "jobs", "mode": "live", "now": 2.0})
+    )
+    assert "JOBS" in out and "j" in out
