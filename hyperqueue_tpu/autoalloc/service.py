@@ -38,6 +38,9 @@ class AutoAllocService:
         self.state = AutoAllocState()
         self.work_dir = Path(work_dir)
         self._handlers: dict[int, object] = {}
+        # queue params are immutable after `alloc add`; the parsed worker
+        # descriptor (which probes host hardware as its base) is cached
+        self._queue_descriptors: dict[int, object] = {}
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
@@ -46,6 +49,11 @@ class AutoAllocService:
     def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+
+    def forget_queue(self, queue_id: int) -> None:
+        """Drop per-queue caches after `alloc remove`."""
+        self._handlers.pop(queue_id, None)
+        self._queue_descriptors.pop(queue_id, None)
 
     def handler_for(self, queue):
         handler = self._handlers.get(queue.queue_id)
@@ -113,25 +121,76 @@ class AutoAllocService:
             )
 
     # ------------------------------------------------------------------
+    def _queue_worker_descriptor(self, queue):
+        """Resource descriptor of the workers this queue would spawn.
+
+        Parsed from the queue's worker args (--cpus / --resource overrides
+        applied over host detection, exactly as `hq worker start` would
+        apply them) — the reference stores the same thing as the queue's
+        cli_resource_descriptor (autoalloc/queue/mod.rs:32). Falls back to
+        plain host detection when the queue declares nothing."""
+        cached = self._queue_descriptors.get(queue.queue_id)
+        if cached is not None:
+            return cached
+        from hyperqueue_tpu.worker.parser import parse_resource_definition
+
+        args = list(queue.params.worker_args or [])
+        cpus = None
+        overrides = {}
+        i = 0
+        while i < len(args):
+            arg = args[i]
+            value = None
+            for flag in ("--cpus", "--resource"):
+                if arg == flag and i + 1 < len(args):
+                    value = args[i + 1]
+                    i += 1
+                    break
+                if arg.startswith(flag + "="):
+                    value = arg.split("=", 1)[1]
+                    break
+            if value is not None:
+                if arg.startswith("--cpus") or arg == "--cpus":
+                    try:
+                        cpus = int(value)
+                    except ValueError:
+                        pass
+                else:
+                    try:
+                        item = parse_resource_definition(value)
+                        overrides[item.name] = item
+                    except ValueError:
+                        pass
+            i += 1
+
+        base = detect_resources(n_cpus=cpus)
+        if overrides:
+            from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+
+            items = {item.name: item for item in base.items}
+            items.update(overrides)
+            base = ResourceDescriptor(items=tuple(items.values()))
+        self._queue_descriptors[queue.queue_id] = base
+        return base
+
     def _fake_worker_demand(self, queue) -> int:
-        """How many NEW workers would receive load right now?
+        """How many NEW single-node workers would receive load right now?
 
         Reference scheduler/query.rs:12-80 — create fake workers per queue
         descriptor and rerun batches+solver against them; the count of fake
-        workers that got tasks is the demand. Here: simulate
-        backlog*workers_per_alloc fake workers with the queue's resources and
-        run the dense solve over (real + fake) workers non-destructively.
+        workers that got tasks is the demand. Simulates
+        backlog*workers_per_alloc fake workers with the queue's DECLARED
+        worker resources and runs the dense solve over (real + fake)
+        workers non-destructively.
         """
         core = self.server.core
         n_fake = queue.params.backlog * queue.params.workers_per_alloc
         if n_fake <= 0:
             return 0
-        if not core.queues.total_ready() and not core.mn_queue:
-            return len(core.mn_queue)
-        # fake worker resources: detected from this host as an approximation
-        # (the reference uses the queue descriptor's declared resources)
+        if not core.queues.total_ready():
+            return 0
         fake_resources = WorkerResources.from_descriptor(
-            detect_resources(), core.resource_map
+            self._queue_worker_descriptor(queue), core.resource_map
         )
         rows = core.worker_rows()
         first_fake = len(rows)
@@ -183,24 +242,74 @@ class AutoAllocService:
         fake_load = np.asarray(counts).sum(axis=(0, 1))[first_fake:]
         return int((fake_load > 0).sum())
 
+    def _mn_demand(self, queue) -> list[int]:
+        """n_nodes of each pending multi-node task this queue should cover.
+
+        Reference process.rs:500 (compute_submission_permit) counts mn
+        allocations separately from sn workers: a pending gang that no
+        current worker group can host needs a whole fresh allocation of at
+        least n_nodes workers with enough lifetime."""
+        from hyperqueue_tpu.server.reactor import _mn_member_eligible
+
+        core = self.server.core
+        wpa = max(queue.params.workers_per_alloc, 1)
+        queue_worker = WorkerResources.from_descriptor(
+            self._queue_worker_descriptor(queue), core.resource_map
+        )
+        out: list[int] = []
+        for task_id in core.mn_queue:
+            task = core.tasks.get(task_id)
+            if task is None or task.is_done:
+                continue
+            req = core.rq_map.get_variants(task.rq_id).variants[0]
+            if req.n_nodes > wpa:
+                continue  # one allocation of this queue can never host it
+            if req.min_time_secs > queue.params.time_limit_secs:
+                continue
+            if any(
+                queue_worker.amount(e.resource_id) < e.amount
+                for e in req.entries
+            ):
+                continue  # this queue's workers could never be members
+            groups: dict[str, int] = {}
+            for w in core.workers.values():
+                if w.mn_task or not _mn_member_eligible(w, req):
+                    continue
+                groups[w.group] = groups.get(w.group, 0) + 1
+            if not any(n >= req.n_nodes for n in groups.values()):
+                out.append(req.n_nodes)
+        return out
+
     async def perform_submits(self) -> None:
         for queue in list(self.state.queues.values()):
             if not queue.can_submit_now():
                 continue
-            demand = self._fake_worker_demand(queue)
-            logger.debug("queue %d demand=%d", queue.queue_id, demand)
-            if demand <= 0:
+            wpa = max(queue.params.workers_per_alloc, 1)
+            sn_workers = self._fake_worker_demand(queue)
+            mn_nodes = self._mn_demand(queue)
+            # queued allocations first satisfy mn demand (a whole alloc per
+            # gang), their remaining workers count against sn demand
+            # (reference process.rs:500 step 1)
+            queued = queue.queued_allocations()
+            for alloc in queued:
+                worker_count = alloc.worker_count
+                if mn_nodes and worker_count >= mn_nodes[0]:
+                    worker_count -= mn_nodes.pop(0)
+                sn_workers = max(0, sn_workers - worker_count)
+            allocs_needed = len(mn_nodes) + -(-sn_workers // wpa)
+            logger.debug(
+                "queue %d sn_demand=%d mn_demand=%d allocs_needed=%d",
+                queue.queue_id, sn_workers, len(mn_nodes), allocs_needed,
+            )
+            if allocs_needed <= 0:
                 continue
-            allocs_needed = -(-demand // queue.params.workers_per_alloc)
             # permit: stay within backlog and max worker count
-            permit = queue.params.backlog - len(queue.queued_allocations())
+            permit = queue.params.backlog - len(queued)
             if queue.params.max_worker_count:
                 headroom = (
                     queue.params.max_worker_count - queue.active_worker_count()
                 )
-                permit = min(
-                    permit, headroom // max(queue.params.workers_per_alloc, 1)
-                )
+                permit = min(permit, headroom // wpa)
             for _ in range(max(0, min(allocs_needed, permit))):
                 await self._submit_one(queue)
 

@@ -727,6 +727,7 @@ class Server:
             except Exception:  # noqa: BLE001
                 logger.warning("failed to remove allocation %s",
                                alloc.allocation_id)
+        self.autoalloc.forget_queue(msg["queue_id"])
         self.emit_event("alloc-queue-removed", {"queue_id": msg["queue_id"]})
         return {"op": "ok"}
 

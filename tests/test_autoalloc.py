@@ -196,3 +196,196 @@ def test_autoalloc_worker_links_to_allocation(env, tmp_path):
         assert qs[0]["allocations"][0]["status"] == "running"
     finally:
         os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)
+
+
+# ------------------------------------------------- planning fidelity (unit)
+class _StubServer:
+    def __init__(self):
+        from pathlib import Path
+
+        from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+        from hyperqueue_tpu.server.core import Core
+
+        self.core = Core()
+        self.model = GreedyCutScanModel(backend="numpy")
+        self.server_dir = Path("/tmp/stub")
+
+
+def _service(tmp_path):
+    from hyperqueue_tpu.autoalloc.service import AutoAllocService
+
+    return AutoAllocService(_StubServer(), tmp_path)
+
+
+def _ready_task(core, task_seq, entries, n_nodes=0, min_time=0.0):
+    from hyperqueue_tpu.ids import make_task_id
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.server.task import Task, TaskState
+
+    if n_nodes:
+        req = ResourceRequest(n_nodes=n_nodes, min_time_secs=min_time)
+    else:
+        req = ResourceRequest(
+            entries=tuple(
+                ResourceRequestEntry(core.resource_map.get_or_create(n), a)
+                for n, a in entries
+            ),
+            min_time_secs=min_time,
+        )
+    rq_id = core.intern_rqv(ResourceRequestVariants.single(req))
+    task = Task(task_id=make_task_id(1, task_seq), rq_id=rq_id,
+                priority=(0, 0))
+    task.state = TaskState.READY
+    core.tasks[task.task_id] = task
+    if n_nodes:
+        core.mn_queue.append(task.task_id)
+    else:
+        core.queues.add(rq_id, task.priority, task.task_id)
+    return task
+
+
+def test_demand_uses_queue_declared_resources(tmp_path):
+    """Fake workers take the queue's declared resources (reference
+    cli_resource_descriptor), not this host's: tasks needing a resource the
+    host lacks still create demand when the queue declares it."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(core, 1, [("cpus", 10_000), ("fpga", 10_000)])
+
+    declared = AllocationQueue(
+        1, QueueParams(manager="slurm",
+                       worker_args=["--cpus", "4", "--resource", "fpga=[a,b]"])
+    )
+    undeclared = AllocationQueue(2, QueueParams(manager="slurm"))
+    assert service._fake_worker_demand(declared) >= 1
+    assert service._fake_worker_demand(undeclared) == 0
+
+
+def test_mn_demand_counts_unhostable_gangs(tmp_path):
+    """A pending gang no current group can host demands a fresh allocation
+    (reference process.rs:500 counts mn allocations separately)."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(core, 1, None, n_nodes=2)
+
+    fits = AllocationQueue(
+        1, QueueParams(manager="slurm", workers_per_alloc=2)
+    )
+    too_small = AllocationQueue(
+        2, QueueParams(manager="slurm", workers_per_alloc=1)
+    )
+    assert service._mn_demand(fits) == [2]
+    assert service._mn_demand(too_small) == []
+
+
+def test_mn_demand_respects_time_limit(tmp_path):
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(core, 1, None, n_nodes=2, min_time=7200.0)
+    short = AllocationQueue(
+        1, QueueParams(manager="slurm", workers_per_alloc=2,
+                       time_limit_secs=600.0)
+    )
+    long = AllocationQueue(
+        2, QueueParams(manager="slurm", workers_per_alloc=2,
+                       time_limit_secs=86400.0)
+    )
+    assert service._mn_demand(short) == []
+    assert service._mn_demand(long) == [2]
+
+
+def test_queued_allocations_absorb_demand(tmp_path):
+    """Already-queued allocations satisfy demand before new submits
+    (reference compute_submission_permit step 1)."""
+    import asyncio
+
+    from hyperqueue_tpu.autoalloc.state import Allocation
+
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(core, 1, [("cpus", 10_000)])
+
+    queue = AllocationQueue(
+        1, QueueParams(manager="slurm", backlog=4, workers_per_alloc=4)
+    )
+    # a queued allocation with 4 workers already covers the single sn task
+    queue.allocations["a1"] = Allocation(
+        allocation_id="a1", queue_id=1, worker_count=4
+    )
+    service.state.queues[1] = queue
+    submitted = []
+    service._submit_one = lambda q: submitted.append(q) or _async_none()
+
+    async def run():
+        await service.perform_submits()
+
+    asyncio.run(run())
+    assert submitted == []
+
+
+def _async_none():
+    import asyncio
+
+    f = asyncio.get_event_loop().create_future()
+    f.set_result(None)
+    return f
+
+
+def test_autoalloc_mn_gang_triggers_submit(env, tmp_path):
+    """e2e: a pending multi-node gang with zero workers drives an allocation
+    submit (previously mn demand never reached the permit)."""
+    bin_dir, log_dir = tmp_path / "bin", tmp_path / "log"
+    make_mock_bins(bin_dir, log_dir)
+    os.environ["PATH"] = f"{bin_dir}:{os.environ['PATH']}"
+    try:
+        env.start_server()
+        env.command(["alloc", "add", "slurm", "--backlog", "1",
+                     "--workers-per-alloc", "2"])
+        env.command(["submit", "--nodes", "2", "--", "hostname"])
+        wait_until(
+            lambda: (log_dir / "sbatch.log").exists(),
+            timeout=25,
+            message="sbatch invoked for mn demand",
+        )
+        script = (log_dir / "script-1.sh").read_text()
+        assert "worker start" in script
+    finally:
+        os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)
+
+
+def test_mn_demand_skips_resource_impossible_gangs(tmp_path):
+    """A gang whose resource entries exceed the queue's declared worker
+    resources must not churn futile allocations."""
+    from hyperqueue_tpu.ids import make_task_id
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.server.task import Task, TaskState
+
+    service = _service(tmp_path)
+    core = service.server.core
+    fpga = core.resource_map.get_or_create("fpga")
+    req = ResourceRequest(
+        n_nodes=2, entries=(ResourceRequestEntry(fpga, 10_000),)
+    )
+    rq_id = core.intern_rqv(ResourceRequestVariants.single(req))
+    task = Task(task_id=make_task_id(1, 1), rq_id=rq_id, priority=(0, 0))
+    task.state = TaskState.READY
+    core.tasks[task.task_id] = task
+    core.mn_queue.append(task.task_id)
+
+    plain = AllocationQueue(
+        1, QueueParams(manager="slurm", workers_per_alloc=2)
+    )
+    with_fpga = AllocationQueue(
+        2, QueueParams(manager="slurm", workers_per_alloc=2,
+                       worker_args=["--resource", "fpga=[a]"])
+    )
+    assert service._mn_demand(plain) == []
+    assert service._mn_demand(with_fpga) == [2]
