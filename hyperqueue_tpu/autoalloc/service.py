@@ -238,6 +238,7 @@ class AutoAllocService:
             needs=needs.astype(np.int32),
             sizes=sizes,
             min_time=min_time,
+            priorities=[b.priority for b in batches],
         )
         fake_load = np.asarray(counts).sum(axis=(0, 1))[first_fake:]
         return int((fake_load > 0).sum())

@@ -96,7 +96,10 @@ def cmd_server_start(args) -> None:
 
     if args.scheduler == "tpu":
         pass  # keep the environment default (the TPU platform)
-    elif args.scheduler == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+    elif (
+        args.scheduler in ("cpu", "milp")
+        or os.environ.get("JAX_PLATFORMS") == "cpu"
+    ):
         jax.config.update("jax_platforms", "cpu")
 
     from hyperqueue_tpu.server.bootstrap import Server
@@ -906,7 +909,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--worker-port", type=int, default=0)
     p.add_argument("--disable-client-authentication", action="store_true")
     p.add_argument("--disable-worker-authentication", action="store_true")
-    p.add_argument("--scheduler", choices=["auto", "cpu", "tpu"], default="auto")
+    p.add_argument("--scheduler", choices=["auto", "cpu", "tpu", "milp"],
+                   default="auto",
+                   help="auto/cpu/tpu pick the greedy cut-scan backend; "
+                        "milp runs the exact host MILP (accuracy oracle)")
     p.add_argument("--journal", default=None)
     p.add_argument("--access-file", default=None,
                    help="start with pre-shared keys/ports from generate-access")

@@ -2,10 +2,12 @@
 
 A "model" here is a scheduling policy: it consumes a dense tick snapshot and
 produces per-(batch, variant, worker) task counts. `greedy` is the production
-cut-scan model (jitted, bucketed shapes). Future models (auction refinement,
-LP-polish) plug in behind the same interface so `--scheduler=` can select them.
+cut-scan model (jitted, bucketed shapes); `milp` is the exact host MILP
+(scipy HiGHS) used as the accuracy oracle and selectable with
+`--scheduler=milp`.
 """
 
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.models.milp import MilpModel
 
-__all__ = ["GreedyCutScanModel"]
+__all__ = ["GreedyCutScanModel", "MilpModel"]

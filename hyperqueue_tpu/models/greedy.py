@@ -72,6 +72,9 @@ class GreedyCutScanModel:
         needs: np.ndarray,      # (B, V, R) int32
         sizes: np.ndarray,      # (B,) int32/int64
         min_time: np.ndarray,   # (B, V) int32 seconds
+        priorities: list | None = None,  # accepted for model-interface
+                                         # parity; rows are already in
+                                         # descending priority order
     ) -> np.ndarray:
         """Returns counts (B, V, W) int32 (unpadded)."""
         n_w, n_r = free.shape

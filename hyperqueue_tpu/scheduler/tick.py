@@ -175,6 +175,7 @@ def run_tick(
         needs=needs.astype(np.int32),
         sizes=sizes,
         min_time=min_time,
+        priorities=[b.priority for b in batches],
     )
 
     assignments: list[Assignment] = []

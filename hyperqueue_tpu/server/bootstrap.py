@@ -20,6 +20,7 @@ from pathlib import Path
 
 from hyperqueue_tpu.ids import task_id_job, task_id_task, make_task_id
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.models.milp import MilpModel
 from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
@@ -174,7 +175,9 @@ class Server:
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
-        self.model = GreedyCutScanModel()
+        self.model = (
+            MilpModel() if scheduler == "milp" else GreedyCutScanModel()
+        )
         self.scheduler_kind = scheduler
         self.access: serverdir.AccessRecord | None = None
         self.autoalloc = None

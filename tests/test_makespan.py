@@ -126,3 +126,107 @@ def test_heterogeneous_resources_makespan():
     # gpu work: 10 tasks / 2 gpus = 5 rounds; cpu work: 40 x 2cpu over
     # (16-ish cpus) — gpu tasks hold 1 cpu each on the gpu box
     assert makespan <= 8.0, f"makespan {makespan:.2f}"
+
+
+# ---------------------------------------------------------------------------
+# Greedy vs exact-MILP accuracy oracle (SURVEY §7.6): the jitted greedy
+# kernel must stay within a small factor of the scipy-HiGHS MILP — the same
+# decision the reference's LP-backed solver makes — on per-tick counts and
+# on simulated makespan.
+# ---------------------------------------------------------------------------
+
+from hyperqueue_tpu.models.milp import MilpModel
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_greedy_tick_counts_near_milp(seed):
+    rng = np.random.default_rng(seed)
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+    from hyperqueue_tpu.utils.constants import INF_TIME
+
+    U = 10_000
+    n_w, n_r, n_b, n_v = 6, 3, 8, 2
+    free = rng.integers(1, 12, size=(n_w, n_r)) * U
+    nt_free = np.full(n_w, 16, dtype=np.int32)
+    lifetime = np.full(n_w, int(INF_TIME), dtype=np.int32)
+    needs = np.where(
+        rng.random((n_b, n_v, n_r)) < 0.5,
+        rng.integers(1, 5, size=(n_b, n_v, n_r)) * U,
+        0,
+    ).astype(np.int64)
+    needs[:, 0, 0] = np.maximum(needs[:, 0, 0], U)  # variant 0 always real
+    sizes = rng.integers(1, 10, size=n_b).astype(np.int32)
+    min_time = np.zeros((n_b, n_v), dtype=np.int32)
+    # batches at 3 priority levels, rows in descending priority order
+    priorities = sorted(
+        (int(p) for p in rng.integers(0, 3, size=n_b)), reverse=True
+    )
+
+    greedy = GreedyCutScanModel(backend="numpy").solve(
+        free=free.astype(np.int32), nt_free=nt_free, lifetime=lifetime,
+        needs=needs.astype(np.int32), sizes=sizes, min_time=min_time,
+    )
+    exact = MilpModel().solve(
+        free=free, nt_free=nt_free, lifetime=lifetime, needs=needs,
+        sizes=sizes, min_time=min_time, priorities=priorities,
+    )
+    # feasibility of the MILP solution
+    used = np.einsum("bvw,bvr->wr", exact.astype(np.int64), needs)
+    assert (used <= free).all()
+    per_b_exact = exact.sum(axis=(1, 2))
+    assert (per_b_exact <= sizes).all()
+    g_total, e_total = int(np.asarray(greedy).sum()), int(exact.sum())
+    assert e_total >= 1
+    assert g_total >= 0.85 * e_total, (
+        f"greedy assigned {g_total} vs exact {e_total}"
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_greedy_makespan_within_milp_bound(seed):
+    """Simulated end-to-end makespan: greedy within 1.3x of the exact MILP
+    scheduler on a heterogeneous random workload."""
+    rng = np.random.default_rng(seed)
+
+    def build(model):
+        env = TestEnv(model=model)
+        env.worker(cpus=8, gpus=2)
+        env.worker(cpus=8)
+        env.worker(cpus=4)
+        ids = []
+        ids += env.submit(n=30, rqv=env.rqv(cpus=1))
+        ids += env.submit(n=10, rqv=env.rqv(cpus=4))
+        ids += env.submit(n=6, rqv=env.rqv(gpus=1))
+        return env, ids
+
+    durations = None
+    results = {}
+    for name, model in [("greedy", None), ("milp", MilpModel())]:
+        env, ids = build(model)
+        if durations is None:
+            durations = {
+                t: float(rng.uniform(0.2, 2.0)) for t in ids
+            }
+        results[name] = simulate(env, durations)
+    assert results["greedy"] <= results["milp"] * 1.3 + 0.5, results
+
+
+def test_milp_scheduler_e2e(tmp_path):
+    """hq server start --scheduler milp runs a real workload end-to-end."""
+    from utils_e2e import HqEnv
+
+    with HqEnv(tmp_path) as env:
+        env.start_server("--scheduler", "milp")
+        env.start_worker(cpus=2)
+        env.wait_workers(1)
+        env.command(["submit", "--array", "0-7", "--wait", "--",
+                     "bash", "-c", "echo ok-$HQ_TASK_ID"])
+        out = env.command(["job", "info", "1", "--output-mode", "json"])
+        import json as _json
+
+        detail = _json.loads(out)[0]
+        assert detail["counters"]["finished"] == 8
+        info = _json.loads(
+            env.command(["server", "info", "--output-mode", "json"])
+        )
+        assert info["scheduler"] == "milp"

@@ -88,11 +88,11 @@ class TestEvents:
 class TestEnv:
     __test__ = False  # not a pytest test class
 
-    def __init__(self):
+    def __init__(self, model=None):
         self.core = Core()
         self.comm = TestComm()
         self.events = TestEvents()
-        self.model = GreedyCutScanModel()
+        self.model = model or GreedyCutScanModel()
         self._task_seq = 0
 
     # --- builders -----------------------------------------------------
