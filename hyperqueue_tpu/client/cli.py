@@ -800,7 +800,11 @@ def cmd_journal_prune(args) -> None:
 def cmd_journal_report(args) -> None:
     from hyperqueue_tpu.client.report import build_report
 
-    html_text = build_report(args.journal_file)
+    html_text = build_report(
+        args.journal_file,
+        start_time=args.start_time,
+        end_time=args.end_time,
+    )
     output = args.output or "hq-report.html"
     with open(output, "w") as f:
         f.write(html_text)
@@ -1114,6 +1118,10 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("journal_file")
     p.add_argument("--output", default=None)
+    p.add_argument("--start-time", type=float, default=None,
+                   help="window start, seconds from the first record")
+    p.add_argument("--end-time", type=float, default=None,
+                   help="window end, seconds from the first record")
     p.set_defaults(fn=cmd_journal_report)
     p = josub.add_parser("flush")
     _add_common(p)

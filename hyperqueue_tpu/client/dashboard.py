@@ -128,6 +128,15 @@ def render_worker_detail(data: DashboardData, worker_id: int,
                     row = []
             if row:
                 lines.append("  " + "  ".join(row))
+        gpus = hw.get("gpus") or []
+        if gpus:
+            lines.append("GPUS")
+            for g in gpus:
+                lines.append(
+                    f"  {g.get('vendor', '?'):<7}{str(g.get('id', ''))[:16]:<16}"
+                    f" util {_bar(g.get('usage_percent', 0) / 100.0, 8)}"
+                    f" mem {_bar(g.get('mem_usage_percent', 0) / 100.0, 8)}"
+                )
     return [ln[:width] for ln in lines[:height]]
 
 

@@ -1,117 +1,241 @@
 """`hq journal report` — static HTML analytics from a journal file.
 
-Reference: crates/hyperqueue/src/client/commands/journal/report.rs (856 LoC
-HTML stats page). Generates a single self-contained HTML file: job table,
-task state totals, worker connect/disconnect timeline, throughput per minute.
+Reference: crates/hyperqueue/src/client/commands/journal/report.rs — traces
+of running tasks and connected workers over time, per-job task-duration
+statistics, per-worker utilization, resource summaries, and a time window
+(--start-time/--end-time offsets) — rendered as one self-contained HTML
+page. Charts are inline SVG (no external assets; this environment has zero
+egress and the reference's page is likewise self-contained).
+
+State reduction reuses the dashboard's event-sourced reducer
+(client/dashboard_data.py) so the report and the TUI agree on semantics.
 """
 
 from __future__ import annotations
 
 import html
-import json
+import statistics
 import time
 from collections import Counter
 from pathlib import Path
 
+from hyperqueue_tpu.client.dashboard_data import DashboardData
 from hyperqueue_tpu.events.journal import Journal
 
 
-def build_report(journal_path: str | Path) -> str:
-    jobs: dict[int, dict] = {}
-    task_states = Counter()
-    per_minute = Counter()
-    workers: list[tuple[float, str, str]] = []
-    first_ts = last_ts = None
+def _fmt(ts: float) -> str:
+    return (
+        time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)) if ts else "-"
+    )
 
-    for rec in Journal.read_all(Path(journal_path)):
-        ts = rec.get("time", 0.0)
+
+def _svg_line(series: list[tuple[float, float]], width=640, height=120,
+              color="#36c") -> str:
+    """Step-line SVG chart for a (t, value) series."""
+    if not series:
+        return "<p>(no data)</p>"
+    t0, t1 = series[0][0], series[-1][0]
+    span = max(t1 - t0, 1e-9)
+    vmax = max((v for _, v in series), default=1.0) or 1.0
+    points = []
+    prev_y = None
+    for t, v in series:
+        x = (t - t0) / span * (width - 2) + 1
+        y = height - 1 - (v / vmax) * (height - 20)
+        if prev_y is not None:
+            points.append(f"{x:.1f},{prev_y:.1f}")
+        points.append(f"{x:.1f},{y:.1f}")
+        prev_y = y
+    return (
+        f'<svg viewBox="0 0 {width} {height}" width="{width}" '
+        f'height="{height}" style="background:#f8f8f8;border:1px solid #ddd">'
+        f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+        f'points="{" ".join(points)}"/>'
+        f'<text x="4" y="12" font-size="11">max {vmax:g}</text></svg>'
+    )
+
+
+def _collect(journal_path: Path, start_time: float | None,
+             end_time: float | None):
+    """Reduce the journal into DashboardData + report-only traces.
+
+    start/end are OFFSETS in seconds from the first record (reference
+    report.rs --start-time / --end-time)."""
+    data = DashboardData()
+    running_trace: list[tuple[float, float]] = []
+    per_minute: Counter = Counter()
+    running = 0
+    first_ts = None
+
+    for rec in Journal.read_all(journal_path):
+        ts = float(rec.get("time", 0.0))
         if first_ts is None:
             first_ts = ts
-        last_ts = ts
+        offset = ts - first_ts
+        if start_time is not None and offset < start_time:
+            continue
+        if end_time is not None and offset > end_time:
+            continue
+        data.add_event(rec)
         kind = rec.get("event", "")
-        job_id = rec.get("job")
-        if kind == "job-submitted":
-            desc = rec.get("desc") or {}
-            jobs[job_id] = {
-                "name": desc.get("name", "?"),
-                "n_tasks": rec.get("n_tasks", len(desc.get("tasks", []))),
-                "submitted": ts,
-                "completed": None,
-                "status": "running",
-            }
-        elif kind == "job-completed" and job_id in jobs:
-            jobs[job_id]["completed"] = ts
-            jobs[job_id]["status"] = rec.get("status", "finished")
-        elif kind.startswith("task-") and kind != "task-notify":
-            task_states[kind.removeprefix("task-")] += 1
+        if kind == "task-started":
+            running += 1
+            running_trace.append((ts, float(running)))
+        elif kind in ("task-finished", "task-failed", "task-canceled",
+                      "task-restarted"):
+            if running > 0:
+                running -= 1
+                running_trace.append((ts, float(running)))
             if kind == "task-finished":
                 per_minute[int(ts // 60)] += 1
-        elif kind == "worker-connected":
-            workers.append((ts, "connect", str(rec.get("id"))))
-        elif kind == "worker-lost":
-            workers.append((ts, "lost", str(rec.get("id"))))
+    return data, running_trace, per_minute
 
-    def fmt(ts):
-        return (
-            time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
-            if ts
+
+def build_report(journal_path: str | Path, start_time: float | None = None,
+                 end_time: float | None = None) -> str:
+    data, running_trace, per_minute = _collect(
+        Path(journal_path), start_time, end_time
+    )
+    lo, hi = data.time_span()
+    span = hi - lo
+
+    # ---- per-job rows with duration statistics -------------------------
+    job_rows = []
+    for job_id, job in sorted(data.jobs.items()):
+        durations = [
+            t.finished_at - t.started_at
+            for t in job.tasks.values()
+            if t.started_at and t.finished_at and t.status == "finished"
+        ]
+        c = job.counters()
+        stats = (
+            f"{min(durations):.2f} / {statistics.median(durations):.2f} / "
+            f"{statistics.mean(durations):.2f} / {max(durations):.2f}"
+            if durations
             else "-"
         )
+        makespan = (
+            f"{job.completed_at - job.submitted_at:.1f}s"
+            if job.completed_at and job.submitted_at
+            else "-"
+        )
+        job_rows.append(
+            f"<tr><td>{job_id}</td><td>{html.escape(job.name)}</td>"
+            f"<td>{job.n_tasks}</td><td>{job.status()}</td>"
+            f"<td>{c['finished']}</td><td>{c['failed']}</td>"
+            f"<td>{c['canceled']}</td>"
+            f"<td>{_fmt(job.submitted_at)}</td><td>{makespan}</td>"
+            f"<td>{stats}</td></tr>"
+        )
 
-    rows = "".join(
-        f"<tr><td>{jid}</td><td>{html.escape(j['name'])}</td>"
-        f"<td>{j['n_tasks']}</td><td>{j['status']}</td>"
-        f"<td>{fmt(j['submitted'])}</td><td>{fmt(j['completed'])}</td>"
-        f"<td>{(j['completed'] - j['submitted']):.1f}s</td></tr>"
-        if j["completed"]
-        else f"<tr><td>{jid}</td><td>{html.escape(j['name'])}</td>"
-        f"<td>{j['n_tasks']}</td><td>{j['status']}</td>"
-        f"<td>{fmt(j['submitted'])}</td><td>-</td><td>-</td></tr>"
-        for jid, j in sorted(jobs.items())
+    # ---- per-worker utilization (one pass over all tasks) --------------
+    online_until = {
+        wid: (w.lost_at if w.lost_at else hi)
+        for wid, w in data.workers.items()
+    }
+    busy_by_worker: dict[int, float] = {}
+    for job in data.jobs.values():
+        for t in job.tasks.values():
+            if not t.started_at:
+                continue
+            # a restarted task keeps started_at with finished_at=0 but is
+            # no longer running; only terminal or still-running spans count
+            if not t.finished_at and t.status != "running":
+                continue
+            for wid in t.workers:
+                end = t.finished_at or online_until.get(wid, hi)
+                busy_by_worker[wid] = busy_by_worker.get(wid, 0.0) + max(
+                    end - t.started_at, 0.0
+                )
+    worker_rows = []
+    for wid, w in sorted(data.workers.items()):
+        online = max(online_until[wid] - w.connected_at, 0.0)
+        busy = busy_by_worker.get(wid, 0.0)
+        util = f"{busy / online * 100:.0f}%" if online > 0 else "-"
+        worker_rows.append(
+            f"<tr><td>{wid}</td><td>{html.escape(w.hostname)}</td>"
+            f"<td>{html.escape(w.group)}</td>"
+            f"<td>{_fmt(w.connected_at)}</td>"
+            f"<td>{_fmt(w.lost_at) if w.lost_at else 'online'}"
+            f"{' (' + html.escape(w.lost_reason) + ')' if w.lost_reason else ''}</td>"
+            f"<td>{w.tasks_done}</td><td>{online:.0f}s</td>"
+            f"<td>{busy:.0f}s</td><td>{util}</td></tr>"
+        )
+
+    # ---- failures ------------------------------------------------------
+    failure_rows = []
+    for job_id, job in sorted(data.jobs.items()):
+        for task_id, t in sorted(job.tasks.items()):
+            if t.status == "failed":
+                failure_rows.append(
+                    f"<tr><td>{job_id}</td><td>{task_id}</td>"
+                    f"<td>{html.escape(t.error[:120])}</td></tr>"
+                )
+    failures = (
+        "<table><tr><th>job</th><th>task</th><th>error</th></tr>"
+        + "".join(failure_rows[:200])
+        + "</table>"
+        if failure_rows
+        else "<p>none</p>"
     )
-    state_rows = "".join(
-        f"<tr><td>{s}</td><td>{n}</td></tr>"
-        for s, n in task_states.most_common()
+
+    # ---- allocation queues --------------------------------------------
+    alloc_rows = []
+    for qid, q in sorted(data.queues.items()):
+        by_status = Counter(a.status for a in q.allocations.values())
+        alloc_rows.append(
+            f"<tr><td>{qid}</td><td>{html.escape(q.manager)}</td>"
+            f"<td>{q.state}</td>"
+            f"<td>{' '.join(f'{k}={v}' for k, v in sorted(by_status.items())) or '-'}</td></tr>"
+        )
+
+    # ---- charts --------------------------------------------------------
+    worker_chart = _svg_line(
+        [(t, float(n)) for t, n in data.worker_series], color="#383"
     )
-    worker_rows = "".join(
-        f"<tr><td>{fmt(ts)}</td><td>{ev}</td><td>{wid}</td></tr>"
-        for ts, ev, wid in workers
+    running_chart = _svg_line(running_trace)
+    throughput_chart = _svg_line(
+        [(m * 60.0, float(per_minute[m])) for m in sorted(per_minute)],
+        color="#a44",
     )
-    minutes = sorted(per_minute)
-    throughput = (
-        json.dumps([[m * 60, per_minute[m]] for m in minutes])
-        if minutes
-        else "[]"
-    )
-    span = (last_ts - first_ts) if (first_ts and last_ts) else 0.0
+
+    task_totals = Counter()
+    for job in data.jobs.values():
+        for status, n in job.counters().items():
+            task_totals[status] += n
+    totals = " ".join(f"{k}={v}" for k, v in sorted(task_totals.items()) if v)
+    window = ""
+    if start_time is not None or end_time is not None:
+        window = (
+            f" window [{start_time if start_time is not None else 0:g}s, "
+            f"{end_time if end_time is not None else span:g}s]"
+        )
 
     return f"""<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>HyperQueue-TPU report</title>
 <style>
-body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
-table {{ border-collapse: collapse; margin: 1rem 0; }}
+body {{ font-family: system-ui, sans-serif; margin: 2rem; max-width: 72rem; }}
+table {{ border-collapse: collapse; margin: 1rem 0; font-size: 0.9rem; }}
 td, th {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left; }}
 h2 {{ margin-top: 2rem; }}
-.bar {{ background: #4a7; display: inline-block; height: 12px; }}
 </style></head><body>
 <h1>HyperQueue-TPU journal report</h1>
-<p>{len(jobs)} job(s), {sum(task_states.values())} task events over
-{span:.0f}s ({html.escape(str(journal_path))})</p>
+<p>{len(data.jobs)} job(s), {len(data.workers)} worker(s), tasks: {totals}
+over {span:.0f}s{window} &mdash; {html.escape(str(journal_path))}</p>
+<h2>Connected workers over time</h2>{worker_chart}
+<h2>Running tasks over time</h2>{running_chart}
+<h2>Throughput (finished tasks per minute)</h2>{throughput_chart}
 <h2>Jobs</h2>
 <table><tr><th>id</th><th>name</th><th>tasks</th><th>status</th>
-<th>submitted</th><th>completed</th><th>makespan</th></tr>{rows}</table>
-<h2>Task events</h2>
-<table><tr><th>state</th><th>count</th></tr>{state_rows}</table>
+<th>finished</th><th>failed</th><th>canceled</th><th>submitted</th>
+<th>makespan</th><th>duration min/med/mean/max (s)</th></tr>
+{"".join(job_rows)}</table>
 <h2>Workers</h2>
-<table><tr><th>time</th><th>event</th><th>worker</th></tr>{worker_rows}</table>
-<h2>Throughput (finished tasks per minute)</h2>
-<div id="chart"></div>
-<script>
-const data = {throughput};
-const max = Math.max(1, ...data.map(d => d[1]));
-document.getElementById("chart").innerHTML = data.map(d =>
-  `<div>${{new Date(d[0] * 1000).toLocaleTimeString()}} ` +
-  `<span class="bar" style="width:${{d[1] / max * 400}}px"></span> ${{d[1]}}</div>`
-).join("");
-</script>
+<table><tr><th>id</th><th>hostname</th><th>group</th><th>connected</th>
+<th>until</th><th>tasks done</th><th>online</th><th>busy</th><th>util</th></tr>
+{"".join(worker_rows)}</table>
+<h2>Failed tasks</h2>{failures}
+<h2>Allocation queues</h2>
+<table><tr><th>queue</th><th>manager</th><th>state</th><th>allocations</th></tr>
+{"".join(alloc_rows) or "<tr><td colspan=4>none</td></tr>"}</table>
 </body></html>"""

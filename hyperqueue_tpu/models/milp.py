@@ -158,7 +158,10 @@ class MilpModel:
                 bounds=Bounds(0, upper),
                 options={"time_limit": level_budget},
             )
-            if not result.success:
+            # status 1 = time/iteration limit with a feasible incumbent in
+            # result.x; discarding it would assign nothing at this level
+            # every tick on instances that persistently exceed the budget
+            if result.x is None or result.status not in (0, 1):
                 logger.warning("milp level %s failed: %s", level,
                                result.message)
                 continue

@@ -362,10 +362,14 @@ class WorkerRuntime:
         interval = self.configuration.overview_interval_secs
         while True:
             await asyncio.sleep(interval)
+            # sampling shells out to nvidia-smi/rocm-smi (blocking, up to
+            # seconds on a wedged driver); keep it off the event loop so
+            # heartbeats and task messaging never stall
+            hw = await asyncio.to_thread(sampler.sample)
             await self._send(
                 {
                     "op": "overview",
-                    "hw": sampler.sample(),
+                    "hw": hw,
                     "n_running": len(self.running),
                 }
             )

@@ -82,3 +82,92 @@ def test_doc_and_completion_cli(capsys):
     out = capsys.readouterr().out
     assert "_hq_complete" in out
     assert "submit" in out
+
+
+def test_journal_report_analytics(tmp_path):
+    """Deep report: per-job duration stats, per-worker utilization, SVG
+    traces, failures table, and the --start-time/--end-time window."""
+    from hyperqueue_tpu.client.report import build_report
+    from hyperqueue_tpu.events.journal import Journal
+
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"time": 100.0, "event": "worker-connected", "id": 1,
+             "hostname": "nodeZ", "group": "g"})
+    j.write({"time": 100.5, "event": "job-submitted", "job": 1,
+             "desc": {"name": "stats"}, "n_tasks": 3})
+    j.write({"time": 101.0, "event": "task-started", "job": 1, "task": 0,
+             "workers": [1]})
+    j.write({"time": 103.0, "event": "task-finished", "job": 1, "task": 0})
+    j.write({"time": 103.0, "event": "task-started", "job": 1, "task": 1,
+             "workers": [1]})
+    j.write({"time": 107.0, "event": "task-finished", "job": 1, "task": 1})
+    j.write({"time": 107.0, "event": "task-started", "job": 1, "task": 2,
+             "workers": [1]})
+    j.write({"time": 108.0, "event": "task-failed", "job": 1, "task": 2,
+             "error": "segfault in step 3"})
+    j.write({"time": 109.0, "event": "worker-lost", "id": 1,
+             "reason": "idle timeout"})
+    j.close()
+
+    html_text = build_report(path)
+    # duration stats: min 2.0, max 4.0 over the two finished tasks
+    assert "2.00" in html_text and "4.00" in html_text
+    assert "nodeZ" in html_text
+    assert "segfault in step 3" in html_text
+    assert "idle timeout" in html_text
+    assert "<svg" in html_text  # inline charts
+    assert html_text.count("<svg") >= 3
+    # worker utilization: busy 2+4+1=7s of ~9s online
+    assert "tasks done" in html_text
+
+    # window: restrict to after the first task finished
+    windowed = build_report(path, start_time=3.5)
+    assert "segfault" in windowed
+    assert "2.00" not in windowed  # task 0's duration is outside the window
+
+
+def test_gpu_stat_parsers():
+    from hyperqueue_tpu.worker.hwmonitor import (
+        parse_nvidia_smi_csv,
+        parse_rocm_smi_json,
+    )
+
+    nvidia = parse_nvidia_smi_csv(
+        "00000000:01:00.0, 35 %, 1024 MiB, 8192 MiB\n"
+        "00000000:02:00.0, 0 %, 0 MiB, 8192 MiB\n"
+    )
+    assert len(nvidia) == 2
+    assert nvidia[0]["vendor"] == "nvidia"
+    assert nvidia[0]["usage_percent"] == 35.0
+    assert nvidia[0]["mem_usage_percent"] == 12.5
+    assert nvidia[1]["usage_percent"] == 0.0
+
+    amd = parse_rocm_smi_json(
+        '{"card0": {"GPU use (%)": "75", "GPU memory use (%)": "50",'
+        ' "PCI Bus": "0000:C1:00.0"},'
+        ' "card1": {"GPU use (%)": "0", "GPU memory use (%)": "0",'
+        ' "PCI Bus": "0000:C6:00.0"}}'
+    )
+    assert len(amd) == 2
+    assert amd[0]["id"] == "0000:C1:00.0"
+    assert amd[0]["usage_percent"] == 75.0
+    assert parse_rocm_smi_json("not json") == []
+    assert parse_nvidia_smi_csv("") == []
+
+
+def test_dashboard_worker_detail_shows_gpus():
+    from hyperqueue_tpu.client.dashboard import render_worker_detail
+    from hyperqueue_tpu.client.dashboard_data import DashboardData
+
+    data = DashboardData()
+    data.add_event({"time": 1.0, "event": "worker-connected", "id": 1,
+                    "hostname": "n", "group": "g"})
+    data.add_event({"time": 2.0, "event": "worker-overview", "id": 1,
+                    "hw": {"cpu_usage_percent": 10.0,
+                           "gpus": [{"id": "b1", "vendor": "nvidia",
+                                     "usage_percent": 80.0,
+                                     "mem_usage_percent": 40.0}]}})
+    out = "\n".join(render_worker_detail(data, 1))
+    assert "GPUS" in out and "nvidia" in out and "b1" in out
