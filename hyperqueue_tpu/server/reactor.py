@@ -22,9 +22,11 @@ logger = logging.getLogger(__name__)
 
 # max tasks queued on a worker beyond its current capacity. The reference
 # uses 40 (scheduler/state.rs:4-21) with its own tick cadence; ours is sized
-# so that prefill_max / schedule_min_delay comfortably exceeds the reference's
-# per-worker throughput target (<0.1 ms/task per node on short tasks).
-PREFILL_MAX = 150
+# so that the refill round-trip (scheduler min-delay + two plane RTTs +
+# batch processing, ~35 ms measured) amortized over a full prefill batch
+# stays well under the <0.1 ms/task overhead target even when every task
+# completes instantly.
+PREFILL_MAX = 512
 
 
 class Comm(Protocol):

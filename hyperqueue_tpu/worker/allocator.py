@@ -456,11 +456,22 @@ class ResourceAllocator:
         be as good as on an empty worker, else the task waits."""
         coupled: list[tuple[dict, _IndexPool]] = []
         any_forced = False
+        plan = []  # (entry, pool, policy): parse once, reuse in the claims
         for entry in entries:
             pool = self.pools.get(entry["name"])
             if pool is None:
                 return None
             policy = AllocationPolicy.parse(entry.get("policy", "compact"))
+            # cheap infeasibility gate — failed attempts dominate on
+            # saturated workers (every release retries the blocked queue).
+            # ALL ignores the amount (grabs whatever the pool has), so it
+            # must not be gated on it.
+            if (
+                policy is not AllocationPolicy.ALL
+                and pool.total_free() < int(entry["amount"])
+            ):
+                return None
+            plan.append((entry, pool, policy))
             if (
                 isinstance(pool, _IndexPool)
                 and 1 < len(pool.groups) <= _MAX_SOLVER_GROUPS
@@ -473,13 +484,14 @@ class ResourceAllocator:
         # least two of the requested resources together; plain compact/tight
         # without weights is served by the cheap per-pool ordering (the
         # solver's per-group objective agrees with it)
-        names = {e["name"] for e, _ in coupled}
-        weights_apply = any(
-            w.resource1 in names and w.resource2 in names
-            for w in self.coupling_weights
-        )
-        if not any_forced and not weights_apply:
-            coupled = []
+        if coupled:
+            names = {e["name"] for e, _ in coupled}
+            weights_apply = any(
+                w.resource1 in names and w.resource2 in names
+                for w in self.coupling_weights
+            )
+            if not any_forced and not weights_apply:
+                coupled = []
 
         masks: dict[str, set[int]] = {}
         if coupled:
@@ -513,9 +525,7 @@ class ResourceAllocator:
                     masks[entry["name"]] = set(sel)
 
         allocation = Allocation()
-        for entry in entries:
-            pool = self.pools[entry["name"]]
-            policy = AllocationPolicy.parse(entry.get("policy", "compact"))
+        for entry, pool, policy in plan:
             if isinstance(pool, _IndexPool):
                 claim = pool.allocate(
                     int(entry["amount"]),

@@ -198,6 +198,33 @@ class WorkerRuntime:
         return True
 
     def _start_with_allocation(self, task_msg: dict, allocation) -> None:
+        body = task_msg.get("body") or {}
+        if (
+            self.zero_worker
+            and not body.get("stream")
+            and not body.get("time_limit")
+        ):
+            # zero-worker fast path: no process ever exists, so completing
+            # inline (two queued uplinks, immediate release) skips the
+            # per-task coroutine + future + RunningTask entirely — the
+            # worker-side floor of the <0.1 ms/task overhead target
+            task_id = task_msg["id"]
+            instance = task_msg.get("instance", 0)
+            self._sendq.put_nowait(
+                {"op": "task_running", "id": task_id, "instance": instance}
+            )
+            self._sendq.put_nowait(
+                {"op": "task_finished", "id": task_id, "instance": instance}
+            )
+            self.last_task_time = time.monotonic()
+            if allocation is not None:
+                self.allocator.release(allocation)
+                if self.blocked:
+                    # re-probe parked tasks — but via call_soon: this fast
+                    # path runs inside _retry_blocked itself, and a direct
+                    # call would recurse one frame per blocked task
+                    asyncio.get_running_loop().call_soon(self._retry_blocked)
+            return
         future = asyncio.create_task(self._run_task(task_msg, allocation))
         self.running[task_msg["id"]] = RunningTask(
             task_msg, allocation, None, future

@@ -81,3 +81,25 @@ def test_worker_stop_on_server_lost(env):
         timeout=30,
         message="worker exited after server loss",
     )
+
+
+def test_zero_worker_blocked_tasks_drain(tmp_path):
+    """Zero-worker fast-path completions must still re-probe the blocked
+    queue: tasks parked on resources wedge forever otherwise."""
+    from utils_e2e import HqEnv
+
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker("--zero-worker", cpus=2)
+        env.wait_workers(1)
+        # 2-cpu worker, 2-cpu tasks: every task needs the whole pool, so
+        # arrivals beyond the first always park in the blocked queue and
+        # only fast-path releases can free them
+        env.command(["submit", "--array", "0-199", "--cpus", "2", "--wait",
+                     "--", "true"], timeout=90)
+        import json as _json
+
+        info = _json.loads(
+            env.command(["job", "info", "1", "--output-mode", "json"])
+        )[0]
+        assert info["counters"]["finished"] == 200
