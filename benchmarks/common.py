@@ -35,7 +35,10 @@ class Cluster:
             if time.time() > deadline:
                 raise TimeoutError("server did not start")
             time.sleep(0.05)
-        worker_args = ["worker", "start", "--cpus", str(cpus), *extra_worker]
+        worker_args = ["worker", "start"]
+        if cpus is not None:
+            worker_args += ["--cpus", str(cpus)]
+        worker_args += list(extra_worker)
         if zero_worker:
             worker_args.append("--zero-worker")
         for i in range(n_workers):

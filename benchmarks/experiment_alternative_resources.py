@@ -1,0 +1,50 @@
+"""Alternative-resources (variants) experiment: tasks preferring a scarce
+gpu variant with a cpu fallback must use both pools concurrently.
+
+Reference: benchmarks/experiment-alternative-resources.py.
+"""
+
+import json
+import sys
+import time
+
+from common import Cluster, emit
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    with Cluster(
+        n_workers=1,
+        zero_worker=True,
+        cpus=8,
+        extra_worker=("--resource", "gpus=[a,b]"),
+    ) as cluster:
+        jobfile = cluster.dir / "variants.toml"
+        blocks = ['name = "variants"']
+        for i in range(n_tasks):
+            blocks.append(
+                f"[[task]]\nid = {i}\ncommand = [\"true\"]\n"
+                "[[task.request]]\nresources = { gpus = \"1\" }\n"
+                "[[task.request]]\nresources = { cpus = \"2\" }\n"
+            )
+        jobfile.write_text("\n".join(blocks))
+        t0 = time.perf_counter()
+        cluster.hq(["job", "submit-file", str(jobfile)])
+        cluster.hq(["job", "wait", "1"])
+        wall = time.perf_counter() - t0
+        info = json.loads(
+            cluster.hq(["job", "info", "1", "--output-mode", "json"])
+        )[0]
+        emit(
+            {
+                "experiment": "alternative-resources",
+                "n_tasks": n_tasks,
+                "wall_s": round(wall, 3),
+                "per_task_ms": round(wall / n_tasks * 1000, 3),
+                "finished": info["counters"]["finished"],
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

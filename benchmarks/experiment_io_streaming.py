@@ -1,0 +1,45 @@
+"""Output-streaming experiment: tasks writing stdout through the stream
+path into per-worker log files instead of one file per task.
+
+Reference: benchmarks/experiment-io-streaming.py.
+"""
+
+import json
+import sys
+import time
+
+from common import Cluster, emit
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    payload = "x" * 256
+    with Cluster(n_workers=1, cpus=4, zero_worker=False) as cluster:
+        stream_dir = cluster.dir / "stream"
+        t0 = time.perf_counter()
+        cluster.hq(
+            ["submit", "--array", f"1-{n_tasks}", "--wait",
+             "--stream", str(stream_dir), "--",
+             "bash", "-c", f"echo {payload}"]
+        )
+        wall = time.perf_counter() - t0
+        summary = json.loads(
+            cluster.hq(
+                ["output-log", "summary", str(stream_dir),
+                 "--output-mode", "json"]
+            )
+        )
+        emit(
+            {
+                "experiment": "io-streaming",
+                "n_tasks": n_tasks,
+                "wall_s": round(wall, 3),
+                "per_task_ms": round(wall / n_tasks * 1000, 3),
+                "streamed_bytes": summary.get("stdout_bytes",
+                                              summary.get("bytes", 0)),
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""NUMA coupling experiment: throughput and placement quality with grouped
+resources + coupling weights on the worker.
+
+Reference: benchmarks/experiment-numa.py — tasks requesting coupled
+cpus+gpus on a multi-socket worker; measures wall time and verifies the
+group solver keeps claims socket-aligned.
+"""
+
+import json
+import sys
+import time
+
+from common import Cluster, emit
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    with Cluster(
+        n_workers=1,
+        zero_worker=False,
+        extra_worker=(
+            "--resource", "cpus=[[0,1,2,3],[4,5,6,7]]",
+            "--resource", "gpus=[[a],[b]]",
+            "--coupling", "cpus[0]:gpus[0]=256,cpus[1]:gpus[1]=256",
+        ),
+        cpus=None,
+    ) as cluster:
+        t0 = time.perf_counter()
+        cluster.hq(
+            ["submit", "--array", f"1-{n_tasks}", "--wait",
+             "--cpus", "2", "--resource", "gpus=1", "--",
+             "bash", "-c",
+             'c=${HQ_RESOURCE_VALUES_cpus%%,*}; g=$HQ_RESOURCE_VALUES_gpus; '
+             'if [ "$g" = a ] && [ "$c" -ge 4 ]; then exit 3; fi; '
+             'if [ "$g" = b ] && [ "$c" -lt 4 ]; then exit 3; fi']
+        )
+        wall = time.perf_counter() - t0
+        info = json.loads(
+            cluster.hq(["job", "info", "1", "--output-mode", "json"])
+        )[0]
+        emit(
+            {
+                "experiment": "numa-coupling",
+                "n_tasks": n_tasks,
+                "wall_s": round(wall, 3),
+                "per_task_ms": round(wall / n_tasks * 1000, 3),
+                "misaligned_claims": info["counters"]["failed"],
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Server CPU utilization while driving a task storm.
+
+Reference: benchmarks/experiment-server-cpu-util.py — measures how much of
+one core the server burns per unit of task throughput.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+from common import Cluster, emit
+
+
+def cpu_seconds(pid: int) -> float:
+    parts = Path(f"/proc/{pid}/stat").read_text().rsplit(") ", 1)[1].split()
+    utime, stime = int(parts[11]), int(parts[12])
+    import os
+
+    return (utime + stime) / os.sysconf("SC_CLK_TCK")
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    with Cluster(n_workers=1, cpus=4, zero_worker=True) as cluster:
+        server_pid = cluster.procs[0].pid
+        cpu0 = cpu_seconds(server_pid)
+        t0 = time.perf_counter()
+        cluster.hq(
+            ["submit", "--array", f"1-{n_tasks}", "--wait", "--", "true"]
+        )
+        wall = time.perf_counter() - t0
+        cpu1 = cpu_seconds(server_pid)
+        emit(
+            {
+                "experiment": "server-cpu-util",
+                "n_tasks": n_tasks,
+                "wall_s": round(wall, 3),
+                "server_cpu_s": round(cpu1 - cpu0, 3),
+                "server_cpu_pct_of_core": round(
+                    (cpu1 - cpu0) / wall * 100, 1
+                ),
+                "server_cpu_us_per_task": round(
+                    (cpu1 - cpu0) / n_tasks * 1e6, 1
+                ),
+            }
+        )
+
+
+if __name__ == "__main__":
+    main()

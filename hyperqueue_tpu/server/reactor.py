@@ -108,6 +108,7 @@ def on_remove_worker(
         if task is None or task.is_done:
             continue
         task.prefilled = False
+        task.retract_pending = False
         task.assigned_worker = 0
         task.increment_instance()
         task.state = TaskState.WAITING
@@ -180,6 +181,7 @@ def on_task_running(
                     core.variant_amounts(task.rq_id, task.assigned_variant),
                 )
             task.prefilled = False
+            task.retract_pending = False
         task.state = TaskState.RUNNING
         workers = list(task.mn_workers) or [task.assigned_worker]
         events.on_task_started(task_id, instance_id, workers)
@@ -292,6 +294,7 @@ def _release_task_resources(core: Core, task: Task) -> None:
         if task.prefilled:
             worker.prefilled_tasks.discard(task.task_id)
             task.prefilled = False
+            task.retract_pending = False
         elif task.task_id in worker.assigned_tasks:
             amounts = core.variant_amounts(task.rq_id, task.assigned_variant)
             worker.unassign(task.task_id, amounts)
@@ -488,13 +491,22 @@ def schedule(
                     reservations[w.worker_id] = batch.priority
                     break
         # prefill in GLOBAL priority order (batches are priority-sorted), so
-        # high-priority classes claim worker budgets first
+        # high-priority classes claim worker budgets first; workers are fed
+        # least-backlog-first so a deep budget cannot pile onto one worker
+        # while its peers run dry between refills
+        workers_by_backlog = sorted(
+            core.workers.values(),
+            key=lambda w: (
+                len(w.prefilled_tasks) + len(w.assigned_tasks),
+                w.worker_id,
+            ),
+        )
         for batch in create_batches(core.queues):
             queue = core.queues.queue(batch.rq_id)
             rqv = core.rq_map.get_variants(batch.rq_id)
-            for worker in core.workers.values():
-                budget = budgets.get(worker.worker_id, 0)
-                if budget <= 0:
+            eligible: list[tuple[Worker, int]] = []
+            for worker in workers_by_backlog:
+                if budgets.get(worker.worker_id, 0) <= 0:
                     continue
                 blocking = reservations.get(worker.worker_id)
                 if blocking is not None and batch.priority < blocking:
@@ -509,22 +521,43 @@ def schedule(
                 )
                 if variant is None:
                     continue
-                for task_id in queue.take(batch.priority, budget):
-                    task = core.tasks[task_id]
-                    task.state = TaskState.ASSIGNED
-                    task.assigned_worker = worker.worker_id
-                    task.assigned_variant = variant
-                    task.prefilled = True
-                    worker.prefilled_tasks.add(task_id)
-                    budgets[worker.worker_id] -= 1
-                    per_worker_msgs.setdefault(
-                        worker.worker_id, []
-                    ).append(_compute_message(core, task, variant))
+                eligible.append((worker, variant))
+            if not eligible:
+                continue
+            # fair-share split across eligible workers (multiple passes so
+            # budget-capped workers' leftovers flow to the others); without
+            # this a deep budget lets the first worker swallow the batch
+            fair = max(-(-batch.size // len(eligible)), 1)
+            progress = True
+            while progress:
+                progress = False
+                for worker, variant in eligible:
+                    budget = budgets.get(worker.worker_id, 0)
+                    if budget <= 0:
+                        continue
+                    taken = queue.take(batch.priority, min(budget, fair))
+                    if not taken:
+                        break
+                    progress = True
+                    for task_id in taken:
+                        task = core.tasks[task_id]
+                        task.state = TaskState.ASSIGNED
+                        task.assigned_worker = worker.worker_id
+                        task.assigned_variant = variant
+                        task.prefilled = True
+                        worker.prefilled_tasks.add(task_id)
+                        budgets[worker.worker_id] -= 1
+                        per_worker_msgs.setdefault(
+                            worker.worker_id, []
+                        ).append(_compute_message(core, task, variant))
 
-    # --- retract: steal prefilled backlog back from loaded workers when
-    # other workers sit idle with nothing ready to schedule (reference
-    # RetractTasks / on_retract_response, reactor.rs:462) ---
-    if prefill and not core.queues.total_ready():
+    # --- retract: steal prefilled backlog back from loaded workers
+    # whenever idle capacity appears that the backlog could use — not only
+    # when the queues are drained; under sustained arrivals the remaining
+    # ready work may simply not fit the idle workers (reference runs this
+    # check periodically on the worker, worker/rpc.rs:322; RetractTasks /
+    # on_retract_response, reactor.rs:462) ---
+    if prefill:
         idle = [
             w for w in core.workers.values()
             if w.is_idle()
@@ -536,16 +569,46 @@ def schedule(
                 (w for w in core.workers.values() if w.prefilled_tasks),
                 key=lambda w: -len(w.prefilled_tasks),
             )
-            want = sum(w.nt_free for w in idle)
+            # per-class slot budget over CAPABLE idle workers only:
+            # retracting a class toward slots that cannot host it would
+            # churn the tasks straight back to the donor next tick
+            class_slots: dict[int, int] = {}
+
+            def slots_for(rq_id: int) -> int:
+                slots = class_slots.get(rq_id)
+                if slots is None:
+                    rqv = core.rq_map.get_variants(rq_id)
+                    slots = sum(
+                        w.nt_free
+                        for w in idle
+                        if w.resources.is_capable_of_rqv(rqv)
+                    )
+                    class_slots[rq_id] = slots
+                return slots
+
             for donor in donors:
-                if want <= 0:
-                    break
-                take = min(len(donor.prefilled_tasks) // 2, want)
-                if take <= 0:
-                    continue
-                victims = sorted(donor.prefilled_tasks)[-take:]
-                comm.send_retract(donor.worker_id, victims)
-                want -= take
+                # tasks prefilled THIS tick have their compute message still
+                # queued behind us; a retract would outrun it and no-op
+                # (FIFO), so only settled, not-already-asked tasks qualify —
+                # oldest first, they are at the worker's queue tail risk
+                just_sent = {
+                    m["id"] for m in per_worker_msgs.get(donor.worker_id, ())
+                }
+                victims = []
+                budget = len(donor.prefilled_tasks) // 2
+                for tid in sorted(donor.prefilled_tasks):
+                    if len(victims) >= budget:
+                        break
+                    task = core.tasks[tid]
+                    if tid in just_sent or task.retract_pending:
+                        continue
+                    if slots_for(task.rq_id) <= 0:
+                        continue
+                    class_slots[task.rq_id] -= 1
+                    task.retract_pending = True
+                    victims.append(tid)
+                if victims:
+                    comm.send_retract(donor.worker_id, victims)
 
     for worker_id, msgs in per_worker_msgs.items():
         comm.send_compute(worker_id, msgs)
@@ -560,6 +623,7 @@ def on_retract_response(
     task = core.tasks.get(task_id)
     if task is None or task.is_done or not task.prefilled:
         return
+    task.retract_pending = False
     if not ok:
         return  # it started racing; task_running accounting takes over
     worker = core.workers.get(task.assigned_worker)

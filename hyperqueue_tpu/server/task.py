@@ -47,6 +47,9 @@ class Task:
     # assigned beyond current capacity: queued on the worker, resources not
     # yet accounted (reference mapping.rs proactive prefilling)
     prefilled: bool = False
+    # a retract request is in flight; don't re-send every tick while the
+    # worker's answer travels back
+    retract_pending: bool = False
     # multi-node gangs: workers allocated to this task (root first)
     mn_workers: tuple[int, ...] = ()
 

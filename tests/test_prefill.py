@@ -168,3 +168,56 @@ def test_prefill_priority_order_across_classes():
     )
     n_low_prefilled = sum(1 for t in low if env.core.tasks[t].prefilled)
     assert n_high_prefilled >= 50 - 1 or n_low_prefilled == 0
+
+
+def test_retract_fires_despite_unschedulable_ready_tasks():
+    """Idle capacity must trigger rebalance even while the queues still hold
+    ready work nobody can run (reference retracts whenever idle capacity
+    appears, worker/rpc.rs:322; previously gated on empty queues)."""
+    env = TestEnv()
+    w1 = env.worker(cpus=2)
+    busy = env.submit(n=2)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    env.submit(n=40)  # builds prefilled backlog on w1
+    env.schedule(prefill=True)
+    assert len(w1.prefilled_tasks) >= 20
+    # ready tasks that no worker can ever run keep total_ready() > 0
+    env.submit(n=3, rqv=env.rqv(cpus=64))
+    w2 = env.worker(cpus=2)  # fresh idle worker
+    before = len(env.comm.retracts)
+    env.schedule(prefill=True)
+    # w2 was either fed by the solve or fed via retract from w1's backlog
+    got_work = bool(w2.assigned_tasks or w2.prefilled_tasks)
+    retracted = len(env.comm.retracts) > before
+    assert got_work or retracted
+
+
+def test_retract_skips_tasks_idle_workers_cannot_run():
+    """No churn: backlog classes the idle worker cannot host stay put."""
+    env = TestEnv()
+    w1 = env.worker(cpus=2, gpus=2)
+    busy = env.submit(n=2)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    env.submit(n=20, rqv=env.rqv(gpus=1))  # gpu backlog prefills onto w1
+    env.schedule(prefill=True)
+    assert w1.prefilled_tasks
+    w2 = env.worker(cpus=2)  # no gpus: cannot host any backlog task
+    before = len(env.comm.retracts)
+    env.schedule(prefill=True)
+    assert len(env.comm.retracts) == before
+
+
+def test_prefill_spreads_across_workers():
+    """Deep prefill budgets must not pile onto one worker while its peers
+    run dry (least-backlog-first feeding)."""
+    env = TestEnv()
+    workers = [env.worker(cpus=1) for _ in range(4)]
+    env.submit(n=4)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    env.submit(n=100)
+    env.schedule(prefill=True)
+    backlogs = sorted(len(w.prefilled_tasks) for w in workers)
+    assert backlogs[0] >= 20, backlogs  # roughly even split of 100
