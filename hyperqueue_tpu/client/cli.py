@@ -639,21 +639,74 @@ def cmd_doc(args) -> None:
 
 
 def cmd_generate_completion(args) -> None:
-    """Emit a bash completion script for the hq CLI."""
+    """Emit a bash completion script for the hq CLI (top-level commands,
+    their subcommands, and per-command long options, walked from the real
+    parser tree — reference uses clap_complete)."""
     parser = build_parser()
-    subs = [a for a in parser._actions
-            if isinstance(a, argparse._SubParsersAction)]
-    top = " ".join(subs[0].choices) if subs else ""
-    print(
-        f"""_hq_complete() {{
-  local cur=${{COMP_WORDS[COMP_CWORD]}}
-  if [ $COMP_CWORD -eq 1 ]; then
-    COMPREPLY=( $(compgen -W "{top}" -- "$cur") )
-  fi
-}}
-complete -F _hq_complete hq
-complete -F _hq_complete "python -m hyperqueue_tpu" 2>/dev/null || true"""
-    )
+
+    def sub_actions(p):
+        return [a for a in p._actions
+                if isinstance(a, argparse._SubParsersAction)]
+
+    def long_opts(p):
+        out = []
+        for a in p._actions:
+            out.extend(s for s in a.option_strings if s.startswith("--"))
+        return out
+
+    subs = sub_actions(parser)
+    top_choices = subs[0].choices if subs else {}
+    lines = [
+        "_hq_complete() {",
+        '  local cur=${COMP_WORDS[COMP_CWORD]}',
+        '  local cmd=${COMP_WORDS[1]:-}',
+        '  local sub=${COMP_WORDS[2]:-}',
+        "  if [ $COMP_CWORD -eq 1 ]; then",
+        f'    COMPREPLY=( $(compgen -W "{" ".join(top_choices)}" -- "$cur") )',
+        "    return",
+        "  fi",
+        '  case "$cmd" in',
+    ]
+    for name, sub_parser in top_choices.items():
+        nested = sub_actions(sub_parser)
+        own_opts = sorted(set(long_opts(sub_parser)))
+        if nested:
+            nested_choices = nested[0].choices
+            second = " ".join([*nested_choices, *own_opts])
+            lines.append(f"    {name})")
+            lines.append("      if [ $COMP_CWORD -eq 2 ]; then")
+            lines.append(
+                f'        COMPREPLY=( $(compgen -W "{second}" -- "$cur") )'
+            )
+            lines.append("        return")
+            lines.append("      fi")
+            lines.append('      case "$sub" in')
+            for nname, nparser in nested_choices.items():
+                nwords = " ".join(sorted(set(long_opts(nparser))))
+                # only complete flags when one is being typed; bare
+                # positions fall through to bash's default (filenames)
+                lines.append(
+                    f'        {nname}) [[ "$cur" == -* ]] && '
+                    f'COMPREPLY=( $(compgen -W "{nwords}" -- "$cur") );'
+                    " return;;"
+                )
+            lines.append("      esac")
+            lines.append("      ;;")
+        else:
+            opt_words = " ".join(own_opts)
+            lines.append(
+                f'    {name}) [[ "$cur" == -* ]] && '
+                f'COMPREPLY=( $(compgen -W "{opt_words}" -- "$cur") );'
+                " return;;"
+            )
+    lines += [
+        "  esac",
+        "}",
+        "complete -o default -F _hq_complete hq",
+        'complete -o default -F _hq_complete "python -m hyperqueue_tpu"'
+        " 2>/dev/null || true",
+    ]
+    print("\n".join(lines))
 
 
 def cmd_job_open(args) -> None:

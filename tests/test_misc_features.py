@@ -171,3 +171,23 @@ def test_dashboard_worker_detail_shows_gpus():
                                      "mem_usage_percent": 40.0}]}})
     out = "\n".join(render_worker_detail(data, 1))
     assert "GPUS" in out and "nvidia" in out and "b1" in out
+
+
+def test_generate_completion_covers_subcommands(capsys):
+    """Completion script covers nested subcommands and per-command long
+    options, not just the top level."""
+    import subprocess
+
+    from hyperqueue_tpu.client.cli import main
+
+    main(["generate-completion"])
+    script = capsys.readouterr().out
+    # nested subcommands present
+    assert "job)" in script and "submit-file" in script
+    assert "alloc)" in script and "dry-run" in script
+    # per-command long options present
+    assert "--nodes" in script and "--replay" in script
+    # valid bash
+    proc = subprocess.run(["bash", "-n"], input=script, text=True,
+                          capture_output=True)
+    assert proc.returncode == 0, proc.stderr
