@@ -389,3 +389,95 @@ def test_mn_demand_skips_resource_impossible_gangs(tmp_path):
     )
     assert service._mn_demand(plain) == []
     assert service._mn_demand(with_fpga) == [2]
+
+
+# --------------------------------------------- worker-query transliterations
+# (reference crates/tako/src/internal/tests/test_query.rs — the demand the
+# autoalloc planner derives from current cluster state + queue descriptors)
+
+def _stub_worker(core, cpus, used=0):
+    from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+    from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+
+    config = WorkerConfiguration(
+        descriptor=ResourceDescriptor.simple_cpus(cpus)
+    )
+    w = Worker.create(
+        core.worker_id_counter.next(), config, core.resource_map
+    )
+    cpu_rid = core.resource_map.get_or_create("cpus")
+    for i in range(used):
+        # go through the real accounting path so the stub cannot diverge
+        w.assign(-(i + 1), [(cpu_rid, 10_000)])
+    core.workers[w.worker_id] = w
+    return w
+
+
+_queue_seq = [0]
+
+
+def _cpus_queue(cpus, n=2, wpa=1):
+    # distinct queue ids: the service caches the parsed worker descriptor
+    # per queue id
+    _queue_seq[0] += 1
+    return AllocationQueue(
+        _queue_seq[0],
+        QueueParams(manager="slurm", backlog=n, workers_per_alloc=wpa,
+                    worker_args=["--cpus", str(cpus)]),
+    )
+
+
+def test_query_enough_workers(tmp_path):
+    """test_query.rs:31 — current workers can host everything: demand 0."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _stub_worker(core, 2)
+    _stub_worker(core, 3)
+    _ready_task(core, 1, [("cpus", 30_000)])
+    _ready_task(core, 2, [("cpus", 10_000)])
+    _ready_task(core, 3, [("cpus", 10_000)])
+    assert service._fake_worker_demand(_cpus_queue(4)) == 0
+
+
+def test_query_not_enough_workers(tmp_path):
+    """test_query.rs:54 — a second 3-cpu task overflows the cluster: one
+    new worker of the 3-cpu queue shape would receive load."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _stub_worker(core, 2)
+    _stub_worker(core, 3)
+    _ready_task(core, 1, [("cpus", 30_000)])
+    _ready_task(core, 2, [("cpus", 30_000)])
+    _ready_task(core, 3, [("cpus", 10_000)])
+    assert service._fake_worker_demand(_cpus_queue(3)) >= 1
+    # a 2-cpu worker shape cannot host the overflowing 3-cpu task
+    assert service._fake_worker_demand(_cpus_queue(2)) == 0
+
+
+def test_query_busy_worker_no_ready(tmp_path):
+    """test_query.rs:86 — occupied workers but an empty ready queue: no
+    demand."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _stub_worker(core, 2, used=2)
+    assert service._fake_worker_demand(_cpus_queue(2)) == 0
+
+
+def test_query_busy_worker_with_ready(tmp_path):
+    """test_query.rs:121 — a fully busy worker plus one ready task: a new
+    worker would receive it."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _stub_worker(core, 2, used=2)
+    _ready_task(core, 1, [("cpus", 10_000)])
+    assert service._fake_worker_demand(_cpus_queue(2)) >= 1
+
+
+def test_query_many_workers_needed(tmp_path):
+    """test_query.rs:158 — 8 single-cpu tasks, no workers: the whole
+    backlog's worth of fake single-cpu workers receives load."""
+    service = _service(tmp_path)
+    core = service.server.core
+    for i in range(8):
+        _ready_task(core, i + 1, [("cpus", 10_000)])
+    assert service._fake_worker_demand(_cpus_queue(1, n=8)) == 8
