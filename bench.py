@@ -182,6 +182,16 @@ def bench_full_tick(args, on_cpu):
     )
     model = GreedyCutScanModel(backend="numpy" if on_cpu else "jax")
 
+    # mirror the server's steady-state GC thresholds (bootstrap.Server
+    # .start): default thresholds fire gen-0 collections mid-tick (~30 ms
+    # spikes). Deliberately NOT freezing the 1M-task state: the production
+    # server receives its tasks after startup, so old-gen collections do
+    # traverse them — the bench must pay the same cost.
+    import gc
+
+    gc.collect()
+    gc.set_threshold(100_000, 50, 25)
+
     def tick():
         return run_tick(queues, worker_rows(), rq_map, resource_map, model)
 
