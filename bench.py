@@ -199,8 +199,8 @@ def bench_full_tick(args, on_cpu):
         # put the assigned ids back (at their original priority) so every
         # rep schedules the same steady heavy-load tick; the real server
         # would instead apply the assignments and shrink the queue
-        for a in assignments:
-            queues.add(a.rq_id, priority_of(a.task_id), a.task_id)
+        for task_id, _worker_id, rq_id, _variant in assignments:
+            queues.add(rq_id, priority_of(task_id), task_id)
 
     warm = tick()  # compile + warmup
     n_assigned = len(warm)

@@ -113,6 +113,27 @@ int64_t hq_queue_take(void* handle, int64_t prio_user, int64_t prio_sched,
     return n;
 }
 
+// Batched mapping take: for each nonzero solver cell i, pop cell_count[i]
+// ids from the queue of batch cell_batch[i] at that batch's priority and
+// append them to out_ids; out_cell_n[i] records how many were written for
+// the cell. One C call replaces thousands of per-cell ctypes round-trips in
+// the tick's counts->assignments mapping. Returns total ids written.
+int64_t hq_map_take(void** queue_handles, const int64_t* prio_user,
+                    const int64_t* prio_sched, const int64_t* cell_batch,
+                    const int64_t* cell_count, int64_t n_cells,
+                    uint64_t* out_ids, int64_t* out_cell_n) {
+    int64_t total = 0;
+    for (int64_t i = 0; i < n_cells; ++i) {
+        int64_t b = cell_batch[i];
+        int64_t got = hq_queue_take(queue_handles[b], prio_user[b],
+                                    prio_sched[b], cell_count[i],
+                                    out_ids + total);
+        out_cell_n[i] = got;
+        total += got;
+    }
+    return total;
+}
+
 // Drain every id (descending priority, FIFO within level) into out_ids
 // (caller sizes it via hq_queue_len). Used for debug dumps/restore.
 int64_t hq_queue_all(void* handle, uint64_t* out_ids, int64_t max) {

@@ -452,15 +452,15 @@ def schedule(
         assignments = run_tick(
             core.queues, rows, core.rq_map, core.resource_map, model
         )
-        for a in assignments:
-            task = core.tasks[a.task_id]
-            worker = core.workers[a.worker_id]
+        for task_id, worker_id, rq_id, variant in assignments:
+            task = core.tasks[task_id]
+            worker = core.workers[worker_id]
             task.state = TaskState.ASSIGNED
-            task.assigned_worker = a.worker_id
-            task.assigned_variant = a.variant
-            worker.assign(a.task_id, core.variant_amounts(a.rq_id, a.variant))
-            per_worker_msgs.setdefault(a.worker_id, []).append(
-                _compute_message(core, task, a.variant)
+            task.assigned_worker = worker_id
+            task.assigned_variant = variant
+            worker.assign(task_id, core.variant_amounts(rq_id, variant))
+            per_worker_msgs.setdefault(worker_id, []).append(
+                _compute_message(core, task, variant)
             )
             assigned += 1
 

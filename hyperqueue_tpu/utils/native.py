@@ -31,31 +31,39 @@ def load_native():
     _tried = True
     if os.environ.get("HQ_DISABLE_NATIVE"):
         return None
-    if not LIB_PATH.exists():
-        try:
-            import fcntl
+    try:
+        import fcntl
 
-            # concurrent processes (test server + workers) may race to build;
-            # serialize via flock and re-check afterwards
-            with open(NATIVE_DIR / ".build.lock", "w") as lock:
-                fcntl.flock(lock, fcntl.LOCK_EX)
-                if not LIB_PATH.exists():
-                    subprocess.run(
-                        ["make", "-C", str(NATIVE_DIR)],
-                        capture_output=True,
-                        timeout=120,
-                        check=True,
-                    )
-        except (OSError, subprocess.CalledProcessError,
-                subprocess.TimeoutExpired) as e:
-            logger.debug("native build unavailable: %s", e)
+        # concurrent processes (test server + workers) may race to build;
+        # serialize via flock. make runs unconditionally — a fresh .so is a
+        # no-op, and a STALE .so (built before a symbol was added) would
+        # otherwise fail the prototype setup below
+        with open(NATIVE_DIR / ".build.lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            subprocess.run(
+                ["make", "-C", str(NATIVE_DIR)],
+                capture_output=True,
+                timeout=120,
+                check=True,
+            )
+    except (OSError, subprocess.CalledProcessError,
+            subprocess.TimeoutExpired) as e:
+        logger.debug("native build unavailable: %s", e)
+        if not LIB_PATH.exists():
             return None
     try:
         lib = ctypes.CDLL(str(LIB_PATH))
-    except OSError as e:
+        _set_prototypes(lib)
+    except (OSError, AttributeError) as e:
+        # AttributeError = a stale .so missing a newer symbol (make failed
+        # or raced); fall back to the Python implementations
         logger.debug("native load failed: %s", e)
         return None
+    _lib = lib
+    return _lib
 
+
+def _set_prototypes(lib) -> None:
     lib.hq_queue_new.restype = ctypes.c_void_p
     lib.hq_queue_free.argtypes = [ctypes.c_void_p]
     lib.hq_queue_add.argtypes = [
@@ -81,8 +89,17 @@ def load_native():
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
     ]
     lib.hq_queue_all.restype = ctypes.c_int64
-    _lib = lib
-    return _lib
+    lib.hq_map_take.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.hq_map_take.restype = ctypes.c_int64
 
 
 class NativeTaskQueue:

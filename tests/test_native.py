@@ -68,3 +68,63 @@ def test_native_python_parity_randomized(native_lib):
                     live = [x for x in live if x[1] not in taken]
             assert len(nq) == len(pq)
             assert nq.priority_sizes() == pq.priority_sizes()
+
+
+def test_map_take_parity_native_vs_python():
+    """The batched hq_map_take mapping path must produce the same
+    assignments as the per-cell Python fallback on a randomized tick."""
+    import numpy as np
+    import pytest
+
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+    from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.scheduler.queues import TaskQueue, TaskQueues
+    from hyperqueue_tpu.scheduler.tick import WorkerRow, run_tick
+    from hyperqueue_tpu.utils.constants import INF_TIME
+    from hyperqueue_tpu.utils import native as native_mod
+
+    if native_mod.load_native() is None:
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(11)
+
+    def build(force_python):
+        resource_map = ResourceIdMap()
+        cpus = resource_map.get_or_create("cpus")
+        rq_map = ResourceRqMap()
+        queues = TaskQueues()
+        if force_python:
+            # bypass the native factory: preinstall Python queues
+            for rq in range(1, 6):
+                queues._queues[rq] = TaskQueue()
+        rq_ids = []
+        for i in range(5):
+            rqv = ResourceRequestVariants.single(
+                ResourceRequest(
+                    entries=(ResourceRequestEntry(cpus, (i + 1) * 10_000),)
+                )
+            )
+            rq_ids.append(rq_map.get_or_create(rqv))
+        tid = 1
+        rng2 = np.random.default_rng(7)
+        for _ in range(500):
+            rq = rq_ids[int(rng2.integers(0, 5))]
+            queues.add(rq, (int(rng2.integers(0, 3)), 0), tid)
+            tid += 1
+        rows = [
+            WorkerRow(worker_id=w + 1, free=[8 * 10_000], nt_free=16,
+                      lifetime_secs=int(INF_TIME))
+            for w in range(6)
+        ]
+        model = GreedyCutScanModel(backend="numpy")
+        return run_tick(queues, rows, rq_map, resource_map, model)
+
+    native_out = build(force_python=False)
+    python_out = build(force_python=True)
+    assert native_out == python_out
+    assert len(native_out) > 0

@@ -95,10 +95,10 @@ def schedule_case(workers, classes, nt_free=64, lifetimes=None):
 
     per_class = [0] * len(classes)
     used = np.zeros((len(workers), n_r), dtype=np.int64)
-    for a in assignments:
-        per_class[class_of[a.task_id]] += 1
-        for e in rq_map.get_variants(a.rq_id).variants[a.variant].entries:
-            used[a.worker_id - 1, e.resource_id] += e.amount
+    for task_id, worker_id, rq_id, variant in assignments:
+        per_class[class_of[task_id]] += 1
+        for e in rq_map.get_variants(rq_id).variants[variant].entries:
+            used[worker_id - 1, e.resource_id] += e.amount
     assert (used <= free).all(), "capacity violated"
     per_worker_cpu = (used[:, 0] // U).tolist()
     return per_class, per_worker_cpu, assignments
