@@ -73,8 +73,13 @@ class CommSender:
     def send_cancel(self, worker_id: int, task_ids: list[int]) -> None:
         self._send(worker_id, {"op": "cancel", "task_ids": task_ids})
 
-    def send_retract(self, worker_id: int, task_ids: list[int]) -> None:
-        self._send(worker_id, {"op": "retract", "task_ids": task_ids})
+    def send_retract(
+        self, worker_id: int, task_refs: list[tuple[int, int]]
+    ) -> None:
+        self._send(
+            worker_id,
+            {"op": "retract", "tasks": [list(ref) for ref in task_refs]},
+        )
 
     def send_stop(self, worker_id: int) -> None:
         self._send(worker_id, {"op": "stop"})
@@ -455,7 +460,8 @@ class Server:
                 )
             elif op == "retract_response":
                 reactor.on_retract_response(
-                    self.core, self.comm, msg["id"], msg.get("ok", False)
+                    self.core, self.comm, msg["id"], msg.get("ok", False),
+                    instance_id=msg.get("instance", -1),
                 )
             elif op == "heartbeat":
                 pass

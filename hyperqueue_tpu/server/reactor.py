@@ -32,7 +32,9 @@ PREFILL_MAX = 512
 class Comm(Protocol):
     def send_compute(self, worker_id: int, tasks: list[dict]) -> None: ...
     def send_cancel(self, worker_id: int, task_ids: list[int]) -> None: ...
-    def send_retract(self, worker_id: int, task_ids: list[int]) -> None: ...
+    def send_retract(
+        self, worker_id: int, task_refs: list[tuple[int, int]]
+    ) -> None: ...  # (task_id, instance_id) pairs
     def ask_for_scheduling(self) -> None: ...
 
 
@@ -425,10 +427,17 @@ def schedule(
                     if newly_reserved and w.prefilled_tasks:
                         # steal the queued backlog back so the drain is
                         # bounded by the currently-running tasks only (sent
-                        # once per reservation, not per tick)
-                        comm.send_retract(
-                            w.worker_id, sorted(w.prefilled_tasks)
-                        )
+                        # once per reservation, not per tick); mark pending
+                        # or on_retract_response drops the answers
+                        refs = []
+                        for tid in sorted(w.prefilled_tasks):
+                            victim = core.tasks[tid]
+                            if victim.retract_pending:
+                                continue  # an earlier retract already covers it
+                            victim.retract_pending = True
+                            refs.append((tid, victim.instance_id))
+                        if refs:
+                            comm.send_retract(w.worker_id, refs)
                 continue
             _clear_mn_reservations(core, task_id)
             for w in chosen:
@@ -606,7 +615,7 @@ def schedule(
                         continue
                     class_slots[task.rq_id] -= 1
                     task.retract_pending = True
-                    victims.append(tid)
+                    victims.append((tid, task.instance_id))
                 if victims:
                     comm.send_retract(donor.worker_id, victims)
 
@@ -616,13 +625,24 @@ def schedule(
 
 
 def on_retract_response(
-    core: Core, comm: Comm, task_id: int, ok: bool
+    core: Core, comm: Comm, task_id: int, ok: bool, instance_id: int
 ) -> None:
     """Worker answered a retract: ok=True means the task had not started and
-    is back in our hands; requeue it for the next tick."""
+    is back in our hands; requeue it for the next tick.
+
+    instance_id is the echo of the instance named in the retract request —
+    the same staleness token every other task message carries. A STALE
+    response (the task was since requeued and re-prefilled, possibly even
+    onto the same worker) carries an old instance and must not steal the
+    task off its new placement while that placement's compute message is in
+    flight (duplicate execution)."""
     task = core.tasks.get(task_id)
     if task is None or task.is_done or not task.prefilled:
         return
+    if task.instance_id != instance_id:
+        return  # answer about a previous incarnation
+    if not task.retract_pending:
+        return  # nothing asked
     task.retract_pending = False
     if not ok:
         return  # it started racing; task_running accounting takes over

@@ -159,16 +159,19 @@ class WorkerRuntime:
                 for task_id in msg["task_ids"]:
                     self._cancel_task(task_id)
             elif op == "retract":
-                for task_id in msg["task_ids"]:
+                for task_id, instance in msg["tasks"]:
                     # retract may only reclaim NOT-YET-STARTED tasks: remove
                     # from the blocked queue, never touch running ones (the
-                    # server treats ok=False as "it started, leave it be")
+                    # server treats ok=False as "it started, leave it be").
+                    # The instance is echoed so the server can discard stale
+                    # answers, like every other task message.
                     before = self._n_blocked
                     self._remove_blocked(task_id)
                     await self._send(
                         {
                             "op": "retract_response",
                             "id": task_id,
+                            "instance": instance,
                             "ok": self._n_blocked < before,
                         }
                     )

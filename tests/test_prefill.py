@@ -102,8 +102,8 @@ def test_retract_rebalances_to_idle_worker():
     assert donor_id == w1.worker_id
     assert len(victims) >= 1
     # worker acks: tasks come back and get scheduled to w2
-    for t in victims:
-        reactor.on_retract_response(env.core, env.comm, t, True)
+    for t, instance in victims:
+        reactor.on_retract_response(env.core, env.comm, t, True, instance)
     env.core.sanity_check()
     env.schedule(prefill=True)
     assert env.core.workers[w2.worker_id].assigned_tasks
@@ -115,7 +115,11 @@ def test_retract_response_not_ok_keeps_task():
     a, b = env.submit(n=2)
     env.schedule(prefill=True)
     # worker says b already started: server keeps the prefilled bookkeeping
-    reactor.on_retract_response(env.core, env.comm, b, False)
+    task_b = env.core.tasks[b]
+    task_b.retract_pending = True  # as if a retract were in flight
+    reactor.on_retract_response(
+        env.core, env.comm, b, False, task_b.instance_id
+    )
     assert env.core.tasks[b].prefilled
     assert b in env.core.workers[w1.worker_id].prefilled_tasks
 

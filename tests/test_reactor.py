@@ -399,3 +399,147 @@ def test_gang_reservation_retract_sent_once():
     env.schedule(prefill=True)
     env.schedule(prefill=True)
     assert len(env.comm.retracts) == after_first  # not re-sent every tick
+
+
+def test_mn_task_fail_releases_gang():
+    """test_reactor.rs:472 — a gang task failing mid-run frees every member
+    and propagates the failure."""
+    env = TestEnv()
+    workers = [env.worker(cpus=2, group="g1") for _ in range(3)]
+    (g,) = env.submit(rqv=env.rqv(n_nodes=3))
+    (child,) = env.submit(deps=[g])
+    env.schedule()
+    env.start_all_assigned()
+    assert env.state(g) is TaskState.RUNNING
+    env.fail(g, "gang exploded")
+    assert env.state(g) is TaskState.FAILED
+    assert env.state(child) is TaskState.CANCELED
+    assert all(w.mn_task == 0 for w in workers)
+    # members accept new work again
+    ids = env.submit(n=3)
+    env.schedule()
+    assert all(env.state(t) is TaskState.ASSIGNED for t in ids)
+
+
+def test_mn_task_cancel_releases_gang_and_notifies_members():
+    """test_reactor.rs:497 — cancelling a running gang cancels on its
+    workers and frees them."""
+    env = TestEnv()
+    workers = [env.worker(cpus=2, group="g1") for _ in range(2)]
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2))
+    env.schedule()
+    env.start_all_assigned()
+    out = env.cancel([g])
+    assert out == [g]
+    assert env.state(g) is TaskState.CANCELED
+    assert all(w.mn_task == 0 for w in workers)
+    canceled_on = {wid for wid, tids in env.comm.cancels if g in tids}
+    assert canceled_on == {w.worker_id for w in workers}
+
+
+def test_prefilled_task_failure_accounts_cleanly():
+    """test_reactor.rs:950 — a prefilled task that starts and fails must
+    fully release its (deferred-then-assigned) resources."""
+    env = TestEnv()
+    w = env.worker(cpus=1)
+    a, b = env.submit(n=2)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    # b is prefilled behind a
+    task_b = env.core.tasks[b]
+    assert task_b.prefilled
+    env.finish(a)
+    # worker reports b running, then failing
+    from hyperqueue_tpu.server import reactor
+
+    reactor.on_task_running(env.core, env.events, b, task_b.instance_id)
+    assert not task_b.prefilled  # resources accounted on start
+    env.fail(b)
+    assert env.state(b) is TaskState.FAILED
+    assert w.free == w.resources.amounts
+    assert not w.assigned_tasks and not w.prefilled_tasks
+
+
+def test_retract_in_flight_source_worker_lost():
+    """test_reactor.rs:1096 — the donor dies while a retract is pending:
+    the task requeues via worker loss and the stale retract answer (ok or
+    not) must be ignored."""
+    from hyperqueue_tpu.server import reactor
+
+    env = TestEnv()
+    w1 = env.worker(cpus=1)
+    busy = env.submit(n=1)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    backlog = env.submit(n=10)
+    env.schedule(prefill=True)
+    assert w1.prefilled_tasks
+    env.worker(cpus=1)  # idle worker triggers a retract
+    env.schedule(prefill=True)
+    pending = [
+        t for t in backlog if env.core.tasks[t].retract_pending
+    ]
+    assert pending
+    victim = pending[0]
+    old_instance = env.core.tasks[victim].instance_id
+    env.lose_worker(w1.worker_id)
+    task = env.core.tasks[victim]
+    assert task.state is TaskState.READY
+    assert not task.retract_pending
+    assert task.instance_id == old_instance + 1
+    # stale retract answers (old instance) arrive after the loss: no-ops
+    reactor.on_retract_response(
+        env.core, env.comm, victim, True, old_instance
+    )
+    assert task.state is TaskState.READY
+    assert task.instance_id == old_instance + 1
+    reactor.on_retract_response(
+        env.core, env.comm, victim, False, old_instance
+    )
+    assert task.state is TaskState.READY
+    assert task.instance_id == old_instance + 1
+
+
+def test_stale_retract_answer_after_reprefill_ignored():
+    """The killer race: a retract answer from a DEAD placement must not
+    steal the task off the worker it was since re-prefilled onto."""
+    from hyperqueue_tpu.server import reactor
+
+    env = TestEnv()
+    w1 = env.worker(cpus=1)
+    env.submit(n=1)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    backlog = env.submit(n=10)
+    env.schedule(prefill=True)
+    w2 = env.worker(cpus=1)
+    env.schedule(prefill=True)  # retract sent to w1 for some backlog
+    pending = [t for t in backlog if env.core.tasks[t].retract_pending]
+    assert pending
+    victim = pending[0]
+    retracted_instance = env.core.tasks[victim].instance_id
+    # occupy w2 so the requeued victim will be re-PREFILLED, not directly
+    # assigned
+    env.submit(n=1)
+    env.schedule(prefill=False)
+    env.start_all_assigned()
+    assert not w2.is_idle()
+    # w1 answers ok=True: task requeues and gets re-prefilled on the next
+    # tick
+    reactor.on_retract_response(
+        env.core, env.comm, victim, True, retracted_instance
+    )
+    env.schedule(prefill=True)
+    task = env.core.tasks[victim]
+    assert task.prefilled
+    new_worker = task.assigned_worker
+    instance = task.instance_id
+    assert instance == retracted_instance + 1
+    # a duplicate/late answer from the OLD placement (old instance) must
+    # NOT touch the new one
+    reactor.on_retract_response(
+        env.core, env.comm, victim, True, retracted_instance
+    )
+    assert task.assigned_worker == new_worker
+    assert task.instance_id == instance
+    assert task.prefilled

@@ -31,7 +31,7 @@ from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
 class TestComm:
     compute: list[tuple[int, list[dict]]] = field(default_factory=list)
     cancels: list[tuple[int, list[int]]] = field(default_factory=list)
-    retracts: list[tuple[int, list[int]]] = field(default_factory=list)
+    retracts: list[tuple[int, list[tuple[int, int]]]] = field(default_factory=list)
     scheduling_asked: int = 0
 
     def send_compute(self, worker_id, tasks):
@@ -40,8 +40,8 @@ class TestComm:
     def send_cancel(self, worker_id, task_ids):
         self.cancels.append((worker_id, task_ids))
 
-    def send_retract(self, worker_id, task_ids):
-        self.retracts.append((worker_id, task_ids))
+    def send_retract(self, worker_id, task_refs):
+        self.retracts.append((worker_id, task_refs))
 
     def ask_for_scheduling(self):
         self.scheduling_asked += 1
