@@ -110,13 +110,25 @@ def run_tick(
         len(rq_map.get_variants(b.rq_id).variants) for b in batches
     )
 
-    free = np.zeros((n_w, n_r), dtype=np.int64)
-    nt_free = np.zeros(n_w, dtype=np.int32)
-    lifetime = np.zeros(n_w, dtype=np.int32)
-    for i, row in enumerate(workers):
-        free[i, : len(row.free)] = row.free
-        nt_free[i] = max(row.nt_free, 0)
-        lifetime[i] = row.lifetime_secs
+    free_lists = [row.free for row in workers]
+    if all(len(f) == n_r for f in free_lists):
+        # uniform rows (steady state): one C-level conversion instead of a
+        # per-worker Python fill loop (~1.4 ms at 1k workers)
+        free = np.array(free_lists, dtype=np.int64)
+    else:
+        # a worker's dense row can lag the global resource map right after
+        # a new resource name is interned
+        free = np.zeros((n_w, n_r), dtype=np.int64)
+        for i, f in enumerate(free_lists):
+            free[i, : len(f)] = f
+    nt_free = np.fromiter(
+        (row.nt_free if row.nt_free > 0 else 0 for row in workers),
+        dtype=np.int32,
+        count=n_w,
+    )
+    lifetime = np.fromiter(
+        (row.lifetime_secs for row in workers), dtype=np.int32, count=n_w
+    )
 
     # Most-constrained-first within a priority level: a class that can ONLY
     # run on scarce resources is placed before same-priority classes with
