@@ -966,10 +966,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--worker-port", type=int, default=0)
     p.add_argument("--disable-client-authentication", action="store_true")
     p.add_argument("--disable-worker-authentication", action="store_true")
-    p.add_argument("--scheduler", choices=["auto", "cpu", "tpu", "milp"],
+    p.add_argument("--scheduler",
+                   choices=["auto", "cpu", "tpu", "milp", "multichip"],
                    default="auto",
                    help="auto/cpu/tpu pick the greedy cut-scan backend; "
-                        "milp runs the exact host MILP (accuracy oracle)")
+                        "milp runs the exact host MILP (accuracy oracle); "
+                        "multichip shards the cut-scan's worker axis over "
+                        "all visible devices (identical semantics)")
     p.add_argument("--journal", default=None)
     p.add_argument("--access-file", default=None,
                    help="start with pre-shared keys/ports from generate-access")

@@ -4,10 +4,12 @@ A "model" here is a scheduling policy: it consumes a dense tick snapshot and
 produces per-(batch, variant, worker) task counts. `greedy` is the production
 cut-scan model (jitted, bucketed shapes); `milp` is the exact host MILP
 (scipy HiGHS) used as the accuracy oracle and selectable with
-`--scheduler=milp`.
+`--scheduler=milp`; `multichip` shards the cut-scan's worker axis over a
+device mesh (`--scheduler=multichip`) with semantics identical to `greedy`.
 """
 
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
 from hyperqueue_tpu.models.milp import MilpModel
+from hyperqueue_tpu.models.multichip import MultichipModel
 
-__all__ = ["GreedyCutScanModel", "MilpModel"]
+__all__ = ["GreedyCutScanModel", "MilpModel", "MultichipModel"]

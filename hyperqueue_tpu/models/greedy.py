@@ -80,7 +80,7 @@ class GreedyCutScanModel:
         n_w, n_r = free.shape
         n_b, n_v, _ = needs.shape
 
-        pw = _bucket(n_w, self.worker_floor)
+        pw = self._worker_bucket(n_w)
         pb = _bucket(max(n_b, 1), self.batch_floor)
         pr = _bucket(max(n_r, 1), self.resource_floor)
         pv = _bucket(max(n_v, 1), self.variant_floor)
@@ -111,10 +111,23 @@ class GreedyCutScanModel:
             pad = np.zeros((pm - class_m.shape[0], pw), dtype=np.int32)
             class_m = np.concatenate([class_m, pad], axis=0)
 
+        counts = self._solve_padded(
+            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
+        )
+        return np.asarray(counts)[:n_b, :n_v, :n_w]
+
+    def _worker_bucket(self, n_w: int) -> int:
+        return _bucket(n_w, self.worker_floor)
+
+    def _solve_padded(
+        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
+    ):
+        """Run the kernel on fully padded inputs; overridden by the
+        multi-chip model (models/multichip.py) to shard the worker axis."""
         solver = (
             greedy_cut_scan_numpy if self._numpy_path() else greedy_cut_scan
         )
         counts, _free_after, _nt_after = solver(
             free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
         )
-        return np.asarray(counts)[:n_b, :n_v, :n_w]
+        return counts

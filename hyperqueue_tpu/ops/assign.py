@@ -85,24 +85,9 @@ def _variant_capacity(free, nt_free, need, time_ok):
     return jnp.maximum(cap, 0)
 
 
-def _water_fill(cap, remaining, order_key):
-    """Assign up to `remaining` tasks across workers, preferring low order_key.
-
-    Returns (assign (W,) int32, assigned_total int32). Pure vector math: sort
-    workers by key, cumulative-sum capacities, clip, inverse-permute. Used by
-    the sharded path; the single-chip scan uses the gather-free classed
-    variant below (arbitrary-permutation gathers cost ~140us each on TPU).
-    """
-    order = jnp.argsort(order_key)  # stable; ascending
-    inv = jnp.argsort(order)
-    cap_sorted = cap[order]
-    cum = jnp.cumsum(cap_sorted)
-    take_sorted = jnp.clip(remaining - (cum - cap_sorted), 0, cap_sorted)
-    assign = take_sorted[inv]
-    return assign, jnp.sum(take_sorted)
-
-
-def _water_fill_classed(cap, remaining, class_onehot):
+def _water_fill_classed(
+    cap, remaining, class_onehot, per_class_total=None, same_class_before=0
+):
     """Water-fill in (waste-class asc, worker-index asc) visit order without
     any sort or permutation gather.
 
@@ -112,16 +97,34 @@ def _water_fill_classed(cap, remaining, class_onehot):
     index-order cumsum within w's own class — all elementwise ops + cumsums,
     which TPUs execute in microseconds where a 1024-element permutation
     gather costs ~140us.
+
+    The multi-chip kernel runs this SAME function on each worker shard
+    (parallel/solve.py): `per_class_total` (C,) is then the cluster-wide
+    per-class capacity (local sums by default — the single-chip case) and
+    `same_class_before` (C,) the same-class capacity on lower-index devices
+    (0 single-chip), which together shift each local prefix to its global
+    position. Returns (assign (W,), assigned_total = min(remaining, total
+    capacity) — the global total even when workers are sharded).
     """
     cap_c = cap[:, None] * class_onehot  # (W, C)
     per_class = jnp.sum(cap_c, axis=0)  # (C,)
-    class_before = jnp.cumsum(per_class) - per_class  # exclusive (C,)
+    if per_class_total is None:
+        per_class_total = per_class
+    class_before = (
+        jnp.cumsum(per_class_total) - per_class_total
+    )  # exclusive (C,)
     within_excl = jnp.cumsum(cap_c, axis=0) - cap_c  # (W, C)
     prefix = jnp.sum(
-        (within_excl + class_before[None, :]) * class_onehot, axis=1
+        (within_excl + (class_before + same_class_before)[None, :])
+        * class_onehot,
+        axis=1,
     )
     assign = jnp.clip(remaining - prefix, 0, cap)
-    return assign, jnp.sum(assign)
+    # water-fill identity: total assigned = min(remaining, total capacity)
+    # (cap >= 0 everywhere, prefix is the exact global exclusive prefix) —
+    # no reduction over `assign` needed, which on a sharded axis would cost
+    # a second collective
+    return assign, jnp.minimum(remaining, jnp.sum(per_class_total))
 
 
 # fixed class-axis width for the gather-free water-fill; distinct waste
@@ -165,31 +168,35 @@ def host_visit_classes(free0, needs, scarcity):
     return class_m, order_ids
 
 
-def greedy_cut_scan_impl(
-    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids
-):
-    """Scan priority-ordered batches, water-filling each over the workers.
-
-    Un-jitted implementation (jit-wrapped below; also reused by the driver
-    entry). class_m (M, W) int32 + order_ids (B, V) int32 come from
-    host_visit_orders: per distinct request mask, each worker's visit class
-    (0 = visited first). Expanded to per-batch one-hots with one gather here
-    (outside the scan — in-scan dynamic row gathers cost ~140us/step) and
-    ride the scan xs. See module docstring for shapes/semantics. Returns
-    (counts, free_after, nt_free_after).
-    """
-    n_variants = needs.shape[1]
+def expand_onehots(class_m, order_ids):
+    """Per-batch visit-class one-hots (B, V, W, C) int32 — built with one
+    broadcasted compare outside the scan. The optimization barrier stops
+    XLA from fusing this into the scan body (it would re-gather
+    class_m[order_ids[i]] every step — a dynamic row gather costing
+    ~140us/step; measured 84ms vs 0.1ms for the whole tick)."""
     class_ids = class_m[order_ids]  # (B, V, W)
-    # one-hot per batch as scan xs: (B, V, W, C) int32 — built with one
-    # broadcasted compare outside the scan. The optimization barrier stops
-    # XLA from fusing this into the scan body (it would re-gather
-    # class_m[order_ids[i]] every step — a dynamic row gather costing
-    # ~140us/step; measured 84ms vs 0.1ms for the whole tick).
     onehots = (
         class_ids[..., None]
         == jnp.arange(N_VISIT_CLASSES, dtype=jnp.int32)
     ).astype(jnp.int32)
-    onehots = jax.lax.optimization_barrier(onehots)
+    return jax.lax.optimization_barrier(onehots)
+
+
+def scan_batches(
+    free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill
+):
+    """Scan priority-ordered batches, water-filling each over the workers.
+
+    The ONE scan body shared by the single-chip and multi-chip kernels —
+    parity between them is structural, not test-maintained: the sharded path
+    (parallel/solve.py) differs only in the `water_fill` it plugs in (its
+    prefix spans devices via all_gather).
+
+    water_fill(cap, remaining, class_onehot) -> (assign (W,), assigned_total);
+    `assigned_total` must be the GLOBAL total when workers are sharded.
+    Returns (counts, free_after, nt_free_after).
+    """
+    n_variants = needs.shape[1]
 
     def batch_body(carry, batch):
         free, nt_free = carry
@@ -201,9 +208,7 @@ def greedy_cut_scan_impl(
             time_ok = b_min_time[v] <= lifetime
             cap = _variant_capacity(free, nt_free, need, time_ok)
             cap = jnp.minimum(cap, remaining)
-            assign, assigned = _water_fill_classed(
-                cap, remaining, b_onehot[v]
-            )
+            assign, assigned = water_fill(cap, remaining, b_onehot[v])
             remaining = remaining - assigned
             free = free - assign[:, None] * need[None, :]
             nt_free = nt_free - assign
@@ -216,6 +221,24 @@ def greedy_cut_scan_impl(
         (needs, sizes, min_time, onehots),
     )
     return counts, free, nt_free
+
+
+def greedy_cut_scan_impl(
+    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids
+):
+    """Single-chip kernel: one-hot expansion + the shared batch scan.
+
+    Un-jitted implementation (jit-wrapped below; also reused by the driver
+    entry). class_m (M, W) int32 + order_ids (B, V) int32 come from
+    host_visit_classes: per distinct request mask, each worker's visit class
+    (0 = visited first). See module docstring for shapes/semantics. Returns
+    (counts, free_after, nt_free_after).
+    """
+    onehots = expand_onehots(class_m, order_ids)
+    return scan_batches(
+        free, nt_free, lifetime, needs, sizes, min_time, onehots,
+        _water_fill_classed,
+    )
 
 
 greedy_cut_scan = functools.partial(jax.jit, donate_argnums=(0, 1))(
@@ -273,21 +296,6 @@ def greedy_cut_scan_numpy(
             nt_free -= assign
             counts[b, v] = assign
     return counts, free, nt_free
-
-
-def solve_tick(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
-    """Convenience wrapper: host-computed visit classes + jitted scan."""
-    class_m, order_ids = host_visit_classes(free, needs, scarcity)
-    return greedy_cut_scan(
-        jnp.asarray(free),
-        jnp.asarray(nt_free),
-        lifetime,
-        needs,
-        sizes,
-        min_time,
-        class_m,
-        order_ids,
-    )
 
 
 def scarcity_weights(total_amounts) -> "np.ndarray":

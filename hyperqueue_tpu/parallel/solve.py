@@ -5,13 +5,28 @@ grows with cluster size (reference target: 1k workers, BASELINE.json 1M x 1k).
 We shard W across a jax.sharding.Mesh axis "w" with shard_map; batches/needs
 are replicated (they are tiny: B x V x R ints).
 
-The only cross-device dependency in the cut-scan is the water-fill's global
-prefix: "how much of this batch was absorbed by workers on earlier devices".
-That is one all_gather of per-device capacity sums (D scalars) per variant
-step — pure ICI traffic, no host round-trip, no resharding of the (W, R)
-state. Worker preference order becomes device-major (device 0's workers
-first, scarcity-aware within a device), which is a valid deterministic
-preference order of the same family the single-chip kernel uses.
+Semantics: IDENTICAL to the single-chip kernel (ops/assign.greedy_cut_scan),
+by construction. Both water-fill each (batch, variant) over workers in
+(visit-class ascending, global worker index ascending) order, where the visit
+classes come from the same host_visit_classes precomputation. shard_map splits
+the worker axis contiguously, so "global worker index order" within a class is
+exactly (device ascending, local index ascending) — the sharded body computes
+each local worker's global water-fill prefix as
+
+    prefix(w) = capacity of strictly-lower classes (cluster-wide)
+              + capacity of w's class on lower-index devices
+              + exclusive local cumsum within w's class
+
+All three terms come from ONE all_gather of the per-device (C,)-vector of
+per-class capacity sums per variant step (C = N_VISIT_CLASSES = 16) — pure ICI
+traffic, no host round-trip, no resharding of the (W, R) state. Exactness is
+pinned by tests/test_parallel.py, which asserts bitwise count equality with
+the single-chip kernel on random and adversarial instances.
+
+Reference anchor: the solver IS the production scheduler there too
+(crates/tako/src/internal/scheduler/{main.rs:40-46,solver.rs:16-461}); this
+module is its multi-device form, selected with `--scheduler=multichip`
+(models/multichip.py).
 """
 
 from __future__ import annotations
@@ -22,9 +37,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from hyperqueue_tpu.ops.assign import _variant_capacity, _water_fill
-
-_WASTE_Q = 65536
+from hyperqueue_tpu.ops.assign import (
+    _water_fill_classed,
+    expand_onehots,
+    scan_batches,
+)
 
 
 def make_worker_mesh(n_devices: int | None = None) -> Mesh:
@@ -34,81 +51,85 @@ def make_worker_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(devices, axis_names=("w",))
 
 
-def _sharded_body(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
-    """shard_map body: free/nt_free/lifetime are local worker shards."""
-    axis = "w"
+def _sharded_water_fill_classed(cap, remaining, class_onehot, axis):
+    """Classed water-fill with a cluster-wide prefix.
+
+    cap (Wl,), class_onehot (Wl, C): LOCAL worker shards. Returns
+    (assign (Wl,), assigned_total int32 replicated). The fill itself IS
+    ops.assign._water_fill_classed — this wrapper only gathers the per-class
+    capacity sums across devices (the single collective) and feeds them in
+    as the global totals + lower-device same-class offsets, so the sharded
+    fill reduces to the single-chip one by construction.
+    """
     my_dev = jax.lax.axis_index(axis)
-    n_dev = jax.lax.axis_size(axis)
-    n_variants = needs.shape[1]
-
-    def batch_body(carry, batch):
-        free, nt_free, = carry
-        b_needs, b_size, b_min_time = batch
-        remaining_global = b_size
-        counts_v = []
-        for v in range(n_variants):
-            need = b_needs[v]
-            time_ok = b_min_time[v] <= lifetime
-            cap = _variant_capacity(free, nt_free, need, time_ok)
-            cap = jnp.minimum(cap, remaining_global)
-            local_sum = jnp.sum(cap)
-            # global exclusive prefix over devices: capacity absorbed by
-            # lower-index devices comes first (device-major worker order)
-            all_sums = jax.lax.all_gather(local_sum, axis)  # (D,)
-            offset = jnp.sum(jnp.where(jnp.arange(n_dev) < my_dev, all_sums, 0))
-            local_remaining = jnp.clip(
-                remaining_global - offset, 0, local_sum
-            )
-            # scarcity-aware order within the local shard
-            unneeded = (free > 0) & (need[None, :] == 0)
-            waste = jnp.sum(unneeded * scarcity[None, :], axis=1)
-            waste_q = jnp.round(waste * _WASTE_Q).astype(jnp.int32)
-            idx = jnp.arange(cap.shape[0], dtype=jnp.int32)
-            order_key = jnp.where(
-                cap > 0, waste_q * cap.shape[0] + idx, jnp.int32(2**31 - 1)
-            )
-            assign, assigned_local = _water_fill(cap, local_remaining, order_key)
-            assigned_global = jax.lax.psum(assigned_local, axis)
-            remaining_global = remaining_global - assigned_global
-            free = free - assign[:, None] * need[None, :]
-            nt_free = nt_free - assign
-            counts_v.append(assign)
-        return (free, nt_free), jnp.stack(counts_v)
-
-    (free, nt_free), counts = jax.lax.scan(
-        batch_body, (free, nt_free), (needs, sizes, min_time)
+    per_class_local = jnp.sum(cap[:, None] * class_onehot, axis=0)  # (C,)
+    all_per_class = jax.lax.all_gather(per_class_local, axis)  # (D, C)
+    per_class_global = jnp.sum(all_per_class, axis=0)  # (C,)
+    n_dev = all_per_class.shape[0]
+    lower_dev = jnp.sum(
+        jnp.where(
+            (jnp.arange(n_dev) < my_dev)[:, None], all_per_class, 0
+        ),
+        axis=0,
+    )  # (C,) same-class capacity on lower-index devices
+    return _water_fill_classed(
+        cap, remaining, class_onehot,
+        per_class_total=per_class_global,
+        same_class_before=lower_dev,
     )
-    return counts, free, nt_free
+
+
+def _sharded_body(free, nt_free, lifetime, needs, sizes, min_time, onehots):
+    """shard_map body: free/nt_free/lifetime/onehots are local worker shards;
+    needs/sizes/min_time are replicated. The scan itself is
+    ops.assign.scan_batches — the SAME code the single-chip kernel runs —
+    with only the water-fill swapped for the cluster-wide-prefix variant, so
+    single/multi-chip parity is structural."""
+
+    def water_fill(cap, remaining, class_onehot):
+        return _sharded_water_fill_classed(cap, remaining, class_onehot, "w")
+
+    return scan_batches(
+        free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def sharded_cut_scan(
-    mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, scarcity
+    mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
+    order_ids,
 ):
-    """Worker-sharded variant of ops.assign.greedy_cut_scan.
+    """Worker-sharded variant of ops.assign.greedy_cut_scan — same inputs,
+    same outputs, identical semantics.
 
     free (W, R), nt_free/lifetime (W,) sharded on axis "w"; needs/sizes/
-    min_time/scarcity replicated. Returns counts (B, V, W) sharded on W.
+    min_time/class_m/order_ids replicated. Returns counts (B, V, W) sharded
+    on W, plus free/nt_free after.
     """
+    # Per-batch visit-class one-hots, expanded OUTSIDE the shard_map/scan
+    # (in-scan dynamic row gathers cost ~140us/step on TPU — same reasoning
+    # as greedy_cut_scan_impl); XLA shards the (B, V, W, C) result on W.
+    onehots = expand_onehots(class_m, order_ids)
+
     return jax.shard_map(
         _sharded_body,
         mesh=mesh,
         in_specs=(
-            P("w", None),   # free
-            P("w"),         # nt_free
-            P("w"),         # lifetime
-            P(),            # needs
-            P(),            # sizes
-            P(),            # min_time
-            P(),            # scarcity
+            P("w", None),              # free
+            P("w"),                    # nt_free
+            P("w"),                    # lifetime
+            P(),                       # needs
+            P(),                       # sizes
+            P(),                       # min_time
+            P(None, None, "w", None),  # onehots
         ),
         out_specs=(P(None, None, "w"), P("w", None), P("w")),
         check_vma=False,
-    )(free, nt_free, lifetime, needs, sizes, min_time, scarcity)
+    )(free, nt_free, lifetime, needs, sizes, min_time, onehots)
 
 
 def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
-                      min_time, scarcity):
+                      min_time, class_m, order_ids):
     """Device-put the tick tensors with the proper shardings."""
     w2 = NamedSharding(mesh, P("w", None))
     w1 = NamedSharding(mesh, P("w"))
@@ -120,5 +141,6 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
         jax.device_put(needs, rep),
         jax.device_put(sizes, rep),
         jax.device_put(min_time, rep),
-        jax.device_put(scarcity, rep),
+        jax.device_put(class_m, rep),
+        jax.device_put(order_ids, rep),
     )

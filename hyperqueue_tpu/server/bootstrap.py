@@ -21,6 +21,7 @@ from pathlib import Path
 from hyperqueue_tpu.ids import task_id_job, task_id_task, make_task_id
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
 from hyperqueue_tpu.models.milp import MilpModel
+from hyperqueue_tpu.models.multichip import MultichipModel
 from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
@@ -180,9 +181,12 @@ class Server:
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
-        self.model = (
-            MilpModel() if scheduler == "milp" else GreedyCutScanModel()
-        )
+        if scheduler == "milp":
+            self.model = MilpModel()
+        elif scheduler == "multichip":
+            self.model = MultichipModel()
+        else:
+            self.model = GreedyCutScanModel()
         self.scheduler_kind = scheduler
         self.access: serverdir.AccessRecord | None = None
         self.autoalloc = None

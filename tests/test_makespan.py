@@ -230,3 +230,29 @@ def test_milp_scheduler_e2e(tmp_path):
             env.command(["server", "info", "--output-mode", "json"])
         )
         assert info["scheduler"] == "milp"
+
+
+def test_multichip_scheduler_e2e(tmp_path):
+    """hq server start --scheduler multichip runs a real workload end-to-end
+    with the worker axis sharded over the virtual 8-device CPU mesh (the
+    server subprocess inherits this suite's XLA_FLAGS device-count forcing)."""
+    import json as _json
+
+    from utils_e2e import HqEnv
+
+    with HqEnv(tmp_path) as env:
+        env.start_server("--scheduler", "multichip")
+        for _ in range(2):
+            env.start_worker(cpus=2)
+        env.wait_workers(2)
+        env.command(["submit", "--array", "0-15", "--wait", "--",
+                     "bash", "-c", "echo ok-$HQ_TASK_ID"])
+        detail = _json.loads(
+            env.command(["job", "info", "1", "--output-mode", "json"])
+        )[0]
+        assert detail["counters"]["finished"] == 16
+        info = _json.loads(
+            env.command(["server", "info", "--output-mode", "json"])
+        )
+        assert info["scheduler"] == "multichip"
+        assert "worker axis sharded over 8 devices" in env.read_log("server")

@@ -1,10 +1,24 @@
-"""Multi-chip sharded solver tests on the virtual 8-device CPU mesh."""
+"""Multi-chip sharded solver tests on the virtual 8-device CPU mesh.
+
+The sharded kernel is semantically IDENTICAL to the single-chip kernel by
+construction (parallel/solve.py module docstring) — so these tests assert
+BITWISE count equality, not just totals, across random and adversarial
+instances (priorities, variants, min_time, heterogeneous workers), plus the
+production model wrapper (models/multichip.py) against GreedyCutScanModel.
+"""
 
 import numpy as np
 
 import jax
+import pytest
 
-from hyperqueue_tpu.ops.assign import scarcity_weights, solve_tick
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.models.multichip import MultichipModel
+from hyperqueue_tpu.ops.assign import (
+    greedy_cut_scan,
+    host_visit_classes,
+    scarcity_weights,
+)
 from hyperqueue_tpu.parallel.solve import (
     make_worker_mesh,
     place_tick_inputs,
@@ -15,51 +29,114 @@ from hyperqueue_tpu.utils.constants import INF_TIME
 U = 10_000
 
 
-def _random_instance(rng, n_w, n_r, n_b, n_v):
+def _random_instance(rng, n_w, n_r, n_b, n_v, with_lifetimes=False):
     free = (rng.integers(0, 8, size=(n_w, n_r)) * U).astype(np.int32)
     nt_free = rng.integers(0, 10, size=n_w).astype(np.int32)
-    lifetime = np.full(n_w, INF_TIME, dtype=np.int32)
+    if with_lifetimes:
+        lifetime = rng.choice(
+            [60, 600, int(INF_TIME)], size=n_w
+        ).astype(np.int32)
+    else:
+        lifetime = np.full(n_w, INF_TIME, dtype=np.int32)
     needs = (rng.integers(0, 3, size=(n_b, n_v, n_r)) * (U // 2)).astype(
         np.int32
     )
     sizes = rng.integers(0, 30, size=n_b).astype(np.int32)
-    min_time = np.zeros((n_b, n_v), dtype=np.int32)
+    min_time = (
+        rng.choice([0, 120, 3600], size=(n_b, n_v)).astype(np.int32)
+        if with_lifetimes
+        else np.zeros((n_b, n_v), dtype=np.int32)
+    )
+    return free, nt_free, lifetime, needs, sizes, min_time
+
+
+def _both_solves(free, nt_free, lifetime, needs, sizes, min_time):
     scarcity = np.asarray(
         scarcity_weights(free.astype(np.int64).sum(axis=0))
+    ).astype(np.float32)
+    class_m, order_ids = host_visit_classes(free, needs, scarcity)
+    single, free_s, nt_s = greedy_cut_scan(
+        free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids
     )
-    return free, nt_free, lifetime, needs, sizes, min_time, scarcity
+    mesh = make_worker_mesh(8)
+    placed = place_tick_inputs(
+        mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
+        order_ids,
+    )
+    sharded, free_d, nt_d = sharded_cut_scan(mesh, *placed)
+    return (
+        np.asarray(single), np.asarray(sharded),
+        np.asarray(free_s), np.asarray(free_d),
+        np.asarray(nt_s), np.asarray(nt_d),
+    )
 
 
 def test_mesh_has_8_devices():
     assert len(jax.devices()) == 8
 
 
-def test_sharded_solve_feasible_and_complete():
-    rng = np.random.default_rng(7)
-    n_w, n_r, n_b, n_v = 16, 4, 8, 2  # W divisible by 8 devices
-    args = _random_instance(rng, n_w, n_r, n_b, n_v)
-    free, nt_free, lifetime, needs, sizes, min_time, scarcity = args
-    mesh = make_worker_mesh(8)
-    placed = place_tick_inputs(mesh, *args)
-    counts, free_after, nt_after = sharded_cut_scan(mesh, *placed)
-    counts = np.asarray(counts)
+@pytest.mark.parametrize("seed", [7, 11, 13])
+def test_sharded_exact_parity_random(seed):
+    rng = np.random.default_rng(seed)
+    args = _random_instance(rng, n_w=16, n_r=4, n_b=8, n_v=2)
+    single, sharded, free_s, free_d, nt_s, nt_d = _both_solves(*args)
+    np.testing.assert_array_equal(single, sharded)
+    np.testing.assert_array_equal(free_s, free_d)
+    np.testing.assert_array_equal(nt_s, nt_d)
 
-    # feasibility: usage within capacity
-    used = np.einsum("bvw,bvr->wr", counts, needs)
+
+def test_sharded_exact_parity_lifetimes_min_time():
+    rng = np.random.default_rng(3)
+    args = _random_instance(
+        rng, n_w=32, n_r=4, n_b=8, n_v=2, with_lifetimes=True
+    )
+    single, sharded, *_ = _both_solves(*args)
+    np.testing.assert_array_equal(single, sharded)
+
+
+def test_sharded_exact_parity_heterogeneous_workers():
+    # distinct per-worker resource patterns => many visit classes; parity
+    # must hold per (batch, variant, worker) cell, not just per totals
+    rng = np.random.default_rng(42)
+    n_w, n_r = 24, 6
+    free = (rng.integers(0, 5, size=(n_w, n_r)) * U).astype(np.int32)
+    free[::3, 1] = 0   # a third of workers lack r1
+    free[1::3, 2] = 0  # another third lack r2
+    nt_free = rng.integers(1, 12, size=n_w).astype(np.int32)
+    lifetime = np.full(n_w, INF_TIME, dtype=np.int32)
+    needs = np.zeros((6, 2, n_r), dtype=np.int32)
+    needs[:, 0, 0] = U
+    needs[0, 0, 1] = U       # class 0 prefers r0+r1
+    needs[1, 1, 2] = 2 * U   # class 1 falls back to r2
+    needs[2, 0, 3] = U // 2  # fractional r3
+    needs[3, 0, 0] = 3 * U
+    needs[4, 1, 0] = U
+    needs[5, 0, 5] = U
+    sizes = np.array([9, 7, 5, 11, 4, 6], dtype=np.int32)
+    min_time = np.zeros((6, 2), dtype=np.int32)
+    single, sharded, *_ = _both_solves(
+        free, nt_free, lifetime, needs, sizes, min_time
+    )
+    np.testing.assert_array_equal(single, sharded)
+
+
+def test_sharded_feasible():
+    rng = np.random.default_rng(5)
+    free, nt_free, lifetime, needs, sizes, min_time = _random_instance(
+        rng, n_w=16, n_r=4, n_b=8, n_v=2
+    )
+    _, sharded, _, free_d, *_ = _both_solves(
+        free, nt_free, lifetime, needs, sizes, min_time
+    )
+    used = np.einsum("bvw,bvr->wr", sharded, needs)
     assert (used <= free).all()
-    assert (counts.sum(axis=(0, 1)) <= nt_free).all()
-    assert (counts.sum(axis=(1, 2)) <= sizes).all()
-    assert (np.asarray(free_after) == free - used).all()
-
-    # same total throughput as the single-chip kernel (orders differ but
-    # both are greedy max-packing over identical capacity)
-    single_counts, _, _ = solve_tick(*args)
-    assert counts.sum() == np.asarray(single_counts).sum()
+    assert (sharded.sum(axis=(0, 1)) <= nt_free).all()
+    assert (sharded.sum(axis=(1, 2)) <= sizes).all()
+    assert (free_d == free - used).all()
 
 
 def test_sharded_priority_dominance():
     # high-priority batch first even when capacity spans devices
-    mesh = make_worker_mesh(8)
     n_w = 8
     free = np.full((n_w, 1), 2 * U, dtype=np.int32)
     nt_free = np.full(n_w, 4, dtype=np.int32)
@@ -67,11 +144,45 @@ def test_sharded_priority_dominance():
     needs = np.array([[[U]], [[U]]], dtype=np.int32)
     sizes = np.array([16, 16], dtype=np.int32)
     min_time = np.zeros((2, 1), dtype=np.int32)
-    scarcity = np.asarray(scarcity_weights(free.astype(np.int64).sum(axis=0)))
-    placed = place_tick_inputs(
-        mesh, free, nt_free, lifetime, needs, sizes, min_time, scarcity
+    _, sharded, *_ = _both_solves(
+        free, nt_free, lifetime, needs, sizes, min_time
     )
-    counts, _, _ = sharded_cut_scan(mesh, *placed)
-    counts = np.asarray(counts)
-    assert counts[0].sum() == 16  # high priority fully placed
-    assert counts[1].sum() == 0   # low priority starved (capacity exhausted)
+    assert sharded[0].sum() == 16  # high priority fully placed
+    assert sharded[1].sum() == 0   # low priority starved (capacity exhausted)
+
+
+# ---------------------------------------------------------------------------
+# the production model wrapper (what `--scheduler=multichip` instantiates)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [2, 9])
+def test_multichip_model_matches_greedy_model(seed):
+    rng = np.random.default_rng(seed)
+    # deliberately awkward unpadded shapes: the model buckets W to a
+    # multiple of the device count itself
+    n_w, n_r, n_b, n_v = 13, 3, 5, 2
+    free, nt_free, lifetime, needs, sizes, min_time = _random_instance(
+        rng, n_w, n_r, n_b, n_v, with_lifetimes=True
+    )
+    greedy = GreedyCutScanModel(backend="jax")
+    multi = MultichipModel()
+    kwargs = dict(
+        free=free, nt_free=nt_free, lifetime=lifetime,
+        needs=needs, sizes=sizes, min_time=min_time,
+    )
+    np.testing.assert_array_equal(greedy.solve(**kwargs), multi.solve(**kwargs))
+
+
+def test_multichip_model_single_device_fallback():
+    model = MultichipModel(n_devices=1)
+    free = np.array([[4 * U]], dtype=np.int32)
+    counts = model.solve(
+        free=free,
+        nt_free=np.array([8], dtype=np.int32),
+        lifetime=np.array([INF_TIME], dtype=np.int32),
+        needs=np.array([[[U]]], dtype=np.int32),
+        sizes=np.array([3], dtype=np.int32),
+        min_time=np.zeros((1, 1), dtype=np.int32),
+    )
+    assert counts.sum() == 3
+    assert model._mesh is False  # degraded to the single-chip kernel

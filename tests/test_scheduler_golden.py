@@ -10,8 +10,10 @@ of packing) are documented inline at the cases that expose them.
 """
 
 import numpy as np
+import pytest
 
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.models.multichip import MultichipModel
 from hyperqueue_tpu.ops.assign import INF_TIME
 from hyperqueue_tpu.server.task import TaskState
 
@@ -20,6 +22,24 @@ from utils_env import TestEnv
 U = 10_000
 INF = int(INF_TIME)
 MODEL = GreedyCutScanModel()
+
+# Every golden case runs under BOTH production models: the single-chip
+# cut-scan and the 8-device sharded multichip backend (they are semantically
+# identical by construction — parallel/solve.py; this pins it at the level
+# of the reference's executable spec). The fixture also swaps the TestEnv
+# default so reactor-level cases (gangs, reservations) exercise the model.
+_MODELS = {"greedy": GreedyCutScanModel(), "multichip": MultichipModel()}
+
+
+@pytest.fixture(autouse=True, params=["greedy", "multichip"])
+def _scheduler_model(request, monkeypatch):
+    global MODEL
+    import utils_env
+
+    MODEL = _MODELS[request.param]
+    monkeypatch.setattr(utils_env, "DEFAULT_MODEL", _MODELS[request.param])
+    yield
+    MODEL = _MODELS["greedy"]
 
 
 def schedule_case(workers, classes, nt_free=64, lifetimes=None):

@@ -44,10 +44,21 @@ def test_stream_roundtrip(env, tmp_path):
     assert {r["channel"] for r in records} == {"stdout", "stderr"}
 
 
-def test_python_api_program_and_function(tmp_path):
+def test_python_api_program_and_function(tmp_path, monkeypatch):
     import os
     import sys
+    from pathlib import Path
 
+    # submit_dir defaults to cwd; without this, job-N/ output dirs litter
+    # the repo root when the suite runs from there. The LocalCluster
+    # subprocesses then need PYTHONPATH to find the package (previously
+    # resolved through cwd).
+    monkeypatch.chdir(tmp_path)
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
     sys.path.insert(0, str(tmp_path))
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from hyperqueue_tpu.api import Client, FailedJobsException, Job, LocalCluster

@@ -85,6 +85,12 @@ class TestEvents:
         self.workers_lost.append((worker_id, reason))
 
 
+# Default scheduling model for TestEnv; test modules that parametrize over
+# backends (test_scheduler_golden.py) monkeypatch this so reactor-level
+# cases exercise the swapped model too.
+DEFAULT_MODEL = GreedyCutScanModel()
+
+
 class TestEnv:
     __test__ = False  # not a pytest test class
 
@@ -92,7 +98,7 @@ class TestEnv:
         self.core = Core()
         self.comm = TestComm()
         self.events = TestEvents()
-        self.model = model or GreedyCutScanModel()
+        self.model = model or DEFAULT_MODEL
         self._task_seq = 0
 
     # --- builders -----------------------------------------------------
