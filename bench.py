@@ -268,6 +268,7 @@ def main() -> None:
     args = parser.parse_args()
 
     device_fallback = False
+    probe_detail = None
     if args.cpu:
         import jax
 
@@ -276,19 +277,43 @@ def main() -> None:
         # The TPU tunnel can wedge if a previous holder died uncleanly; probe
         # device init in a subprocess with a timeout so the benchmark cannot
         # hang, and fall back to CPU (honestly marked) if the chip is stuck.
+        # The probe's failure detail goes into the JSON so the artifact
+        # distinguishes "relay absent / tunnel wedged" from "builder broke
+        # device init".
         import subprocess
         import sys as _sys
 
+        import os
+
+        probe = ("import jax; ds = jax.devices(); "
+                 "print([d.platform for d in ds])")
+        probe_timeout = float(os.environ.get("HQ_BENCH_PROBE_TIMEOUT", 240))
         try:
-            subprocess.run(
-                [_sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=240,
+            done = subprocess.run(
+                [_sys.executable, "-c", probe],
+                timeout=probe_timeout,
                 check=True,
                 capture_output=True,
+                text=True,
             )
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+            print(f"# device probe: {done.stdout.strip()}", file=sys.stderr)
+        except subprocess.TimeoutExpired as exc:
+            probe_detail = {
+                "probe": f"timeout after {probe_timeout:.0f}s (jax.devices() "
+                         "hung - TPU relay absent or tunnel wedged)",
+                "stderr": ((exc.stderr or b"").decode("utf-8", "replace")
+                           if isinstance(exc.stderr, bytes)
+                           else (exc.stderr or ""))[-500:],
+            }
+        except subprocess.CalledProcessError as exc:
+            probe_detail = {
+                "probe": f"device init exited {exc.returncode}",
+                "stderr": (exc.stderr or "")[-500:],
+            }
+        if probe_detail is not None:
             print(
-                "# WARNING: TPU device init unavailable; falling back to CPU",
+                "# WARNING: TPU device init unavailable; falling back to CPU"
+                f" ({probe_detail['probe']})",
                 file=sys.stderr,
             )
             device_fallback = True
@@ -314,9 +339,11 @@ def main() -> None:
         "value": round(median_ms, 3),
         "unit": "ms",
         "vs_baseline": round(BASELINE_MS / median_ms, 2),
+        "device": device.platform,
     }
     if device_fallback:
-        result["note"] = "cpu-fallback: TPU device init timed out"
+        result["note"] = "cpu-fallback: TPU device init unavailable"
+        result["probe"] = probe_detail
     print(json.dumps(result))
     print(
         f"# device={device.platform} assigned={n_assigned} "

@@ -88,6 +88,7 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
         return conn
 
     loop = asyncio.new_event_loop()
+    conn = None
     try:
         conn = loop.run_until_complete(_connect())
         if on_subscribed is not None:
@@ -96,4 +97,13 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
             msg = loop.run_until_complete(conn.recv())
             yield msg
     finally:
+        # the consumer may break out of the generator at any point
+        # (dashboard quit, Ctrl-C in `hq journal stream`): close the
+        # authenticated connection before the loop, or the socket leaks
+        if conn is not None:
+            try:
+                conn.close()
+                loop.run_until_complete(conn.wait_closed())
+            except Exception:
+                pass
         loop.close()

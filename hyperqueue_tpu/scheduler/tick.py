@@ -92,14 +92,22 @@ def run_tick(
     rq_map: ResourceRqMap,
     resource_map: ResourceIdMap,
     model,
+    batches: list[Batch] | None = None,
 ) -> list[Assignment]:
     """Solve one tick and pop assigned tasks from the queues.
 
     Removes assigned tasks from `queues`; does NOT touch worker resource
     accounting — the caller (reactor) applies each Assignment to its Worker
     state, which keeps one owner for the free/nt_free bookkeeping.
+
+    `batches` lets the caller pass a precomputed create_batches(queues)
+    result (the reactor builds it once per schedule() and reuses it for the
+    prefill phase); the caller's list order is left untouched.
     """
-    batches = create_batches(queues)
+    if batches is None:
+        batches = create_batches(queues)
+    else:
+        batches = list(batches)  # sorted in place below; don't reorder caller
     if not batches or not workers:
         return []
 

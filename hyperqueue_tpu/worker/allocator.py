@@ -420,6 +420,13 @@ class ResourceAllocator:
         # EMPTY worker, cached per request shape (reference allocator.rs
         # optional_objectives)
         self._optimal_cache: dict[tuple, float] = {}
+        # memoized group solves keyed on (request shape, pool free-state
+        # fingerprint): the exact subset enumeration is up to 2^12-1 subsets
+        # per coupled resource and re-runs on every blocked-queue retry of a
+        # saturated worker, where the free state usually hasn't changed
+        self._solve_cache: dict[
+            tuple, tuple[list[list[int]], float] | None
+        ] = {}
 
     def _solve_groups(
         self, coupled: list[tuple[dict, "_IndexPool"]], empty: bool
@@ -432,6 +439,16 @@ class ResourceAllocator:
                 pool.group_full_state() if empty else pool.group_free_state()
             )
             requests.append(divmod(int(entry["amount"]), FRACTIONS_PER_UNIT))
+        # (request shape, free-state fingerprint) fully determines the solve
+        # (weights are fixed per worker); memoize so blocked-queue retries on
+        # an unchanged worker skip the exponential enumeration
+        key = (
+            tuple((e["name"], int(e["amount"])) for e, _ in coupled),
+            tuple(tuple(s) for s in states),
+            empty,
+        )
+        if key in self._solve_cache:
+            return self._solve_cache[key]
         weights = [
             (
                 index_of[w.resource1],
@@ -443,7 +460,11 @@ class ResourceAllocator:
             for w in self.coupling_weights
             if w.resource1 in index_of and w.resource2 in index_of
         ]
-        return group_solver(states, requests, weights)
+        solved = group_solver(states, requests, weights)
+        if len(self._solve_cache) >= 1024:
+            self._solve_cache.pop(next(iter(self._solve_cache)))
+        self._solve_cache[key] = solved
+        return solved
 
     def try_allocate(self, entries: list[dict]) -> Allocation | None:
         """entries: [{name, amount, policy}] from the compute message.
