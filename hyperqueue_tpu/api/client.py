@@ -51,6 +51,7 @@ class Job:
         resources: dict | None = None,
         nodes: int = 0,
         time_request: float = 0.0,
+        weight: float = 1.0,
     ) -> Task:
         """Add a program task. resources: {"cpus": "2", "gpus": "0.5"}."""
         from hyperqueue_tpu.resources.amount import amount_from_str
@@ -80,7 +81,7 @@ class Job:
             "request": {
                 "variants": [
                     {"n_nodes": nodes, "min_time": time_request,
-                     "entries": entries}
+                     "weight": weight, "entries": entries}
                 ]
             },
             "deps": [t.task_id for t in (deps or [])],

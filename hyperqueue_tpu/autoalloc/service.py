@@ -23,6 +23,7 @@ from hyperqueue_tpu.autoalloc.state import (
     QueueParams,
 )
 from hyperqueue_tpu.ops.assign import INF_TIME
+from hyperqueue_tpu.resources.request import AllocationPolicy
 from hyperqueue_tpu.resources.worker_resources import WorkerResources
 from hyperqueue_tpu.scheduler.tick import WorkerRow, create_batches
 from hyperqueue_tpu.worker.hwdetect import detect_resources
@@ -210,10 +211,13 @@ class AutoAllocService:
             return 0
         n_r = len(core.resource_map)
         free = np.zeros((len(rows), n_r), dtype=np.int64)
+        total = np.zeros((len(rows), n_r), dtype=np.int64)
         nt_free = np.zeros(len(rows), dtype=np.int32)
         lifetime = np.zeros(len(rows), dtype=np.int32)
         for i, row in enumerate(rows):
             free[i, : len(row.free)] = row.free
+            src = row.total if row.total is not None else row.free
+            total[i, : len(src)] = src
             nt_free[i] = max(row.nt_free, 0)
             lifetime[i] = row.lifetime_secs
         n_b = len(batches)
@@ -221,6 +225,7 @@ class AutoAllocService:
             len(core.rq_map.get_variants(b.rq_id).variants) for b in batches
         )
         needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
+        all_mask = np.zeros((n_b, n_v, n_r), dtype=np.int32)
         sizes = np.zeros(n_b, dtype=np.int32)
         min_time = np.full((n_b, n_v), int(INF_TIME), dtype=np.int32)
         for bi, batch in enumerate(batches):
@@ -230,7 +235,19 @@ class AutoAllocService:
             ):
                 min_time[bi, vi] = min(int(variant.min_time_secs), int(INF_TIME))
                 for entry in variant.entries:
-                    needs[bi, vi, entry.resource_id] = entry.amount
+                    if entry.policy is AllocationPolicy.ALL:
+                        # ALL takes the worker's whole pool; without the
+                        # mask the zero amount would read as "variant
+                        # absent" and the class would generate no demand
+                        all_mask[bi, vi, entry.resource_id] = 1
+                    else:
+                        needs[bi, vi, entry.resource_id] = entry.amount
+        extra = {}
+        if all_mask.any():
+            extra = {
+                "total": total.astype(np.int32),
+                "all_mask": all_mask,
+            }
         counts = self.server.model.solve(
             free=free.astype(np.int32),
             nt_free=nt_free,
@@ -239,6 +256,7 @@ class AutoAllocService:
             sizes=sizes,
             min_time=min_time,
             priorities=[b.priority for b in batches],
+            **extra,
         )
         fake_load = np.asarray(counts).sum(axis=(0, 1))[first_fake:]
         return int((fake_load > 0).sum())

@@ -206,6 +206,7 @@ def cmd_worker_start(args) -> None:
         idle_timeout_secs=args.idle_timeout or 0.0,
         on_server_lost=args.on_server_lost,
         overview_interval_secs=args.overview_interval,
+        min_utilization=args.min_utilization,
         manager=manager_info.manager,
         manager_job_id=manager_info.job_id,
         alloc_id=os.environ.get("HQ_ALLOC_ID", ""),
@@ -383,9 +384,13 @@ def _parse_env(pairs: list[str]) -> dict:
 def _build_request(args) -> dict:
     entries = []
     if args.cpus:
-        entries.append(
-            {"name": "cpus", "amount": amount_from_str(args.cpus), "policy": "compact"}
-        )
+        if str(args.cpus) == "all":
+            entries.append({"name": "cpus", "amount": 0, "policy": "all"})
+        else:
+            entries.append(
+                {"name": "cpus", "amount": amount_from_str(args.cpus),
+                 "policy": "compact"}
+            )
     for spec in args.resource_request or []:
         name, sep, amount = spec.partition("=")
         if not sep:
@@ -402,7 +407,27 @@ def _build_request(args) -> dict:
         "min_time": args.time_request or 0.0,
         "entries": entries,
     }
+    if getattr(args, "weight", None):
+        variant["weight"] = args.weight
     return {"variants": [variant]}
+
+
+def _parse_weight(text: str) -> float:
+    value = float(text)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(
+            "resource weight has to be a positive number"
+        )
+    return value
+
+
+def _parse_min_utilization(text: str) -> float:
+    value = float(text)
+    if not 0.0 <= value <= 1.0:
+        raise argparse.ArgumentTypeError(
+            "min utilization has to be in range 0.0-1.0"
+        )
+    return value
 
 
 def cmd_submit(args) -> None:
@@ -738,7 +763,12 @@ def _alloc_params(args) -> dict:
         "max_worker_count": args.max_worker_count or 0,
         "time_limit_secs": args.time_limit,
         "name": args.name or "",
-        "worker_args": args.worker_args or [],
+        "worker_args": (args.worker_args or [])
+        + (
+            ["--min-utilization", str(args.min_utilization)]
+            if args.min_utilization
+            else []
+        ),
         "additional_args": args.additional_args or [],
         "idle_timeout_secs": args.idle_timeout,
     }
@@ -1021,6 +1051,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch manager detection (time limit from walltime)")
     p.add_argument("--overview-interval", type=float, default=0.0,
                    help="send hardware telemetry every N seconds")
+    p.add_argument("--min-utilization", type=_parse_min_utilization,
+                   default=0.0,
+                   help="only accept tasks while at least this fraction of "
+                        "the worker's cpus would be busy (0.0-1.0)")
     p.add_argument("--zero-worker", action="store_true",
                    help="benchmark mode: tasks succeed instantly, no spawn")
     p.set_defaults(fn=cmd_worker_start)
@@ -1062,6 +1096,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--time-limit", type=float, default=None,
                    help="kill a task after this many seconds")
     p.add_argument("--priority", type=int, default=0)
+    p.add_argument("--weight", type=_parse_weight, default=None,
+                   help="scheduler objective weight: biases which same-"
+                        "priority job wins contended workers (default 1.0)")
     p.add_argument("--max-fails", type=int, default=None)
     p.add_argument("--crash-limit", type=int, default=5)
     p.add_argument("--array", default=None)
@@ -1137,6 +1174,10 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--idle-timeout", type=float, default=300.0)
         p.add_argument("--name", default=None)
         p.add_argument("--worker-args", action="append")
+        p.add_argument("--min-utilization", type=_parse_min_utilization,
+                       default=0.0,
+                       help="spawned workers only take tasks while at least "
+                            "this fraction of their cpus stays busy")
         p.add_argument("manager", choices=["pbs", "slurm"])
         p.add_argument("additional_args", nargs="*",
                        help="extra qsub/sbatch arguments after --")

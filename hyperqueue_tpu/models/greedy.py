@@ -75,6 +75,12 @@ class GreedyCutScanModel:
         priorities: list | None = None,  # accepted for model-interface
                                          # parity; rows are already in
                                          # descending priority order
+        total: np.ndarray | None = None,     # (W, R) int32 pool totals
+        all_mask: np.ndarray | None = None,  # (B, V, R) int32 0/1 ALL-policy
+        weights: np.ndarray | None = None,   # (B, V) request weights —
+                                             # consumed on the host by
+                                             # run_tick's batch ordering;
+                                             # accepted for interface parity
     ) -> np.ndarray:
         """Returns counts (B, V, W) int32 (unpadded)."""
         n_w, n_r = free.shape
@@ -99,11 +105,24 @@ class GreedyCutScanModel:
         mt_p[:n_b, :n_v] = min_time
         # absent variants must never be eligible: give them infinite min_time
         mt_p[:, n_v:] = int(INF_TIME)
+        if all_mask is not None and not np.any(all_mask):
+            all_mask = None  # keep the common no-ALL compiled program
+        total_p = amask_p = None
+        if all_mask is not None:
+            total_p = np.zeros((pw, pr), dtype=np.int32)
+            if total is not None:
+                total_p[:n_w, :n_r] = total
+            else:
+                total_p[:n_w, :n_r] = free
+            amask_p = np.zeros((pb, pv, pr), dtype=np.int32)
+            amask_p[:n_b, :n_v, :n_r] = all_mask
 
         scarcity = np.asarray(
             scarcity_weights(free_p.astype(np.int64).sum(axis=0))
         ).astype(np.float32)
-        class_m, order_ids = host_visit_classes(free_p, needs_p, scarcity)
+        class_m, order_ids = host_visit_classes(
+            free_p, needs_p, scarcity, all_mask=amask_p
+        )
         # bucket the mask-table dimension so steady-state ticks reuse the
         # compiled program; padding rows are all-class-0 (never referenced)
         pm = _bucket(class_m.shape[0], 4)
@@ -112,7 +131,8 @@ class GreedyCutScanModel:
             class_m = np.concatenate([class_m, pad], axis=0)
 
         counts = self._solve_padded(
-            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
+            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids,
+            total_p=total_p, amask_p=amask_p,
         )
         return np.asarray(counts)[:n_b, :n_v, :n_w]
 
@@ -120,7 +140,8 @@ class GreedyCutScanModel:
         return _bucket(n_w, self.worker_floor)
 
     def _solve_padded(
-        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
+        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
+        order_ids, total_p=None, amask_p=None,
     ):
         """Run the kernel on fully padded inputs; overridden by the
         multi-chip model (models/multichip.py) to shard the worker axis."""
@@ -128,6 +149,7 @@ class GreedyCutScanModel:
             greedy_cut_scan_numpy if self._numpy_path() else greedy_cut_scan
         )
         counts, _free_after, _nt_after = solver(
-            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
+            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids,
+            total=total_p, all_mask=amask_p,
         )
         return counts

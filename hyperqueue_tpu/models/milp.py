@@ -1,17 +1,30 @@
 """MILP scheduling model: the host-solver accuracy oracle.
 
-Reference: crates/tako/src/internal/scheduler/solver.rs builds one integer
-program per tick (variables per (worker, batch, variant), worker resource
-constraints, priority blocking) and solves it with an LP backend; this model
-re-creates that decision quality on the host via scipy's HiGHS MILP, for use
-as a second `--scheduler` backend and as the makespan/accuracy oracle the
-greedy TPU kernel is tested against (SURVEY §7.6).
+Reference: crates/tako/src/internal/scheduler/solver.rs builds ONE integer
+program per tick — variables per (worker, batch, variant) with a
+share-density x request-weight objective (solver.rs:520-549), priority
+blocking variables with gap relaxation (solver.rs:211-330), min-utilization
+all-or-nothing worker constraints (solver.rs:479-518) and multi-node gang
+count variables per worker group (solver.rs:177-209) — and solves it with an
+LP backend. This model re-creates that decision quality on the host via
+scipy's HiGHS MILP, for use as a second `--scheduler` backend and as the
+makespan/accuracy oracle the greedy TPU kernel is tested against (SURVEY
+§7.6).
 
-Priority dominance is enforced structurally instead of with big-M weights:
-batches are grouped by priority level and each level is solved as its own
-maximization over the capacity left by higher levels — exactly the
-cut-with-gap-relaxation semantics the reference's blocking variables encode,
-with no conditioning problems.
+Priority dominance is enforced by LEXICOGRAPHIC solves over one joint
+variable set instead of the reference's blocking variables: levels are
+maximized highest-first, each next solve pinning the previous levels'
+achieved scores as lower-bound constraints while every variable stays free.
+This yields the same cut-with-gap-relaxation outcome (lower levels only fill
+capacity higher levels cannot use) and — unlike solving each level on the
+residual capacity — lets a lower-priority task help satisfy a shared
+constraint such as a min-utilization floor, exactly like the reference's one
+joint program.
+
+Per-level score: task count when every request weight in the level is 1.0
+(the packing objective the golden tests pin), else the reference's
+share-density x weight value (solver.rs:528-546), so `--weight` biases which
+same-priority class wins under this backend too.
 
 This is a HOST model (numpy + scipy): tens of workers x dozens of batches
 solve in milliseconds, which is plenty for the oracle role and for small
@@ -28,7 +41,7 @@ logger = logging.getLogger(__name__)
 
 
 class MilpModel:
-    """Same interface as GreedyCutScanModel.solve; exact per-level packing."""
+    """Same interface as GreedyCutScanModel.solve; joint lexicographic MILP."""
 
     def __init__(self, time_limit_secs: float = 10.0):
         # budget for the WHOLE tick (split across priority levels): the
@@ -46,131 +59,222 @@ class MilpModel:
         min_time: np.ndarray,   # (B, V) int32 seconds
         priorities: list | None = None,  # per-batch priority (row order =
                                          # descending priority when absent)
+        total: np.ndarray | None = None,     # (W, R) pool totals
+        all_mask: np.ndarray | None = None,  # (B, V, R) 0/1 ALL-policy
+        weights: np.ndarray | None = None,   # (B, V) request weights
+        cpu_floor: np.ndarray | None = None,  # (W,) min-utilization floors
     ) -> np.ndarray:
         from scipy.optimize import Bounds, LinearConstraint, milp
         from scipy.sparse import lil_matrix
 
-        free = np.asarray(free, dtype=np.int64).copy()
-        nt_free = np.asarray(nt_free, dtype=np.int64).copy()
+        free = np.asarray(free, dtype=np.int64)
+        nt_free = np.asarray(nt_free, dtype=np.int64)
         lifetime = np.asarray(lifetime)
         needs = np.asarray(needs, dtype=np.int64)
-        # copied: decremented per level below, and asarray aliases the
-        # caller's buffer when the dtype already matches
-        sizes = np.array(sizes, dtype=np.int64, copy=True)
+        sizes = np.asarray(sizes, dtype=np.int64)
         min_time = np.asarray(min_time)
+        if total is not None:
+            total = np.asarray(total, dtype=np.int64)
         n_b, n_v, n_r = needs.shape
         n_w = free.shape[0]
         counts = np.zeros((n_b, n_v, n_w), dtype=np.int32)
 
         if priorities is None:
-            # run_tick hands batches in descending priority order; treat each
-            # row as its own level unless told otherwise... rows sharing a
-            # level must be solved jointly, so default to one level per
-            # distinct row index is WRONG for equal priorities — callers
-            # that care (run_tick via priorities kwarg) pass the real levels.
-            priorities = list(range(n_b, 0, -1))
+            # every batch row its own dominance level is wrong for rows that
+            # SHARE a priority (they must pack jointly); with no information
+            # the safe default is one joint level (callers that care —
+            # run_tick — always pass the real levels)
+            priorities = [0] * n_b
+
+        if weights is None:
+            weights = np.ones((n_b, n_v), dtype=np.float64)
+        else:
+            weights = np.asarray(weights, dtype=np.float64)
+
+        # --- candidate variables over ALL levels: (b, v, w) ---
+        # per-variable resource needs (ALL-policy entries take the worker's
+        # whole pool and require it untouched, solver.rs:120-124)
+        variables: list[tuple[int, int, int]] = []
+        var_needs: list[np.ndarray] = []
+        var_upper: list[int] = []
+        for b in range(n_b):
+            if sizes[b] <= 0:
+                continue
+            for v in range(n_v):
+                is_all = (
+                    all_mask[b, v] > 0
+                    if all_mask is not None
+                    else np.zeros(n_r, dtype=bool)
+                )
+                if not (needs[b, v] > 0).any() and not is_all.any():
+                    continue  # absent variant row
+                for w in range(n_w):
+                    if min_time[b, v] > lifetime[w]:
+                        continue
+                    if nt_free[w] <= 0:
+                        continue
+                    nv = needs[b, v].copy()
+                    if is_all.any():
+                        if total is None:
+                            continue
+                        if (
+                            (free[w][is_all] != total[w][is_all])
+                            | (total[w][is_all] <= 0)
+                        ).any():
+                            continue  # pool not fully idle
+                        nv[is_all] = total[w][is_all]
+                    if (nv > free[w]).any():
+                        continue
+                    variables.append((b, v, w))
+                    var_needs.append(nv)
+                    cap = min(int(sizes[b]), int(nt_free[w]))
+                    if is_all.any():
+                        cap = min(cap, 1)
+                    var_upper.append(cap)
+        if not variables:
+            return counts
+        n_x = len(variables)
+
+        # min-utilization bool variables, one per floored worker
+        floors = {}
+        if cpu_floor is not None:
+            cpu_floor = np.asarray(cpu_floor, dtype=np.int64)
+            for w in range(n_w):
+                if cpu_floor[w] > 0:
+                    floors[w] = n_x + len(floors)
+        n_y = len(floors)
+        n_all = n_x + n_y
+
+        # --- shared constraint matrix ---
+        rows = lil_matrix((n_w * (n_r + 1) + n_b + 2 * n_y, n_all))
+        lo: list[float] = []
+        hi: list[float] = []
+        row = 0
+        by_worker: dict[int, list[int]] = {}
+        by_batch: dict[int, list[int]] = {}
+        for xi, (b, v, w) in enumerate(variables):
+            by_worker.setdefault(w, []).append(xi)
+            by_batch.setdefault(b, []).append(xi)
+        for w, xis in by_worker.items():
+            for r in range(n_r):
+                touched = False
+                for xi in xis:
+                    if var_needs[xi][r]:
+                        rows[row, xi] = float(var_needs[xi][r])
+                        touched = True
+                if touched:
+                    lo.append(0.0)
+                    hi.append(float(free[w, r]))
+                    row += 1
+            for xi in xis:
+                rows[row, xi] = 1.0
+            lo.append(0.0)
+            hi.append(float(nt_free[w]))
+            row += 1
+        for b, xis in by_batch.items():
+            for xi in xis:
+                rows[row, xi] = 1.0
+            lo.append(0.0)
+            hi.append(float(sizes[b]))
+            row += 1
+        # min-utilization: cpu use on w is 0, or at least the floor
+        # (reference add_min_utilization, solver.rs:479-518): with bool y_w,
+        #   sum(cpu) - floor*y >= 0  and  sum(cpu) - free_cpu*y <= 0
+        for w, yi in floors.items():
+            for xi in by_worker.get(w, []):
+                if var_needs[xi][0]:
+                    rows[row, xi] = float(var_needs[xi][0])
+                    rows[row + 1, xi] = float(var_needs[xi][0])
+            rows[row, yi] = -float(cpu_floor[w])
+            lo.append(0.0)
+            hi.append(np.inf)
+            row += 1
+            rows[row, yi] = -float(free[w, 0])
+            lo.append(-np.inf)
+            hi.append(0.0)
+            row += 1
+        rows = rows[:row].tocsr()
+        base_constraints = [LinearConstraint(rows, np.array(lo), np.array(hi))]
+
+        # --- per-level lexicographic objective rows ---
+        # share-density x weight value (solver.rs:528-546) with a tiny
+        # lower-worker-index bonus as the tie-break the reference folds into
+        # the objective
+        res_sums = np.maximum(free, 0).sum(axis=0).astype(np.float64)
+        value = np.zeros(n_all)
+        for xi, (b, v, w) in enumerate(variables):
+            share = sum(
+                var_needs[xi][r] / res_sums[r]
+                for r in range(n_r)
+                if var_needs[xi][r] > 0 and res_sums[r] > 0
+            )
+            value[xi] = share * weights[b, v] * (
+                1.0 + 1e-6 * (n_w - w) / max(n_w, 1)
+            )
 
         levels: dict = {}
         for bi, p in enumerate(priorities):
             levels.setdefault(p, []).append(bi)
+        level_keys = sorted(levels, reverse=True)
+
+        level_rows = []
+        for level in level_keys:
+            batch_set = set(levels[level])
+            weighted = any(
+                abs(weights[b, v] - 1.0) > 1e-9
+                for b in batch_set
+                for v in range(n_v)
+            )
+            srow = np.zeros(n_all)
+            for xi, (b, v, w) in enumerate(variables):
+                if b in batch_set:
+                    # count objective with a value tie-break, or pure value
+                    # when the level carries non-default weights
+                    srow[xi] = (
+                        value[xi] if weighted else 1.0 + 1e-6 * value[xi]
+                    )
+            level_rows.append(srow)
 
         import time as _time
 
         deadline = _time.monotonic() + self.time_limit_secs
-        level_keys = sorted(levels, reverse=True)
-        for li, level in enumerate(level_keys):
-            batch_ids = levels[level]
-            remaining_budget = max(deadline - _time.monotonic(), 0.1)
-            level_budget = remaining_budget / (len(level_keys) - li)
-            # candidate variables: (b, v, w) with a usable variant that fits
-            # worker lifetime and a positive remaining size
-            variables = []
-            for b in batch_ids:
-                if sizes[b] <= 0:
-                    continue
-                for v in range(n_v):
-                    if not (needs[b, v] > 0).any():
-                        continue  # absent variant row
-                    for w in range(n_w):
-                        if min_time[b, v] > lifetime[w]:
-                            continue
-                        if (needs[b, v] > free[w]).any():
-                            continue
-                        if nt_free[w] <= 0:
-                            continue
-                        variables.append((b, v, w))
-            if not variables:
+        integrality = np.ones(n_all)
+        upper = np.array(
+            var_upper + [1] * n_y, dtype=np.float64
+        )
+        pins: list = []
+        x_final = None
+        for li, srow in enumerate(level_rows):
+            if not srow.any():
                 continue
-            n_x = len(variables)
-            # objective: maximize assigned tasks (milp minimizes)
-            c = -np.ones(n_x)
-
-            rows = []
-            lo = []
-            hi = []
-            a = lil_matrix(
-                (n_w * (n_r + 1) + len(batch_ids), n_x), dtype=np.float64
-            )
-            row = 0
-            # per worker per resource capacity
-            for w in range(n_w):
-                for r in range(n_r):
-                    touched = False
-                    for xi, (b, v, ww) in enumerate(variables):
-                        if ww == w and needs[b, v, r]:
-                            a[row, xi] = float(needs[b, v, r])
-                            touched = True
-                    if touched:
-                        lo.append(0.0)
-                        hi.append(float(free[w, r]))
-                        row += 1
-                # task-slot cap
-                touched = False
-                for xi, (b, v, ww) in enumerate(variables):
-                    if ww == w:
-                        a[row, xi] = 1.0
-                        touched = True
-                if touched:
-                    lo.append(0.0)
-                    hi.append(float(nt_free[w]))
-                    row += 1
-            # per-batch size cap
-            for b in batch_ids:
-                touched = False
-                for xi, (bb, v, w) in enumerate(variables):
-                    if bb == b:
-                        a[row, xi] = 1.0
-                        touched = True
-                if touched:
-                    lo.append(0.0)
-                    hi.append(float(sizes[b]))
-                    row += 1
-            a = a[:row].tocsr()
-
-            upper = np.array(
-                [min(int(sizes[b]), int(nt_free[w])) for b, v, w in variables],
-                dtype=np.float64,
+            budget = max(deadline - _time.monotonic(), 0.1) / (
+                len(level_rows) - li
             )
             result = milp(
-                c,
-                constraints=LinearConstraint(a, np.array(lo), np.array(hi)),
-                integrality=np.ones(n_x),
+                -srow,
+                constraints=base_constraints + pins,
+                integrality=integrality,
                 bounds=Bounds(0, upper),
-                options={"time_limit": level_budget},
+                options={"time_limit": budget},
             )
-            # status 1 = time/iteration limit with a feasible incumbent in
-            # result.x; discarding it would assign nothing at this level
-            # every tick on instances that persistently exceed the budget
+            # status 1 = time limit with a feasible incumbent in result.x;
+            # discarding it would assign nothing on over-budget instances
             if result.x is None or result.status not in (0, 1):
-                logger.warning("milp level %s failed: %s", level,
-                               result.message)
+                logger.warning(
+                    "milp level %s failed: %s", level_keys[li], result.message
+                )
                 continue
-            x = np.round(result.x).astype(np.int64)
-            for xi, (b, v, w) in enumerate(variables):
-                if x[xi] <= 0:
-                    continue
-                counts[b, v, w] += int(x[xi])
-                free[w] -= needs[b, v] * x[xi]
-                nt_free[w] -= x[xi]
-                sizes[b] -= x[xi]
+            x_final = result.x
+            achieved = float(srow @ result.x)
+            # pin this level's score (small slack absorbs solver tolerance)
+            pins.append(
+                LinearConstraint(srow[None, :], achieved - 1e-6, np.inf)
+            )
+
+        if x_final is None:
+            return counts
+        x = np.round(np.asarray(x_final)[:n_x]).astype(np.int64)
+        for xi, (b, v, w) in enumerate(variables):
+            if x[xi] > 0:
+                counts[b, v, w] = int(x[xi])
         return counts

@@ -72,13 +72,14 @@ class MultichipModel(GreedyCutScanModel):
         return pw
 
     def _solve_padded(
-        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids
+        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
+        order_ids, total_p=None, amask_p=None,
     ):
         mesh = self._get_mesh()
         if not mesh:
             return super()._solve_padded(
                 free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-                order_ids,
+                order_ids, total_p=total_p, amask_p=amask_p,
             )
         from hyperqueue_tpu.parallel.solve import (
             place_tick_inputs,
@@ -87,7 +88,7 @@ class MultichipModel(GreedyCutScanModel):
 
         placed = place_tick_inputs(
             mesh, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-            order_ids,
+            order_ids, total=total_p, all_mask=amask_p,
         )
         counts, _free_after, _nt_after = sharded_cut_scan(mesh, *placed)
         return np.asarray(counts)

@@ -56,7 +56,7 @@ _WASTE_Q = 65536
 MAX_KERNEL_AMOUNT = 2**23  # all amounts must be below this (float32-exact)
 
 
-def _variant_capacity(free, nt_free, need, time_ok):
+def _variant_capacity(free, nt_free, need, time_ok, total=None, all_r=None):
     """(W,) int32: how many tasks of `need` fit on each worker right now.
 
     TPUs have no hardware integer division; XLA expands `//` into a long
@@ -65,6 +65,11 @@ def _variant_capacity(free, nt_free, need, time_ok):
     scheduler/tick.py / models/greedy.py): free and need < 2^23, so both are
     exactly representable in float32 and the float quotient is within 1 of
     the true floor — two int32 multiply-compare corrections make it exact.
+
+    all_r (R,) int32 0/1 marks ALL-policy resources (request.rs:14-21 All):
+    the task takes the worker's ENTIRE pool of that resource, so it fits only
+    where the pool is untouched (free == total, reference solver.rs:120-124
+    amount_or_none_if_all) — at most one such task per worker per tick.
     """
     needed = need > 0
     denom = jnp.where(needed, need, 1)
@@ -77,11 +82,19 @@ def _variant_capacity(free, nt_free, need, time_ok):
     too_small = (q + 1) * denom[None, :] <= free
     q = q + too_small.astype(jnp.int32)
     per_res = jnp.where(needed[None, :], q, jnp.int32(2**30))
+    any_req = jnp.any(needed)
+    if all_r is not None:
+        is_all = all_r > 0
+        all_fit = ((free == total) & (total > 0)).astype(jnp.int32)
+        per_res = jnp.where(
+            is_all[None, :], all_fit, per_res
+        )
+        any_req = any_req | jnp.any(is_all)
     cap = jnp.min(per_res, axis=1)
     cap = jnp.minimum(cap, nt_free)
     cap = jnp.where(time_ok, cap, 0)
     # an absent (all-zero) variant must contribute nothing
-    cap = jnp.where(jnp.any(needed), cap, 0)
+    cap = jnp.where(any_req, cap, 0)
     return jnp.maximum(cap, 0)
 
 
@@ -134,7 +147,7 @@ def _water_fill_classed(
 N_VISIT_CLASSES = 16
 
 
-def host_visit_classes(free0, needs, scarcity):
+def host_visit_classes(free0, needs, scarcity, all_mask=None):
     """Precompute worker visit classes per distinct request mask (numpy).
 
     The preference order (avoid burning scarce resources a request does not
@@ -154,6 +167,9 @@ def host_visit_classes(free0, needs, scarcity):
     n_b, n_v, _n_r = needs.shape
     has = np.asarray(free0) > 0  # (W, R)
     masks = np.asarray(needs) == 0  # (B, V, R): resources NOT requested
+    if all_mask is not None:
+        # an ALL-policy entry requests the resource (amount is the pool)
+        masks = masks & ~(np.asarray(all_mask) > 0)
     flat = masks.reshape(n_b * n_v, -1)
     uniq, inverse = np.unique(flat, axis=0, return_inverse=True)
     weighted = has * np.asarray(scarcity)[None, :]  # (W, R)
@@ -183,7 +199,8 @@ def expand_onehots(class_m, order_ids):
 
 
 def scan_batches(
-    free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill
+    free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill,
+    total=None, all_mask=None,
 ):
     """Scan priority-ordered batches, water-filling each over the workers.
 
@@ -194,50 +211,66 @@ def scan_batches(
 
     water_fill(cap, remaining, class_onehot) -> (assign (W,), assigned_total);
     `assigned_total` must be the GLOBAL total when workers are sharded.
-    Returns (counts, free_after, nt_free_after).
+    total (W, R) and all_mask (B, V, R) enable ALL-policy requests: an
+    assigned ALL task drains the worker's whole pool of the marked resources
+    (reference solver.rs:120-124). Returns (counts, free_after,
+    nt_free_after).
     """
     n_variants = needs.shape[1]
+    has_all = all_mask is not None
 
     def batch_body(carry, batch):
         free, nt_free = carry
-        b_needs, b_size, b_min_time, b_onehot = batch
+        if has_all:
+            b_needs, b_size, b_min_time, b_onehot, b_all = batch
+        else:
+            b_needs, b_size, b_min_time, b_onehot = batch
+            b_all = None
         remaining = b_size
         counts_v = []
         for v in range(n_variants):  # V is tiny and static: unrolled
             need = b_needs[v]
             time_ok = b_min_time[v] <= lifetime
-            cap = _variant_capacity(free, nt_free, need, time_ok)
+            all_r = b_all[v] if has_all else None
+            cap = _variant_capacity(
+                free, nt_free, need, time_ok, total=total, all_r=all_r
+            )
             cap = jnp.minimum(cap, remaining)
             assign, assigned = water_fill(cap, remaining, b_onehot[v])
             remaining = remaining - assigned
             free = free - assign[:, None] * need[None, :]
+            if has_all:
+                # an ALL assignment (assign is 0/1 there: cap <= 1) empties
+                # the worker's pool of the marked resources
+                free = free * (1 - assign[:, None] * all_r[None, :])
             nt_free = nt_free - assign
             counts_v.append(assign)
         return (free, nt_free), jnp.stack(counts_v)
 
-    (free, nt_free), counts = jax.lax.scan(
-        batch_body,
-        (free, nt_free),
-        (needs, sizes, min_time, onehots),
-    )
+    xs = (needs, sizes, min_time, onehots)
+    if has_all:
+        xs = xs + (all_mask,)
+    (free, nt_free), counts = jax.lax.scan(batch_body, (free, nt_free), xs)
     return counts, free, nt_free
 
 
 def greedy_cut_scan_impl(
-    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids
+    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
+    total=None, all_mask=None,
 ):
     """Single-chip kernel: one-hot expansion + the shared batch scan.
 
     Un-jitted implementation (jit-wrapped below; also reused by the driver
     entry). class_m (M, W) int32 + order_ids (B, V) int32 come from
     host_visit_classes: per distinct request mask, each worker's visit class
-    (0 = visited first). See module docstring for shapes/semantics. Returns
+    (0 = visited first). total/all_mask enable ALL-policy requests (see
+    scan_batches). See module docstring for shapes/semantics. Returns
     (counts, free_after, nt_free_after).
     """
     onehots = expand_onehots(class_m, order_ids)
     return scan_batches(
         free, nt_free, lifetime, needs, sizes, min_time, onehots,
-        _water_fill_classed,
+        _water_fill_classed, total=total, all_mask=all_mask,
     )
 
 
@@ -247,7 +280,8 @@ greedy_cut_scan = functools.partial(jax.jit, donate_argnums=(0, 1))(
 
 
 def greedy_cut_scan_numpy(
-    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids
+    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
+    total=None, all_mask=None,
 ):
     """Vectorized numpy implementation of the cut-scan (identical semantics).
 
@@ -260,6 +294,8 @@ def greedy_cut_scan_numpy(
     free = np.asarray(free, dtype=np.int64).copy()
     nt_free = np.asarray(nt_free, dtype=np.int64).copy()
     lifetime = np.asarray(lifetime)
+    if total is not None:
+        total = np.asarray(total, dtype=np.int64)
     n_b, n_v, _n_r = needs.shape
     n_w = free.shape[0]
     counts = np.zeros((n_b, n_v, n_w), dtype=np.int32)
@@ -273,12 +309,28 @@ def greedy_cut_scan_numpy(
                 break
             need = needs[b, v]
             needed = need > 0
-            if not needed.any():
-                continue
-            per_res = np.min(
-                free[:, needed] // np.asarray(need, dtype=np.int64)[needed],
-                axis=1,
+            all_r = (
+                np.asarray(all_mask[b, v]) > 0 if all_mask is not None
+                else np.zeros_like(needed)
             )
+            if not needed.any() and not all_r.any():
+                continue
+            if needed.any():
+                per_res = np.min(
+                    free[:, needed]
+                    // np.asarray(need, dtype=np.int64)[needed],
+                    axis=1,
+                )
+            else:
+                per_res = np.full(n_w, 2**30, dtype=np.int64)
+            if all_r.any():
+                # ALL-policy resources: fits only on a fully idle pool,
+                # at most one task per worker (solver.rs:120-124)
+                all_fit = (
+                    (free[:, all_r] == total[:, all_r])
+                    & (total[:, all_r] > 0)
+                ).all(axis=1)
+                per_res = np.minimum(per_res, all_fit.astype(np.int64))
             cap = np.minimum(per_res, nt_free)
             cap[min_time[b, v] > lifetime] = 0
             np.clip(cap, 0, remaining, out=cap)
@@ -293,6 +345,8 @@ def greedy_cut_scan_numpy(
             assigned = int(take_sorted.sum())
             remaining -= assigned
             free -= assign[:, None] * need[None, :]
+            if all_r.any():
+                free[:, all_r] *= 1 - assign[:, None]
             nt_free -= assign
             counts[b, v] = assign
     return counts, free, nt_free

@@ -79,9 +79,12 @@ def _sharded_water_fill_classed(cap, remaining, class_onehot, axis):
     )
 
 
-def _sharded_body(free, nt_free, lifetime, needs, sizes, min_time, onehots):
-    """shard_map body: free/nt_free/lifetime/onehots are local worker shards;
-    needs/sizes/min_time are replicated. The scan itself is
+def _sharded_body(
+    free, nt_free, lifetime, needs, sizes, min_time, onehots,
+    total=None, all_mask=None,
+):
+    """shard_map body: free/nt_free/lifetime/onehots/total are local worker
+    shards; needs/sizes/min_time/all_mask are replicated. The scan itself is
     ops.assign.scan_batches — the SAME code the single-chip kernel runs —
     with only the water-fill swapped for the cluster-wide-prefix variant, so
     single/multi-chip parity is structural."""
@@ -90,51 +93,78 @@ def _sharded_body(free, nt_free, lifetime, needs, sizes, min_time, onehots):
         return _sharded_water_fill_classed(cap, remaining, class_onehot, "w")
 
     return scan_batches(
-        free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill
+        free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill,
+        total=total, all_mask=all_mask,
     )
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def sharded_cut_scan(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
-    order_ids,
+    order_ids, total=None, all_mask=None,
 ):
     """Worker-sharded variant of ops.assign.greedy_cut_scan — same inputs,
     same outputs, identical semantics.
 
-    free (W, R), nt_free/lifetime (W,) sharded on axis "w"; needs/sizes/
-    min_time/class_m/order_ids replicated. Returns counts (B, V, W) sharded
-    on W, plus free/nt_free after.
+    free/total (W, R), nt_free/lifetime (W,) sharded on axis "w"; needs/
+    sizes/min_time/class_m/order_ids/all_mask replicated. Returns counts
+    (B, V, W) sharded on W, plus free/nt_free after.
     """
     # Per-batch visit-class one-hots, expanded OUTSIDE the shard_map/scan
     # (in-scan dynamic row gathers cost ~140us/step on TPU — same reasoning
     # as greedy_cut_scan_impl); XLA shards the (B, V, W, C) result on W.
     onehots = expand_onehots(class_m, order_ids)
 
+    in_specs = [
+        P("w", None),              # free
+        P("w"),                    # nt_free
+        P("w"),                    # lifetime
+        P(),                       # needs
+        P(),                       # sizes
+        P(),                       # min_time
+        P(None, None, "w", None),  # onehots
+    ]
+    args = [free, nt_free, lifetime, needs, sizes, min_time, onehots]
+    # optional ALL-policy inputs: None args are dropped from the pytree so
+    # the no-ALL compiled program is unchanged
+    if total is not None:
+        in_specs.append(P("w", None))
+        args.append(total)
+    if all_mask is not None:
+        in_specs.append(P())
+        args.append(all_mask)
+
+    def body(free, nt_free, lifetime, needs, sizes, min_time, onehots,
+             *extra):
+        i = 0
+        t = m = None
+        if total is not None:
+            t = extra[i]
+            i += 1
+        if all_mask is not None:
+            m = extra[i]
+        return _sharded_body(
+            free, nt_free, lifetime, needs, sizes, min_time, onehots,
+            total=t, all_mask=m,
+        )
+
     return jax.shard_map(
-        _sharded_body,
+        body,
         mesh=mesh,
-        in_specs=(
-            P("w", None),              # free
-            P("w"),                    # nt_free
-            P("w"),                    # lifetime
-            P(),                       # needs
-            P(),                       # sizes
-            P(),                       # min_time
-            P(None, None, "w", None),  # onehots
-        ),
+        in_specs=tuple(in_specs),
         out_specs=(P(None, None, "w"), P("w", None), P("w")),
         check_vma=False,
-    )(free, nt_free, lifetime, needs, sizes, min_time, onehots)
+    )(*args)
 
 
 def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
-                      min_time, class_m, order_ids):
+                      min_time, class_m, order_ids, total=None,
+                      all_mask=None):
     """Device-put the tick tensors with the proper shardings."""
     w2 = NamedSharding(mesh, P("w", None))
     w1 = NamedSharding(mesh, P("w"))
     rep = NamedSharding(mesh, P())
-    return (
+    out = (
         jax.device_put(free, w2),
         jax.device_put(nt_free, w1),
         jax.device_put(lifetime, w1),
@@ -144,3 +174,9 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
         jax.device_put(class_m, rep),
         jax.device_put(order_ids, rep),
     )
+    if total is not None or all_mask is not None:
+        out = out + (
+            None if total is None else jax.device_put(total, w2),
+            None if all_mask is None else jax.device_put(all_mask, rep),
+        )
+    return out

@@ -68,8 +68,15 @@ class ResourceRequest:
     entries: tuple[ResourceRequestEntry, ...] = ()
     n_nodes: int = 0
     min_time_secs: float = 0.0
+    # Scheduler objective multiplier (reference request.rs:137,150
+    # ResourceWeight): within one priority level, classes are packed in
+    # descending (weight x resource-share) order, so a user can bias which
+    # same-priority class wins a contended worker. 1.0 = neutral.
+    weight: float = 1.0
 
     def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError("resource weight has to be a positive number")
         ids = [e.resource_id for e in self.entries]
         if len(set(ids)) != len(ids):
             raise ValueError("duplicate resource in request")

@@ -40,6 +40,12 @@ class WorkerRow:
     free: list[int]       # dense fractions, aligned to ResourceIdMap
     nt_free: int
     lifetime_secs: int    # INF_TIME if unlimited
+    # pool totals (None = use free; only read for ALL-policy requests)
+    total: list[int] | None = None
+    # min-utilization floor in cpu fractions still to fill before this worker
+    # may take any task at all (reference worker configuration
+    # min_utilization, solver.rs:479-518); 0 = normal worker
+    cpu_floor: int = 0
 
 
 # One assignment is a plain (task_id, worker_id, rq_id, variant) tuple:
@@ -63,16 +69,108 @@ def create_batches(queues: TaskQueues) -> list[Batch]:
     return batches
 
 
-def _range_compress(needs: np.ndarray, free: np.ndarray) -> None:
+def _apply_weight_order(batches, rq_map, free, n_r) -> None:
+    """Re-order same-priority runs whose classes carry non-default request
+    weights (reference request.rs:137 ResourceWeight, consumed by the LP
+    objective in solver.rs:520-549).
+
+    The reference maximizes sum(weight x resource-share) jointly per level;
+    the greedy equivalent is to take classes in descending ACHIEVABLE
+    objective: per-task value = weight x sum_r(amount_r / cluster_total_r),
+    capped by how many tasks could fit cluster-wide right now. Levels where
+    every class has weight 1.0 (the overwhelmingly common case) keep the
+    scarcity order the kernel's golden tests pin.
+    """
+    from hyperqueue_tpu.resources.request import AllocationPolicy
+
+    totals = np.maximum(free, 0).sum(axis=0)  # (R,) cluster-wide
+    n_w = free.shape[0]
+
+    def per_task_value(rq_id: int) -> float:
+        best = 0.0
+        for variant in rq_map.get_variants(rq_id).variants:
+            share = 0.0
+            for e in variant.entries:
+                if e.resource_id >= n_r:
+                    continue
+                tot = float(totals[e.resource_id])
+                if e.policy is AllocationPolicy.ALL:
+                    # amount is the worker's whole pool; approximate the
+                    # share with the per-worker average
+                    share += 1.0 / max(n_w, 1)
+                elif e.amount > 0 and tot > 0:
+                    share += e.amount / tot
+            best = max(best, variant.weight * share)
+        return best
+
+    i = 0
+    while i < len(batches):
+        j = i + 1
+        while j < len(batches) and batches[j].priority == batches[i].priority:
+            j += 1
+        level = batches[i:j]
+        if len(level) > 1 and any(
+            any(
+                v.weight != 1.0
+                for v in rq_map.get_variants(b.rq_id).variants
+            )
+            for b in level
+        ):
+            scored = []
+            for b in level:
+                per_task = per_task_value(b.rq_id)
+                # achievable objective: per-task value x how many could run
+                cluster_fit = _cluster_fit(b, rq_map, free, n_r)
+                scored.append(
+                    (per_task * min(b.size, cluster_fit), per_task, b)
+                )
+            scored.sort(key=lambda t: (-t[0], -t[1]))
+            batches[i:j] = [t[2] for t in scored]
+        i = j
+
+
+def _cluster_fit(batch, rq_map, free, n_r) -> int:
+    """Upper bound on how many tasks of this class fit cluster-wide now."""
+    from hyperqueue_tpu.resources.request import AllocationPolicy
+
+    best = 0
+    for variant in rq_map.get_variants(batch.rq_id).variants:
+        fit = 0
+        for w in range(free.shape[0]):
+            w_fit = 2**30
+            for e in variant.entries:
+                if e.resource_id >= n_r:
+                    w_fit = 0
+                    break
+                if e.policy is AllocationPolicy.ALL:
+                    w_fit = min(w_fit, 1)
+                elif e.amount > 0:
+                    w_fit = min(
+                        w_fit, int(free[w, e.resource_id]) // e.amount
+                    )
+            if w_fit < 2**30:
+                fit += max(w_fit, 0)
+        best = max(best, fit)
+    return best
+
+
+def _range_compress(
+    needs: np.ndarray, free: np.ndarray, total: np.ndarray | None = None
+) -> None:
     """Shift down any resource column whose values exceed int32-safe range.
 
     needs are ceil-shifted (request never shrinks to zero) and free floor-
-    shifted, so feasibility decisions stay sound (never optimistic).
+    shifted, so feasibility decisions stay sound (never optimistic). When
+    `total` is present (ALL-policy requests in this tick) it shifts with
+    free, and a partially-used pool is kept STRICTLY below its shifted total
+    so the kernel's free == total idle check can never go optimistic.
     """
     for r in range(free.shape[1]):
         peak = max(
             int(free[:, r].max(initial=0)), int(needs[:, :, r].max(initial=0))
         )
+        if total is not None:
+            peak = max(peak, int(total[:, r].max(initial=0)))
         shift = 0
         while (peak >> shift) >= MAX_SAFE_AMOUNT:
             shift += 1
@@ -83,7 +181,17 @@ def _range_compress(needs: np.ndarray, free: np.ndarray) -> None:
                 np.maximum((needs[:, :, r] + (1 << shift) - 1) >> shift, 1),
                 0,
             )
+            was_partial = (
+                free[:, r] < total[:, r] if total is not None else None
+            )
             free[:, r] >>= shift
+            if total is not None:
+                total[:, r] >>= shift
+                np.minimum(
+                    free[:, r],
+                    np.where(was_partial, total[:, r] - 1, free[:, r]),
+                    out=free[:, r],
+                )
 
 
 def run_tick(
@@ -111,6 +219,29 @@ def run_tick(
     if not batches or not workers:
         return []
 
+    # min-utilization workers take tasks all-or-nothing (enough to clear
+    # their cpu floor, or none); the dense water-fill cannot express that,
+    # so they are carved out of the main solve and each gets an exact
+    # host-side search over whatever the main solve left in the queues.
+    # Deviation from the reference (one joint MILP, solver.rs:479-518):
+    # a task never chooses BETWEEN a normal and a mu worker in one decision
+    # — mu workers only see the leftovers. The joint trade-off is restored
+    # in the MilpModel oracle.
+    mu_workers = [w for w in workers if w.cpu_floor > 0]
+    workers = [w for w in workers if w.cpu_floor <= 0]
+    if not workers:
+        return _solve_mu_workers(queues, mu_workers, rq_map, resource_map)
+    assignments = _run_main_solve(
+        queues, workers, rq_map, resource_map, model, batches
+    )
+    if mu_workers:
+        assignments.extend(
+            _solve_mu_workers(queues, mu_workers, rq_map, resource_map)
+        )
+    return assignments
+
+
+def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
     n_w = len(workers)
     n_r = len(resource_map)
     n_b = len(batches)
@@ -129,6 +260,23 @@ def run_tick(
         free = np.zeros((n_w, n_r), dtype=np.int64)
         for i, f in enumerate(free_lists):
             free[i, : len(f)] = f
+
+    # ALL-policy requests need the pool totals alongside free (the kernel's
+    # idle check); only materialized when some batch actually uses ALL
+    from hyperqueue_tpu.resources.request import AllocationPolicy
+
+    has_all = any(
+        entry.policy is AllocationPolicy.ALL
+        for b in batches
+        for variant in rq_map.get_variants(b.rq_id).variants
+        for entry in variant.entries
+    )
+    total = None
+    if has_all:
+        total = np.zeros((n_w, n_r), dtype=np.int64)
+        for i, row in enumerate(workers):
+            src = row.total if row.total is not None else row.free
+            total[i, : min(len(src), n_r)] = src[:n_r]
     nt_free = np.fromiter(
         (row.nt_free if row.nt_free > 0 else 0 for row in workers),
         dtype=np.int32,
@@ -162,7 +310,10 @@ def run_tick(
         for variant in rq_map.get_variants(batch.rq_id).variants:
             v_score = 0.0
             for entry in variant.entries:
-                if entry.amount > 0 and entry.resource_id < n_r:
+                if (
+                    entry.amount > 0
+                    or entry.policy is AllocationPolicy.ALL
+                ) and entry.resource_id < n_r:
                     s = float(weights[entry.resource_id])
                     if s > v_score:
                         v_score = s
@@ -171,21 +322,37 @@ def run_tick(
         return 0.0 if score == float("inf") else score
 
     batches.sort(key=lambda b: (b.priority, _scarcity(b)), reverse=True)
+    _apply_weight_order(batches, rq_map, free, n_r)
 
     needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
     sizes = np.zeros(n_b, dtype=np.int32)
     min_time = np.zeros((n_b, n_v), dtype=np.int32)
     min_time[:] = int(INF_TIME)  # absent variants never eligible
+    all_mask = np.zeros((n_b, n_v, n_r), dtype=np.int32) if has_all else None
     for bi, batch in enumerate(batches):
         sizes[bi] = min(batch.size, 2**30)
         variants = rq_map.get_variants(batch.rq_id).variants
         for vi, variant in enumerate(variants):
             min_time[bi, vi] = min(int(variant.min_time_secs), int(INF_TIME))
             for entry in variant.entries:
-                needs[bi, vi, entry.resource_id] = entry.amount
+                if entry.policy is AllocationPolicy.ALL:
+                    all_mask[bi, vi, entry.resource_id] = 1
+                else:
+                    needs[bi, vi, entry.resource_id] = entry.amount
 
-    _range_compress(needs, free)
+    _range_compress(needs, free, total)
     free32 = free.astype(np.int32)
+    extra = {}
+    if all_mask is not None and all_mask.any():
+        extra = {"total": total.astype(np.int32), "all_mask": all_mask}
+    w_arr = np.ones((n_b, n_v), dtype=np.float64)
+    for bi, batch in enumerate(batches):
+        for vi, variant in enumerate(rq_map.get_variants(batch.rq_id).variants):
+            w_arr[bi, vi] = variant.weight
+    if (w_arr != 1.0).any():
+        # request weights: the greedy model already consumed them through
+        # _apply_weight_order; the MILP folds them into its objective
+        extra["weights"] = w_arr
     counts = model.solve(
         free=free32,
         nt_free=nt_free,
@@ -194,6 +361,7 @@ def run_tick(
         sizes=sizes,
         min_time=min_time,
         priorities=[b.priority for b in batches],
+        **extra,
     )
 
     assignments: list[Assignment] = []
@@ -238,6 +406,191 @@ def run_tick(
         worker_id = workers[wi].worker_id
         for task_id in task_ids:
             append((task_id, worker_id, rq_id, vi))
+    return assignments
+
+
+def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):
+    """Exact all-or-nothing solve for min-utilization workers (host side).
+
+    Reference semantics (solver.rs:479-518 add_min_utilization): a worker
+    with min_utilization either receives enough cpu work to push its busy
+    cpus to at least mu x all_cpus, or receives no CPU-consuming work this
+    tick (the constraint binds only cpu-consuming variables, so zero-cpu
+    tasks — e.g. gpu-only — may land regardless). Per
+    worker, a depth-first branch-and-bound over (request class, priority,
+    variant) candidate counts maximizes the priority-lexicographic score
+    (per level: task count, or weight x resource-share value when the level
+    carries non-default request weights — mirroring the LP objective,
+    solver.rs:520-549) subject to the worker's resources and the cpu floor.
+
+    Candidates are capped at the 32 best (priority, value) classes and the
+    search at ~50k nodes — beyond that the worker just stays idle this tick
+    and retries next tick (mu workers are rare; exactness on small instances
+    matters more than scale here).
+    """
+    from hyperqueue_tpu.resources.request import AllocationPolicy
+
+    assignments: list[Assignment] = []
+    n_r = len(resource_map)
+
+    for row in sorted(mu_rows, key=lambda r: r.worker_id):
+        free0 = list(row.free[:n_r]) + [0] * (n_r - len(row.free))
+        total0 = list((row.total or row.free)[:n_r])
+        total0 += [0] * (n_r - len(total0))
+        floor = row.cpu_floor
+        nt0 = max(row.nt_free, 0)
+        if nt0 == 0:
+            continue
+
+        # --- gather candidates from the current queue state ---
+        # group = (rq_id, priority): variants of one class share the queued
+        # count, so the DFS constrains the SUM of their takes (mirrors the
+        # kernel's one `remaining` across the variant axis in scan_batches)
+        cands = []  # (priority, value, rq_id, vi, needs(R,), max_count, grp)
+        group_count: dict[tuple[int, tuple], int] = {}
+        for rq_id, queue in queues.items():
+            rqv = rq_map.get_variants(rq_id)
+            if rqv.is_multi_node:
+                continue
+            for priority, count in queue.priority_sizes():
+                if count <= 0:
+                    continue
+                group_count[(rq_id, priority)] = count
+                for vi, variant in enumerate(rqv.variants):
+                    if variant.min_time_secs > row.lifetime_secs:
+                        continue
+                    needs_vec = [0] * n_r
+                    ok = True
+                    for e in variant.entries:
+                        if e.resource_id >= n_r:
+                            ok = False
+                            break
+                        amt = (
+                            total0[e.resource_id]
+                            if e.policy is AllocationPolicy.ALL
+                            else e.amount
+                        )
+                        if e.policy is AllocationPolicy.ALL and (
+                            amt <= 0 or free0[e.resource_id] != amt
+                        ):
+                            ok = False
+                            break
+                        needs_vec[e.resource_id] = amt
+                    if not ok:
+                        continue
+                    fit = nt0
+                    for r in range(n_r):
+                        if needs_vec[r] > 0:
+                            fit = min(fit, free0[r] // needs_vec[r])
+                    if fit <= 0:
+                        continue
+                    value = variant.weight * sum(
+                        needs_vec[r] / total0[r]
+                        for r in range(n_r)
+                        if needs_vec[r] > 0 and total0[r] > 0
+                    )
+                    cands.append(
+                        (priority, value, rq_id, vi, needs_vec,
+                         min(count, fit), (rq_id, priority))
+                    )
+        if not cands:
+            continue
+        cands.sort(key=lambda c: (c[0], c[1]), reverse=True)
+        cands = cands[:32]
+        group_left0 = dict(group_count)
+
+        # priority levels and their scoring mode (count vs weighted value)
+        levels = sorted({c[0] for c in cands}, reverse=True)
+        level_of = {p: i for i, p in enumerate(levels)}
+        weighted_level = [False] * len(levels)
+        for c in cands:
+            if abs(rq_map.get_variants(c[2]).variants[c[3]].weight - 1.0) \
+                    > 1e-9:
+                weighted_level[level_of[c[0]]] = True
+
+        def task_score(c):
+            return c[1] if weighted_level[level_of[c[0]]] else 1.0
+
+        # optimistic per-level remaining score from candidate i onward
+        n_c = len(cands)
+        opt = [[0.0] * len(levels) for _ in range(n_c + 1)]
+        for i in range(n_c - 1, -1, -1):
+            opt[i] = list(opt[i + 1])
+            c = cands[i]
+            opt[i][level_of[c[0]]] += task_score(c) * c[5]
+
+        # static suffix bound on addable cpus (ignores shared resources:
+        # an over-estimate, which is what a prune needs)
+        suffix_cpu = [0] * (n_c + 1)
+        for i in range(n_c - 1, -1, -1):
+            suffix_cpu[i] = suffix_cpu[i + 1] + cands[i][4][0] * cands[i][5]
+
+        best_score: list[float] | None = None
+        best_take: list[int] | None = None
+        nodes = 0
+
+        def dfs(i, free, nt, cpu_used, score, take):
+            nonlocal best_score, best_take, nodes
+            nodes += 1
+            if nodes > 50_000:
+                return
+            # prune: even everything remaining cannot beat the best
+            if best_score is not None:
+                bound = [s + o for s, o in zip(score, opt[i])]
+                if bound <= best_score:
+                    return
+            # prune: floor unreachable even with all remaining cpus (only
+            # once cpus are committed — an all-zero-cpu completion stays
+            # feasible from cpu_used == 0)
+            if 0 < cpu_used and cpu_used + suffix_cpu[i] < floor:
+                return
+            if i == n_c:
+                # all-or-nothing applies to CPU usage (reference
+                # solver.rs:479-518 constrains only cpu-consuming variables):
+                # zero-cpu assignments (e.g. gpu-only tasks) are always
+                # allowed on a floored worker
+                if (cpu_used == 0 or cpu_used >= floor) and (
+                    best_score is None or score > best_score
+                ):
+                    best_score = list(score)
+                    best_take = list(take)
+                return
+            c = cands[i]
+            needs_vec = c[4]
+            x_max = min(c[5], nt, group_left[c[6]])
+            for r in range(n_r):
+                if needs_vec[r] > 0:
+                    x_max = min(x_max, free[r] // needs_vec[r])
+            for x in range(x_max, -1, -1):
+                if x:
+                    new_free = [
+                        free[r] - x * needs_vec[r] for r in range(n_r)
+                    ]
+                else:
+                    new_free = free
+                li = level_of[c[0]]
+                new_score = list(score)
+                new_score[li] += task_score(c) * x
+                take.append(x)
+                group_left[c[6]] -= x
+                dfs(
+                    i + 1, new_free, nt - x,
+                    cpu_used + x * needs_vec[0], new_score, take,
+                )
+                group_left[c[6]] += x
+                take.pop()
+
+        group_left = dict(group_left0)
+        dfs(0, free0, nt0, 0, [0.0] * len(levels), [])
+
+        if not best_take or not any(best_take):
+            continue
+        for c, x in zip(cands, best_take):
+            if x <= 0:
+                continue
+            priority, _value, rq_id, vi = c[0], c[1], c[2], c[3]
+            for task_id in queues.queue(rq_id).take(priority, x):
+                assignments.append((task_id, row.worker_id, rq_id, vi))
     return assignments
 
 

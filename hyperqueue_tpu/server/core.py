@@ -46,16 +46,34 @@ class Core:
                 free=w.free,
                 nt_free=w.nt_free,
                 lifetime_secs=w.lifetime_secs(),
+                total=w.resources.amounts,
+                cpu_floor=w.cpu_floor(),
             )
             for w in self.workers.values()
             if w.mn_task == 0 and w.mn_reserved == 0
         ]
 
-    def variant_amounts(self, rq_id: int, variant: int) -> list[tuple[int, int]]:
-        """[(resource_id, amount)] of the chosen variant for accounting."""
+    def variant_amounts(
+        self, rq_id: int, variant: int, worker=None
+    ) -> list[tuple[int, int]]:
+        """[(resource_id, amount)] of the chosen variant for accounting.
+
+        ALL-policy entries take the WORKER's whole pool (reference
+        solver.rs:120-124 amount_or_none_if_all), so `worker` must be passed
+        whenever the request could contain one — assign and release then
+        stay symmetric because the pool size is static per worker.
+        """
+        from hyperqueue_tpu.resources.request import AllocationPolicy
+
         rqv = self.rq_map.get_variants(rq_id)
         return [
-            (e.resource_id, e.amount)
+            (
+                e.resource_id,
+                worker.resources.amount(e.resource_id)
+                if worker is not None
+                and e.policy is AllocationPolicy.ALL
+                else e.amount,
+            )
             for e in rqv.variants[variant].entries
         ]
 

@@ -51,6 +51,7 @@ def rqv_to_wire(rqv: ResourceRequestVariants, resource_map: ResourceIdMap) -> di
             {
                 "n_nodes": v.n_nodes,
                 "min_time": v.min_time_secs,
+                "weight": v.weight,
                 "entries": [
                     {
                         "name": resource_map.name_of(e.resource_id),
@@ -89,6 +90,7 @@ def rqv_from_wire(data: dict, resource_map: ResourceIdMap) -> ResourceRequestVar
                 entries=entries,
                 n_nodes=int(v.get("n_nodes", 0)),
                 min_time_secs=float(v.get("min_time", 0.0)),
+                weight=float(v.get("weight", 1.0)),
             )
         )
     rqv = ResourceRequestVariants(variants=tuple(variants))

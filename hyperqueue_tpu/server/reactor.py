@@ -180,7 +180,9 @@ def on_task_running(
                 worker.prefilled_tasks.discard(task_id)
                 worker.assign(
                     task_id,
-                    core.variant_amounts(task.rq_id, task.assigned_variant),
+                    core.variant_amounts(
+                        task.rq_id, task.assigned_variant, worker
+                    ),
                 )
             task.prefilled = False
             task.retract_pending = False
@@ -298,7 +300,9 @@ def _release_task_resources(core: Core, task: Task) -> None:
             task.prefilled = False
             task.retract_pending = False
         elif task.task_id in worker.assigned_tasks:
-            amounts = core.variant_amounts(task.rq_id, task.assigned_variant)
+            amounts = core.variant_amounts(
+                task.rq_id, task.assigned_variant, worker
+            )
             worker.unassign(task.task_id, amounts)
     task.assigned_worker = 0
 
@@ -476,7 +480,9 @@ def schedule(
             task.state = TaskState.ASSIGNED
             task.assigned_worker = worker_id
             task.assigned_variant = variant
-            worker.assign(task_id, core.variant_amounts(rq_id, variant))
+            worker.assign(
+                task_id, core.variant_amounts(rq_id, variant, worker)
+            )
             per_worker_msgs.setdefault(worker_id, []).append(
                 _compute_message(core, task, variant)
             )

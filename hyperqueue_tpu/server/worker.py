@@ -27,6 +27,11 @@ class WorkerConfiguration:
     idle_timeout_secs: float = 0.0
     on_server_lost: str = "stop"  # stop | finish-running
     overview_interval_secs: float = 0.0
+    # Scheduler only plans tasks here while at least min_utilization x cpus
+    # would be busy afterwards — all-or-nothing per tick (reference worker
+    # configuration.rs:52, enforced in solver.rs:479-518 add_min_utilization;
+    # used by autoalloc so allocation-spawned workers pack-or-idle).
+    min_utilization: float = 0.0
     listen_address: str = ""
     # autoalloc linkage: batch manager + allocation id (HQ_ALLOC_ID env)
     manager: str = "none"
@@ -43,6 +48,7 @@ class WorkerConfiguration:
             "idle_timeout_secs": self.idle_timeout_secs,
             "on_server_lost": self.on_server_lost,
             "overview_interval_secs": self.overview_interval_secs,
+            "min_utilization": self.min_utilization,
             "listen_address": self.listen_address,
             "manager": self.manager,
             "manager_job_id": self.manager_job_id,
@@ -60,6 +66,7 @@ class WorkerConfiguration:
             idle_timeout_secs=data.get("idle_timeout_secs", 0.0),
             on_server_lost=data.get("on_server_lost", "stop"),
             overview_interval_secs=data.get("overview_interval_secs", 0.0),
+            min_utilization=data.get("min_utilization", 0.0),
             listen_address=data.get("listen_address", ""),
             manager=data.get("manager", "none"),
             manager_job_id=data.get("manager_job_id", ""),
@@ -122,6 +129,24 @@ class Worker:
             return int(INF_TIME)
         remaining = limit - (time.monotonic() - self.started_at)
         return max(int(remaining), 0)
+
+    def cpu_floor(self) -> int:
+        """Cpu fractions this tick must still fill for min_utilization.
+
+        floor = ceil(mu x all_cpus) - used_cpus = mu x all - (all - free);
+        0 for normal workers or once enough is already running (reference
+        solver.rs:493-498). Resource id 0 is the cpus column by convention
+        (reference CPU_RESOURCE_ID)."""
+        mu = self.configuration.min_utilization
+        if mu <= 0.001 or not self.free:
+            return 0
+        all_cpus = self.resources.amount(0)
+        if all_cpus <= 0:
+            return 0
+        import math
+
+        floor = math.ceil(mu * all_cpus) - (all_cpus - self.free[0])
+        return max(floor, 0)
 
     def assign(self, task_id: int, amounts: list[tuple[int, int]]) -> None:
         """amounts: [(resource_id, fraction_amount)] of the chosen variant."""

@@ -217,7 +217,8 @@ def _service(tmp_path):
     return AutoAllocService(_StubServer(), tmp_path)
 
 
-def _ready_task(core, task_seq, entries, n_nodes=0, min_time=0.0):
+def _ready_task(core, task_seq, entries, n_nodes=0, min_time=0.0,
+                policies=None):
     from hyperqueue_tpu.ids import make_task_id
     from hyperqueue_tpu.resources.request import (
         ResourceRequest,
@@ -229,9 +230,14 @@ def _ready_task(core, task_seq, entries, n_nodes=0, min_time=0.0):
     if n_nodes:
         req = ResourceRequest(n_nodes=n_nodes, min_time_secs=min_time)
     else:
+        from hyperqueue_tpu.resources.request import AllocationPolicy
+
         req = ResourceRequest(
             entries=tuple(
-                ResourceRequestEntry(core.resource_map.get_or_create(n), a)
+                ResourceRequestEntry(
+                    core.resource_map.get_or_create(n), a,
+                    policy=(policies or {}).get(n, AllocationPolicy.COMPACT),
+                )
                 for n, a in entries
             ),
             min_time_secs=min_time,
@@ -263,6 +269,25 @@ def test_demand_uses_queue_declared_resources(tmp_path):
     undeclared = AllocationQueue(2, QueueParams(manager="slurm"))
     assert service._fake_worker_demand(declared) >= 1
     assert service._fake_worker_demand(undeclared) == 0
+
+
+def test_demand_counts_all_policy_tasks(tmp_path):
+    """A queue of --cpus all tasks still generates worker demand: the ALL
+    entry (amount 0) must reach the demand solve as an all_mask, not as an
+    absent variant (scheduler/tick.py run_tick does the same)."""
+    from hyperqueue_tpu.resources.request import AllocationPolicy
+
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(
+        core, 1,
+        [("cpus", 0)],
+        policies={"cpus": AllocationPolicy.ALL},
+    )
+    queue = AllocationQueue(
+        1, QueueParams(manager="slurm", worker_args=["--cpus", "4"])
+    )
+    assert service._fake_worker_demand(queue) >= 1
 
 
 def test_mn_demand_counts_unhostable_gangs(tmp_path):

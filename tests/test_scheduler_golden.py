@@ -42,16 +42,21 @@ def _scheduler_model(request, monkeypatch):
     MODEL = _MODELS["greedy"]
 
 
-def schedule_case(workers, classes, nt_free=64, lifetimes=None):
+def schedule_case(workers, classes, nt_free=64, lifetimes=None,
+                  weights=None, mu=None, used=None):
     """Drive the PRODUCTION tick path (TaskQueues -> create_batches ->
     run_tick -> mapping) on a synthetic case.
 
     workers: [cpus] or [(cpus, extra_resource_amounts...)]; classes:
     [(priority, n_tasks, needs[, min_time_secs])] where needs is cpus or a
-    tuple per resource. Returns (per-class assigned counts, per-worker cpu
-    use, assignments)."""
+    tuple per resource, with "all" as an amount meaning the ALL policy (take
+    the worker's whole pool). Optional: `weights` — per-class request
+    weights; `mu` — per-worker min_utilization fractions; `used` — per-worker
+    cpus already busy (running tasks). Returns (per-class assigned counts,
+    per-worker cpu use, assignments)."""
     from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
     from hyperqueue_tpu.resources.request import (
+        AllocationPolicy,
         ResourceRequest,
         ResourceRequestEntry,
         ResourceRequestVariants,
@@ -78,13 +83,19 @@ def schedule_case(workers, classes, nt_free=64, lifetimes=None):
     for ci, cls in enumerate(classes):
         req = cls[2] if isinstance(cls[2], tuple) else (cls[2],)
         entries = tuple(
-            ResourceRequestEntry(r, int(a * U))
+            ResourceRequestEntry(r, 0, policy=AllocationPolicy.ALL)
+            if a == "all"
+            else ResourceRequestEntry(r, int(a * U))
             for r, a in enumerate(req)
             if a
         )
         min_time = float(cls[3]) if len(cls) > 3 else 0.0
         rqv = ResourceRequestVariants.single(
-            ResourceRequest(entries=entries, min_time_secs=min_time)
+            ResourceRequest(
+                entries=entries,
+                min_time_secs=min_time,
+                weight=weights[ci] if weights else 1.0,
+            )
         )
         rq_id = rq_map.get_or_create(rqv)
         class_rq.append(rq_id)
@@ -97,30 +108,50 @@ def schedule_case(workers, classes, nt_free=64, lifetimes=None):
     free = np.zeros((len(workers), n_r), dtype=np.int64)
     for i, w in enumerate(workers):
         amounts = w if isinstance(w, tuple) else (w,)
-        row_free = [0] * n_r
+        row_total = [0] * n_r
         for r, a in enumerate(amounts):
-            row_free[r] = a * U
-            free[i, r] = a * U
+            row_total[r] = a * U
+        row_free = list(row_total)
+        if used is not None and used[i]:
+            row_free[0] -= used[i] * U
+        free[i] = row_free
         life = lifetimes[i] if lifetimes is not None else INF
+        floor = 0
+        if mu is not None and mu[i] > 0:
+            floor = max(
+                int(-(-mu[i] * row_total[0] // 1))
+                - (row_total[0] - row_free[0]),
+                0,
+            )
         rows.append(
             WorkerRow(
                 worker_id=i + 1,
                 free=row_free,
                 nt_free=nt_free,
                 lifetime_secs=int(life),
+                total=row_total,
+                cpu_floor=floor,
             )
         )
 
     assignments = run_tick(queues, rows, rq_map, resource_map, MODEL)
 
     per_class = [0] * len(classes)
-    used = np.zeros((len(workers), n_r), dtype=np.int64)
+    used_m = np.zeros((len(workers), n_r), dtype=np.int64)
+    totals = np.array(
+        [r.total for r in rows], dtype=np.int64
+    )
     for task_id, worker_id, rq_id, variant in assignments:
         per_class[class_of[task_id]] += 1
         for e in rq_map.get_variants(rq_id).variants[variant].entries:
-            used[worker_id - 1, e.resource_id] += e.amount
-    assert (used <= free).all(), "capacity violated"
-    per_worker_cpu = (used[:, 0] // U).tolist()
+            amt = (
+                totals[worker_id - 1, e.resource_id]
+                if e.policy is AllocationPolicy.ALL
+                else e.amount
+            )
+            used_m[worker_id - 1, e.resource_id] += amt
+    assert (used_m <= free).all(), "capacity violated"
+    per_worker_cpu = (used_m[:, 0] // U).tolist()
     return per_class, per_worker_cpu, assignments
 
 
@@ -511,3 +542,138 @@ def test_reservation_levels_do_not_block_distinct_workers():
     assert env.state(p4) is TaskState.ASSIGNED
     # at least one small task fills a remaining gap
     assert any(env.state(t) is TaskState.ASSIGNED for t in p2s)
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:1354/1373 test_schedule_resource_weights1/2
+# ---------------------------------------------------------------------------
+
+def test_resource_weights_density_decides_level():
+    # ref weights1: t1 3cpu w1.0 vs t2 2cpu w1.49 on w4 — density
+    # (weight x cpus/total) 0.75 beats 0.745, t1 wins the worker
+    got, _, _ = schedule_case(
+        [4], [(0, 1, 3), (0, 1, 2)], weights=[1.0, 1.49]
+    )
+    assert got == [1, 0]
+    got, _, _ = schedule_case(
+        [4], [(0, 1, 3), (0, 1, 2)], weights=[1.0, 1.51]
+    )
+    assert got == [0, 1]
+
+
+def test_resource_weights_joint_vs_all_policy():
+    # ref weights2: 5x 3cpu w1.1 vs one cpus=all on w12 — the achievable
+    # joint objective 4 x 0.275 = 1.1 beats the all-task's 1.0
+    got, _, _ = schedule_case(
+        [12], [(0, 5, 3), (0, 1, "all")], weights=[1.1, 1.0]
+    )
+    assert got == [4, 0]
+    # flipped: the weighted all-task (1.1) beats 4 x 0.25 = 1.0
+    got, _, _ = schedule_case(
+        [12], [(0, 5, 3), (0, 1, "all")], weights=[1.0, 1.1]
+    )
+    assert got == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_sn.rs:1392/1414/1447 test_schedule_min_utilization1-3
+# ---------------------------------------------------------------------------
+
+def test_min_utilization1_all_or_nothing():
+    # 2x3cpu cannot reach 9/9 busy -> nothing; 3x3cpu exactly can
+    got, _, _ = schedule_case([9], [(0, 2, 3)], mu=[1.0])
+    assert got == [0]
+    got, _, _ = schedule_case([9], [(0, 3, 3)], mu=[1.0])
+    assert got == [3]
+    # a task already running lowers the floor: 3 used + 2x3 = 9
+    got, _, _ = schedule_case([9], [(0, 2, 3)], mu=[1.0], used=[3])
+    assert got == [2]
+
+
+def test_min_utilization2_thresholds():
+    for mu, n, expect in [
+        (0.5, 2, 2),    # 6/12 >= 0.5
+        (0.51, 2, 0),   # 6/12 < 0.51 -> nothing
+        (0.51, 3, 3),   # 9/12 >= 0.51
+        (0.75, 3, 3),   # 9/12 >= 0.75
+        (0.76, 3, 0),   # 9/12 < 0.76 -> nothing
+    ]:
+        got, _, _ = schedule_case([12], [(0, n, 3)], mu=[mu])
+        assert got == [expect], (mu, n, got)
+
+
+def test_min_utilization3_weights_and_all_policy():
+    # 3x3cpu w2.0 cannot fill 12/12 -> the all-task runs instead
+    got, _, _ = schedule_case(
+        [12], [(0, 3, 3), (0, 1, "all")], weights=[2.0, 1.0], mu=[1.0]
+    )
+    assert got == [0, 1]
+    # with 4 the weighted class fills the worker exactly and wins
+    got, _, _ = schedule_case(
+        [12], [(0, 4, 3), (0, 1, "all")], weights=[2.0, 1.0], mu=[1.0]
+    )
+    assert got == [4, 0]
+
+
+def test_all_policy_requires_idle_pool():
+    # an ALL task only fits a fully idle pool: the half-used worker is
+    # skipped, the idle one drained whole
+    got, per_w, _ = schedule_case(
+        [8, 8], [(0, 2, "all")], used=[3, 0]
+    )
+    assert got == [1]
+    assert per_w == [0, 8]
+
+
+def test_min_utilization_multivariant_counts_shared():
+    """Variants of one class share the queued count in the mu solve (the
+    kernel's one `remaining` across the V axis): with a SINGLE queued task
+    whose variants are 4cpu-or-2gpu, a mu worker must not double-plan it to
+    clear its floor."""
+    from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.scheduler.queues import TaskQueues
+    from hyperqueue_tpu.scheduler.tick import WorkerRow, run_tick
+
+    rmap = ResourceIdMap()
+    rmap.get_or_create("cpus")
+    rmap.get_or_create("gpus")
+    rq_map = ResourceRqMap()
+    rqv = ResourceRequestVariants(
+        variants=(
+            ResourceRequest(entries=(ResourceRequestEntry(0, 4 * U),)),
+            ResourceRequest(
+                entries=(
+                    ResourceRequestEntry(0, 2 * U),
+                    ResourceRequestEntry(1, 2 * U),
+                )
+            ),
+        )
+    )
+    rq = rq_map.get_or_create(rqv)
+    queues = TaskQueues()
+    queues.add(rq, (0, 0), 1)  # ONE task
+    rows = [
+        WorkerRow(worker_id=1, free=[8 * U, 4 * U], nt_free=64,
+                  lifetime_secs=INF, total=[8 * U, 4 * U],
+                  cpu_floor=6 * U),  # needs 6 cpus busy
+    ]
+    got = run_tick(queues, rows, rq_map, rmap, MODEL)
+    # one task brings at most 4 cpus — the floor (6) is unreachable, so the
+    # worker takes NOTHING; double-planning the two variants of the single
+    # task would wrongly count 4+2 = 6 toward the floor and assign it
+    assert got == []
+
+
+def test_min_utilization_zero_cpu_tasks_always_allowed():
+    """The floor binds only cpu-consuming work (reference solver.rs:479-518
+    constrains cpu variables only): a gpu-only task lands on a floored
+    worker even while its cpu floor is unmet."""
+    got, _, _ = schedule_case(
+        [(8, 4)], [(0, 1, (0, 2))], mu=[1.0]
+    )
+    assert got == [1]
