@@ -174,6 +174,25 @@ class AutoAllocService:
         self._queue_descriptors[queue.queue_id] = base
         return base
 
+    @staticmethod
+    def _queue_min_utilization(queue) -> float:
+        """min_utilization the queue's spawned workers will carry (parsed
+        from worker args like the descriptor; reference WorkerTypeQuery
+        carries it explicitly, query.rs + test_query.rs:273-342)."""
+        args = list(queue.params.worker_args or [])
+        for i, arg in enumerate(args):
+            if arg == "--min-utilization" and i + 1 < len(args):
+                try:
+                    return float(args[i + 1])
+                except ValueError:
+                    return 0.0
+            if arg.startswith("--min-utilization="):
+                try:
+                    return float(arg.split("=", 1)[1])
+                except ValueError:
+                    return 0.0
+        return 0.0
+
     def _fake_worker_demand(self, queue) -> int:
         """How many NEW single-node workers would receive load right now?
 
@@ -193,7 +212,13 @@ class AutoAllocService:
         fake_resources = WorkerResources.from_descriptor(
             self._queue_worker_descriptor(queue), core.resource_map
         )
-        rows = core.worker_rows()
+        # Real min-utilization workers are carved out of the production
+        # solve and may leave ANY load unserved (all-or-nothing floors,
+        # scheduler/tick.py run_tick) — counting their capacity here would
+        # absorb demand that production won't serve and starve the queue,
+        # so the demand estimate drops them (conservative: may spawn a
+        # worker a mu-host would in fact have taken).
+        rows = [r for r in core.worker_rows() if r.cpu_floor <= 0]
         first_fake = len(rows)
         for i in range(n_fake):
             rows.append(
@@ -258,7 +283,23 @@ class AutoAllocService:
             priorities=[b.priority for b in batches],
             **extra,
         )
-        fake_load = np.asarray(counts).sum(axis=(0, 1))[first_fake:]
+        counts = np.asarray(counts)
+        fake_load = counts.sum(axis=(0, 1))[first_fake:]
+        mu = self._queue_min_utilization(queue)
+        if mu > 0.001:
+            # a projected worker is only worth spawning if the work it
+            # would attract keeps it above its utilization floor (reference
+            # query.rs min_utilization, test_query.rs:273-342)
+            cpu_fr = np.einsum(
+                "bvw,bv->w", counts[:, :, first_fake:], needs[:, :, 0]
+            ).astype(np.float64)
+            # an ALL-policy cpu task occupies the whole pool (its needs
+            # row is zero; the amount lives in the mask)
+            cpu_fr += np.einsum(
+                "bvw,bv->w", counts[:, :, first_fake:], all_mask[:, :, 0]
+            ) * float(fake_resources.amounts[0])
+            floor = mu * float(fake_resources.amounts[0])
+            return int((cpu_fr >= floor).sum())
         return int((fake_load > 0).sum())
 
     def _mn_demand(self, queue) -> list[int]:

@@ -69,91 +69,6 @@ def create_batches(queues: TaskQueues) -> list[Batch]:
     return batches
 
 
-def _apply_weight_order(batches, rq_map, free, n_r) -> None:
-    """Re-order same-priority runs whose classes carry non-default request
-    weights (reference request.rs:137 ResourceWeight, consumed by the LP
-    objective in solver.rs:520-549).
-
-    The reference maximizes sum(weight x resource-share) jointly per level;
-    the greedy equivalent is to take classes in descending ACHIEVABLE
-    objective: per-task value = weight x sum_r(amount_r / cluster_total_r),
-    capped by how many tasks could fit cluster-wide right now. Levels where
-    every class has weight 1.0 (the overwhelmingly common case) keep the
-    scarcity order the kernel's golden tests pin.
-    """
-    from hyperqueue_tpu.resources.request import AllocationPolicy
-
-    totals = np.maximum(free, 0).sum(axis=0)  # (R,) cluster-wide
-    n_w = free.shape[0]
-
-    def per_task_value(rq_id: int) -> float:
-        best = 0.0
-        for variant in rq_map.get_variants(rq_id).variants:
-            share = 0.0
-            for e in variant.entries:
-                if e.resource_id >= n_r:
-                    continue
-                tot = float(totals[e.resource_id])
-                if e.policy is AllocationPolicy.ALL:
-                    # amount is the worker's whole pool; approximate the
-                    # share with the per-worker average
-                    share += 1.0 / max(n_w, 1)
-                elif e.amount > 0 and tot > 0:
-                    share += e.amount / tot
-            best = max(best, variant.weight * share)
-        return best
-
-    i = 0
-    while i < len(batches):
-        j = i + 1
-        while j < len(batches) and batches[j].priority == batches[i].priority:
-            j += 1
-        level = batches[i:j]
-        if len(level) > 1 and any(
-            any(
-                v.weight != 1.0
-                for v in rq_map.get_variants(b.rq_id).variants
-            )
-            for b in level
-        ):
-            scored = []
-            for b in level:
-                per_task = per_task_value(b.rq_id)
-                # achievable objective: per-task value x how many could run
-                cluster_fit = _cluster_fit(b, rq_map, free, n_r)
-                scored.append(
-                    (per_task * min(b.size, cluster_fit), per_task, b)
-                )
-            scored.sort(key=lambda t: (-t[0], -t[1]))
-            batches[i:j] = [t[2] for t in scored]
-        i = j
-
-
-def _cluster_fit(batch, rq_map, free, n_r) -> int:
-    """Upper bound on how many tasks of this class fit cluster-wide now."""
-    from hyperqueue_tpu.resources.request import AllocationPolicy
-
-    best = 0
-    for variant in rq_map.get_variants(batch.rq_id).variants:
-        fit = 0
-        for w in range(free.shape[0]):
-            w_fit = 2**30
-            for e in variant.entries:
-                if e.resource_id >= n_r:
-                    w_fit = 0
-                    break
-                if e.policy is AllocationPolicy.ALL:
-                    w_fit = min(w_fit, 1)
-                elif e.amount > 0:
-                    w_fit = min(
-                        w_fit, int(free[w, e.resource_id]) // e.amount
-                    )
-            if w_fit < 2**30:
-                fit += max(w_fit, 0)
-        best = max(best, fit)
-    return best
-
-
 def _range_compress(
     needs: np.ndarray, free: np.ndarray, total: np.ndarray | None = None
 ) -> None:
@@ -321,8 +236,51 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
                 score = v_score
         return 0.0 if score == float("inf") else score
 
-    batches.sort(key=lambda b: (b.priority, _scarcity(b)), reverse=True)
-    _apply_weight_order(batches, rq_map, free, n_r)
+    totals_by_r = np.maximum(free, 0).sum(axis=0)
+
+    def _objective(batch: Batch) -> tuple[float, float]:
+        """Within equal scarcity, emulate the reference LP objective
+        (solver.rs:528-546): classes are taken in descending ACHIEVABLE
+        share value — weight x per-task share-density x how many could run
+        now (aggregate upper bound, O(R)) — with equal-value ties going to
+        the smaller per-task ask (more tasks fit; the reference LP is
+        indifferent and its worker-order bonus resolves the same way).
+        Request weights (request.rs:137 ResourceWeight) scale the value, so
+        `--weight` biases which equal-scarcity class wins. Pinned by golden
+        multiple_resources2 / generic_resource_assign2 /
+        generic_resource_balance2 / resource_weights1-2."""
+        best = (0.0, 0.0)
+        for variant in rq_map.get_variants(batch.rq_id).variants:
+            share = 0.0
+            fit = float("inf")
+            for entry in variant.entries:
+                if entry.resource_id >= n_r:
+                    fit = 0.0
+                    break
+                tot = float(totals_by_r[entry.resource_id])
+                if entry.policy is AllocationPolicy.ALL:
+                    # amount is the worker's whole pool; approximate the
+                    # share with the per-worker average
+                    share += 1.0 / max(n_w, 1)
+                    fit = min(fit, float(n_w))
+                elif entry.amount > 0:
+                    if tot <= 0:
+                        fit = 0.0
+                        break
+                    share += entry.amount / tot
+                    fit = min(fit, tot // entry.amount)
+            if fit == float("inf"):
+                fit = 0.0
+            value = variant.weight * share
+            cand = (value * min(batch.size, fit), -value)
+            if cand > best:
+                best = cand
+        return best
+
+    batches.sort(
+        key=lambda b: (b.priority, _scarcity(b), _objective(b)),
+        reverse=True,
+    )
 
     needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
     sizes = np.zeros(n_b, dtype=np.int32)
@@ -351,7 +309,7 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
             w_arr[bi, vi] = variant.weight
     if (w_arr != 1.0).any():
         # request weights: the greedy model already consumed them through
-        # _apply_weight_order; the MILP folds them into its objective
+        # the batch-order objective; the MILP folds them into its own
         extra["weights"] = w_arr
     counts = model.solve(
         free=free32,

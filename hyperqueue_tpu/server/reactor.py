@@ -339,6 +339,21 @@ def _top_sn_priority(core: Core) -> Priority_t | None:
     return best
 
 
+def _sn_runnable_on(core: Core, above_user_priority: int, workers) -> bool:
+    """Is some ready single-node class with user priority strictly above
+    `above_user_priority` runnable on one of these (idle) workers right
+    now? (User-priority comparison only — the tuple's second component is
+    -job_id and an older job must not permanently outrank a gang.)"""
+    for rq_id, queue in core.queues.items():
+        sizes = queue.priority_sizes()
+        if not any(p[0] > above_user_priority for p, n in sizes if n > 0):
+            continue
+        rqv = core.rq_map.get_variants(rq_id)
+        if any(w.resources.is_capable_of_rqv(rqv) for w in workers):
+            return True
+    return False
+
+
 def _clear_mn_reservations(core: Core, task_id: int) -> None:
     for w in core.workers.values():
         if w.mn_reserved == task_id:
@@ -396,6 +411,17 @@ def schedule(
                     )
                     chosen = idle[:n_nodes]
                     break
+            if (
+                chosen is not None
+                and top_sn is not None
+                and top_sn[0] > task.priority[0]
+                and _sn_runnable_on(core, task.priority[0], chosen)
+            ):
+                # strictly-higher-priority single-node work can use these
+                # workers: it goes first this tick (the reference MILP
+                # blocks the gang the same way, solver.rs:479-518); the
+                # gang retries on what the sn solve leaves idle
+                chosen = None
             if chosen is None:
                 remaining_mn.append(task_id)
                 # user-priority comparison only: the scheduler component of

@@ -208,6 +208,9 @@ class _IndexPool:
     def total_free(self) -> int:
         return len(self.free) * FRACTIONS_PER_UNIT + sum(self.partial.values())
 
+    def capacity(self) -> int:
+        return sum(len(g) for g in self.groups) * FRACTIONS_PER_UNIT
+
     def group_free_state(self) -> list[tuple[int, int]]:
         """(whole_free_units, max_partial_fraction) per group — the f/g
         columns of the group solver (reference concise.rs amount_max_per_group)."""
@@ -271,11 +274,24 @@ class _IndexPool:
                 key=lambda l: (pref(l), counts[self.group_of[l]],
                                self.group_of[l], l),
             )
-        # compact/default: prefer groups with the MOST free indices so the
-        # allocation lands in as few groups as possible
+        # compact/default: when some group can hold the whole request,
+        # BEST-FIT — the tightest such group first, preserving large holes
+        # for future big requests (reference test_pool_compact1: two 3-cpu
+        # tasks share one socket while an untouched one stays whole).
+        # When no group fits, span as few groups as possible by taking
+        # the fullest-free groups first.
+        fits = any(c >= n_units for c in counts.values())
+
+        def group_key(gi: int) -> tuple:
+            c = counts[gi]
+            if fits:
+                # groups that fit, tightest first; too-small groups last
+                return (0, c) if c >= n_units else (1, -c)
+            return (0, -c)
+
         return sorted(
             self.free,
-            key=lambda l: (pref(l), -counts[self.group_of[l]],
+            key=lambda l: (pref(l), group_key(self.group_of[l]),
                            self.group_of[l], l),
         )
 
@@ -306,9 +322,12 @@ class _IndexPool:
         need = units + (1 if fraction and not has_partial_donor else 0)
         if sum(1 for l in self.free if in_mask(l)) < need:
             return None
+        # best-fit group choice must count the fresh index a fractional
+        # remainder will break (`need`, not `units`) — otherwise a 2.5-unit
+        # request picks a 2-free group and splits the donor into another
         ordered = [
             l
-            for l in self._ordered_free(policy, units, preferred_groups)
+            for l in self._ordered_free(policy, need, preferred_groups)
             if in_mask(l)
         ]
         if group_mask is None and policy is AllocationPolicy.FORCE_COMPACT:
@@ -332,9 +351,15 @@ class _IndexPool:
         for label in taken:
             self.free.remove(label)
         if fraction:
-            # prefer an already-partial index with enough remaining
+            # prefer an already-partial index with enough remaining, in a
+            # group the whole units already use (compactness)
+            taken_groups = {self.group_of[l] for l in taken}
             donor = None
-            for label, remaining in sorted(self.partial.items()):
+            for label, remaining in sorted(
+                self.partial.items(),
+                key=lambda kv: (self.group_of[kv[0]] not in taken_groups,
+                                kv[0]),
+            ):
                 if in_mask(label) and remaining >= fraction:
                     donor = label
                     break
@@ -368,7 +393,11 @@ class _IndexPool:
 
 
 class _SumPool:
+    def capacity(self) -> int:
+        return self.size
+
     def __init__(self, size: int):
+        self.size = size
         self.free = size
 
     def total_free(self) -> int:
@@ -485,12 +514,14 @@ class ResourceAllocator:
             policy = AllocationPolicy.parse(entry.get("policy", "compact"))
             # cheap infeasibility gate — failed attempts dominate on
             # saturated workers (every release retries the blocked queue).
-            # ALL ignores the amount (grabs whatever the pool has), so it
-            # must not be gated on it.
-            if (
-                policy is not AllocationPolicy.ALL
-                and pool.total_free() < int(entry["amount"])
-            ):
+            # ALL ignores the amount and takes the ENTIRE pool, which must
+            # be untouched (reference test_allocator.rs:260-280: after one
+            # cpu is taken an `all` request fails; the scheduler kernel's
+            # free == total check mirrors this).
+            if policy is AllocationPolicy.ALL:
+                if pool.total_free() < pool.capacity():
+                    return None
+            elif pool.total_free() < int(entry["amount"]):
                 return None
             plan.append((entry, pool, policy))
             if (

@@ -353,3 +353,275 @@ def test_force_compact_large_group_count_no_starvation():
     assert a is not None
     assert len({alloc.pools["cpus"].group_of[i]
                 for i in a.claim_for("cpus").indices}) == 1
+
+
+# ---------------------------------------------------------------------------
+# Direct transliterations of the remaining reference cases
+# (crates/tako/src/internal/worker/resources/test_allocator.rs)
+# ---------------------------------------------------------------------------
+
+def _sockets(n, size, name="cpus"):
+    return ResourceDescriptorItem.group_list(
+        name, [[str(s * size + i) for i in range(size)] for s in range(n)]
+    )
+
+
+def _alloc_of(*items):
+    return ResourceAllocator(ResourceDescriptor(items=tuple(items)))
+
+
+def _socks(al, a, name="cpus"):
+    c = a.claim_for(name)
+    idx = list(c.indices) + ([c.fraction_index] if c.fraction_index else [])
+    return {al.pools[name].group_of[i] for i in idx}
+
+
+def test_pool_compact1():
+    # ref test_allocator.rs:184 — best-fit keeps whole sockets whole
+    al = _alloc_of(_sockets(4, 6))
+    s1 = _socks(al, al.try_allocate([entry("cpus", 4 * U)]))
+    s2 = _socks(al, al.try_allocate([entry("cpus", 4 * U)]))
+    s3 = _socks(al, al.try_allocate([entry("cpus", 3 * U)]))
+    s4 = _socks(al, al.try_allocate([entry("cpus", 3 * U)]))
+    assert len(s1) == len(s2) == len(s3) == len(s4) == 1
+    assert s1 != s2 and s3 == s4 and s3 not in (s1, s2)
+    for n, expected_sockets in [(6, 1), (7, 2), (8, 2), (9, 3)]:
+        a = al.try_allocate([entry("cpus", n * U)])
+        assert len(_socks(al, a)) == expected_sockets, n
+        al.release(a)
+
+
+def test_pool_allocate_compact_all():
+    # ref test_allocator.rs:240
+    al = _alloc_of(_sockets(4, 6))
+    a = al.try_allocate([entry("cpus", 24 * U)])
+    assert len(a.claim_for("cpus").indices) == 24
+    assert al.pools["cpus"].total_free() == 0
+    al.release(a)
+    assert al.pools["cpus"].total_free() == 24 * U
+
+
+def test_pool_allocate_all_then_partial():
+    # ref test_allocator.rs:260
+    al = _alloc_of(_sockets(4, 6))
+    a = al.try_allocate([entry("cpus", 0, "all")])
+    assert len(a.claim_for("cpus").indices) == 24
+    assert al.pools["cpus"].total_free() == 0
+    al.release(a)
+    assert al.pools["cpus"].total_free() == 24 * U
+    assert al.try_allocate([entry("cpus", 1 * U)]) is not None
+    # ALL needs the whole pool back
+    assert al.try_allocate([entry("cpus", 0, "all")]) is None
+
+
+def test_pool_force_compact1():
+    # ref test_allocator.rs:284 — 2 sockets x 4
+    al = _alloc_of(_sockets(2, 4))
+    assert al.try_allocate([entry("cpus", 9 * U, "compact!")]) is None
+    for _ in range(4):
+        a = al.try_allocate([entry("cpus", 2 * U, "compact!")])
+        assert len(a.claim_for("cpus").indices) == 2
+        assert len(_socks(al, a)) == 1
+    assert al.try_allocate([entry("cpus", 2 * U, "compact!")]) is None
+
+
+def test_pool_force_compact2():
+    # ref test_allocator.rs:303
+    al = _alloc_of(_sockets(2, 4))
+    for _ in range(2):
+        a = al.try_allocate([entry("cpus", 3 * U, "compact!")])
+        assert len(a.claim_for("cpus").indices) == 3
+        assert len(_socks(al, a)) == 1
+    # 2 more would need one index from each socket: forced compact refuses
+    assert al.try_allocate([entry("cpus", 2 * U, "compact!")]) is None
+    # plain compact accepts the split
+    assert al.try_allocate([entry("cpus", 2 * U)]) is not None
+
+
+def test_pool_force_compact3():
+    # ref test_allocator.rs:324 — minimal socket count at larger sizes
+    al = _alloc_of(_sockets(3, 4))
+    for n, expected_sockets in [(8, 2), (5, 2), (10, 3)]:
+        a = al.try_allocate([entry("cpus", n * U, "compact!")])
+        assert len(a.claim_for("cpus").indices) == n
+        assert len(_socks(al, a)) == expected_sockets
+        al.release(a)
+
+
+def test_pool_force_scatter1():
+    # ref test_allocator.rs:351 — scatter spreads as widely as possible
+    al = _alloc_of(_sockets(3, 4))
+    a = al.try_allocate([entry("cpus", 3 * U, "scatter")])
+    assert len(_socks(al, a)) == 3
+    a = al.try_allocate([entry("cpus", 4 * U, "scatter")])
+    assert len(_socks(al, a)) == 3
+    a = al.try_allocate([entry("cpus", 2 * U, "scatter")])
+    assert len(_socks(al, a)) == 2
+
+
+def test_pool_force_scatter2():
+    # ref test_allocator.rs:374 — scatter over what remains
+    al = _alloc_of(_sockets(3, 4))
+    al.try_allocate([entry("cpus", 4 * U, "compact!")])
+    a = al.try_allocate([entry("cpus", 5 * U, "scatter")])
+    assert len(a.claim_for("cpus").indices) == 5
+    assert len(_socks(al, a)) == 2
+
+
+def test_pool_generic_resources_mix():
+    # ref test_allocator.rs:390 — five pools of three kinds in one request
+    al = _alloc_of(
+        _sockets(1, 4),
+        ResourceDescriptorItem.range("res0", 5, 100),
+        ResourceDescriptorItem.sum("res1", 100_000_000 * U),
+        ResourceDescriptorItem.list("res2", ["0", "1"]),
+        ResourceDescriptorItem.list("res3", ["0", "1"]),
+    )
+    a = al.try_allocate([
+        entry("cpus", 1 * U),
+        entry("res0", 12 * U),
+        entry("res1", 1_000_000 * U),
+        entry("res3", 1 * U),
+    ])
+    assert a is not None
+    assert len(a.claim_for("res0").indices) == 12
+    assert a.claim_for("res1").sum_amount == 1_000_000 * U
+    assert len(a.claim_for("res3").indices) == 1
+    assert al.pools["res0"].total_free() == 84 * U
+    assert al.pools["res1"].total_free() == 99_000_000 * U
+    assert al.pools["res2"].total_free() == 2 * U
+    assert al.pools["res3"].total_free() == 1 * U
+    rq = [entry("cpus", 1 * U), entry("res3", 2 * U)]
+    assert al.try_allocate(rq) is None
+    al.release(a)
+    assert al.pools["res0"].total_free() == 96 * U
+    assert al.pools["res1"].total_free() == 100_000_000 * U
+    assert al.pools["res3"].total_free() == 2 * U
+    assert al.try_allocate(rq) is not None
+
+
+def test_allocator_sum_max_fractions():
+    # ref test_allocator.rs:484 — a 0.03-unit sum pool
+    al = _alloc_of(ResourceDescriptorItem.sum("cpus", 300))
+    assert al.try_allocate([entry("cpus", U)]) is None
+    assert al.try_allocate([entry("cpus", 301)]) is None
+    assert al.try_allocate([entry("cpus", 250)]) is not None
+
+
+def test_allocator_indices_and_fractions():
+    # ref test_allocator.rs:510 — whole indices plus one fractional donor
+    al = _alloc_of(_sockets(1, 4))
+    assert al.try_allocate([entry("cpus", 4 * U + 1)]) is None
+    a1 = al.try_allocate([entry("cpus", 2 * U + 1500)])
+    c1 = a1.claim_for("cpus")
+    assert len(c1.indices) == 2 and c1.fraction == 1500
+    a2 = al.try_allocate([entry("cpus", 5200)])
+    c2 = a2.claim_for("cpus")
+    # the second fractional share re-uses a1's donor index (5200+1500 < 1)
+    assert c2.fraction_index == c1.fraction_index
+    a3 = al.try_allocate([entry("cpus", 5200)])
+    assert a3.claim_for("cpus").fraction_index != c1.fraction_index
+    assert al.try_allocate([entry("cpus", 5200)]) is None
+    al.release(a1)
+    assert al.pools["cpus"].total_free() == 2 * U + 9600
+    al.release(a3)
+    al.release(a2)
+    assert al.pools["cpus"].total_free() == 4 * U
+
+
+def test_allocator_fractions_compactness():
+    # ref test_allocator.rs:568 — two 0.75 holes do not make a 1.5
+    al = _alloc_of(_sockets(1, 2))
+    a1 = al.try_allocate([entry("cpus", 7500)])
+    a2 = al.try_allocate([entry("cpus", 7500)])
+    a3 = al.try_allocate([entry("cpus", 2500)])
+    a4 = al.try_allocate([entry("cpus", 2500)])
+    assert a1 and a2 and a3 and a4
+    assert al.pools["cpus"].total_free() == 0
+    al.release(a1)
+    al.release(a2)
+    assert al.pools["cpus"].total_free() == U + 5000
+    assert al.try_allocate([entry("cpus", U + 5000)]) is None
+    al.release(a4)
+    a5 = al.try_allocate([entry("cpus", U + 5000)])
+    assert a5 is not None
+    al.release(a3)
+    al.release(a5)
+    assert al.pools["cpus"].total_free() == 2 * U
+
+
+def test_allocator_groups_and_fractions_scatter():
+    # ref test_allocator.rs:611 — scattered 2.5 allocations share a donor
+    al = _alloc_of(_sockets(3, 2))
+    assert al.try_allocate([entry("cpus", 6 * U + 1, "scatter")]) is None
+    a1 = al.try_allocate([entry("cpus", 2 * U + 5000, "scatter")])
+    a2 = al.try_allocate([entry("cpus", 2 * U + 5000, "scatter")])
+    c1, c2 = a1.claim_for("cpus"), a2.claim_for("cpus")
+    assert c1.fraction == 5000 and c2.fraction == 5000
+    g = al.pools["cpus"].group_of
+    assert g[c1.fraction_index] == g[c2.fraction_index]
+    al.release(a1)
+    al.release(a2)
+    assert al.pools["cpus"].total_free() == 6 * U
+
+
+def test_allocator_sum_fractions():
+    # ref test_allocator.rs:717 — fractional arithmetic on a sum pool
+    al = _alloc_of(ResourceDescriptorItem.sum("cpus", 2 * U))
+    assert al.try_allocate([entry("cpus", 2 * U + 3000)]) is None
+    a1 = al.try_allocate([entry("cpus", U + 3000)])
+    assert a1.claim_for("cpus").sum_amount == U + 3000
+    assert al.try_allocate([entry("cpus", 7001)]) is None
+    a2 = al.try_allocate([entry("cpus", 7000)])
+    assert a2 is not None
+    al.release(a1)
+    assert al.try_allocate([entry("cpus", 2 * U)]) is None
+    assert al.try_allocate([entry("cpus", U + 3001)]) is None
+    a3 = al.try_allocate([entry("cpus", U)])
+    a4 = al.try_allocate([entry("cpus", 2000)])
+    assert a3 and a4
+    al.release(a4)
+    assert al.pools["cpus"].total_free() == 3000
+    al.release(a2)
+    al.release(a3)
+    assert al.pools["cpus"].total_free() == 2 * U
+
+
+def test_compact_scattering():
+    # ref test_allocator.rs:1039 — 6 from 4x4 sockets splits 3 + 3
+    al = _alloc_of(_sockets(4, 4))
+    a = al.try_allocate([entry("cpus", 6 * U)])
+    c = a.claim_for("cpus")
+    groups = [al.pools["cpus"].group_of[i] for i in c.indices]
+    assert len(c.indices) == 6
+    assert len(set(groups)) == 2
+
+
+def test_tight_scattering():
+    # ref test_allocator.rs:1056 — tight fills one socket whole, 4 + 2
+    al = _alloc_of(_sockets(4, 4))
+    a = al.try_allocate([entry("cpus", 6 * U, "tight")])
+    c = a.claim_for("cpus")
+    groups = [al.pools["cpus"].group_of[i] for i in c.indices]
+    assert len(set(groups)) == 2
+    from collections import Counter
+
+    assert sorted(Counter(groups).values()) == [2, 4]
+
+
+def test_all_policy_sum_pool_requires_untouched():
+    al = _alloc_of(ResourceDescriptorItem.sum("mem", 10 * U))
+    hold = al.try_allocate([entry("mem", 1 * U)])
+    assert al.try_allocate([entry("mem", 0, "all")]) is None
+    al.release(hold)
+    a = al.try_allocate([entry("mem", 0, "all")])
+    assert a is not None and a.claim_for("mem").sum_amount == 10 * U
+
+
+def test_best_fit_counts_fraction_donor():
+    """A 2.5-unit compact request needs THREE indices; the 2-free socket
+    must not be chosen as the best fit (review regression)."""
+    al = _alloc_of(_sockets(2, 4))
+    hold = al.try_allocate([entry("cpus", 2 * U)])  # socket A: 2 free
+    a = al.try_allocate([entry("cpus", 2 * U + 5000)])
+    assert len(_socks(al, a)) == 1  # all three indices from socket B

@@ -506,3 +506,117 @@ def test_query_many_workers_needed(tmp_path):
     for i in range(8):
         _ready_task(core, i + 1, [("cpus", 10_000)])
     assert service._fake_worker_demand(_cpus_queue(1, n=8)) == 8
+
+
+def test_query_no_tasks(tmp_path):
+    # ref test_query.rs:13 — nothing ready, no demand
+    service = _service(tmp_path)
+    queue = AllocationQueue(
+        1, QueueParams(manager="slurm", worker_args=["--cpus", "4"])
+    )
+    assert service._fake_worker_demand(queue) == 0
+
+
+def test_query_min_utilization1(tmp_path):
+    """ref test_query.rs:273 — a projected worker only counts if the work
+    it would attract clears min_utilization x cpus."""
+    for mu, expected, cpus in [
+        (0.5, 0, 12),
+        (0.3, 1, 12),
+        (0.8, 0, 12),
+        (1.0, 1, 5),
+        (0.5, 2, 3),
+        (0.7, 1, 3),
+    ]:
+        service = _service(tmp_path)
+        core = service.server.core
+        for seq, c in [(1, 3), (2, 1), (3, 1)]:
+            _ready_task(core, seq, [("cpus", c * 10_000)])
+        queue = AllocationQueue(
+            1,
+            QueueParams(
+                manager="slurm", backlog=2,
+                worker_args=["--cpus", str(cpus),
+                             "--min-utilization", str(mu)],
+            ),
+        )
+        assert service._fake_worker_demand(queue) == expected, (mu, cpus)
+
+
+def test_query_min_utilization2(tmp_path):
+    """ref test_query.rs:304 — utilization is judged on cpus while other
+    resources still gate feasibility."""
+    for mu, expected, cpus, gpus in [
+        (0.49, 1, 29, 40),
+        (0.49, 0, 29, 30),
+        (0.67, 0, 41, 30),
+        (0.50, 0, 41, 200),
+        (0.45, 1, 39, 200),
+    ]:
+        service = _service(tmp_path)
+        core = service.server.core
+        for seq in (1, 2):
+            _ready_task(
+                core, seq,
+                [("cpus", 10 * 10_000), ("gpus", 20 * 10_000)],
+            )
+        queue = AllocationQueue(
+            1,
+            QueueParams(
+                manager="slurm", backlog=2,
+                worker_args=[
+                    "--cpus", str(cpus),
+                    "--resource", f"gpus=range(0-{gpus - 1})",
+                    "--min-utilization", str(mu),
+                ],
+            ),
+        )
+        assert service._fake_worker_demand(queue) == expected, (
+            mu, cpus, gpus,
+        )
+
+
+def test_real_mu_worker_does_not_absorb_demand(tmp_path):
+    """A real min-utilization worker whose floor the queue load cannot
+    clear must not swallow the projected demand (it is carved out of the
+    production solve and would leave the task unserved forever)."""
+    from hyperqueue_tpu.resources.descriptor import (
+        ResourceDescriptor,
+        ResourceDescriptorItem,
+    )
+    from hyperqueue_tpu.server import reactor as R
+    from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+
+    service = _service(tmp_path)
+    core = service.server.core
+    config = WorkerConfiguration(
+        descriptor=ResourceDescriptor(
+            items=(ResourceDescriptorItem.range("cpus", 0, 11),)
+        ),
+        min_utilization=1.0,
+    )
+    w = Worker.create(core.worker_id_counter.next(), config,
+                      core.resource_map)
+    core.workers[w.worker_id] = w
+    _ready_task(core, 1, [("cpus", 10_000)])
+    queue = AllocationQueue(
+        1, QueueParams(manager="slurm", worker_args=["--cpus", "4"])
+    )
+    assert service._fake_worker_demand(queue) >= 1
+
+
+def test_query_min_utilization_counts_all_policy_cpu(tmp_path):
+    """An ALL-policy cpu task fills a projected worker's whole pool, so it
+    clears any utilization floor."""
+    from hyperqueue_tpu.resources.request import AllocationPolicy
+
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(core, 1, [("cpus", 0)],
+                policies={"cpus": AllocationPolicy.ALL})
+    queue = AllocationQueue(
+        1, QueueParams(manager="slurm",
+                       worker_args=["--cpus", "4",
+                                    "--min-utilization", "1.0"]),
+    )
+    assert service._fake_worker_demand(queue) == 1

@@ -543,3 +543,154 @@ def test_stale_retract_answer_after_reprefill_ignored():
     assert task.assigned_worker == new_worker
     assert task.instance_id == instance
     assert task.prefilled
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_mn.rs:89/139/195/261 — gang scheduling orders and packing
+# (mn batches live in core.mn_queue here, not TaskQueues — the reference's
+# mn batch-structure cases test_mn_task_batches1/2 have no direct analog;
+# their scheduling OUTCOMES are pinned below instead)
+# ---------------------------------------------------------------------------
+
+def test_mn_simple_priority_order_and_refill():
+    """schedule_mn_simple: four 2-node gangs over five workers — the two
+    highest-priority gangs run on disjoint pairs; finishing one admits the
+    next-highest."""
+    env = TestEnv()
+    for _ in range(5):
+        env.worker(cpus=5)
+    t1 = env.submit(rqv=env.rqv(n_nodes=2), priority=(1, 0))[0]
+    t2 = env.submit(rqv=env.rqv(n_nodes=2), priority=(2, 0))[0]
+    t3 = env.submit(rqv=env.rqv(n_nodes=2), priority=(3, 0))[0]
+    t4 = env.submit(rqv=env.rqv(n_nodes=2), priority=(4, 0))[0]
+    env.schedule()
+    ws3 = env.core.tasks[t3].mn_workers
+    ws4 = env.core.tasks[t4].mn_workers
+    assert len(ws3) == 2 and len(ws4) == 2
+    assert not set(ws3) & set(ws4)
+    assert env.state(t2) in (TaskState.READY, TaskState.WAITING)
+    assert env.state(t1) in (TaskState.READY, TaskState.WAITING)
+    env.finish(t3)
+    env.schedule()
+    assert len(env.core.tasks[t2].mn_workers) == 2
+
+
+def test_mn_reserve_sequential_gangs():
+    """schedule_mn_reserve: gangs of 3, 2, 3 nodes at descending priority
+    over three 1-cpu workers run strictly in priority order as each
+    finishes."""
+    env = TestEnv()
+    for _ in range(3):
+        env.worker(cpus=1)
+    t1 = env.submit(rqv=env.rqv(n_nodes=3), priority=(10, 0))[0]
+    t2 = env.submit(rqv=env.rqv(n_nodes=2), priority=(5, 0))[0]
+    t3 = env.submit(rqv=env.rqv(n_nodes=3), priority=(0, 0))[0]
+    env.schedule()
+    assert len(env.core.tasks[t1].mn_workers) == 3
+    assert env.core.tasks[t2].mn_workers == ()
+    env.finish(t1)
+    env.schedule()
+    assert len(env.core.tasks[t2].mn_workers) == 2
+    assert env.core.tasks[t3].mn_workers == ()
+    env.finish(t2)
+    env.schedule()
+    assert len(env.core.tasks[t3].mn_workers) == 3
+    env.finish(t3)
+    for w in env.core.workers.values():
+        assert w.mn_task == 0
+
+
+def test_mn_fill_all_gangs_at_once():
+    """schedule_mn_fill: gangs of 3+5+1+2 nodes exactly cover 11 workers in
+    one tick."""
+    env = TestEnv()
+    for _ in range(11):
+        env.worker(cpus=2)
+    tasks = [
+        env.submit(rqv=env.rqv(n_nodes=n))[0] for n in (3, 5, 1, 2)
+    ]
+    env.schedule()
+    for t in tasks:
+        assert env.state(t) is TaskState.ASSIGNED, t
+    assert all(w.mn_task != 0 for w in env.core.workers.values())
+
+
+def test_mn_sleep_wakeup_at_once():
+    """mn_sleep_wakeup_at_once: the unsatisfiable high-priority gang waits
+    while a smaller lower-priority one starts the same tick."""
+    env = TestEnv()
+    env.worker(cpus=4)
+    env.worker(cpus=1)
+    t1 = env.submit(rqv=env.rqv(n_nodes=4), priority=(10, 0))[0]
+    t2 = env.submit(rqv=env.rqv(n_nodes=2), priority=(1, 0))[0]
+    env.schedule()
+    assert env.core.tasks[t1].mn_workers == ()
+    assert len(env.core.tasks[t2].mn_workers) == 2
+
+
+# ---------------------------------------------------------------------------
+# test_scheduler_mn.rs:315-356 test_schedule_mn_and_sn1-4
+# ---------------------------------------------------------------------------
+
+def test_mn_and_sn_priority_matrix():
+    """Gang-vs-single-node priority: the higher priority side wins both
+    workers; at equal priority the gang goes first (reference mn_and_sn3);
+    with a spare worker both run (mn_and_sn4)."""
+    # sn1: gang@2 beats sn@1 -> gang runs, sn waits
+    env = TestEnv()
+    env.worker(cpus=4)
+    env.worker(cpus=4)
+    g = env.submit(rqv=env.rqv(n_nodes=2), priority=(2, 0))[0]
+    s = env.submit(rqv=env.rqv(cpus=4), priority=(1, 0))[0]
+    env.schedule()
+    assert len(env.core.tasks[g].mn_workers) == 2
+    assert env.state(s) is not TaskState.ASSIGNED
+
+    # sn2: sn@2 beats gang@1 -> sn assigned, gang waits
+    env = TestEnv()
+    env.worker(cpus=4)
+    env.worker(cpus=4)
+    g = env.submit(rqv=env.rqv(n_nodes=2), priority=(1, 0))[0]
+    s = env.submit(rqv=env.rqv(cpus=4), priority=(2, 0))[0]
+    env.schedule()
+    assert env.core.tasks[g].mn_workers == ()
+    assert env.state(s) is TaskState.ASSIGNED
+
+    # sn3: equal priority -> the gang wins the pair
+    env = TestEnv()
+    env.worker(cpus=4)
+    env.worker(cpus=4)
+    g = env.submit(rqv=env.rqv(n_nodes=2), priority=(1, 0))[0]
+    s = env.submit(rqv=env.rqv(cpus=4), priority=(1, 0))[0]
+    env.schedule()
+    assert len(env.core.tasks[g].mn_workers) == 2
+    assert env.state(s) is not TaskState.ASSIGNED
+
+    # sn4: three workers -> gang takes two, sn the third
+    env = TestEnv()
+    env.worker(cpus=4)
+    env.worker(cpus=3)
+    env.worker(cpus=4)
+    g = env.submit(rqv=env.rqv(n_nodes=2), priority=(1, 0))[0]
+    s = env.submit(rqv=env.rqv(cpus=4), priority=(1, 0))[0]
+    env.schedule()
+    assert len(env.core.tasks[g].mn_workers) == 2
+    assert env.state(s) is TaskState.ASSIGNED
+
+
+def test_gang_defers_to_any_higher_priority_sn_class():
+    """Deference scans every strictly-higher-user-priority sn class, not
+    just the single top tuple: here the TOP class is unschedulable on the
+    gang's workers but a middle class is, and it must still win them."""
+    env = TestEnv()
+    env.worker(cpus=4)
+    env.worker(cpus=4)
+    env.worker(cpus=1, gpus=1)
+    # top-priority class: gpu-only, cannot use the gang's 4-cpu workers
+    env.submit(rqv=env.rqv(gpus=1), priority=(5, 0))
+    # middle class CAN use them and outranks the gang
+    s = env.submit(rqv=env.rqv(cpus=4), priority=(4, 0))[0]
+    g = env.submit(rqv=env.rqv(n_nodes=2), priority=(3, 0))[0]
+    env.schedule()
+    assert env.state(s) is TaskState.ASSIGNED
+    assert env.core.tasks[g].mn_workers == ()
