@@ -17,9 +17,41 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
+def detect_profilers() -> list[str]:
+    """Available profiler modes, best first (reference
+    benchmarks/src/clusterutils/profiler.py supports flamegraph / perf-stat
+    / cachegrind wrappers; this image carries none of those binaries, so
+    cProfile — already hooked into every server/worker process via the
+    HQ_PROFILE env var — is the always-available mode, and py-spy/perf are
+    picked up automatically when present)."""
+    import shutil
+
+    modes = []
+    if shutil.which("py-spy"):
+        modes.append("py-spy")
+    if shutil.which("perf"):
+        modes.append("perf-stat")
+    modes.append("cprofile")
+    return modes
+
+
+def profile_report(profile_path, top=30) -> str:
+    """Human-readable top-N cumulative report from an HQ_PROFILE dump."""
+    import io
+    import pstats
+
+    out = io.StringIO()
+    stats = pstats.Stats(str(profile_path), stream=out)
+    stats.sort_stats("cumulative").print_stats(top)
+    return out.getvalue()
+
+
 class Cluster:
     def __init__(self, n_workers=1, cpus=4, zero_worker=True, extra_server=(),
-                 extra_worker=()):
+                 extra_worker=(), profile_dir=None):
+        """profile_dir: attach the cProfile profiler to every spawned
+        server/worker process; each writes <profile_dir>/profile.<role> on
+        exit (the HQ_PROFILE hook in client/cli.py)."""
         self.dir = Path(tempfile.mkdtemp(prefix="hq-bench-"))
         self.env = {
             **os.environ,
@@ -27,6 +59,15 @@ class Cluster:
             "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}",
             "HQ_SERVER_DIR": str(self.dir / "sd"),
         }
+        # This image's sitecustomize imports jax (~2.4 s) into EVERY python
+        # process when the TPU-relay env var is present. CLI clients and
+        # workers never touch jax, and the bench server is forced to the
+        # CPU backend anyway — without this, every `hq` invocation carries
+        # a fixed 2.4 s that swamps the quantities being measured.
+        self.env.pop("PALLAS_AXON_POOL_IPS", None)
+        if profile_dir is not None:
+            Path(profile_dir).mkdir(parents=True, exist_ok=True)
+            self.env["HQ_PROFILE"] = str(Path(profile_dir) / "profile")
         self.procs = []
         self._spawn("server", ["server", "start", *extra_server])
         deadline = time.time() + 30

@@ -1,0 +1,75 @@
+"""Throughput against another executor on the same sleep workload.
+
+Reference: benchmarks/experiment-dask.py (DaskVsHqSleep) — the same total
+amount of sleeping divided into varying task counts, run through both
+HyperQueue and Dask, comparing makespans.
+
+Dask is not installable in this image, so the comparison executor is:
+  * dask.distributed LocalCluster when importable (picked up automatically),
+  * otherwise a ProcessPoolExecutor stand-in with one Python process per
+    core running the same sleep calls — the same executor family the
+    reference's 1-process-per-core Dask configuration degenerates to.
+"""
+
+import sys
+import time
+from concurrent.futures import ProcessPoolExecutor
+
+from common import Cluster, emit
+
+
+def _sleep_task(seconds: float) -> None:
+    time.sleep(seconds)
+
+
+def run_pool(n_tasks: int, seconds: float, cores: int) -> float:
+    try:
+        from dask.distributed import Client, LocalCluster  # noqa
+
+        with LocalCluster(
+            n_workers=cores, threads_per_worker=1
+        ) as lc, Client(lc) as client:
+            t0 = time.perf_counter()
+            futures = [
+                client.submit(_sleep_task, seconds, pure=False)
+                for _ in range(n_tasks)
+            ]
+            client.gather(futures)
+            return time.perf_counter() - t0
+    except ImportError:
+        with ProcessPoolExecutor(max_workers=cores) as pool:
+            t0 = time.perf_counter()
+            list(pool.map(_sleep_task, [seconds] * n_tasks, chunksize=1))
+            return time.perf_counter() - t0
+
+
+def run_hq(n_tasks: int, seconds: float, cores: int) -> float:
+    with Cluster(n_workers=1, cpus=cores, zero_worker=False) as c:
+        t0 = time.perf_counter()
+        c.hq([
+            "submit", "--array", f"1-{n_tasks}", "--wait", "--",
+            "sleep", str(seconds),
+        ])
+        return time.perf_counter() - t0
+
+
+def main():
+    total_sleep_s = float(sys.argv[1]) if len(sys.argv) > 1 else 8.0
+    cores = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    for n_tasks in (200, 1000):
+        seconds = total_sleep_s / n_tasks
+        hq = run_hq(n_tasks, seconds, cores)
+        other = run_pool(n_tasks, seconds, cores)
+        emit({
+            "experiment": "dask-comparison",
+            "n_tasks": n_tasks,
+            "task_sleep_ms": round(seconds * 1000, 3),
+            "cores": cores,
+            "hq_makespan_s": round(hq, 3),
+            "pool_makespan_s": round(other, 3),
+            "hq_vs_pool": round(hq / other, 3) if other else None,
+        })
+
+
+if __name__ == "__main__":
+    main()

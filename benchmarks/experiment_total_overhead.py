@@ -1,0 +1,48 @@
+"""Total overhead: ideal task-graph duration vs actual makespan.
+
+Reference: benchmarks/experiment-total-overhead.py — sums all task durations
+to the theoretical execution time on the given core count, runs the same
+graph through the scheduler, and reports the difference (the whole stack's
+overhead: submit, scheduling, spawn, bookkeeping, result delivery).
+
+Real (non-zero) workers run real `sleep` processes here, so the measured
+makespan includes process spawn like the reference's variant without the
+fast spawner.
+"""
+
+import sys
+import time
+
+from common import Cluster, emit
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    sleep_ms = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    n_workers = 2
+    cpus = 4
+    cores = n_workers * cpus
+    ideal = (n_tasks * sleep_ms / 1000.0) / cores
+    with Cluster(n_workers=n_workers, cpus=cpus, zero_worker=False) as c:
+        t0 = time.perf_counter()
+        c.hq([
+            "submit", "--array", f"1-{n_tasks}", "--wait", "--",
+            "sleep", str(sleep_ms / 1000.0),
+        ])
+        makespan = time.perf_counter() - t0
+    emit({
+        "experiment": "total-overhead",
+        "n_tasks": n_tasks,
+        "sleep_ms": sleep_ms,
+        "cores": cores,
+        "ideal_s": round(ideal, 3),
+        "makespan_s": round(makespan, 3),
+        "overhead_s": round(makespan - ideal, 3),
+        "overhead_per_task_ms": round(
+            (makespan - ideal) / n_tasks * 1000, 4
+        ),
+    })
+
+
+if __name__ == "__main__":
+    main()
