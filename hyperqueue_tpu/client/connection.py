@@ -65,7 +65,7 @@ class ClientSession:
 
 
 def stream_events(server_dir: Path, history: bool = False, filters=(),
-                  on_subscribed=None):
+                  on_subscribed=None, overviews: bool = False):
     """Generator of event records from the server's client-plane stream.
 
     Blocking-recv based (read_frame is not cancellation-safe, so no
@@ -83,7 +83,10 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
         )
         await conn.send(
             {"op": "stream_events", "history": history,
-             "filter": list(filters)}
+             "filter": list(filters),
+             # ask the server to force worker hw overviews on while this
+             # stream is attached (dashboards; SetOverviewIntervalOverride)
+             "overviews": overviews}
         )
         return conn
 

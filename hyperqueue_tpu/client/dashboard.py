@@ -266,7 +266,8 @@ def _stream_events_into(server_dir, data: DashboardData, lock,
 
     try:
         for msg in stream_events(
-            server_dir, history=False, on_subscribed=subscribed.set
+            server_dir, history=False, on_subscribed=subscribed.set,
+            overviews=True,
         ):
             if msg.get("op") == "event":
                 with lock:

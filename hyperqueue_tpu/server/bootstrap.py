@@ -28,6 +28,7 @@ from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
 from hyperqueue_tpu.server.protocol import rqv_from_wire
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+from hyperqueue_tpu.utils.trace import TRACER
 from hyperqueue_tpu.transport.auth import (
     ROLE_CLIENT,
     ROLE_SERVER,
@@ -41,6 +42,9 @@ from hyperqueue_tpu.utils import serverdir
 logger = logging.getLogger("hq.server")
 
 SCHEDULE_MIN_DELAY = 0.01  # seconds; reference msd: 500ms prod / 20ms in benches
+# forced worker overview cadence while a dashboard/stream listens
+# (reference DEFAULT_WORKER_OVERVIEW_INTERVAL, server/worker.rs:63)
+OVERVIEW_OVERRIDE_INTERVAL = 2.0
 
 
 class CommSender:
@@ -84,6 +88,17 @@ class CommSender:
 
     def send_stop(self, worker_id: int) -> None:
         self._send(worker_id, {"op": "stop"})
+
+    def send_overview_override(
+        self, worker_id: int, interval: float | None
+    ) -> None:
+        self._send(
+            worker_id, {"op": "set_overview_override", "interval": interval}
+        )
+
+    def broadcast_overview_override(self, interval: float | None) -> None:
+        for worker_id in list(self._queues):
+            self.send_overview_override(worker_id, interval)
 
     def ask_for_scheduling(self) -> None:
         self.scheduling_event.set()
@@ -196,6 +211,11 @@ class Server:
         self._job_waiters: dict[int, list[asyncio.Event]] = {}
         self._event_listeners: list[asyncio.Queue] = []
         self._event_seq = 0
+        # dashboards/streams that asked for live hardware overviews; while
+        # any is attached, workers are forced onto a 2 s overview interval
+        # (reference SetOverviewIntervalOverride, control.rs:180-203,
+        # DEFAULT_WORKER_OVERVIEW_INTERVAL server/worker.rs:63)
+        self._overview_listeners = 0
         self._worker_conns: dict[int, Connection] = {}
         self._tasks: list[asyncio.Task] = []
         self._servers: list[asyncio.base_events.Server] = []
@@ -340,6 +360,7 @@ class Server:
             self.comm.scheduling_event.clear()
             t0 = time.perf_counter()
             n = reactor.schedule(self.core, self.comm, self.events, self.model)
+            TRACER.record("scheduler/tick", time.perf_counter() - t0)
             if n:
                 logger.debug(
                     "tick assigned %d tasks in %.2f ms",
@@ -403,6 +424,12 @@ class Server:
                 }
             )
             reactor.on_new_worker(self.core, self.comm, self.events, worker)
+            if self._overview_listeners > 0:
+                # a dashboard is attached: the new worker starts under the
+                # forced overview cadence too
+                self.comm.send_overview_override(
+                    worker_id, OVERVIEW_OVERRIDE_INTERVAL
+                )
             if config.alloc_id and getattr(self, "autoalloc", None):
                 self.autoalloc.on_worker_connected(worker_id, config.alloc_id)
 
@@ -909,6 +936,7 @@ class Server:
             )
         return {
             "op": "server_debug_dump",
+            "trace": TRACER.snapshot(),
             "tasks": {
                 "total": len(self.core.tasks),
                 "by_state": state_counts,
@@ -961,6 +989,13 @@ class Server:
         # record seq to drop events that were appended to the journal while
         # the replay was await-ing sends (they arrive on both paths)
         self._event_listeners.append(queue)
+        wants_overviews = bool(msg.get("overviews"))
+        if wants_overviews:
+            self._overview_listeners += 1
+            if self._overview_listeners == 1:
+                self.comm.broadcast_overview_override(
+                    OVERVIEW_OVERRIDE_INTERVAL
+                )
         replayed_seq = -1
         try:
             if msg.get("history") and self.journal_path is not None:
@@ -974,14 +1009,43 @@ class Server:
                     if not prefixes or record.get("event", "").startswith(prefixes):
                         await conn.send({"op": "event", "record": record})
             await conn.send({"op": "stream_live"})
-            while True:
-                record = await queue.get()
-                if record.get("seq", -1) <= replayed_seq:
-                    continue  # already sent during the history replay
-                if not prefixes or record.get("event", "").startswith(prefixes):
-                    await conn.send({"op": "event", "record": record})
+            # the stream is send-only from here: watch the read side so a
+            # client detach is noticed IMMEDIATELY (not at the next failed
+            # send, which for an overview listener can lag two cadences and
+            # leave workers sampling hw after the dashboard is gone)
+            eof = asyncio.ensure_future(conn.recv())
+            try:
+                while True:
+                    getter = asyncio.ensure_future(queue.get())
+                    done, _pending = await asyncio.wait(
+                        (getter, eof), return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if eof in done:
+                        getter.cancel()
+                        eof.exception()  # retrieve (EOF/conn reset)
+                        break
+                    record = getter.result()
+                    if record.get("seq", -1) <= replayed_seq:
+                        continue  # already sent during the history replay
+                    if not prefixes or record.get("event", "").startswith(
+                        prefixes
+                    ):
+                        await conn.send({"op": "event", "record": record})
+            finally:
+                if not eof.done():
+                    eof.cancel()
+                    # consume the cancellation so it never surfaces as an
+                    # un-retrieved exception in the loop's log
+                    try:
+                        await eof
+                    except (asyncio.CancelledError, Exception):
+                        pass
         finally:
             self._event_listeners.remove(queue)
+            if wants_overviews:
+                self._overview_listeners -= 1
+                if self._overview_listeners == 0:
+                    self.comm.broadcast_overview_override(None)
 
     async def _client_journal_flush(self, msg: dict) -> dict:
         if self.journal is None:

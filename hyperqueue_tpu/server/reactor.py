@@ -10,6 +10,7 @@ batches via an "ask_for_scheduling" flag + wakeup, never reentrantly
 from __future__ import annotations
 
 import logging
+import time as _time
 from typing import Protocol
 
 from hyperqueue_tpu.scheduler.queues import Priority as Priority_t
@@ -17,6 +18,7 @@ from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
+from hyperqueue_tpu.utils.trace import TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -382,6 +384,7 @@ def schedule(
     # unless strictly-higher-priority sn work is still pending, which keeps
     # the reference's priority interleaving (the MILP schedules higher
     # classes first and only blocks lower ones, solver.rs:479-518). ---
+    _t_phase = _time.perf_counter()
     if core.mn_queue:
         top_sn = _top_sn_priority(core)
         remaining_mn = []
@@ -484,6 +487,7 @@ def schedule(
             per_worker_msgs.setdefault(root.worker_id, []).append(msg)
             assigned += 1
         core.mn_queue = remaining_mn
+        TRACER.record("scheduler/gangs", _time.perf_counter() - _t_phase)
 
     # --- single-node: dense solve ---
     # Batches are built ONCE per schedule(): run_tick consumes this list,
@@ -493,6 +497,7 @@ def schedule(
     # host work at 1k queues x 32 cuts).
     rows = core.worker_rows()
     leftover_batches = None
+    _t_phase = _time.perf_counter()
     if rows and core.queues.total_ready():
         batches = create_batches(core.queues)
         assignments = run_tick(
@@ -522,10 +527,12 @@ def schedule(
             )
             if batch.size > 0:
                 leftover_batches.append(batch)
+        TRACER.record("scheduler/solve", _time.perf_counter() - _t_phase)
 
     # --- proactive prefilling: push extra top-priority tasks to busy
     # workers so short tasks pipeline without a server round-trip per task
     # (reference mapping.rs:159 process_proactive_filling, max 40/worker) ---
+    _t_phase = _time.perf_counter()
     if prefill and core.queues.total_ready():
         budgets = {
             w.worker_id: PREFILL_MAX - len(w.prefilled_tasks)
@@ -670,6 +677,7 @@ def schedule(
                     victims.append((tid, task.instance_id))
                 if victims:
                     comm.send_retract(donor.worker_id, victims)
+        TRACER.record("scheduler/prefill", _time.perf_counter() - _t_phase)
 
     for worker_id, msgs in per_worker_msgs.items():
         comm.send_compute(worker_id, msgs)

@@ -1,0 +1,84 @@
+"""Span tracing around hot runtime phases.
+
+Reference: crates/tako/src/internal/common/trace.rs:1-33 — `trace_time!`
+wraps a block in a ScopedTimer that emits start/end tracing events; the
+scheduler wraps its whole tick in one (scheduler/main.rs:49). Python
+tracing emits are comparatively expensive, so this tracer keeps rolling
+per-span statistics (count/total/max/last) plus a small ring of recent
+spans in-process, logs each span at DEBUG like the reference's events, and
+surfaces the aggregate through `hq server debug-dump` — enough to see
+which tick phase (gangs, solve, mapping, prefill) is hot without attaching
+a profiler.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+logger = logging.getLogger("hq.trace")
+
+
+@dataclass(slots=True)
+class SpanStats:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+    last_s: float = 0.0
+
+    def record(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        if dt > self.max_s:
+            self.max_s = dt
+        self.last_s = dt
+
+
+@dataclass
+class Tracer:
+    stats: dict[str, SpanStats] = field(default_factory=dict)
+    recent: deque = field(default_factory=lambda: deque(maxlen=256))
+
+    def record(self, name: str, dt: float) -> None:
+        """Record a measured duration directly (the `span` context manager
+        for blocks that a `with` would force to re-indent)."""
+        entry = self.stats.get(name)
+        if entry is None:
+            entry = self.stats[name] = SpanStats()
+        entry.record(dt)
+        self.recent.append((name, dt))
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug("span %s: %.3f ms", name, dt * 1000)
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        """JSON-ready per-span statistics for the debug dump."""
+        return {
+            name: {
+                "count": s.count,
+                "total_ms": round(s.total_s * 1000, 3),
+                "mean_ms": round(s.total_s / s.count * 1000, 4),
+                "max_ms": round(s.max_s * 1000, 3),
+                "last_ms": round(s.last_s * 1000, 4),
+            }
+            for name, s in sorted(self.stats.items())
+        }
+
+    def reset(self) -> None:
+        self.stats.clear()
+        self.recent.clear()
+
+
+# process-wide tracer (one server or worker per process)
+TRACER = Tracer()
+span = TRACER.span

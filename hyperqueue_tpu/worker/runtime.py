@@ -65,6 +65,9 @@ class WorkerRuntime:
         self._send_lock = asyncio.Lock()
         self._sendq: asyncio.Queue = asyncio.Queue()
         self._stop = asyncio.Event()
+        # server-forced overview cadence (None = use configuration)
+        self._overview_override: float | None = None
+        self._overview_wake = asyncio.Event()
         self.localcomm = None
 
     async def _send(self, msg: dict) -> None:
@@ -117,8 +120,9 @@ class WorkerRuntime:
             asyncio.create_task(self._heartbeat_loop()),
             asyncio.create_task(self._limits_loop()),
         ]
-        if self.configuration.overview_interval_secs > 0:
-            tasks.append(asyncio.create_task(self._overview_loop()))
+        # always started: the server can force overviews on at any time
+        # while a dashboard listens (set_overview_override)
+        tasks.append(asyncio.create_task(self._overview_loop()))
         stop_wait = asyncio.create_task(self._stop.wait())
         try:
             done, pending = await asyncio.wait(
@@ -175,6 +179,12 @@ class WorkerRuntime:
                             "ok": self._n_blocked < before,
                         }
                     )
+            elif op == "set_overview_override":
+                interval = msg.get("interval")
+                self._overview_override = (
+                    float(interval) if interval is not None else None
+                )
+                self._overview_wake.set()
             elif op == "stop":
                 self._stop.set()
                 return
@@ -386,12 +396,34 @@ class WorkerRuntime:
                 rt.future.cancel()
 
     async def _overview_loop(self) -> None:
+        """Send hw telemetry on the configured cadence — or on the
+        server-forced one while a dashboard listens (reference
+        SetOverviewIntervalOverride, messages/worker.rs:76-165, applied in
+        worker/rpc.rs:394-396)."""
         from hyperqueue_tpu.worker.hwmonitor import HwSampler
 
         sampler = HwSampler()
-        interval = self.configuration.overview_interval_secs
         while True:
-            await asyncio.sleep(interval)
+            interval = (
+                self._overview_override
+                if self._overview_override is not None
+                else self.configuration.overview_interval_secs
+            )
+            self._overview_wake.clear()
+            if interval <= 0:
+                # overviews disabled: park until an override arrives
+                await self._overview_wake.wait()
+                continue
+            try:
+                # an arriving override interrupts the wait so a dashboard
+                # gets telemetry immediately even under a long configured
+                # interval (and detach restores the old cadence at once)
+                await asyncio.wait_for(
+                    self._overview_wake.wait(), timeout=interval
+                )
+                continue  # re-read the effective interval
+            except asyncio.TimeoutError:
+                pass  # cadence elapsed: sample and send
             # sampling shells out to nvidia-smi/rocm-smi (blocking, up to
             # seconds on a wedged driver); keep it off the event loop so
             # heartbeats and task messaging never stall

@@ -178,3 +178,75 @@ def test_dashboard_live_e2e(tmp_path):
         assert out.returncode == 0, out.stderr
         assert "hq dashboard (live)" in out.stdout
         assert "workers=1" in out.stdout
+
+
+def test_overview_override_forces_hw_telemetry(tmp_path):
+    """A dashboard/stream attaching with `overviews` forces workers started
+    WITHOUT --overview-interval to send hw telemetry, and detaching
+    restores silence (reference SetOverviewIntervalOverride,
+    control.rs:180-203, messages/worker.rs)."""
+    import threading
+
+    from utils_e2e import HqEnv, wait_until
+
+    from hyperqueue_tpu.client.connection import stream_events
+
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker()  # no --overview-interval: telemetry off
+        env.wait_workers(1)
+
+        got_overview = threading.Event()
+        stop = threading.Event()
+
+        def listen():
+            try:
+                for msg in stream_events(
+                    env.server_dir, history=False, overviews=True
+                ):
+                    if (
+                        msg.get("op") == "event"
+                        and msg["record"].get("event") == "worker-overview"
+                    ):
+                        got_overview.set()
+                    if stop.is_set():
+                        return
+            except Exception:
+                pass
+
+        t = threading.Thread(target=listen, daemon=True)
+        t.start()
+        # forced cadence is 2 s; one sample must arrive well within 15 s
+        wait_until(got_overview.is_set, timeout=15.0,
+                   message="forced worker overview")
+        stop.set()
+        # the listener thread exits on the next event; closing its stream
+        # drops the last overview listener and the server must broadcast
+        # the restore. Attach a NON-overview stream and assert telemetry
+        # goes quiet again (the worker was started without an interval).
+        wait_until(lambda: not t.is_alive(), timeout=15.0,
+                   message="listener thread exit")
+        import time as _time
+
+        _time.sleep(1.0)  # let the restore broadcast land on the worker
+        seen_after = threading.Event()
+
+        def listen_quiet():
+            try:
+                for msg in stream_events(env.server_dir, history=False):
+                    if (
+                        msg.get("op") == "event"
+                        and msg["record"].get("event") == "worker-overview"
+                    ):
+                        seen_after.set()
+                        return
+            except Exception:
+                pass
+
+        t2 = threading.Thread(target=listen_quiet, daemon=True)
+        t2.start()
+        # two forced cadences' worth of silence proves the restore landed
+        _time.sleep(5.0)
+        assert not seen_after.is_set(), (
+            "worker kept sending overviews after the dashboard detached"
+        )

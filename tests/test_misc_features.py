@@ -191,3 +191,26 @@ def test_generate_completion_covers_subcommands(capsys):
     proc = subprocess.run(["bash", "-n"], input=script, text=True,
                           capture_output=True)
     assert proc.returncode == 0, proc.stderr
+
+
+def test_trace_spans_record_tick_phases():
+    """Span tracing around the scheduler phases (reference trace.rs:1-33
+    trace_time!): a schedule() with gangs, a solve and prefill leaves
+    aggregate span stats behind, surfaced via `hq server debug-dump`."""
+    from utils_env import TestEnv
+
+    from hyperqueue_tpu.utils.trace import TRACER
+
+    TRACER.reset()
+    env = TestEnv()
+    env.worker(cpus=2)
+    env.worker(cpus=2)
+    env.worker(cpus=2)
+    env.submit(n=8)
+    env.submit(rqv=env.rqv(n_nodes=2))
+    env.schedule(prefill=True)
+    snap = TRACER.snapshot()
+    assert snap["scheduler/solve"]["count"] >= 1
+    assert snap["scheduler/gangs"]["count"] >= 1
+    assert snap["scheduler/prefill"]["count"] >= 1
+    assert snap["scheduler/solve"]["mean_ms"] > 0
