@@ -180,7 +180,19 @@ def bench_full_tick(args, on_cpu):
     queues, worker_rows, rq_map, resource_map, priority_of = build_tick_state(
         n_workers=args.workers, n_tasks=args.tasks
     )
-    model = GreedyCutScanModel(backend="numpy" if on_cpu else "jax")
+    # the PRODUCTION selection: backend "auto" solves on the device only
+    # when its sync round trip fits the tick budget (models/greedy.py;
+    # a tunneled TPU with ~70 ms relay RTT runs the kernel in <1 ms but
+    # the host cannot see the counts sooner than the relay allows, so the
+    # host solve wins end to end there)
+    model = GreedyCutScanModel(backend="numpy" if on_cpu else "auto")
+    if not on_cpu:
+        # wait for the background latency probe so every timed rep uses
+        # the same backend decision (the server never waits; see
+        # models/greedy.py device_sync_ms)
+        from hyperqueue_tpu.models.greedy import device_sync_ms
+
+        device_sync_ms(wait_s=45)
 
     # mirror the server's steady-state GC thresholds (bootstrap.Server
     # .start): default thresholds fire gen-0 collections mid-tick (~30 ms
@@ -212,7 +224,8 @@ def bench_full_tick(args, on_cpu):
         out = tick()
         times.append((time.perf_counter() - t0) * 1e3)
         restore(out)
-    return times, n_assigned
+    backend = "host-numpy" if model._numpy_path() else "device-jax"
+    return times, n_assigned, backend
 
 
 def bench_kernel(args, on_cpu):
@@ -321,17 +334,55 @@ def main() -> None:
 
             jax.config.update("jax_platforms", "cpu")
 
+    # watchdog armed BEFORE the main process touches the device: the relay
+    # can wedge between the successful probe and our own jax.devices()
+    import os
+    import signal
+
+    def _wedged(signum, frame):
+        print(json.dumps({
+            "metric": (
+                "tick_latency_1M_tasks_x_1k_workers" if args.kernel
+                else "full_tick_1M_tasks_x_1k_workers"
+            ),
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": 0,
+            "device": "tpu",
+            "note": "TPU relay wedged mid-benchmark; rerun with --cpu",
+        }))
+        os._exit(3)
+
+    watchdog = (
+        not args.cpu and not device_fallback and hasattr(signal, "SIGALRM")
+    )
+    if watchdog:
+        signal.signal(signal.SIGALRM, _wedged)
+        signal.alarm(480)
+
     import jax
 
     on_cpu = args.cpu or device_fallback or jax.default_backend() == "cpu"
     device = jax.devices()[0]
 
+    solve_backend = None
     if args.kernel:
         times, n_assigned = bench_kernel(args, on_cpu)
         metric = "tick_latency_1M_tasks_x_1k_workers"
+        if not on_cpu:
+            result_note = (
+                "timed to block_until_ready on pre-placed inputs; through "
+                "a network-relayed device this can reflect enqueue rather "
+                "than readback - the full-tick metric is the end-to-end one"
+            )
+        else:
+            result_note = None
     else:
-        times, n_assigned = bench_full_tick(args, on_cpu)
+        times, n_assigned, solve_backend = bench_full_tick(args, on_cpu)
         metric = "full_tick_1M_tasks_x_1k_workers"
+        result_note = None
+    if watchdog:
+        signal.alarm(0)
     median_ms = float(np.median(times))
 
     result = {
@@ -341,6 +392,24 @@ def main() -> None:
         "vs_baseline": round(BASELINE_MS / median_ms, 2),
         "device": device.platform,
     }
+    if result_note:
+        result["note"] = result_note
+    if solve_backend is not None:
+        result["solve_backend"] = solve_backend
+        if solve_backend == "host-numpy" and not on_cpu:
+            from hyperqueue_tpu.models.greedy import device_sync_ms
+
+            sync = device_sync_ms()
+            result["device_sync_ms"] = (
+                round(sync, 2)
+                if sync is not None and sync != float("inf")
+                else "unresolved"
+            )
+            result["note"] = (
+                "device visible but its sync round trip exceeds the tick "
+                "budget (network-relayed chip); production auto-selects "
+                "the host solve - kernel-on-device metric via --kernel"
+            )
     if device_fallback:
         result["note"] = "cpu-fallback: TPU device init unavailable"
         result["probe"] = probe_detail

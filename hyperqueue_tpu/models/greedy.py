@@ -32,6 +32,68 @@ def _bucket(n: int, floor: int) -> int:
     return size
 
 
+# One-shot device sync-latency probe, shared by all models in the process.
+# None = not yet resolved; float = measured round-trip ms (inf = probe
+# failed). Probed in a BACKGROUND daemon thread: in-process (an exclusively
+# attached TPU cannot be re-initialized from a subprocess), and without
+# ever blocking the caller (this environment's relay is known to WEDGE —
+# a hung probe simply never resolves and the host solve stays selected).
+_DEVICE_SYNC_MS: float | None = None
+_PROBE_STARTED = False
+_PROBE_DONE = None  # threading.Event once started
+
+# A tick must complete in single-digit milliseconds; a device whose
+# dispatch+readback round trip alone exceeds this is not worth using for
+# the solve (e.g. a TPU reached through a network relay with ~70 ms RTT —
+# the kernel is sub-millisecond ON the device, but the scheduler runs on
+# a host that cannot see the result sooner than the relay allows).
+DISPATCH_LATENCY_BUDGET_MS = 5.0
+
+
+def device_sync_ms(wait_s: float = 0.0) -> float | None:
+    """Current known device sync round trip in ms.
+
+    Starts the background probe on first call; returns None while it is
+    unresolved (callers treat that as "use the host solve for now").
+    `wait_s` > 0 blocks up to that long for a result — benchmarks use it
+    for a stable backend choice; the server never passes it."""
+    global _PROBE_STARTED, _PROBE_DONE
+    if not _PROBE_STARTED:
+        import threading
+
+        _PROBE_STARTED = True
+        _PROBE_DONE = threading.Event()
+
+        def _probe():
+            global _DEVICE_SYNC_MS
+            import time
+
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                f = jax.jit(lambda v: (v * 2).sum())
+                x = jax.device_put(jnp.arange(256, dtype=jnp.int32))
+                np.asarray(f(x))  # compile + first transfer
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    np.asarray(f(x))
+                    ts.append((time.perf_counter() - t0) * 1000)
+                _DEVICE_SYNC_MS = min(ts)
+            except Exception:
+                _DEVICE_SYNC_MS = float("inf")
+            finally:
+                _PROBE_DONE.set()
+
+        threading.Thread(
+            target=_probe, name="hq-device-probe", daemon=True
+        ).start()
+    if wait_s > 0:
+        _PROBE_DONE.wait(wait_s)
+    return _DEVICE_SYNC_MS
+
+
 class GreedyCutScanModel:
     """Stateless apart from jit's own compile cache.
 
@@ -61,7 +123,30 @@ class GreedyCutScanModel:
         if self._use_numpy is None:
             import jax
 
-            self._use_numpy = jax.default_backend() == "cpu"
+            if jax.default_backend() == "cpu":
+                # the XLA while-loop overhead loses to numpy on CPU hosts
+                self._use_numpy = True
+            else:
+                # an accelerator is visible — but only worth using when the
+                # host can actually get the answer back within the tick
+                # budget (a tunneled chip with tens of ms of relay RTT runs
+                # the kernel in <1 ms and then sits on the result; the host
+                # solve at ~16 ms for 1M x 1k beats it end to end). The
+                # probe runs in the background: until it resolves, solve on
+                # the host WITHOUT caching the decision (never blocks the
+                # server's event loop; a wedged relay simply never resolves)
+                sync_ms = device_sync_ms()
+                if sync_ms is None:
+                    return True  # provisional — retry next solve
+                self._use_numpy = sync_ms > DISPATCH_LATENCY_BUDGET_MS
+                if self._use_numpy:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "device sync round trip %.1f ms exceeds the %.0f ms "
+                        "tick budget: solving on the host (numpy) instead",
+                        sync_ms, DISPATCH_LATENCY_BUDGET_MS,
+                    )
         return self._use_numpy
 
     def solve(

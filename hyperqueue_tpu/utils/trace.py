@@ -61,9 +61,10 @@ class Tracer:
         finally:
             self.record(name, time.perf_counter() - t0)
 
-    def snapshot(self) -> dict:
-        """JSON-ready per-span statistics for the debug dump."""
-        return {
+    def snapshot(self, recent: int = 16) -> dict:
+        """JSON-ready per-span statistics (+ the most recent spans, for
+        "what just happened" debugging) for the debug dump."""
+        out = {
             name: {
                 "count": s.count,
                 "total_ms": round(s.total_s * 1000, 3),
@@ -73,6 +74,12 @@ class Tracer:
             }
             for name, s in sorted(self.stats.items())
         }
+        if recent:
+            out["_recent"] = [
+                [name, round(dt * 1000, 4)]
+                for name, dt in list(self.recent)[-recent:]
+            ]
+        return out
 
     def reset(self) -> None:
         self.stats.clear()
