@@ -224,7 +224,9 @@ def bench_full_tick(args, on_cpu):
         out = tick()
         times.append((time.perf_counter() - t0) * 1e3)
         restore(out)
-    backend = "host-numpy" if model._numpy_path() else "device-jax"
+    backend = model.last_backend or (
+        "host-numpy" if model._numpy_path() else "device-jax"
+    )
     return times, n_assigned, backend
 
 
@@ -396,7 +398,7 @@ def main() -> None:
         result["note"] = result_note
     if solve_backend is not None:
         result["solve_backend"] = solve_backend
-        if solve_backend == "host-numpy" and not on_cpu:
+        if solve_backend.startswith("host-") and not on_cpu:
             from hyperqueue_tpu.models.greedy import device_sync_ms
 
             sync = device_sync_ms()

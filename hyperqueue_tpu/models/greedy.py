@@ -115,6 +115,9 @@ class GreedyCutScanModel:
         self.resource_floor = resource_floor
         self.variant_floor = variant_floor
         self.backend = backend
+        # which path the last solve actually ran (host-native / host-numpy
+        # / device-jax); bench.py reports it
+        self.last_backend: str | None = None
         self._use_numpy: bool | None = (
             None if backend == "auto" else (backend == "numpy")
         )
@@ -230,10 +233,26 @@ class GreedyCutScanModel:
     ):
         """Run the kernel on fully padded inputs; overridden by the
         multi-chip model (models/multichip.py) to shard the worker axis."""
-        solver = (
-            greedy_cut_scan_numpy if self._numpy_path() else greedy_cut_scan
-        )
-        counts, _free_after, _nt_after = solver(
+        if self._numpy_path():
+            # host solve: the native C++ scan (identical semantics, with
+            # saturation early-exits) when the lib is available, else numpy
+            from hyperqueue_tpu.utils.native import native_cut_scan
+
+            counts = native_cut_scan(
+                free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
+                order_ids, total=total_p, all_mask=amask_p,
+            )
+            if counts is not None:
+                self.last_backend = "host-native"
+                return counts
+            self.last_backend = "host-numpy"
+            counts, _free_after, _nt_after = greedy_cut_scan_numpy(
+                free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
+                order_ids, total=total_p, all_mask=amask_p,
+            )
+            return counts
+        self.last_backend = "device-jax"
+        counts, _free_after, _nt_after = greedy_cut_scan(
             free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids,
             total=total_p, all_mask=amask_p,
         )

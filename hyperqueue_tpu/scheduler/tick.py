@@ -236,9 +236,15 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
                 score = v_score
         return 0.0 if score == float("inf") else score
 
-    totals_by_r = np.maximum(free, 0).sum(axis=0)
+    # plain Python list: the sort key touches these per batch per entry and
+    # numpy scalar indexing is ~10x a list index on this path
+    totals_by_r = np.maximum(free, 0).sum(axis=0).tolist()
+    # the (scarcity, objective) key is pure per request class + this tick's
+    # totals; distinct classes per tick << batches (priority levels), so
+    # memoize per rq_id for the sort below
+    _key_cache: dict = {}
 
-    def _objective(batch: Batch) -> tuple[float, float]:
+    def _objective_value(rq_id: int) -> list[tuple[float, float]]:
         """Within equal scarcity, emulate the reference LP objective
         (solver.rs:528-546): classes are taken in descending ACHIEVABLE
         share value — weight x per-task share-density x how many could run
@@ -248,16 +254,19 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
         Request weights (request.rs:137 ResourceWeight) scale the value, so
         `--weight` biases which equal-scarcity class wins. Pinned by golden
         multiple_resources2 / generic_resource_assign2 /
-        generic_resource_balance2 / resource_weights1-2."""
-        best = (0.0, 0.0)
-        for variant in rq_map.get_variants(batch.rq_id).variants:
+        generic_resource_balance2 / resource_weights1-2.
+
+        Returns [(value, fit), ...] per variant; the sort maximizes
+        (value x min(size, fit), -value) over them with the batch size."""
+        out = []
+        for variant in rq_map.get_variants(rq_id).variants:
             share = 0.0
             fit = float("inf")
             for entry in variant.entries:
                 if entry.resource_id >= n_r:
                     fit = 0.0
                     break
-                tot = float(totals_by_r[entry.resource_id])
+                tot = totals_by_r[entry.resource_id]
                 if entry.policy is AllocationPolicy.ALL:
                     # amount is the worker's whole pool; approximate the
                     # share with the per-worker average
@@ -271,45 +280,81 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
                     fit = min(fit, tot // entry.amount)
             if fit == float("inf"):
                 fit = 0.0
-            value = variant.weight * share
-            cand = (value * min(batch.size, fit), -value)
+            out.append((variant.weight * share, fit))
+        return out
+
+    def _sort_key(b: Batch):
+        cached = _key_cache.get(b.rq_id)
+        if cached is None:
+            cached = (_scarcity(b), _objective_value(b.rq_id))
+            _key_cache[b.rq_id] = cached
+        scarcity, per_variant = cached
+        # the achievable objective depends on the batch SIZE, so the best
+        # variant is chosen here, per batch, from the cached class values
+        best = (0.0, 0.0)
+        size = b.size
+        for value, fit in per_variant:
+            cand = (value * (size if size < fit else fit), -value)
             if cand > best:
                 best = cand
-        return best
+        return (b.priority, scarcity, best)
 
-    batches.sort(
-        key=lambda b: (b.priority, _scarcity(b), _objective(b)),
-        reverse=True,
-    )
+    batches.sort(key=_sort_key, reverse=True)
 
     needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
     sizes = np.zeros(n_b, dtype=np.int32)
     min_time = np.zeros((n_b, n_v), dtype=np.int32)
     min_time[:] = int(INF_TIME)  # absent variants never eligible
     all_mask = np.zeros((n_b, n_v, n_r), dtype=np.int32) if has_all else None
+    # dense rows per request class are immutable — cache them on the rq_map
+    # (keyed by the resource-map width, which can grow) instead of
+    # re-walking every entry of every batch each tick
+    cache_key, dense_cache = getattr(rq_map, "_dense_cache", (None, None))
+    if cache_key != n_r:
+        dense_cache = {}
+        rq_map._dense_cache = (n_r, dense_cache)
+    weighted_rows: list[tuple[int, int, np.ndarray]] = []
     for bi, batch in enumerate(batches):
         sizes[bi] = min(batch.size, 2**30)
-        variants = rq_map.get_variants(batch.rq_id).variants
-        for vi, variant in enumerate(variants):
-            min_time[bi, vi] = min(int(variant.min_time_secs), int(INF_TIME))
-            for entry in variant.entries:
-                if entry.policy is AllocationPolicy.ALL:
-                    all_mask[bi, vi, entry.resource_id] = 1
-                else:
-                    needs[bi, vi, entry.resource_id] = entry.amount
+        row = dense_cache.get(batch.rq_id)
+        if row is None:
+            variants = rq_map.get_variants(batch.rq_id).variants
+            k = len(variants)
+            nd = np.zeros((k, n_r), dtype=np.int64)
+            am = np.zeros((k, n_r), dtype=np.int32)
+            mt = np.empty(k, dtype=np.int32)
+            for vi, variant in enumerate(variants):
+                mt[vi] = min(int(variant.min_time_secs), int(INF_TIME))
+                for entry in variant.entries:
+                    if entry.policy is AllocationPolicy.ALL:
+                        am[vi, entry.resource_id] = 1
+                    else:
+                        nd[vi, entry.resource_id] = entry.amount
+            wt = np.array([v.weight for v in variants], dtype=np.float64)
+            row = (k, nd, am if am.any() else None, mt,
+                   wt if (wt != 1.0).any() else None)
+            dense_cache[batch.rq_id] = row
+        k, nd, am, mt, wt = row
+        needs[bi, :k] = nd
+        min_time[bi, :k] = mt
+        if am is not None and all_mask is not None:
+            all_mask[bi, :k] = am
+        if wt is not None:
+            weighted_rows.append((bi, k, wt))
 
     _range_compress(needs, free, total)
     free32 = free.astype(np.int32)
     extra = {}
     if all_mask is not None and all_mask.any():
         extra = {"total": total.astype(np.int32), "all_mask": all_mask}
-    w_arr = np.ones((n_b, n_v), dtype=np.float64)
-    for bi, batch in enumerate(batches):
-        for vi, variant in enumerate(rq_map.get_variants(batch.rq_id).variants):
-            w_arr[bi, vi] = variant.weight
-    if (w_arr != 1.0).any():
-        # request weights: the greedy model already consumed them through
-        # the batch-order objective; the MILP folds them into its own
+    if weighted_rows:
+        # request weights (from the dense cache — only classes that carry a
+        # non-default weight appear): the greedy model already consumed
+        # them through the batch-order objective; the MILP folds them into
+        # its own
+        w_arr = np.ones((n_b, n_v), dtype=np.float64)
+        for bi, k, wt in weighted_rows:
+            w_arr[bi, :k] = wt
         extra["weights"] = w_arr
     counts = model.solve(
         free=free32,

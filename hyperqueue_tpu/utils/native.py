@@ -100,6 +100,78 @@ def _set_prototypes(lib) -> None:
         ctypes.POINTER(ctypes.c_int64),
     ]
     lib.hq_map_take.restype = ctypes.c_int64
+    lib.hq_cut_scan.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),   # free (W,R)
+        ctypes.POINTER(ctypes.c_int64),   # total (W,R) or NULL
+        ctypes.POINTER(ctypes.c_int64),   # nt (W)
+        ctypes.POINTER(ctypes.c_int32),   # lifetime (W)
+        ctypes.POINTER(ctypes.c_int64),   # needs (B,V,R)
+        ctypes.POINTER(ctypes.c_int32),   # all_mask (B,V,R) or NULL
+        ctypes.POINTER(ctypes.c_int64),   # sizes (B)
+        ctypes.POINTER(ctypes.c_int32),   # min_time (B,V)
+        ctypes.POINTER(ctypes.c_int32),   # class_m (M,W)
+        ctypes.POINTER(ctypes.c_int32),   # order_ids (B,V)
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64,                   # W R B V M
+        ctypes.POINTER(ctypes.c_int32),   # counts out (B,V,W)
+    ]
+    lib.hq_cut_scan.restype = None
+
+
+def native_cut_scan(
+    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
+    total=None, all_mask=None,
+):
+    """Native host solve with the numpy fallback's exact semantics
+    (ops/assign.greedy_cut_scan_numpy); returns counts (B,V,W) int32 or
+    None when the native lib is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    import numpy as np
+
+    free = np.ascontiguousarray(free, dtype=np.int64)
+    nt = np.ascontiguousarray(nt_free, dtype=np.int64)
+    life = np.ascontiguousarray(lifetime, dtype=np.int32)
+    needs = np.ascontiguousarray(needs, dtype=np.int64)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int64)
+    mt = np.ascontiguousarray(min_time, dtype=np.int32)
+    cm = np.ascontiguousarray(class_m, dtype=np.int32)
+    oi = np.ascontiguousarray(order_ids, dtype=np.int32)
+    n_w, n_r = free.shape
+    n_b, n_v, _ = needs.shape
+    counts = np.zeros((n_b, n_v, n_w), dtype=np.int32)
+
+    def ptr(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    total_p = None
+    amask_p = None
+    if all_mask is not None:
+        if total is None:
+            # the numpy reference reads total[:, all_r] and would raise;
+            # silently substituting free would grant ALL requests on
+            # partially-busy workers
+            raise ValueError("all_mask requires total")
+        total = np.ascontiguousarray(total, dtype=np.int64)
+        amask = np.ascontiguousarray(all_mask, dtype=np.int32)
+        total_p = ptr(total, ctypes.c_int64)
+        amask_p = ptr(amask, ctypes.c_int32)
+    lib.hq_cut_scan(
+        ptr(free, ctypes.c_int64),
+        total_p,
+        ptr(nt, ctypes.c_int64),
+        ptr(life, ctypes.c_int32),
+        ptr(needs, ctypes.c_int64),
+        amask_p,
+        ptr(sizes, ctypes.c_int64),
+        ptr(mt, ctypes.c_int32),
+        ptr(cm, ctypes.c_int32),
+        ptr(oi, ctypes.c_int32),
+        n_w, n_r, n_b, n_v, cm.shape[0],
+        ptr(counts, ctypes.c_int32),
+    )
+    return counts
 
 
 class NativeTaskQueue:

@@ -128,3 +128,56 @@ def test_map_take_parity_native_vs_python():
     python_out = build(force_python=True)
     assert native_out == python_out
     assert len(native_out) > 0
+
+
+def test_native_cut_scan_parity_randomized():
+    """The C++ host solve (hq_cut_scan) is bitwise-identical to the numpy
+    cut-scan across randomized instances incl. ALL-policy pools, min_time
+    gating, and partial totals."""
+    import numpy as np
+
+    from hyperqueue_tpu.ops.assign import (
+        greedy_cut_scan_numpy,
+        host_visit_classes,
+        scarcity_weights,
+    )
+    from hyperqueue_tpu.utils.native import native_cut_scan
+
+    rng = np.random.default_rng(11)
+    U = 10_000
+    ran = 0
+    for _trial in range(25):
+        W = int(rng.integers(1, 40))
+        R = int(rng.integers(1, 6))
+        B = int(rng.integers(1, 30))
+        V = int(rng.integers(1, 3))
+        free = rng.integers(0, 10, size=(W, R)).astype(np.int64) * U
+        total = free + rng.integers(0, 2, size=(W, R)) * U
+        nt = rng.integers(0, 20, size=W).astype(np.int64)
+        life = rng.integers(0, 1000, size=W).astype(np.int32)
+        needs = np.where(
+            rng.random((B, V, R)) < 0.5,
+            rng.integers(1, 5, size=(B, V, R)) * U,
+            0,
+        ).astype(np.int64)
+        am = (rng.random((B, V, R)) < 0.15).astype(np.int32)
+        needs[am > 0] = 0
+        sizes = rng.integers(0, 8, size=B).astype(np.int64)
+        mt = rng.integers(0, 1200, size=(B, V)).astype(np.int32)
+        sc = scarcity_weights(np.maximum(free, 0).sum(axis=0))
+        cm, oi = host_visit_classes(free, needs, sc, all_mask=am)
+        want, _, _ = greedy_cut_scan_numpy(
+            free, nt, life, needs, sizes, mt, cm, oi,
+            total=total, all_mask=am,
+        )
+        got = native_cut_scan(
+            free, nt, life, needs, sizes, mt, cm, oi,
+            total=total, all_mask=am,
+        )
+        if got is None:
+            import pytest
+
+            pytest.skip("native library unavailable")
+        assert np.array_equal(want, got), _trial
+        ran += 1
+    assert ran == 25
