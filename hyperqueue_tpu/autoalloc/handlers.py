@@ -13,7 +13,6 @@ import asyncio
 import os
 import shlex
 import sys
-import tempfile
 from pathlib import Path
 
 from hyperqueue_tpu.autoalloc.state import QueueParams
@@ -59,26 +58,44 @@ class QueueHandler:
         self.work_dir = Path(work_dir)
         self.work_dir.mkdir(parents=True, exist_ok=True)
 
-    def build_script(self, queue_id: int, params: QueueParams) -> str:
+    def build_script(
+        self, queue_id: int, params: QueueParams, workdir: Path | None = None
+    ) -> str:
         raise NotImplementedError
 
     def parse_submit_output(self, stdout: str) -> str:
         raise NotImplementedError
 
+    def _create_allocation_dir(self, queue_id: int, params: QueueParams) -> Path:
+        """Per-allocation working directory holding the submit script and the
+        manager-captured stdout/stderr (reference queue/common.rs
+        create_allocation_dir: <server_dir>/autoalloc/<id>[-name]/<n>)."""
+        name = str(queue_id) + (f"-{params.name}" if params.name else "")
+        parent = self.work_dir / name
+        parent.mkdir(parents=True, exist_ok=True)
+        n = len(list(parent.iterdir()))
+        while True:
+            n += 1
+            workdir = parent / f"{n:03d}"
+            try:
+                workdir.mkdir()
+                return workdir
+            except FileExistsError:
+                continue
+
     async def submit_allocation(
         self, queue_id: int, params: QueueParams, dry_run: bool = False
-    ) -> str:
-        """Run qsub/sbatch on a generated script; returns the allocation id."""
-        script = self.build_script(queue_id, params)
-        fd, path = tempfile.mkstemp(
-            suffix=".sh", prefix=f"hq-alloc-q{queue_id}-", dir=self.work_dir
-        )
-        with os.fdopen(fd, "w") as f:
-            f.write(script)
+    ) -> tuple[str, str]:
+        """Run qsub/sbatch on a generated script; returns
+        (allocation id, allocation working directory)."""
+        workdir = self._create_allocation_dir(queue_id, params)
+        script = self.build_script(queue_id, params, workdir)
+        path = workdir / "hq-submit.sh"
+        path.write_text(script)
         os.chmod(path, 0o755)
-        cmd = [self.submit_binary, *params.additional_args, path]
+        cmd = [self.submit_binary, *params.additional_args, str(path)]
         if dry_run:
-            return f"dry-run:{path}"
+            return f"dry-run:{path}", str(workdir)
         process = await asyncio.create_subprocess_exec(
             *cmd,
             stdout=asyncio.subprocess.PIPE,
@@ -90,7 +107,7 @@ class QueueHandler:
                 f"{self.submit_binary} failed "
                 f"(exit {process.returncode}): {stderr.decode(errors='replace')}"
             )
-        return self.parse_submit_output(stdout.decode())
+        return self.parse_submit_output(stdout.decode()), str(workdir)
 
     async def refresh_statuses(self, allocation_ids: list[str]) -> dict[str, str]:
         """allocation_id -> queued|running|finished|failed."""
@@ -113,13 +130,22 @@ class PbsHandler(QueueHandler):
     manager = "pbs"
     submit_binary = "qsub"
 
-    def build_script(self, queue_id: int, params: QueueParams) -> str:
+    def build_script(
+        self, queue_id: int, params: QueueParams, workdir: Path | None = None
+    ) -> str:
         worker_cmd = _worker_command(self.server_dir, queue_id, params)
         lines = [
             "#!/bin/bash",
             f"#PBS -N hq-alloc-{queue_id}",
             f"#PBS -l select={params.workers_per_alloc}",
             f"#PBS -l walltime={_format_walltime(params.time_limit_secs)}",
+        ]
+        if workdir is not None:
+            lines += [
+                f"#PBS -o {workdir / 'stdout'}",
+                f"#PBS -e {workdir / 'stderr'}",
+            ]
+        lines += [
             "export HQ_ALLOC_QUEUE=%d" % queue_id,
             'export HQ_ALLOC_ID="$PBS_JOBID"',
         ]
@@ -165,13 +191,22 @@ class SlurmHandler(QueueHandler):
     manager = "slurm"
     submit_binary = "sbatch"
 
-    def build_script(self, queue_id: int, params: QueueParams) -> str:
+    def build_script(
+        self, queue_id: int, params: QueueParams, workdir: Path | None = None
+    ) -> str:
         worker_cmd = _worker_command(self.server_dir, queue_id, params)
         lines = [
             "#!/bin/bash",
             f"#SBATCH --job-name=hq-alloc-{queue_id}",
             f"#SBATCH --nodes={params.workers_per_alloc}",
             f"#SBATCH --time={_format_walltime(params.time_limit_secs)}",
+        ]
+        if workdir is not None:
+            lines += [
+                f"#SBATCH --output={workdir / 'stdout'}",
+                f"#SBATCH --error={workdir / 'stderr'}",
+            ]
+        lines += [
             "export HQ_ALLOC_QUEUE=%d" % queue_id,
             'export HQ_ALLOC_ID="$SLURM_JOB_ID"',
         ]

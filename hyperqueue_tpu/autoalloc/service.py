@@ -376,7 +376,7 @@ class AutoAllocService:
     async def _submit_one(self, queue) -> None:
         handler = self.handler_for(queue)
         try:
-            allocation_id = await handler.submit_allocation(
+            allocation_id, workdir = await handler.submit_allocation(
                 queue.queue_id, queue.params
             )
         except (SubmitError, OSError) as e:
@@ -396,6 +396,7 @@ class AutoAllocService:
             allocation_id=allocation_id,
             queue_id=queue.queue_id,
             worker_count=queue.params.workers_per_alloc,
+            workdir=workdir,
         )
         self.server.emit_event(
             "alloc-queued",

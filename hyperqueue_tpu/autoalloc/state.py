@@ -48,6 +48,7 @@ class Allocation:
     started_at: float = 0.0
     ended_at: float = 0.0
     connected_workers: set[int] = field(default_factory=set)
+    workdir: str = ""           # holds hq-submit.sh + manager stdout/stderr
 
     @property
     def is_active(self) -> bool:
@@ -63,6 +64,7 @@ class Allocation:
             "started_at": self.started_at,
             "ended_at": self.ended_at,
             "workers": sorted(self.connected_workers),
+            "workdir": self.workdir,
         }
 
 

@@ -603,8 +603,8 @@ def cmd_job_cat(args) -> None:
     for job in jobs:
         detail = job
         task_filter = (
-            set(parse_selector(args.tasks)) if args.tasks else None
-        )
+            set(parse_selector(args.tasks)) or None  # 'all' -> [] = all tasks
+        ) if args.tasks else None
         for task in detail["tasks"]:
             if task_filter is not None and task["id"] not in task_filter:
                 continue
@@ -646,6 +646,42 @@ def cmd_job_progress(args) -> None:
                 print()
                 return
             time.sleep(0.5)
+
+
+def _format_id_ranges(ids: list[int]) -> str:
+    """Compact `1-3,5,7-9` rendering of a sorted id list."""
+    parts: list[str] = []
+    i = 0
+    ids = sorted(ids)
+    while i < len(ids):
+        j = i
+        while j + 1 < len(ids) and ids[j + 1] == ids[j] + 1:
+            j += 1
+        parts.append(str(ids[i]) if i == j else f"{ids[i]}-{ids[j]}")
+        i = j + 1
+    return ",".join(parts)
+
+
+def cmd_job_task_ids(args) -> None:
+    """Print the task ids of selected jobs, optionally filtered by task
+    status (reference JobCommand::TaskIds, commands/job.rs)."""
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+    statuses = set(args.filter.split(",")) if args.filter else None
+    per_job = {
+        j["id"]: [
+            t["id"] for t in j["tasks"]
+            if statuses is None or t["status"] in statuses
+        ]
+        for j in jobs
+    }
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(per_job)
+        return
+    for job_id, task_ids in per_job.items():
+        print(f"{job_id}: {_format_id_ranges(task_ids)}")
 
 
 def cmd_doc(args) -> None:
@@ -828,6 +864,24 @@ def cmd_alloc_info(args) -> None:
     )
 
 
+def cmd_alloc_log(args) -> None:
+    """Print the manager-captured stdout/stderr of one allocation
+    (reference commands/autoalloc.rs AutoAllocCommand::Log)."""
+    with _session(args) as session:
+        response = session.request(
+            {"op": "alloc_log", "allocation_id": args.allocation_id}
+        )
+    alloc = response["allocation"]
+    path = Path(alloc["workdir"]) / args.channel
+    if not path.exists():
+        fail(
+            f"allocation {args.allocation_id} has no captured {args.channel} "
+            f"(expected at {path}; the allocation may not have started yet)"
+        )
+    sys.stdout.write(path.read_text(errors="replace"))
+    sys.stdout.flush()
+
+
 def cmd_alloc_remove(args) -> None:
     with _session(args) as session:
         session.request({"op": "alloc_remove", "queue_id": args.queue_id})
@@ -931,7 +985,9 @@ def cmd_output_log(args) -> None:
         channel = STDOUT if args.channel == "stdout" else STDERR
         # stream records carry packed (job, task) ids; --tasks selects by the
         # job-task part
-        wanted = set(parse_selector(args.tasks)) if args.tasks else None
+        wanted = (
+            set(parse_selector(args.tasks)) or None  # 'all' parses to [] = all tasks
+        ) if args.tasks else None
         for task_id in log.task_ids():
             if wanted is None or task_id_task(task_id) in wanted:
                 sys.stdout.buffer.write(log.cat(task_id, channel))
@@ -976,6 +1032,79 @@ def cmd_task_list(args) -> None:
                 for t in job["tasks"]
             ],
         )
+
+
+def cmd_task_info(args) -> None:
+    """Detailed info for selected tasks of a job (reference
+    TaskCommand::Info, client/task.rs)."""
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+    if not jobs:
+        fail("job not found")
+    wanted = (
+        set(parse_selector(args.tasks)) or None  # 'all' parses to [] = all tasks
+    ) if args.tasks else None
+    rows = []
+    for job in jobs:
+        for t in job["tasks"]:
+            if wanted is not None and t["id"] not in wanted:
+                continue
+            rows.append((job, t))
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value([
+            {"job": job["id"], **t} for job, t in rows
+        ])
+        return
+    for job, t in rows:
+        runtime = ""
+        if t["started_at"] and t["finished_at"]:
+            runtime = f"{t['finished_at'] - t['started_at']:.3f}s"
+        out.record({
+            "job": job["id"],
+            "task": t["id"],
+            "status": t["status"],
+            "workers": ",".join(map(str, t["workers"])),
+            "started": _format_time(t["started_at"]),
+            "finished": _format_time(t["finished_at"]),
+            "runtime": runtime,
+            "error": t["error"],
+        })
+
+
+def _format_time(ts: float) -> str:
+    if not ts:
+        return ""
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+
+
+def cmd_worker_hwdetect(args) -> None:
+    """Detect and print this node's resources without starting a worker
+    (reference WorkerCommand::HwDetect)."""
+    from hyperqueue_tpu.worker.hwdetect import detect_resources
+
+    descriptor = detect_resources(
+        n_cpus=None,
+        no_hyper_threading=args.no_hyper_threading,
+        with_memory=True,
+    )
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(descriptor.to_dict())
+        return
+    for item in descriptor.items:
+        groups = item.index_groups()
+        if item.kind.value == "sum":
+            print(f"{item.name}: sum({item.total_amount()})")
+        elif len(groups) > 1:
+            print(f"{item.name}: {len(groups)} groups "
+                  f"{[len(g) for g in groups]} "
+                  f"({sum(len(g) for g in groups)} total)")
+        else:
+            print(f"{item.name}: {len(groups[0]) if groups else 0}")
+    if descriptor.coupling:
+        print(f"coupling: {', '.join(descriptor.coupling.names)}")
 
 
 # ---------------------------------------------------------------- parser
@@ -1058,6 +1187,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero-worker", action="store_true",
                    help="benchmark mode: tasks succeed instantly, no spawn")
     p.set_defaults(fn=cmd_worker_start)
+    p = wsub.add_parser("hw-detect", help="print detected node resources")
+    _add_common(p)
+    p.add_argument("--no-hyper-threading", action="store_true")
+    p.set_defaults(fn=cmd_worker_hwdetect)
     p = wsub.add_parser("list")
     _add_common(p)
     p.set_defaults(fn=cmd_worker_list)
@@ -1086,43 +1219,46 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_worker_deploy_ssh)
 
     # submit
+    def _add_submit_args(p):
+        _add_common(p)
+        p.add_argument("--name", default=None)
+        p.add_argument("--cpus", default=None)
+        p.add_argument("--resource", dest="resource_request", action="append")
+        p.add_argument("--nodes", type=int, default=None)
+        p.add_argument("--time-request", type=float, default=None)
+        p.add_argument("--time-limit", type=float, default=None,
+                       help="kill a task after this many seconds")
+        p.add_argument("--priority", type=int, default=0)
+        p.add_argument("--weight", type=_parse_weight, default=None,
+                       help="scheduler objective weight: biases which same-"
+                            "priority job wins contended workers (default 1.0)")
+        p.add_argument("--max-fails", type=int, default=None)
+        p.add_argument("--crash-limit", type=int, default=5)
+        p.add_argument("--array", default=None)
+        p.add_argument("--each-line", default=None)
+        p.add_argument("--from-json", default=None)
+        p.add_argument("--env", action="append")
+        p.add_argument("--cwd", default=None)
+        p.add_argument("--stdout", default=None)
+        p.add_argument("--stderr", default=None)
+        p.add_argument("--stream", default=None,
+                       help="stream task output into this directory (.hqs files)")
+        p.add_argument("--pin", choices=["taskset", "omp"], default=None,
+                       help="pin tasks to their claimed cpu indices")
+        p.add_argument("--task-dir", action="store_true",
+                       help="create a private task directory (HQ_TASK_DIR)")
+        p.add_argument("--stdin", action="store_true")
+        p.add_argument("--wait", action="store_true")
+        p.add_argument("--job", type=int, default=None,
+                       help="submit into an existing open job")
+        p.add_argument("--directives", choices=["auto", "file", "off"],
+                       default="auto",
+                       help="parse #HQ directive lines from the submitted script")
+        p.add_argument("command", nargs=argparse.REMAINDER)
+        p.set_defaults(fn=cmd_submit)
+
     p = sub.add_parser("submit", help="submit a job")
-    _add_common(p)
-    p.add_argument("--name", default=None)
-    p.add_argument("--cpus", default=None)
-    p.add_argument("--resource", dest="resource_request", action="append")
-    p.add_argument("--nodes", type=int, default=None)
-    p.add_argument("--time-request", type=float, default=None)
-    p.add_argument("--time-limit", type=float, default=None,
-                   help="kill a task after this many seconds")
-    p.add_argument("--priority", type=int, default=0)
-    p.add_argument("--weight", type=_parse_weight, default=None,
-                   help="scheduler objective weight: biases which same-"
-                        "priority job wins contended workers (default 1.0)")
-    p.add_argument("--max-fails", type=int, default=None)
-    p.add_argument("--crash-limit", type=int, default=5)
-    p.add_argument("--array", default=None)
-    p.add_argument("--each-line", default=None)
-    p.add_argument("--from-json", default=None)
-    p.add_argument("--env", action="append")
-    p.add_argument("--cwd", default=None)
-    p.add_argument("--stdout", default=None)
-    p.add_argument("--stderr", default=None)
-    p.add_argument("--stream", default=None,
-                   help="stream task output into this directory (.hqs files)")
-    p.add_argument("--pin", choices=["taskset", "omp"], default=None,
-                   help="pin tasks to their claimed cpu indices")
-    p.add_argument("--task-dir", action="store_true",
-                   help="create a private task directory (HQ_TASK_DIR)")
-    p.add_argument("--stdin", action="store_true")
-    p.add_argument("--wait", action="store_true")
-    p.add_argument("--job", type=int, default=None,
-                   help="submit into an existing open job")
-    p.add_argument("--directives", choices=["auto", "file", "off"],
-                   default="auto",
-                   help="parse #HQ directive lines from the submitted script")
-    p.add_argument("command", nargs=argparse.REMAINDER)
-    p.set_defaults(fn=cmd_submit)
+    _add_submit_args(p)
 
     # job
     job = sub.add_parser("job", help="job inspection")
@@ -1142,6 +1278,14 @@ def build_parser() -> argparse.ArgumentParser:
         _add_common(p)
         p.add_argument("selector")
         p.set_defaults(fn=fn)
+    p = jsub.add_parser("submit", help="alias of top-level `hq submit`")
+    _add_submit_args(p)
+    p = jsub.add_parser("task-ids", help="print task ids of selected jobs")
+    _add_common(p)
+    p.add_argument("selector")
+    p.add_argument("--filter", default=None,
+                   help="comma-separated task statuses (e.g. failed,running)")
+    p.set_defaults(fn=cmd_job_task_ids)
     p = jsub.add_parser("cat")
     _add_common(p)
     p.add_argument("selector")
@@ -1193,6 +1337,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = asub.add_parser("list")
     _add_common(p)
     p.set_defaults(fn=cmd_alloc_list)
+    p = asub.add_parser("log", help="show an allocation's stdout/stderr")
+    _add_common(p)
+    p.add_argument("allocation_id")
+    p.add_argument("channel", choices=["stdout", "stderr"])
+    p.set_defaults(fn=cmd_alloc_log)
     for name, fn in [("info", cmd_alloc_info), ("remove", cmd_alloc_remove),
                      ("pause", cmd_alloc_pause), ("resume", cmd_alloc_pause)]:
         p = asub.add_parser(name)
@@ -1243,6 +1392,12 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("selector")
     p.set_defaults(fn=cmd_task_list)
+    p = tsub.add_parser("info", help="detailed task info")
+    _add_common(p)
+    p.add_argument("selector")
+    p.add_argument("tasks", nargs="?", default=None,
+                   help="task id selector (e.g. 1-3,7); all tasks if omitted")
+    p.set_defaults(fn=cmd_task_info)
     p = tsub.add_parser("explain", help="why is this task (not) running")
     _add_common(p)
     p.add_argument("job_id", type=int)
@@ -1340,7 +1495,7 @@ def cmd_job_submit_file(args) -> None:
 def main(argv: list[str] | None = None) -> None:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     args = build_parser().parse_args(argv)
-    if args.cmd == "submit":
+    if getattr(args, "fn", None) is cmd_submit:  # `submit` or `job submit`
         if args.command and args.command[0] == "--":
             args.command = args.command[1:]
         # #HQ directives from the submitted script; explicit CLI args win

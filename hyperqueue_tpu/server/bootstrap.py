@@ -796,6 +796,18 @@ class Server:
             queue.next_submit_at = 0.0
         return {"op": "ok", "state": queue.state}
 
+    async def _client_alloc_log(self, msg: dict) -> dict:
+        """Locate an allocation so the client can read its manager-captured
+        stdout/stderr (reference commands/autoalloc.rs print_allocation_output
+        via AutoAllocRequest::GetAllocationInfo)."""
+        _queue, alloc = self.autoalloc.state.find_allocation(msg["allocation_id"])
+        if alloc is None:
+            return {
+                "op": "error",
+                "message": f"allocation {msg['allocation_id']} not found",
+            }
+        return {"op": "alloc_log", "allocation": alloc.to_wire()}
+
     async def _client_alloc_dry_run(self, msg: dict) -> dict:
         from hyperqueue_tpu.autoalloc.state import QueueParams
 

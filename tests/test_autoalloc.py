@@ -11,6 +11,7 @@ import os
 import stat
 import textwrap
 import time
+from pathlib import Path
 
 import pytest
 
@@ -620,3 +621,37 @@ def test_query_min_utilization_counts_all_policy_cpu(tmp_path):
                                     "--min-utilization", "1.0"]),
     )
     assert service._fake_worker_demand(queue) == 1
+
+
+def test_alloc_log_e2e(env, tmp_path):
+    """`hq alloc log <id> stdout|stderr` prints the manager-captured output
+    from the allocation workdir (reference AutoAllocCommand::Log)."""
+    bin_dir, log_dir = tmp_path / "bin", tmp_path / "log"
+    make_mock_bins(bin_dir, log_dir)
+    os.environ["PATH"] = f"{bin_dir}:{os.environ['PATH']}"
+    try:
+        env.start_server()
+        env.command(["alloc", "add", "slurm"])
+        env.command(["submit", "--array", "1-4", "--", "sleep", "1"])
+        wait_until(
+            lambda: (log_dir / "sbatch.log").exists(),
+            timeout=25, message="sbatch invoked",
+        )
+        queues = json.loads(
+            env.command(["alloc", "list", "--output-mode", "json"])
+        )
+        alloc = queues[0]["allocations"][0]
+        workdir = Path(alloc["workdir"])
+        assert workdir.is_dir()
+        script = (workdir / "hq-submit.sh").read_text()
+        assert f"#SBATCH --output={workdir / 'stdout'}" in script
+        assert f"#SBATCH --error={workdir / 'stderr'}" in script
+        # the mock manager never runs the script; fabricate its stdout
+        (workdir / "stdout").write_text("manager says hi\n")
+        out = env.command(["alloc", "log", alloc["id"], "stdout"])
+        assert out == "manager says hi\n"
+        env.command(["alloc", "log", alloc["id"], "stderr"], expect_fail=True)
+        env.command(["alloc", "log", "no-such-alloc", "stdout"],
+                    expect_fail=True)
+    finally:
+        os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)

@@ -203,3 +203,67 @@ def test_preshared_access_file_e2e(env, tmp_path):
     env.command(["submit", "--wait", "--", "echo", "preshared-ok"])
     out = env.command(["job", "cat", "1", "stdout"])
     assert out.strip() == "preshared-ok"
+
+
+def test_job_task_ids_e2e(env):
+    """Reference JobCommand::TaskIds: ids of selected jobs, filterable by
+    task status (commands/job.rs)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--wait", "--array", "1-4", "--crash-limit", "1",
+         "--", "bash", "-c", 'test "$HQ_TASK_ID" != 3'],
+        expect_fail=True,
+    )
+    out = env.command(["job", "task-ids", "1"])
+    assert out.strip() == "1: 1-4"
+    out = env.command(["job", "task-ids", "1", "--filter", "failed"])
+    assert out.strip() == "1: 3"
+    out = json.loads(
+        env.command(["job", "task-ids", "1", "--filter", "finished",
+                     "--output-mode", "json"])
+    )
+    assert out == {"1": [1, 2, 4]}
+
+
+def test_task_info_e2e(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--array", "0-2", "--", "true"])
+    info = json.loads(
+        env.command(["task", "info", "1", "1", "--output-mode", "json"])
+    )
+    assert len(info) == 1
+    assert info[0]["job"] == 1 and info[0]["id"] == 1
+    assert info[0]["status"] == "finished"
+    assert info[0]["finished_at"] >= info[0]["started_at"] > 0
+    # no task selector: all tasks
+    info = json.loads(
+        env.command(["task", "info", "1", "--output-mode", "json"])
+    )
+    assert [t["id"] for t in info] == [0, 1, 2]
+
+
+def test_job_submit_alias_e2e(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["job", "submit", "--wait", "--", "echo", "via-alias"])
+    assert env.command(["job", "cat", "1", "stdout"]).strip() == "via-alias"
+
+
+def test_worker_hw_detect():
+    """`hq worker hw-detect` needs no server: prints detected resources."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "hyperqueue_tpu", "worker", "hw-detect",
+         "--output-mode", "json"],
+        capture_output=True, text=True, timeout=60, check=True,
+    ).stdout
+    data = json.loads(out)
+    names = [item["name"] for item in data["items"]]
+    assert "cpus" in names and "mem" in names
