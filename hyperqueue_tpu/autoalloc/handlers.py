@@ -39,12 +39,29 @@ def _worker_command(server_dir: str, queue_id: int, params: QueueParams) -> str:
         "--idle-timeout",
         str(params.idle_timeout_secs),
         "--time-limit",
-        str(params.time_limit_secs),
+        str(params.worker_time_limit_secs or params.time_limit_secs),
         "--on-server-lost",
-        "finish-running",
+        params.on_server_lost or "finish-running",
         *params.worker_args,
     ]
-    return " ".join(shlex.quote(a) for a in args)
+    cmd = " ".join(shlex.quote(a) for a in args)
+    if params.worker_wrap_cmd:
+        # reference worker_wrap_cmd: `<wrap> hq worker start ...`
+        cmd = f"{params.worker_wrap_cmd} {cmd}"
+    return cmd
+
+
+def _node_command(params: QueueParams, worker_cmd: str) -> str:
+    """Per-node shell line: start hook, (wrapped) worker, stop hook.
+    The stop hook runs regardless of the worker's exit status
+    (reference worker_start_cmd/worker_stop_cmd, best-effort)."""
+    parts = []
+    if params.worker_start_cmd:
+        parts.append(params.worker_start_cmd)
+    parts.append(worker_cmd)
+    if params.worker_stop_cmd:
+        parts.append(params.worker_stop_cmd)
+    return " ; ".join(parts)
 
 
 class QueueHandler:
@@ -149,12 +166,13 @@ class PbsHandler(QueueHandler):
             "export HQ_ALLOC_QUEUE=%d" % queue_id,
             'export HQ_ALLOC_ID="$PBS_JOBID"',
         ]
+        node_cmd = _node_command(params, worker_cmd)
         if params.workers_per_alloc > 1:
             lines.append(
-                f"pbsdsh -- bash -l -c {shlex.quote(worker_cmd)}"
+                f"pbsdsh -- bash -l -c {shlex.quote(node_cmd)}"
             )
         else:
-            lines.append(worker_cmd)
+            lines.append(node_cmd)
         return "\n".join(lines) + "\n"
 
     def parse_submit_output(self, stdout: str) -> str:
@@ -210,10 +228,11 @@ class SlurmHandler(QueueHandler):
             "export HQ_ALLOC_QUEUE=%d" % queue_id,
             'export HQ_ALLOC_ID="$SLURM_JOB_ID"',
         ]
+        node_cmd = _node_command(params, worker_cmd)
         if params.workers_per_alloc > 1:
-            lines.append(f"srun --overlap bash -c {shlex.quote(worker_cmd)}")
+            lines.append(f"srun --overlap bash -c {shlex.quote(node_cmd)}")
         else:
-            lines.append(worker_cmd)
+            lines.append(node_cmd)
         return "\n".join(lines) + "\n"
 
     def parse_submit_output(self, stdout: str) -> str:

@@ -417,3 +417,21 @@ class AutoAllocService:
         )
         script = handler.build_script(0, params)
         return {"script": script, "submit_binary": handler.submit_binary}
+
+    async def probe_submit(self, params: QueueParams) -> str | None:
+        """Submit a probing allocation and immediately cancel it — `alloc add`
+        verifies queue parameters this way unless --no-dry-run (reference
+        commands/autoalloc.rs no_dry_run, process.rs dry-run submit).
+        Returns an error message, or None if the probe succeeded."""
+        handler = make_handler(
+            params.manager, str(self.server.server_dir), self.work_dir / "dryrun"
+        )
+        try:
+            allocation_id, _workdir = await handler.submit_allocation(0, params)
+        except (SubmitError, OSError) as e:
+            return str(e)
+        try:
+            await handler.remove_allocation(allocation_id)
+        except Exception:  # noqa: BLE001 — cancel is best-effort
+            logger.warning("failed to cancel probe allocation %s", allocation_id)
+        return None

@@ -29,6 +29,12 @@ class QueueParams:
     worker_args: list[str] = field(default_factory=list)  # extra hq args
     additional_args: list[str] = field(default_factory=list)  # qsub/sbatch args
     idle_timeout_secs: float = 300.0
+    # reference SharedQueueOpts (commands/autoalloc.rs:96-180)
+    worker_start_cmd: str = ""    # shell line run before each worker starts
+    worker_stop_cmd: str = ""     # shell line run after the worker terminates
+    worker_wrap_cmd: str = ""     # prefix for the `hq worker start` command
+    worker_time_limit_secs: float = 0.0  # 0 = allocation time limit
+    on_server_lost: str = "finish-running"
 
     def to_wire(self) -> dict:
         return self.__dict__.copy()

@@ -116,6 +116,8 @@ def cmd_server_start(args) -> None:
             disable_worker_auth=args.disable_worker_authentication,
             scheduler=args.scheduler,
             journal_path=Path(args.journal) if args.journal else None,
+            idle_timeout=args.idle_timeout,
+            journal_flush_period=args.journal_flush_period,
             access_file=Path(args.access_file) if args.access_file else None,
         )
         access = await server.start()
@@ -203,7 +205,11 @@ def cmd_worker_start(args) -> None:
         group=args.group,
         heartbeat_secs=args.heartbeat,
         time_limit_secs=time_limit,
-        idle_timeout_secs=args.idle_timeout or 0.0,
+        # None = flag not given -> adopt the server default at registration;
+        # an explicit --idle-timeout 0 means "never idle-stop"
+        idle_timeout_secs=(
+            args.idle_timeout if args.idle_timeout is not None else -1.0
+        ),
         on_server_lost=args.on_server_lost,
         overview_interval_secs=args.overview_interval,
         min_utilization=args.min_utilization,
@@ -430,6 +436,143 @@ def _parse_min_utilization(text: str) -> float:
     return value
 
 
+_DURATION_UNITS = {
+    "ms": 0.001, "s": 1.0, "sec": 1.0, "secs": 1.0, "second": 1.0,
+    "seconds": 1.0, "m": 60.0, "min": 60.0, "mins": 60.0, "minute": 60.0,
+    "minutes": 60.0, "h": 3600.0, "hour": 3600.0, "hours": 3600.0,
+    "hrs": 3600.0, "d": 86400.0, "day": 86400.0, "days": 86400.0,
+}
+
+
+def _parse_duration(text: str) -> float:
+    """Seconds from `90`, `1.5h`, `10min`, `1h30m`, or `HH:MM:SS`
+    (reference parse_hms_or_human_time, common/parser2.rs)."""
+    text = text.strip()
+    try:
+        return float(text)  # plain seconds
+    except ValueError:
+        pass
+    if ":" in text:  # [HH:]MM:SS
+        parts = text.split(":")
+        if len(parts) in (2, 3) and all(p.isdigit() for p in parts):
+            secs = 0.0
+            for p in parts:
+                secs = secs * 60 + int(p)
+            return secs
+        raise argparse.ArgumentTypeError(f"invalid duration {text!r}")
+    import re
+
+    matches = re.findall(r"(\d+(?:\.\d+)?)\s*([a-zA-Z]+)", text)
+    if not matches or "".join(n + u for n, u in matches) != text.replace(" ", ""):
+        raise argparse.ArgumentTypeError(
+            f"invalid duration {text!r} (expected e.g. 30, 10min, 1h30m, 01:30:00)"
+        )
+    secs = 0.0
+    for number, unit in matches:
+        scale = _DURATION_UNITS.get(unit.lower())
+        if scale is None:
+            raise argparse.ArgumentTypeError(
+                f"unknown duration unit {unit!r} in {text!r}"
+            )
+        secs += float(number) * scale
+    return secs
+
+
+def _parse_crash_limit(text: str) -> int:
+    """Positive integer, `never-restart`, or `unlimited` (reference
+    CrashLimit, gateway.rs:96-106). 0 encodes unlimited on the wire."""
+    if text == "never-restart":
+        return 1  # fail on the first crash, never reschedule
+    if text == "unlimited":
+        return 0
+    try:
+        value = int(text)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"crash limit must be a positive integer, 'never-restart' or "
+            f"'unlimited', got {text!r}"
+        )
+    if value <= 0:
+        raise argparse.ArgumentTypeError("crash limit must be positive")
+    return value
+
+
+class _NotifyRunner:
+    """Streams task-notify events in a daemon thread and runs the
+    `--on-notify` program serially for events of the submitted job
+    (reference JobSubmitOpts::on_notify). Subscription is acknowledged by
+    the server's `stream_live` frame BEFORE the submit happens on the other
+    connection, so no notify of the submitted job can precede the listener.
+    Records arriving before the job id is known are buffered and replayed
+    via flush() once `set_job_id` runs."""
+
+    def __init__(self, args):
+        import threading
+
+        self._args = args
+        self._job_id = None
+        self.stop = False
+        self._buffered: list[dict] = []
+        self._lock = threading.Lock()
+        self._subscribed = threading.Event()
+        threading.Thread(target=self._loop, daemon=True).start()
+        if not self._subscribed.wait(timeout=10):
+            print("--on-notify: event stream subscription timed out; "
+                  "notifications disabled", file=sys.stderr)
+
+    def set_job_id(self, job_id: int) -> None:
+        with self._lock:
+            self._job_id = job_id
+            self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        while self._buffered:
+            self._run(self._buffered.pop(0))
+
+    def _run(self, rec: dict) -> None:
+        import subprocess
+
+        if rec.get("job") != self._job_id:
+            return
+        try:
+            subprocess.run([self._args.on_notify, json.dumps(rec)],
+                           check=False)
+        except OSError as e:
+            print(f"--on-notify program failed: {e}", file=sys.stderr)
+
+    def _loop(self):
+        from hyperqueue_tpu.client.connection import stream_events
+
+        try:
+            for msg in stream_events(
+                _server_dir(self._args), filters=("task-notify",)
+            ):
+                if self.stop:
+                    break
+                op = msg.get("op")
+                if op == "stream_live":
+                    self._subscribed.set()
+                    continue
+                if op != "event":
+                    continue
+                with self._lock:
+                    if self._job_id is None:
+                        self._buffered.append(msg["record"])
+                    else:
+                        self._flush_locked()
+                        self._run(msg["record"])
+        except Exception as e:
+            if not self._subscribed.is_set():
+                print(f"--on-notify: event stream unavailable ({e}); "
+                      "notifications disabled", file=sys.stderr)
+                self._subscribed.set()  # unblock the submit
+            # post-subscription errors: stream teardown at process exit
+
+
 def cmd_submit(args) -> None:
     if not args.command:
         fail("no command given")
@@ -451,7 +594,9 @@ def cmd_submit(args) -> None:
     if args.time_limit:
         body_base["time_limit"] = args.time_limit
     if args.stdin:
-        body_base["stdin"] = sys.stdin.buffer.read()
+        body_base["stdin"] = (
+            getattr(args, "_stdin_data", None) or sys.stdin.buffer.read()
+        )
     request = _build_request(args)
 
     task_ids: list[int] | None = None
@@ -497,9 +642,14 @@ def cmd_submit(args) -> None:
     if args.job is not None:
         job_desc["job_id"] = args.job
 
+    notify_runner = None
+    if args.on_notify and (args.wait or args.progress):
+        notify_runner = _NotifyRunner(args)
     with _session(args) as session:
         response = session.request({"op": "submit", "job": job_desc})
         job_id = response["job_id"]
+        if notify_runner is not None:
+            notify_runner.set_job_id(job_id)
         out = make_output(args.output_mode)
         if args.output_mode == "quiet":
             out.value(job_id)
@@ -508,15 +658,26 @@ def cmd_submit(args) -> None:
                 f"Job submitted successfully, job ID: {job_id}"
                 f" ({response['n_tasks']} tasks)"
             )
-        if args.wait:
-            info = session.request({"op": "job_wait", "job_ids": [job_id]})
-            job = info["jobs"][0] if info["jobs"] else None
-            ok = job is not None and not (
-                job["counters"]["failed"] or job["counters"]["canceled"]
-            )
+        try:
+            if args.progress:
+                jobs = _progress_loop(session, [job_id])
+                job = jobs[0] if jobs else None
+            elif args.wait:
+                info = session.request({"op": "job_wait", "job_ids": [job_id]})
+                job = info["jobs"][0] if info["jobs"] else None
+            else:
+                return
+        finally:
+            if notify_runner is not None:
+                notify_runner.flush()  # buffered notifies of a fast job
+                notify_runner.stop = True
+        ok = job is not None and not (
+            job["counters"]["failed"] or job["counters"]["canceled"]
+        )
+        if not args.progress:
             out.message(f"job {job_id} {job['status'] if job else 'unknown'}")
-            if not ok:
-                raise SystemExit(1)
+        if not ok:
+            raise SystemExit(1)
 
 
 # ---------------------------------------------------------------- job cmds
@@ -623,29 +784,34 @@ def cmd_job_cat(args) -> None:
     sys.stdout.flush()
 
 
+def _progress_loop(session, ids: list[int]) -> list[dict]:
+    """Poll + render a progress line until every job in `ids` is done;
+    returns the final job infos."""
+    while True:
+        jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
+        parts = []
+        all_done = True
+        for j in jobs:
+            c = j["counters"]
+            done = c["finished"] + c["failed"] + c["canceled"]
+            parts.append(
+                f"job {j['id']}: {done}/{j['n_tasks']} "
+                f"(run {c['running']}, fail {c['failed']})"
+            )
+            if done < j["n_tasks"] or j["status"] == "running":
+                all_done = False
+        print("\r" + " | ".join(parts) + " " * 8, end="", flush=True)
+        if all_done:
+            print()
+            return jobs
+        time.sleep(0.5)
+
+
 def cmd_job_progress(args) -> None:
     """Live progress display while jobs run (reference `hq job progress`)."""
     with _session(args) as session:
         ids = _resolve_job_selector(session, args.selector)
-        while True:
-            jobs = session.request({"op": "job_info", "job_ids": ids})["jobs"]
-            parts = []
-            all_done = True
-            for j in jobs:
-                c = j["counters"]
-                done = c["finished"] + c["failed"] + c["canceled"]
-                total = j["n_tasks"] or 1
-                parts.append(
-                    f"job {j['id']}: {done}/{j['n_tasks']} "
-                    f"(run {c['running']}, fail {c['failed']})"
-                )
-                if done < j["n_tasks"] or j["status"] == "running":
-                    all_done = False
-            print("\r" + " | ".join(parts) + " " * 8, end="", flush=True)
-            if all_done:
-                print()
-                return
-            time.sleep(0.5)
+        _progress_loop(session, ids)
 
 
 def _format_id_ranges(ids: list[int]) -> str:
@@ -807,13 +973,19 @@ def _alloc_params(args) -> dict:
         ),
         "additional_args": args.additional_args or [],
         "idle_timeout_secs": args.idle_timeout,
+        "worker_start_cmd": args.worker_start_cmd or "",
+        "worker_stop_cmd": args.worker_stop_cmd or "",
+        "worker_wrap_cmd": args.worker_wrap_cmd or "",
+        "worker_time_limit_secs": args.worker_time_limit or 0.0,
+        "on_server_lost": args.on_server_lost,
     }
 
 
 def cmd_alloc_add(args) -> None:
     with _session(args) as session:
         response = session.request(
-            {"op": "alloc_add", "params": _alloc_params(args)}
+            {"op": "alloc_add", "params": _alloc_params(args),
+             "no_dry_run": args.no_dry_run}
         )
     out = make_output(args.output_mode)
     if args.output_mode == "quiet":
@@ -1133,6 +1305,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "multichip shards the cut-scan's worker axis over "
                         "all visible devices (identical semantics)")
     p.add_argument("--journal", default=None)
+    p.add_argument("--journal-flush-period", type=_parse_duration, default=0.0,
+                   help="flush the journal on this period instead of after "
+                        "every event (0 = per-event, the default)")
+    p.add_argument("--idle-timeout", type=_parse_duration, default=0.0,
+                   help="default idle timeout adopted by workers that set "
+                        "none of their own")
     p.add_argument("--access-file", default=None,
                    help="start with pre-shared keys/ports from generate-access")
     p.set_defaults(fn=cmd_server_start)
@@ -1170,15 +1348,15 @@ def build_parser() -> argparse.ArgumentParser:
                         'e.g. "cpus,gpus"')
     p.add_argument("--group", default="default")
     p.add_argument("--no-hyper-threading", action="store_true")
-    p.add_argument("--heartbeat", type=float, default=8.0)
-    p.add_argument("--time-limit", type=float, default=None)
-    p.add_argument("--idle-timeout", type=float, default=None)
+    p.add_argument("--heartbeat", type=_parse_duration, default=8.0)
+    p.add_argument("--time-limit", type=_parse_duration, default=None)
+    p.add_argument("--idle-timeout", type=_parse_duration, default=None)
     p.add_argument("--on-server-lost", choices=["stop", "finish-running"],
                    default="stop")
     p.add_argument("--manager", choices=["auto", "pbs", "slurm", "none"],
                    default="auto",
                    help="batch manager detection (time limit from walltime)")
-    p.add_argument("--overview-interval", type=float, default=0.0,
+    p.add_argument("--overview-interval", type=_parse_duration, default=0.0,
                    help="send hardware telemetry every N seconds")
     p.add_argument("--min-utilization", type=_parse_min_utilization,
                    default=0.0,
@@ -1225,15 +1403,18 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--cpus", default=None)
         p.add_argument("--resource", dest="resource_request", action="append")
         p.add_argument("--nodes", type=int, default=None)
-        p.add_argument("--time-request", type=float, default=None)
-        p.add_argument("--time-limit", type=float, default=None,
-                       help="kill a task after this many seconds")
+        p.add_argument("--time-request", type=_parse_duration, default=None,
+                       help="minimal remaining worker lifetime needed to "
+                            "start the task (e.g. 30, 10min, 01:30:00)")
+        p.add_argument("--time-limit", type=_parse_duration, default=None,
+                       help="kill a task after this long (e.g. 30, 10min)")
         p.add_argument("--priority", type=int, default=0)
         p.add_argument("--weight", type=_parse_weight, default=None,
                        help="scheduler objective weight: biases which same-"
                             "priority job wins contended workers (default 1.0)")
         p.add_argument("--max-fails", type=int, default=None)
-        p.add_argument("--crash-limit", type=int, default=5)
+        p.add_argument("--crash-limit", type=_parse_crash_limit, default=5,
+                       help="positive integer, 'never-restart' or 'unlimited'")
         p.add_argument("--array", default=None)
         p.add_argument("--each-line", default=None)
         p.add_argument("--from-json", default=None)
@@ -1249,11 +1430,18 @@ def build_parser() -> argparse.ArgumentParser:
                        help="create a private task directory (HQ_TASK_DIR)")
         p.add_argument("--stdin", action="store_true")
         p.add_argument("--wait", action="store_true")
+        p.add_argument("--progress", action="store_true",
+                       help="show a progress line until the job finishes")
+        p.add_argument("--on-notify", default=None, metavar="PROGRAM",
+                       help="with --wait/--progress: run PROGRAM (serially) "
+                            "for each `hq task notify` event of this job, "
+                            "event JSON as the first argument")
         p.add_argument("--job", type=int, default=None,
                        help="submit into an existing open job")
-        p.add_argument("--directives", choices=["auto", "file", "off"],
+        p.add_argument("--directives", choices=["auto", "file", "stdin", "off"],
                        default="auto",
-                       help="parse #HQ directive lines from the submitted script")
+                       help="parse #HQ directive lines from the submitted "
+                            "script (stdin: from the --stdin payload)")
         p.add_argument("command", nargs=argparse.REMAINDER)
         p.set_defaults(fn=cmd_submit)
 
@@ -1314,14 +1502,30 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--backlog", type=int, default=1)
         p.add_argument("--workers-per-alloc", type=int, default=1)
         p.add_argument("--max-worker-count", type=int, default=None)
-        p.add_argument("--time-limit", type=float, default=3600.0)
-        p.add_argument("--idle-timeout", type=float, default=300.0)
+        p.add_argument("--time-limit", type=_parse_duration, default=3600.0)
+        p.add_argument("--idle-timeout", type=_parse_duration, default=300.0)
         p.add_argument("--name", default=None)
         p.add_argument("--worker-args", action="append")
         p.add_argument("--min-utilization", type=_parse_min_utilization,
                        default=0.0,
                        help="spawned workers only take tasks while at least "
                             "this fraction of their cpus stays busy")
+        p.add_argument("--worker-start-cmd", default=None,
+                       help="shell command run before each worker starts")
+        p.add_argument("--worker-stop-cmd", default=None,
+                       help="shell command run after the worker terminates "
+                            "(best-effort)")
+        p.add_argument("--worker-wrap-cmd", default=None,
+                       help="command prepended to `hq worker start ...`")
+        p.add_argument("--worker-time-limit", type=_parse_duration,
+                       default=None,
+                       help="stop workers this long after start (default: "
+                            "the allocation time limit)")
+        p.add_argument("--on-server-lost",
+                       choices=["stop", "finish-running"],
+                       default="finish-running")
+        p.add_argument("--no-dry-run", action="store_true",
+                       help="skip the probing allocation submit on `alloc add`")
         p.add_argument("manager", choices=["pbs", "slurm"])
         p.add_argument("additional_args", nargs="*",
                        help="extra qsub/sbatch arguments after --")
@@ -1502,18 +1706,31 @@ def main(argv: list[str] | None = None) -> None:
         # because they come later in the re-parsed argv
         from hyperqueue_tpu.client.directives import (
             parse_directives,
+            parse_directives_text,
             should_parse,
         )
 
-        if args.command and should_parse(args.command[0], args.directives):
+        stdin_data = None
+        tokens: list[str] = []
+        if args.directives == "stdin":
+            # the script arrives on stdin (used with --stdin); directives are
+            # parsed from it rather than from the command path
+            if not args.stdin:
+                fail("--directives=stdin requires --stdin (the script is "
+                     "read from standard input and passed to the task)")
+            stdin_data = sys.stdin.buffer.read()
+            tokens = parse_directives_text(stdin_data.decode(errors="replace"))
+        elif args.command and should_parse(args.command[0], args.directives):
             tokens = parse_directives(args.command[0])
-            if tokens:
-                idx = argv.index("submit")
-                args = build_parser().parse_args(
-                    argv[: idx + 1] + tokens + argv[idx + 1 :]
-                )
-                if args.command and args.command[0] == "--":
-                    args.command = args.command[1:]
+        if tokens:
+            idx = argv.index("submit")
+            args = build_parser().parse_args(
+                argv[: idx + 1] + tokens + argv[idx + 1 :]
+            )
+            if args.command and args.command[0] == "--":
+                args.command = args.command[1:]
+        if stdin_data is not None:
+            args._stdin_data = stdin_data
     try:
         args.fn(args)
     except (ClientError, ValueError) as e:
@@ -1522,6 +1739,13 @@ def main(argv: list[str] | None = None) -> None:
         fail(str(e))
     except FileNotFoundError as e:
         fail(str(e))
+    except BrokenPipeError:
+        # `hq ... | head` closed the pipe: exit quietly like other CLIs.
+        # Point stdout at devnull so interpreter shutdown's implicit flush
+        # does not raise a second time.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        raise SystemExit(141)
     except KeyboardInterrupt:
         raise SystemExit(130)
 

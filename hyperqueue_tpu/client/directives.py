@@ -17,13 +17,19 @@ MAX_SCAN_BYTES = 32 * 1024
 
 def parse_directives(path: str | Path) -> list[str]:
     """Extract tokens from #HQ lines in the leading comment block."""
-    tokens: list[str] = []
     try:
         with open(path, "r", errors="replace") as f:
             text = f.read(MAX_SCAN_BYTES)
     except OSError:
-        return tokens
-    for i, line in enumerate(text.splitlines()):
+        return []
+    return parse_directives_text(text)
+
+
+def parse_directives_text(text: str) -> list[str]:
+    """#HQ tokens from script text (used for `--directives stdin`, where the
+    script arrives on standard input — reference DirectivesMode::Stdin)."""
+    tokens: list[str] = []
+    for i, line in enumerate(text[:MAX_SCAN_BYTES].splitlines()):
         stripped = line.strip()
         if i == 0 and stripped.startswith("#!"):
             continue

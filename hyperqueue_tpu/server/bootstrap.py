@@ -181,9 +181,15 @@ class Server:
         scheduler: str = "auto",
         schedule_min_delay: float = SCHEDULE_MIN_DELAY,
         journal_path: Path | None = None,
-        idle_worker_stop: bool = False,
+        idle_timeout: float = 0.0,
+        journal_flush_period: float = 0.0,
         access_file: Path | None = None,
     ):
+        # idle_timeout: default worker idle timeout, adopted at registration
+        # by workers that set none (reference ServerStartOpts idle_timeout,
+        # tako rpc.rs sync_worker_configuration). journal_flush_period: 0 =
+        # flush the journal on every event (stronger than the reference's
+        # 30 s default); > 0 = flush on that period instead.
         self.server_dir = Path(server_dir)
         self.host = host or socket.gethostname()
         self.client_port = client_port
@@ -191,6 +197,8 @@ class Server:
         self.disable_client_auth = disable_client_auth
         self.disable_worker_auth = disable_worker_auth
         self.access_file = access_file
+        self.idle_timeout = idle_timeout
+        self.journal_flush_period = journal_flush_period
         self.schedule_min_delay = schedule_min_delay
         self.core = Core()
         self.jobs = JobManager()
@@ -283,6 +291,10 @@ class Server:
         self.autoalloc.start()
         self._tasks.append(asyncio.create_task(self._scheduler_loop()))
         self._tasks.append(asyncio.create_task(self._heartbeat_reaper()))
+        if self.journal is not None and self.journal_flush_period > 0:
+            self._tasks.append(
+                asyncio.create_task(self._journal_flush_loop())
+            )
         logger.info(
             "server started uid=%s client=%s:%d worker=%s:%d",
             self.access.server_uid,
@@ -329,10 +341,12 @@ class Server:
         self._event_seq += 1
         if self.journal is not None:
             self.journal.write(record)
-            # flush to the OS on every event: a crashed server process then
-            # restores everything (fsync-against-OS-crash happens on close
-            # and on `hq journal flush`, reference --journal-flush-period)
-            self.journal.flush()
+            # default: flush to the OS on every event, so a crashed server
+            # process restores everything (fsync-against-OS-crash happens on
+            # close and `hq journal flush`). With --journal-flush-period the
+            # periodic loop flushes instead (reference 30 s default).
+            if not self.journal_flush_period:
+                self.journal.flush()
         for q in self._event_listeners:
             q.put_nowait(record)
 
@@ -367,6 +381,13 @@ class Server:
                     n,
                     (time.perf_counter() - t0) * 1e3,
                 )
+
+    async def _journal_flush_loop(self) -> None:
+        """Flush the journal on --journal-flush-period instead of per event
+        (reference bootstrap.rs journal_flush_period, default 30 s there)."""
+        while True:
+            await asyncio.sleep(self.journal_flush_period)
+            self.journal.flush()
 
     async def _heartbeat_reaper(self) -> None:
         """Drop workers whose heartbeats stopped (beyond TCP-close detection;
@@ -421,6 +442,9 @@ class Server:
                     "worker_id": worker_id,
                     "server_uid": self.access.server_uid if self.access else "",
                     "heartbeat_secs": config.heartbeat_secs,
+                    # workers with no own idle timeout adopt the server's
+                    # default (reference sync_worker_configuration)
+                    "server_idle_timeout": self.idle_timeout,
                 }
             )
             reactor.on_new_worker(self.core, self.comm, self.events, worker)
@@ -758,6 +782,12 @@ class Server:
         if params.manager not in ("pbs", "slurm"):
             return {"op": "error",
                     "message": f"unknown manager {params.manager!r}"}
+        if not msg.get("no_dry_run"):
+            error = await self.autoalloc.probe_submit(params)
+            if error is not None:
+                return {"op": "error",
+                        "message": f"allocation dry-run failed: {error} "
+                                   "(use --no-dry-run to skip this check)"}
         queue = self.autoalloc.state.add_queue(params)
         self.emit_event(
             "alloc-queue-created",

@@ -26,6 +26,7 @@ class LaunchedTask:
     stdout_path: str | None
     stderr_path: str | None
     pumps: tuple = ()  # stream-mode output pump tasks
+    rm_if_finished: tuple = ()  # stdio paths removed on successful exit
 
     async def wait(self) -> tuple[int, str]:
         """Returns (exit_code, error_detail)."""
@@ -42,6 +43,13 @@ class LaunchedTask:
                     detail = f.read().decode(errors="replace")
             except OSError:
                 pass
+        if code == 0:
+            # reference FileOnCloseBehavior::RmIfFinished (program.rs)
+            for path in self.rm_if_finished:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         return code, detail
 
     def kill(self) -> None:
@@ -137,16 +145,26 @@ async def launch_task(
 
     stream_mode = streamer is not None and body.get("stream")
 
+    rm_paths: list[str] = []
+
     def open_stdio(key: str):
         if stream_mode:
             return asyncio.subprocess.PIPE, None
         spec = body.get(key)
         if spec == "none":
             return asyncio.subprocess.DEVNULL, None
+        # `<path>:rm-if-finished` / `:rm-if-finished` (default path): remove
+        # the file when the task exits successfully (reference StdioDefInput)
+        rm_on_ok = False
+        if spec and spec.endswith(":rm-if-finished"):
+            rm_on_ok = True
+            spec = spec[: -len(":rm-if-finished")]
         if not spec:
             spec = f"%{{SUBMIT_DIR}}/job-%{{JOB_ID}}/%{{TASK_ID}}.{key}"
         path = fill_placeholders(spec, mapping)
         Path(path).parent.mkdir(parents=True, exist_ok=True)
+        if rm_on_ok:
+            rm_paths.append(path)
         return open(path, "wb"), path
 
     stdout_f, stdout_path = open_stdio("stdout")
@@ -206,4 +224,5 @@ async def launch_task(
         stdout_path=stdout_path,
         stderr_path=stderr_path,
         pumps=pumps,
+        rm_if_finished=tuple(rm_paths),
     )

@@ -105,6 +105,13 @@ class WorkerRuntime:
             raise RuntimeError(f"registration failed: {registered}")
         self.worker_id = registered["worker_id"]
         self.server_uid = registered.get("server_uid", "")
+        if self.configuration.idle_timeout_secs < 0:
+            # --idle-timeout not given: adopt the server-wide default
+            # (reference tako rpc.rs:130 sync_worker_configuration). An
+            # explicit --idle-timeout 0 opts out and is left alone.
+            self.configuration.idle_timeout_secs = float(
+                registered.get("server_idle_timeout") or 0.0
+            )
         logger.info("registered as worker %d", self.worker_id)
 
         import tempfile

@@ -98,6 +98,17 @@ def env(tmp_path):
         yield e
 
 
+
+def wait_for_allocation(env, n=1):
+    """Wait until the queue records >= n allocations. The `alloc add` probe
+    submission also touches the mock's sbatch.log, so waiting on that file
+    no longer proves a demand-driven submit happened."""
+    def check():
+        qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+        return len(qs[0]["allocations"]) >= n
+    wait_until(check, timeout=30, message="allocation recorded")
+
+
 def test_autoalloc_submits_on_demand(env, tmp_path):
     bin_dir, log_dir = tmp_path / "bin", tmp_path / "log"
     make_mock_bins(bin_dir, log_dir)
@@ -107,11 +118,7 @@ def test_autoalloc_submits_on_demand(env, tmp_path):
         env.command(["alloc", "add", "slurm", "--backlog", "2"])
         # demand: pending tasks with no workers
         env.command(["submit", "--array", "1-8", "--", "sleep", "1"])
-        wait_until(
-            lambda: (log_dir / "sbatch.log").exists(),
-            timeout=25,
-            message="sbatch invoked",
-        )
+        wait_for_allocation(env)
         queues = json.loads(
             env.command(["alloc", "list", "--output-mode", "json"])
         )
@@ -142,7 +149,10 @@ def test_autoalloc_backoff_pauses_queue(env, tmp_path):
     os.environ["PATH"] = f"{bin_dir}:{os.environ['PATH']}"
     try:
         env.start_server()
-        env.command(["alloc", "add", "slurm"])
+        # without --no-dry-run the probing submit surfaces the broken
+        # parameters immediately (reference `alloc add` dry-run)
+        env.command(["alloc", "add", "slurm"], expect_fail=True)
+        env.command(["alloc", "add", "slurm", "--no-dry-run"])
         env.command(["submit", "--", "sleep", "1"])
 
         def paused():
@@ -175,13 +185,18 @@ def test_autoalloc_worker_links_to_allocation(env, tmp_path):
         env.start_server()
         env.command(["alloc", "add", "slurm"])
         env.command(["submit", "--", "true"])
-        wait_until(
-            lambda: (log_dir / "sbatch.log").exists(),
-            timeout=25,
-            message="sbatch invoked",
-        )
-        # emulate the allocation's worker connecting (HQ_ALLOC_ID=1)
-        os.environ["HQ_ALLOC_ID"] = "1"
+
+        def has_alloc():
+            qs = json.loads(
+                env.command(["alloc", "list", "--output-mode", "json"])
+            )
+            return bool(qs[0]["allocations"])
+
+        wait_until(has_alloc, timeout=25, message="allocation recorded")
+        # emulate the allocation's worker connecting with the recorded id
+        qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+        alloc_id = qs[0]["allocations"][0]["id"]
+        os.environ["HQ_ALLOC_ID"] = alloc_id
         try:
             env.start_worker()
         finally:
@@ -372,11 +387,7 @@ def test_autoalloc_mn_gang_triggers_submit(env, tmp_path):
         env.command(["alloc", "add", "slurm", "--backlog", "1",
                      "--workers-per-alloc", "2"])
         env.command(["submit", "--nodes", "2", "--", "hostname"])
-        wait_until(
-            lambda: (log_dir / "sbatch.log").exists(),
-            timeout=25,
-            message="sbatch invoked for mn demand",
-        )
+        wait_for_allocation(env)
         script = (log_dir / "script-1.sh").read_text()
         assert "worker start" in script
     finally:
@@ -633,10 +644,7 @@ def test_alloc_log_e2e(env, tmp_path):
         env.start_server()
         env.command(["alloc", "add", "slurm"])
         env.command(["submit", "--array", "1-4", "--", "sleep", "1"])
-        wait_until(
-            lambda: (log_dir / "sbatch.log").exists(),
-            timeout=25, message="sbatch invoked",
-        )
+        wait_for_allocation(env)
         queues = json.loads(
             env.command(["alloc", "list", "--output-mode", "json"])
         )
@@ -655,3 +663,31 @@ def test_alloc_log_e2e(env, tmp_path):
                     expect_fail=True)
     finally:
         os.environ["PATH"] = os.environ["PATH"].replace(f"{bin_dir}:", "", 1)
+
+
+def test_script_worker_hooks_wrap_and_limits(tmp_path):
+    """worker_start_cmd / worker_stop_cmd / worker_wrap_cmd /
+    worker_time_limit / on_server_lost shape the generated script
+    (reference SharedQueueOpts, commands/autoalloc.rs:96-180)."""
+    handler = SlurmHandler("/srv", tmp_path)
+    params = QueueParams(
+        manager="slurm",
+        worker_start_cmd="module load hpc",
+        worker_stop_cmd="./cleanup.sh",
+        worker_wrap_cmd="numactl -N 0",
+        worker_time_limit_secs=120.0,
+        on_server_lost="stop",
+        time_limit_secs=600.0,
+    )
+    script = handler.build_script(1, params)
+    line = next(l for l in script.splitlines() if "worker start" in l)
+    # order: start hook ; wrapped worker ; stop hook
+    assert line.index("module load hpc") < line.index("numactl -N 0")
+    assert line.index("numactl -N 0") < line.index("worker start")
+    assert line.index("worker start") < line.index("./cleanup.sh")
+    assert "--time-limit 120.0" in line      # worker limit beats alloc limit
+    assert "--on-server-lost stop" in line
+    # default: worker time limit falls back to the allocation walltime
+    plain = handler.build_script(1, QueueParams(manager="slurm",
+                                               time_limit_secs=600.0))
+    assert "--time-limit 600.0" in plain

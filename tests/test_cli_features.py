@@ -267,3 +267,97 @@ def test_worker_hw_detect():
     data = json.loads(out)
     names = [item["name"] for item in data["items"]]
     assert "cpus" in names and "mem" in names
+
+
+def test_duration_and_crash_limit_parsers():
+    import argparse
+
+    from hyperqueue_tpu.client.cli import _parse_crash_limit, _parse_duration
+
+    assert _parse_duration("90") == 90.0
+    assert _parse_duration("1.5h") == 5400.0
+    assert _parse_duration("10min") == 600.0
+    assert _parse_duration("1h30m") == 5400.0
+    assert _parse_duration("01:30:00") == 5400.0
+    assert _parse_duration("2:05") == 125.0
+    assert _parse_duration("500ms") == 0.5
+    for bad in ("abc", "10parsecs", "1:2:3:4"):
+        with pytest.raises(argparse.ArgumentTypeError):
+            _parse_duration(bad)
+    assert _parse_crash_limit("never-restart") == 1
+    assert _parse_crash_limit("unlimited") == 0
+    assert _parse_crash_limit("7") == 7
+    for bad in ("0", "-1", "sometimes"):
+        with pytest.raises(argparse.ArgumentTypeError):
+            _parse_crash_limit(bad)
+
+
+def test_stdio_none_and_rm_if_finished_e2e(env):
+    """Reference StdioDefInput: `--stdout none` discards output;
+    `<path>:rm-if-finished` removes the file after a successful exit."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--stdout", "none", "--", "echo", "gone"])
+    assert not (env.work_dir / "job-1" / "0.stdout").exists()
+    assert (env.work_dir / "job-1" / "0.stderr").exists()
+
+    kept = env.work_dir / "ok.txt"
+    env.command(["submit", "--wait", "--stdout",
+                 f"{kept}:rm-if-finished", "--", "echo", "ephemeral"])
+    assert not kept.exists()
+
+    failed = env.work_dir / "fail.txt"
+    env.command(["submit", "--wait", "--stdout",
+                 f"{failed}:rm-if-finished", "--", "bash", "-c",
+                 "echo kept-on-failure; exit 3"], expect_fail=True)
+    assert failed.read_text() == "kept-on-failure\n"
+
+
+def test_submit_progress_and_on_notify_e2e(env, tmp_path):
+    """`hq submit --progress` renders a progress line; `--on-notify PROG`
+    runs PROG for each task notify event while waiting (reference
+    JobSubmitOpts on_notify/progress)."""
+    notify_log = tmp_path / "notify.log"
+    prog = tmp_path / "on-notify.sh"
+    prog.write_text(f"#!/bin/bash\necho \"$1\" >> {notify_log}\n")
+    prog.chmod(0o755)
+    out = env.command
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    output = out(
+        ["submit", "--progress", "--on-notify", str(prog), "--", "bash", "-c",
+         "python -m hyperqueue_tpu task notify 'stage-one done'; sleep 0.2"]
+    )
+    assert "job 1: 1/1" in output
+    assert notify_log.exists()
+    rec = json.loads(notify_log.read_text().splitlines()[0])
+    assert rec["event"] == "task-notify"
+    assert rec["payload"] == "stage-one done"
+    assert rec["job"] == 1
+
+
+def test_directives_stdin_e2e(env):
+    """`--directives stdin` parses #HQ lines from the --stdin payload
+    (reference DirectivesMode::Stdin)."""
+    import subprocess
+    import sys
+
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    script = "#!/bin/bash\n#HQ --name from-stdin\necho stdin-script-ran\n"
+    from utils_e2e import _env_base
+
+    result = subprocess.run(
+        [sys.executable, "-m", "hyperqueue_tpu", "submit", "--wait",
+         "--stdin", "--directives", "stdin", "--", "bash"],
+        input=script.encode(),
+        env={**_env_base(), "HQ_SERVER_DIR": str(env.server_dir)},
+        cwd=env.work_dir, capture_output=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stderr
+    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    assert jobs[0]["name"] == "from-stdin"
+    assert env.command(["job", "cat", "1", "stdout"]).strip() == "stdin-script-ran"

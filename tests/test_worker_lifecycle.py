@@ -103,3 +103,50 @@ def test_zero_worker_blocked_tasks_drain(tmp_path):
             env.command(["job", "info", "1", "--output-mode", "json"])
         )[0]
         assert info["counters"]["finished"] == 200
+
+
+def test_server_default_idle_timeout_adopted(env):
+    """`hq server start --idle-timeout` is adopted by workers that set no
+    idle timeout of their own (reference ServerStartOpts idle_timeout,
+    tako rpc.rs sync_worker_configuration)."""
+    env.start_server("--idle-timeout", "5")
+    process = env.start_worker()  # no --idle-timeout
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    wait_until(
+        lambda: process.poll() is not None,
+        timeout=30,
+        message="worker exited on the server-default idle timeout",
+    )
+
+
+def test_journal_flush_period(env, tmp_path):
+    """With --journal-flush-period the journal is flushed periodically, and
+    events written before a crash survive once the period elapses."""
+    journal = tmp_path / "j.bin"
+    env.start_server("--journal", str(journal),
+                     "--journal-flush-period", "1")
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    time.sleep(2.5)  # > flush period
+    env.kill_process("server")  # crash: no clean close/flush
+    out = [
+        json.loads(line)
+        for line in env.command(
+            ["journal", "export", str(journal)]
+        ).splitlines()
+    ]
+    kinds = {r["event"] for r in out}
+    assert "task-finished" in kinds
+
+
+def test_worker_idle_timeout_zero_opts_out(env):
+    """An explicit `--idle-timeout 0` means 'never idle-stop' and must not
+    be overwritten by the server-wide default."""
+    env.start_server("--idle-timeout", "2")
+    process = env.start_worker("--idle-timeout", "0")
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    time.sleep(5)  # well past the server default
+    assert process.poll() is None

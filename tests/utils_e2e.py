@@ -18,11 +18,17 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 # Subprocesses must never grab the real TPU during tests. Built per call so
 # tests that mutate os.environ (PATH mocks, HQ_ALLOC_ID) are picked up.
 def _env_base() -> dict:
-    return {
+    env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": f"{REPO_ROOT}:{os.environ.get('PYTHONPATH', '')}",
     }
+    # When the TPU relay is up, the image's sitecustomize imports jax and
+    # initializes the TPU plugin in EVERY spawned python process (~10 s and
+    # chip contention). CLI clients and workers never need jax; drop the
+    # trigger variable like benchmarks/common.py does.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
 
 
 def wait_until(predicate, timeout=15.0, interval=0.05, message="condition"):
