@@ -117,6 +117,7 @@ def restore_from_journal(server) -> None:
                 rq_id=rq_id,
                 priority=(int(t.get("priority", 0)), -job_id),
                 body=t.get("body", {}),
+                entry=t.get("entry"),
                 deps=deps,
                 crash_limit=int(t.get("crash_limit", 5)),
             )

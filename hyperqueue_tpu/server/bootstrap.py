@@ -73,7 +73,30 @@ class CommSender:
 
     # reactor.Comm protocol
     def send_compute(self, worker_id: int, tasks: list[dict]) -> None:
-        self._send(worker_id, {"op": "compute", "tasks": tasks})
+        # shared/separate split (reference messages/worker.rs:28-54
+        # ComputeTasksMsg): tasks of one array share a body OBJECT, so an
+        # identity dedup sends each distinct body once per message and the
+        # tasks carry an index — at 512-task prefill batches this turns
+        # ~512 serialized bodies into 1
+        shared: list[dict] = []
+        index: dict[int, int] = {}
+        out = []
+        for msg in tasks:
+            body = msg.get("body")
+            key = id(body)
+            idx = index.get(key)
+            if idx is None:
+                idx = len(shared)
+                index[key] = idx
+                shared.append(body)
+            slim = dict(msg)
+            del slim["body"]
+            slim["b"] = idx
+            out.append(slim)
+        self._send(
+            worker_id,
+            {"op": "compute", "tasks": out, "shared_bodies": shared},
+        )
 
     def send_cancel(self, worker_id: int, task_ids: list[int]) -> None:
         self._send(worker_id, {"op": "cancel", "task_ids": task_ids})
@@ -650,10 +673,6 @@ class Server:
                 if job_task_id in used:
                     raise ValueError(f"duplicate task id {job_task_id}")
                 used.add(job_task_id)
-                body = shared_body
-                if entries is not None:
-                    body = dict(shared_body)
-                    body["entry"] = entries[i]
                 job.tasks[job_task_id] = JobTaskInfo(job_task_id=job_task_id)
                 task_id = make_task_id(job.job_id, job_task_id)
                 new_tasks.append(
@@ -661,7 +680,8 @@ class Server:
                         task_id=task_id,
                         rq_id=rq_id,
                         priority=(priority, -job.job_id),
-                        body=body,
+                        body=shared_body,  # one dict for the whole array
+                        entry=entries[i] if entries is not None else None,
                         crash_limit=crash_limit,
                     )
                 )

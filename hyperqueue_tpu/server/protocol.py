@@ -28,20 +28,20 @@ def expand_desc_tasks(job_desc: dict) -> list[dict]:
         return list(job_desc.get("tasks", []))
     out = []
     entries = array.get("entries")
+    shared_body = array.get("body", {})
     for i, task_id in enumerate(array["ids"]):
-        body = array.get("body", {})
+        task = {
+            "id": task_id,
+            # ONE body object for the whole array; the entry travels as its
+            # own field so the compute-message body dedup survives restore
+            "body": shared_body,
+            "request": array.get("request") or {},
+            "priority": array.get("priority", 0),
+            "crash_limit": array.get("crash_limit", 5),
+        }
         if entries is not None:
-            body = dict(body)
-            body["entry"] = entries[i]
-        out.append(
-            {
-                "id": task_id,
-                "body": body,
-                "request": array.get("request") or {},
-                "priority": array.get("priority", 0),
-                "crash_limit": array.get("crash_limit", 5),
-            }
-        )
+            task["entry"] = entries[i]
+        out.append(task)
     return out
 
 

@@ -737,7 +737,7 @@ def _compute_message(core: Core, task: Task, variant: int) -> dict:
         cached = (entries, request.n_nodes)
         core.entries_cache[key] = cached
     entries, n_nodes = cached
-    return {
+    msg = {
         "id": task.task_id,
         "instance": task.instance_id,
         "body": task.body,
@@ -745,3 +745,6 @@ def _compute_message(core: Core, task: Task, variant: int) -> dict:
         "n_nodes": n_nodes,
         "priority": list(task.priority),
     }
+    if task.entry is not None:
+        msg["entry"] = task.entry
+    return msg

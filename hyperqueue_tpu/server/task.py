@@ -34,6 +34,11 @@ class Task:
     rq_id: int
     priority: tuple[int, int] = (0, 0)
     body: dict = field(default_factory=dict)
+    # array-entry payload (HQ_ENTRY), kept OUT of body so every task of an
+    # entries array shares one body object — the wire layer dedups shared
+    # bodies per compute message (reference messages/worker.rs:28-54
+    # shared/separate data split)
+    entry: str | None = None
     deps: tuple[int, ...] = ()
     crash_limit: int = DEFAULT_CRASH_LIMIT
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import shutil
 import signal
 from dataclasses import dataclass
 from pathlib import Path
@@ -27,6 +28,7 @@ class LaunchedTask:
     stderr_path: str | None
     pumps: tuple = ()  # stream-mode output pump tasks
     rm_if_finished: tuple = ()  # stdio paths removed on successful exit
+    cleanup_dirs: tuple = ()  # task dirs removed once the task completes
 
     async def wait(self) -> tuple[int, str]:
         """Returns (exit_code, error_detail)."""
@@ -50,6 +52,11 @@ class LaunchedTask:
                     os.unlink(path)
                 except OSError:
                     pass
+        # task dirs are transient scratch space, deleted when the task
+        # completes whatever the outcome (reference program.rs task-dir
+        # removal; tests/test_task_cleanup.py)
+        for d in self.cleanup_dirs:
+            shutil.rmtree(d, ignore_errors=True)
         return code, detail
 
     def kill(self) -> None:
@@ -108,7 +115,7 @@ async def launch_task(
     env["HQ_SUBMIT_DIR"] = submit_dir
     env["HQ_SERVER_UID"] = server_uid
     env["HQ_WORKER_ID"] = str(worker_id)
-    env["HQ_ENTRY"] = body.get("entry", "") or ""
+    env["HQ_ENTRY"] = task_msg.get("entry") or body.get("entry", "") or ""
     if not env["HQ_ENTRY"]:
         env.pop("HQ_ENTRY")
 
@@ -125,20 +132,30 @@ async def launch_task(
                 # portable subset)
                 env["OMP_NUM_THREADS"] = str(max(len(claim.indices), 1))
 
+    cleanup_dirs: list[str] = []
+
     # optional private task directory (reference program.rs task-dir)
     if body.get("task_dir"):
         task_dir = Path(cwd) / f".hq-task-dir-{job_id}-{job_task_id}-{task_msg.get('instance', 0)}"
         task_dir.mkdir(parents=True, exist_ok=True)
         env["HQ_TASK_DIR"] = str(task_dir)
         env.setdefault("TMPDIR", str(task_dir))
+        cleanup_dirs.append(str(task_dir))
 
     # multi-node gang: write the node file and expose it
     node_hostnames = task_msg.get("node_hostnames")
     if node_hostnames:
-        task_dir = Path(cwd) / f".hq-task-{job_id}-{job_task_id}"
+        # instance-suffixed like the private task dir: on a shared FS a dying
+        # prior instance's cleanup must not delete the rescheduled
+        # instance's node file
+        task_dir = (
+            Path(cwd)
+            / f".hq-task-{job_id}-{job_task_id}-{task_msg.get('instance', 0)}"
+        )
         task_dir.mkdir(parents=True, exist_ok=True)
         node_file = task_dir / "hq_nodes"
         node_file.write_text("\n".join(node_hostnames) + "\n")
+        cleanup_dirs.append(str(task_dir))
         env["HQ_NODE_FILE"] = str(node_file)
         env["HQ_HOST_FILE"] = str(node_file)
         env["HQ_NUM_NODES"] = str(len(node_hostnames))
@@ -225,4 +242,5 @@ async def launch_task(
         stderr_path=stderr_path,
         pumps=pumps,
         rm_if_finished=tuple(rm_paths),
+        cleanup_dirs=tuple(cleanup_dirs),
     )

@@ -164,7 +164,12 @@ class WorkerRuntime:
             msg = await self._conn.recv()
             op = msg.get("op")
             if op == "compute":
+                shared = msg.get("shared_bodies")
                 for task_msg in msg["tasks"]:
+                    if shared is not None and "b" in task_msg:
+                        # resolve the shared/separate split; the body dict
+                        # stays shared between tasks (read-only downstream)
+                        task_msg["body"] = shared[task_msg.pop("b")]
                     self._try_start(task_msg)
             elif op == "cancel":
                 for task_id in msg["task_ids"]:

@@ -139,3 +139,22 @@ def test_graph_submit_without_ids_journals_assigned_ids(tmp_path):
     server._build_tasks(job, desc)
     ids = [t.get("id") for t in expand_desc_tasks(desc)]
     assert sorted(ids) == [0, 1, 2]
+
+
+def test_restore_preserves_array_entries(env, tmp_path):
+    """Entry arrays survive restore: HQ_ENTRY still reaches each task and
+    the restored tasks share one body object (the wire dedup relies on
+    identity sharing; see protocol.expand_desc_tasks)."""
+    journal = tmp_path / "journal.bin"
+    lines = tmp_path / "lines.txt"
+    lines.write_text("alpha\nbeta\ngamma\n")
+    env.start_server("--journal", str(journal))
+    env.command(["submit", "--each-line", str(lines), "--", "bash", "-c",
+                 "echo got=$HQ_ENTRY"])
+    env.kill_process("server")
+
+    env.start_server("--journal", str(journal))
+    env.start_worker()
+    env.command(["job", "wait", "all"], timeout=40)
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert sorted(out.split()) == ["got=alpha", "got=beta", "got=gamma"]

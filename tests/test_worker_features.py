@@ -73,6 +73,35 @@ def test_pinning_env_and_task_dir(env):
     assert ".hq-task-dir-1-0-" in out
 
 
+def test_task_dir_cleaned_up_after_task(env):
+    """The private task directory is deleted when the task completes,
+    success or failure (reference program.rs task-dir removal,
+    tests/test_task_cleanup.py)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--task-dir", "--wait", "--", "bash", "-c",
+         "touch $HQ_TASK_DIR/scratch && echo $HQ_TASK_DIR"]
+    )
+    task_dir = env.command(["job", "cat", "1", "stdout"]).strip()
+    assert ".hq-task-dir-1-0-" in task_dir
+    from utils_e2e import wait_until
+    from pathlib import Path
+
+    wait_until(lambda: not Path(task_dir).exists(), timeout=10,
+               message="task dir removed after success")
+    # failure path cleans up too
+    env.command(
+        ["submit", "--task-dir", "--wait", "--", "bash", "-c",
+         "echo $HQ_TASK_DIR; exit 3"],
+        expect_fail=True,
+    )
+    task_dir = env.command(["job", "cat", "2", "stdout"]).strip()
+    wait_until(lambda: not Path(task_dir).exists(), timeout=10,
+               message="task dir removed after failure")
+
+
 def test_task_time_limit_kills_task(env):
     env.start_server()
     env.start_worker()
