@@ -270,21 +270,29 @@ def cmd_worker_deploy_ssh(args) -> None:
 
 
 def cmd_worker_list(args) -> None:
+    want_all = args.all or args.filter == "offline"
     with _session(args) as session:
-        workers = session.request({"op": "worker_list"})["workers"]
+        workers = session.request(
+            {"op": "worker_list", "all": want_all}
+        )["workers"]
+    if args.filter:
+        workers = [w for w in workers
+                   if w.get("status", "running") == args.filter]
     out = make_output(args.output_mode)
     if args.output_mode == "json":
         out.value(workers)
         return
     out.table(
-        ["id", "hostname", "group", "running", "resources"],
+        ["id", "hostname", "status", "group", "running", "resources"],
         [
             [
                 w["id"],
                 w["hostname"],
+                w.get("status", "running"),
                 w["group"],
                 w["n_running"],
-                " ".join(f"{k}={v / 10_000:g}" for k, v in w["resources"].items()),
+                " ".join(f"{k}={v / 10_000:g}"
+                         for k, v in w["resources"].items()),
             ]
             for w in workers
         ],
@@ -300,10 +308,14 @@ def cmd_worker_info(args) -> None:
     if args.output_mode == "json":
         out.value(worker)
         return
-    worker["free"] = " ".join(
-        f"{k}={v / 10_000:g}" for k, v in worker["free"].items() if v
-    )
-    worker["running_tasks"] = " ".join(worker["running_tasks"]) or "-"
+    if "free" in worker:  # absent on offline (past) workers
+        worker["free"] = " ".join(
+            f"{k}={v / 10_000:g}" for k, v in worker["free"].items() if v
+        )
+    if "running_tasks" in worker:
+        worker["running_tasks"] = " ".join(worker["running_tasks"]) or "-"
+    if "lost_at" in worker:
+        worker["lost_at"] = _format_time(worker["lost_at"])
     worker.pop("descriptor", None)
     overview = worker.pop("overview", None) or {}
     if overview.get("hw"):
@@ -684,6 +696,18 @@ def cmd_submit(args) -> None:
 def cmd_job_list(args) -> None:
     with _session(args) as session:
         jobs = session.request({"op": "job_list"})["jobs"]
+    # reference JobListOpts: open/running only by default; --all shows
+    # everything; --filter selects explicit states
+    if args.filter:
+        wanted = set(args.filter.split(","))
+        unknown = wanted - {"opened", "running", "finished", "failed",
+                            "canceled"}
+        if unknown:
+            fail(f"unknown job state(s) {sorted(unknown)}; valid: "
+                 "opened, running, finished, failed, canceled")
+        jobs = [j for j in jobs if j["status"] in wanted]
+    elif not args.all:
+        jobs = [j for j in jobs if j["status"] in ("opened", "running")]
     out = make_output(args.output_mode)
     if args.output_mode == "json":
         out.value(jobs)
@@ -1371,6 +1395,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_worker_hwdetect)
     p = wsub.add_parser("list")
     _add_common(p)
+    p.add_argument("--all", action="store_true",
+                   help="include disconnected workers")
+    p.add_argument("--filter", choices=["running", "offline"], default=None)
     p.set_defaults(fn=cmd_worker_list)
     p = wsub.add_parser("stop")
     _add_common(p)
@@ -1453,6 +1480,11 @@ def build_parser() -> argparse.ArgumentParser:
     jsub = job.add_subparsers(dest="job_cmd", required=True)
     p = jsub.add_parser("list")
     _add_common(p)
+    p.add_argument("--all", action="store_true",
+                   help="include finished/failed/canceled jobs")
+    p.add_argument("--filter", default=None,
+                   help="comma-separated job states to show "
+                        "(opened,running,finished,failed,canceled)")
     p.set_defaults(fn=cmd_job_list)
     for name, fn, extra in [
         ("info", cmd_job_info, ()),

@@ -223,6 +223,9 @@ class Server:
         self.idle_timeout = idle_timeout
         self.journal_flush_period = journal_flush_period
         self.schedule_min_delay = schedule_min_delay
+        # disconnected workers, for `worker list --all` / `worker info` on a
+        # dead id (reference keeps them in the HQ State worker map)
+        self.past_workers: dict[int, dict] = {}
         self.core = Core()
         self.jobs = JobManager()
         self.comm = CommSender()
@@ -430,6 +433,9 @@ class Server:
                     if conn is not None:
                         conn.close()
                     self.comm.unregister_worker(worker.worker_id)
+                    self._record_past_worker(
+                        worker.worker_id, "heartbeat timeout"
+                    )
                     reactor.on_remove_worker(
                         self.core,
                         self.comm,
@@ -497,6 +503,7 @@ class Server:
                 self._worker_conns.pop(worker_id, None)
                 self.comm.unregister_worker(worker_id)
                 if worker_id in self.core.workers:
+                    self._record_past_worker(worker_id, "connection lost")
                     reactor.on_remove_worker(
                         self.core, self.comm, self.events, worker_id, "connection lost"
                     )
@@ -543,6 +550,10 @@ class Server:
                 )
             elif op == "heartbeat":
                 pass
+            elif op == "goodbye":
+                # deliberate worker exit (idle/time limit): its running
+                # tasks requeue without a crash-counter charge
+                worker.clean_stop = True
             elif op == "task_notify":
                 task_id = msg.get("id", 0)
                 self.emit_event(
@@ -940,29 +951,51 @@ class Server:
             "workers": workers,
         }
 
-    async def _client_worker_list(self, msg: dict) -> dict:
-        return {
-            "op": "worker_list",
-            "workers": [
-                {
-                    "id": w.worker_id,
-                    "hostname": w.configuration.hostname,
-                    "group": w.group,
-                    "n_running": len(w.assigned_tasks),
-                    "resources": {
-                        self.core.resource_map.name_of(i): amount
-                        for i, amount in enumerate(w.resources.amounts)
-                        if amount
-                    },
-                    "overview": w.last_overview,
-                }
-                for w in self.core.workers.values()
-            ],
+    def _record_past_worker(self, worker_id: int, reason: str) -> None:
+        w = self.core.workers.get(worker_id)
+        if w is None:
+            return
+        self.past_workers[worker_id] = {
+            "id": worker_id,
+            "hostname": w.configuration.hostname,
+            "group": w.group,
+            "status": "offline",
+            "n_running": 0,
+            "resources": {},
+            "overview": None,
+            "lost_at": time.time(),
+            "reason": reason,
         }
+        while len(self.past_workers) > 1000:  # bound server memory
+            self.past_workers.pop(next(iter(self.past_workers)))
+
+    async def _client_worker_list(self, msg: dict) -> dict:
+        workers = [
+            {
+                "id": w.worker_id,
+                "hostname": w.configuration.hostname,
+                "group": w.group,
+                "status": "running",
+                "n_running": len(w.assigned_tasks),
+                "resources": {
+                    self.core.resource_map.name_of(i): amount
+                    for i, amount in enumerate(w.resources.amounts)
+                    if amount
+                },
+                "overview": w.last_overview,
+            }
+            for w in self.core.workers.values()
+        ]
+        if msg.get("all"):
+            workers.extend(self.past_workers.values())
+        return {"op": "worker_list", "workers": workers}
 
     async def _client_worker_info(self, msg: dict) -> dict:
         w = self.core.workers.get(msg["worker_id"])
         if w is None:
+            past = self.past_workers.get(msg["worker_id"])
+            if past is not None:
+                return {"op": "worker_info", "worker": past}
             return {"op": "error", "message": "worker not found"}
         return {
             "op": "worker_info",
@@ -1028,7 +1061,9 @@ class Server:
     async def _client_worker_stop(self, msg: dict) -> dict:
         stopped = []
         for wid in msg["worker_ids"]:
-            if wid in self.core.workers:
+            worker = self.core.workers.get(wid)
+            if worker is not None:
+                worker.clean_stop = True  # crash counters stay untouched
                 self.comm.send_stop(wid)
                 stopped.append(wid)
         return {"op": "worker_stop", "stopped": stopped}

@@ -124,7 +124,9 @@ def on_remove_worker(
         was_running = task.state is TaskState.RUNNING
         task.assigned_worker = 0
         task.increment_instance()
-        if was_running and task.crashed():
+        # a deliberate stop (hq worker stop, idle/time limit) restarts the
+        # task without charging its crash counter (reference CrashLimit)
+        if was_running and not worker.clean_stop and task.crashed():
             task.state = TaskState.FAILED
             _propagate_failure(core, events, task, "worker lost too many times")
             continue
@@ -135,12 +137,14 @@ def on_remove_worker(
     if worker.mn_task:
         task = core.tasks.get(worker.mn_task)
         if task is not None and not task.is_done:
-            _teardown_gang(core, comm, events, task, lost_worker=worker_id)
+            _teardown_gang(core, comm, events, task, lost_worker=worker_id,
+                           clean=worker.clean_stop)
     comm.ask_for_scheduling()
 
 
 def _teardown_gang(
-    core: Core, comm: Comm, events: EventSink, task: Task, lost_worker: int
+    core: Core, comm: Comm, events: EventSink, task: Task, lost_worker: int,
+    clean: bool = False
 ) -> None:
     root = task.mn_workers[0] if task.mn_workers else 0
     for wid in task.mn_workers:
@@ -158,7 +162,8 @@ def _teardown_gang(
                 comm.send_cancel(wid, [task.task_id])
     task.mn_workers = ()
     task.increment_instance()
-    if lost_worker == root and task.state is TaskState.RUNNING and task.crashed():
+    if (lost_worker == root and task.state is TaskState.RUNNING
+            and not clean and task.crashed()):
         task.state = TaskState.FAILED
         _propagate_failure(core, events, task, "gang root lost too many times")
         return

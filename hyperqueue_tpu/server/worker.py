@@ -99,6 +99,10 @@ class Worker:
     mn_reserved: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     last_overview: dict = field(default_factory=dict)
+    # the worker is going away deliberately (`hq worker stop`, idle/time
+    # limit): its tasks requeue WITHOUT a crash-counter increment
+    # (reference gateway.rs CrashLimit doc: stops don't count)
+    clean_stop: bool = False
 
     @classmethod
     def create(

@@ -454,6 +454,17 @@ class WorkerRuntime:
             await asyncio.sleep(interval)
             await self._send({"op": "heartbeat"})
 
+    async def _goodbye(self, reason: str) -> None:
+        """Tell the server this is a DELIBERATE exit (idle/time limit), so
+        requeued tasks don't get charged a crash (reference CrashLimit:
+        stops and time limits don't count). Sent directly — the batching
+        drainer may never run again once _stop is set."""
+        try:
+            async with self._send_lock:
+                await self._conn.send({"op": "goodbye", "reason": reason})
+        except (ConnectionError, OSError):
+            pass
+
     async def _limits_loop(self) -> None:
         while True:
             await asyncio.sleep(0.5)
@@ -461,6 +472,7 @@ class WorkerRuntime:
             limit = self.configuration.time_limit_secs
             if limit > 0 and now - self.started_at >= limit:
                 logger.info("time limit reached; stopping")
+                await self._goodbye("time limit")
                 self._stop.set()
                 return
             idle = self.configuration.idle_timeout_secs
@@ -471,6 +483,7 @@ class WorkerRuntime:
                 and now - self.last_task_time >= idle
             ):
                 logger.info("idle timeout reached; stopping")
+                await self._goodbye("idle timeout")
                 self._stop.set()
                 return
 

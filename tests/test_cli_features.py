@@ -128,7 +128,7 @@ def test_directives_e2e(env):
     env.command(["submit", "--wait", "--", "bash", str(script)])
     # auto mode triggers only when script is the command itself
     env.command(["submit", "--wait", str(script)])
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     names = {j["name"] for j in jobs}
     assert "from-directive" in names
 
@@ -358,6 +358,32 @@ def test_directives_stdin_e2e(env):
         cwd=env.work_dir, capture_output=True, timeout=60,
     )
     assert result.returncode == 0, result.stderr
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     assert jobs[0]["name"] == "from-stdin"
     assert env.command(["job", "cat", "1", "stdout"]).strip() == "stdin-script-ran"
+
+
+def test_job_list_default_hides_finished(env):
+    """Reference JobListOpts: only queued/running jobs by default; --all and
+    --filter select more."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])          # finishes
+    env.command(["submit", "--", "sleep", "30"])             # stays running
+    default = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    assert [j["id"] for j in default] == [2]
+    everything = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    assert [j["id"] for j in everything] == [1, 2]
+    finished = json.loads(
+        env.command(["job", "list", "--filter", "finished",
+                     "--output-mode", "json"])
+    )
+    assert [j["id"] for j in finished] == [1]
+
+
+def test_job_list_filter_validates_states(env):
+    env.start_server()
+    env.command(["job", "list", "--filter", "queued"], expect_fail=True)

@@ -33,7 +33,7 @@ def test_job_list_and_info(env):
     env.wait_workers(1)
     env.command(["submit", "--name", "myjob", "--wait", "--", "true"])
     listing = json.loads(
-        env.command(["job", "list", "--output-mode", "json"])
+        env.command(["job", "list", "--all", "--output-mode", "json"])
     )
     assert len(listing) == 1
     assert listing[0]["name"] == "myjob"
@@ -87,7 +87,7 @@ def test_resource_limit_respected(env):
          "bash", "-c", "sleep 0.4"],
         timeout=60,
     )
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     assert jobs[0]["counters"]["finished"] == 4
 
 
@@ -98,14 +98,14 @@ def test_cancel_running_job(env):
     env.command(["submit", "--", "sleep", "30"])
 
     def running():
-        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
         return jobs and jobs[0]["counters"]["running"] == 1
 
     wait_until(running, message="task running")
     env.command(["job", "cancel", "1"])
 
     def canceled():
-        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
         return jobs[0]["status"] == "canceled"
 
     wait_until(canceled, message="job canceled")
@@ -118,14 +118,14 @@ def test_worker_lost_task_requeued(env):
     env.command(["submit", "--", "sleep", "600"])
 
     def running():
-        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
         return jobs and jobs[0]["counters"]["running"] == 1
 
     wait_until(running, message="task running")
     env.kill_process("worker0")
 
     def requeued():
-        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
         return jobs[0]["counters"]["running"] == 0
 
     wait_until(requeued, message="task requeued after worker loss")
@@ -197,6 +197,6 @@ def test_open_job_multiple_submits(env):
          "echo", "b"]
     )
     env.command(["job", "close", str(job_id)])
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     assert jobs[0]["n_tasks"] == 3
     assert jobs[0]["status"] == "finished"

@@ -62,13 +62,13 @@ def test_max_fails_aborts_job(env):
     )
 
     def aborted():
-        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
         c = jobs[0]["counters"]
         done = c["finished"] + c["failed"] + c["canceled"]
         return done == 20 and c["canceled"] > 0
 
     wait_until(aborted, timeout=40, message="job aborted by max-fails")
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     c = jobs[0]["counters"]
     assert c["failed"] >= 3  # a few may race in before the abort
     assert c["failed"] + c["canceled"] == 20

@@ -80,13 +80,13 @@ def test_server_restore_resumes_pending_job(env, tmp_path):
     env.kill_process("server")
 
     env.start_server("--journal", str(journal))
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     names = {j["name"] for j in jobs}
     assert names == {"pending", "also-pending"}
     # a worker arrives; the restored pending job must now run to completion
     env.start_worker()
     env.command(["job", "wait", "all"], timeout=40)
-    jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     assert all(j["status"] == "finished" for j in jobs)
     out = env.command(["job", "cat", "1", "stdout"])
     assert out.strip() == "restored"

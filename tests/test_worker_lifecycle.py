@@ -57,7 +57,7 @@ def test_worker_finish_running_on_server_lost(env, tmp_path):
     )
 
     def running():
-        jobs = json.loads(env.command(["job", "list", "--output-mode", "json"]))
+        jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
         return jobs and jobs[0]["counters"]["running"] == 1
 
     wait_until(running, timeout=30, message="task running")
@@ -150,3 +150,58 @@ def test_worker_idle_timeout_zero_opts_out(env):
     env.command(["submit", "--wait", "--", "true"])
     time.sleep(5)  # well past the server default
     assert process.poll() is None
+
+
+def test_worker_list_all_shows_offline(env):
+    """`hq worker list --all` includes disconnected workers with their loss
+    reason; `worker info` on a dead id still answers (reference keeps dead
+    workers in the HQ state)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.kill_process("worker0")
+
+    def offline():
+        ws = json.loads(env.command(
+            ["worker", "list", "--all", "--output-mode", "json"]
+        ))
+        return [w for w in ws if w.get("status") == "offline"]
+
+    lost = wait_until(offline, timeout=30, message="worker shown offline")
+    assert lost[0]["id"] == 1 and lost[0]["reason"]
+    # default list hides it
+    ws = json.loads(env.command(["worker", "list", "--output-mode", "json"]))
+    assert ws == []
+    info = json.loads(
+        env.command(["worker", "info", "1", "--output-mode", "json"])
+    )
+    assert info["status"] == "offline"
+    # default cli renderer must not crash on the slimmer offline record
+    out = env.command(["worker", "info", "1"])
+    assert "offline" in out
+
+
+def test_worker_stop_does_not_charge_crash_counter(env):
+    """`hq worker stop` is a deliberate stop: the interrupted task restarts
+    without a crash-counter charge, so even --crash-limit never-restart
+    survives it (reference CrashLimit: stops/time limits don't count)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--crash-limit", "never-restart", "--",
+                 "bash", "-c", "sleep 3 && echo finally-done"])
+
+    def running():
+        jobs = json.loads(
+            env.command(["job", "list", "--all", "--output-mode", "json"])
+        )
+        return jobs and jobs[0]["counters"]["running"] >= 1
+
+    wait_until(running, timeout=20, message="task running")
+    env.command(["worker", "stop", "1"])
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["job", "wait", "1"], timeout=40)
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
+    assert jobs[0]["status"] == "finished"
+    assert env.command(["job", "cat", "1", "stdout"]).strip() == "finally-done"
