@@ -1,0 +1,112 @@
+"""JSON output schema stability (reference tests/output/test_json.py):
+scripts consume `--output-mode json`; these tests pin the field names and
+types of every major command so a refactor cannot silently break them."""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _check(record: dict, spec: dict, where: str):
+    for key, types in spec.items():
+        assert key in record, f"{where}: missing field {key!r}"
+        assert isinstance(record[key], types), (
+            f"{where}.{key}: {type(record[key]).__name__}, "
+            f"expected {types}"
+        )
+
+
+def test_json_output_schemas(env):
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--name", "stable", "--array", "0-1",
+                 "--", "true"])
+
+    info = json.loads(env.command(["server", "info", "--output-mode", "json"]))
+    _check(info, {
+        "server_uid": str, "host": str, "client_port": int,
+        "worker_port": int, "n_workers": int, "n_jobs": int,
+    }, "server info")
+
+    jobs = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    assert len(jobs) == 1
+    _check(jobs[0], {
+        "id": int, "name": str, "status": str, "n_tasks": int,
+        "counters": dict, "submitted_at": float,
+    }, "job list")
+    _check(jobs[0]["counters"], {
+        "running": int, "finished": int, "failed": int, "canceled": int,
+    }, "job counters")
+
+    detail = json.loads(
+        env.command(["job", "info", "1", "--output-mode", "json"])
+    )[0]
+    _check(detail, {"tasks": list, "submit_dir": str}, "job info")
+    _check(detail["tasks"][0], {
+        "id": int, "status": str, "error": str, "workers": list,
+        "started_at": float, "finished_at": float,
+    }, "job info task")
+
+    tasks = json.loads(
+        env.command(["task", "info", "1", "--output-mode", "json"])
+    )
+    _check(tasks[0], {"job": int, "id": int, "status": str}, "task info")
+
+    workers = json.loads(
+        env.command(["worker", "list", "--output-mode", "json"])
+    )
+    _check(workers[0], {
+        "id": int, "hostname": str, "status": str, "group": str,
+        "n_running": int, "resources": dict,
+    }, "worker list")
+
+    winfo = json.loads(
+        env.command(["worker", "info", "1", "--output-mode", "json"])
+    )
+    _check(winfo, {
+        "id": int, "hostname": str, "group": str, "manager": str,
+        "time_limit_secs": (int, float), "lifetime_secs": (int, float),
+        "descriptor": dict, "free": dict, "running_tasks": list,
+    }, "worker info")
+
+    explain = json.loads(
+        env.command(["task", "explain", "1", "0", "--output-mode", "json"])
+    )
+    _check(explain, {"state": str, "workers": list}, "task explain")
+    _check(explain["workers"][0], {
+        "id": int, "hostname": str, "runnable": bool, "variants": list,
+    }, "explain worker")
+
+
+def test_json_alloc_schema(env):
+    env.start_server()
+    env.command(["alloc", "add", "slurm", "--no-dry-run", "--name", "q"])
+    queues = json.loads(
+        env.command(["alloc", "list", "--output-mode", "json"])
+    )
+    _check(queues[0], {
+        "id": int, "state": str, "params": dict, "allocations": list,
+    }, "alloc list")
+    _check(queues[0]["params"], {
+        "manager": str, "backlog": int, "workers_per_alloc": int,
+        "time_limit_secs": (int, float), "name": str,
+    }, "alloc params")
+
+
+def test_quiet_mode_emits_bare_ids(env):
+    env.start_server()
+    job_id = env.command(
+        ["submit", "--output-mode", "quiet", "--", "true"]
+    ).strip()
+    assert job_id == "1"
