@@ -28,7 +28,7 @@ Layout:
   utils/            — small shared helpers
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 JOURNAL_VERSION = 1
 PROTOCOL_VERSION = 1

@@ -281,3 +281,26 @@ extern "C" void hq_cut_scan(
         }
     }
 }
+
+// Nonzero cells of a (B,V,W) int32 counts array in row-major order —
+// replaces np.nonzero in the tick's mapping phase (~1.5 ms at 256x2x1024).
+// Returns the number of cells written; out arrays must hold at least
+// min(n, capacity) entries.
+extern "C" int64_t hq_nonzero(
+    const int32_t* counts, int64_t n,
+    int64_t* bs_vs_ws,   // flattened flat-index per cell
+    int64_t* vals,
+    int64_t capacity
+) {
+    int64_t out = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t c = counts[i];
+        if (c != 0) {
+            if (out >= capacity) return out;
+            bs_vs_ws[out] = i;
+            vals[out] = c;
+            ++out;
+        }
+    }
+    return out;
+}

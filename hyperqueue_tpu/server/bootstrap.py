@@ -18,6 +18,7 @@ import socket
 import time
 from pathlib import Path
 
+from hyperqueue_tpu import __version__
 from hyperqueue_tpu.ids import task_id_job, task_id_task, make_task_id
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
 from hyperqueue_tpu.models.milp import MilpModel
@@ -621,7 +622,9 @@ class Server:
         return {
             "op": "server_info",
             "server_uid": self.access.server_uid if self.access else "",
-            "version": "0.1.0",
+            "version": __version__,
+            "host": self.host,
+            "server_dir": str(self.server_dir),
             "client_port": self.client_port,
             "worker_port": self.worker_port,
             "started_at": self.started_at,

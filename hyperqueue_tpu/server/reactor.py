@@ -99,9 +99,12 @@ def on_remove_worker(
     """Worker lost: requeue its tasks with crash accounting.
 
     Reference reactor.rs:64 — sn tasks go back to the queues with
-    crash_counter+1 and die at the crash limit; for mn tasks, loss of a
-    non-root worker does NOT fail the task (reference CHANGELOG v0.25.1) but
-    the gang is torn down and rescheduled.
+    crash_counter+1 and die at the crash limit (deliberate stops are
+    exempt). mn tasks: a RUNNING gang losing a NON-root member keeps
+    running on the root with the member dropped (reference
+    RunningMultiNode retain; CHANGELOG v0.25.1); root loss — or any
+    member loss before the gang reports running — tears the gang down
+    and reschedules it.
     """
     worker = core.workers.pop(worker_id, None)
     if worker is None:
@@ -137,8 +140,22 @@ def on_remove_worker(
     if worker.mn_task:
         task = core.tasks.get(worker.mn_task)
         if task is not None and not task.is_done:
-            _teardown_gang(core, comm, events, task, lost_worker=worker_id,
-                           clean=worker.clean_stop)
+            if (
+                task.state is TaskState.RUNNING
+                and task.mn_workers
+                and worker_id != task.mn_workers[0]
+            ):
+                # non-root member lost while RUNNING: the task keeps running
+                # on the root — the user's launcher inside the task decides
+                # what a dead node means (reference reactor.rs
+                # RunningMultiNode ws.retain; CHANGELOG v0.25.1)
+                task.mn_workers = tuple(
+                    w for w in task.mn_workers if w != worker_id
+                )
+            else:
+                _teardown_gang(core, comm, events, task,
+                               lost_worker=worker_id,
+                               clean=worker.clean_stop)
     comm.ask_for_scheduling()
 
 

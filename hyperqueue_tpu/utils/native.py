@@ -116,6 +116,14 @@ def _set_prototypes(lib) -> None:
         ctypes.POINTER(ctypes.c_int32),   # counts out (B,V,W)
     ]
     lib.hq_cut_scan.restype = None
+    lib.hq_nonzero.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.hq_nonzero.restype = ctypes.c_int64
 
 
 def native_cut_scan(
@@ -172,6 +180,36 @@ def native_cut_scan(
         ptr(counts, ctypes.c_int32),
     )
     return counts
+
+
+def native_nonzero(counts):
+    """(flat_indices, values) of nonzero cells of an int32 ndarray in
+    row-major order, or None when the native lib is unavailable. One C pass
+    instead of numpy's nonzero + fancy-index gather."""
+    lib = load_native()
+    if lib is None:
+        return None
+    import numpy as np
+
+    if counts.dtype != np.int32 or not counts.flags.c_contiguous:
+        return None  # a copy here would eat the win; caller uses np.nonzero
+    n = counts.size
+    # nonzero cells are bounded by the number of (batch, worker) pairs the
+    # water-fill can touch; start modest and retry on overflow
+    capacity = min(n, 65536)
+    while True:
+        flat = np.empty(capacity, dtype=np.int64)
+        vals = np.empty(capacity, dtype=np.int64)
+        got = lib.hq_nonzero(
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            capacity,
+        )
+        if got < capacity or capacity >= n:
+            return flat[:got], vals[:got]
+        capacity = n
 
 
 class NativeTaskQueue:

@@ -91,3 +91,37 @@ def test_gang_skips_short_lifetime_workers_e2e(env):
     workers_used = info[0]["tasks"][0]["workers"]
     assert len(workers_used) == 2
     assert not (set(workers_used) & brief), (workers_used, brief)
+
+
+def test_gang_survives_non_root_worker_loss(env):
+    """Losing a NON-root member of a RUNNING gang does not restart or fail
+    the task — it keeps running on the root and the user's launcher decides
+    what a dead node means (reference reactor.rs RunningMultiNode retain,
+    CHANGELOG v0.25.1)."""
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.start_worker(cpus=2)
+    env.wait_workers(2)
+    env.command(["submit", "--nodes", "2", "--", "bash", "-c",
+                 "sleep 4 && echo gang-done"])
+
+    def running():
+        tasks = json.loads(
+            env.command(["task", "list", "1", "--output-mode", "json"])
+        )
+        t = tasks[0]["tasks"][0]
+        return t if t["status"] == "running" else None
+
+    task = wait_until(running, timeout=20, message="gang running")
+    root = task["workers"][0]
+    non_root = next(w for w in task["workers"] if w != root)
+    # worker ids are assigned in connection order: id N is process worker{N-1}
+    env.kill_process(f"worker{non_root - 1}")
+    env.command(["job", "wait", "1"], timeout=40)
+    jobs = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    assert jobs[0]["status"] == "finished"
+    # ran exactly once: no restart happened
+    assert env.command(["job", "cat", "1", "stdout"]).strip() == "gang-done"

@@ -181,3 +181,25 @@ def test_native_cut_scan_parity_randomized():
         assert np.array_equal(want, got), _trial
         ran += 1
     assert ran == 25
+
+
+def test_native_nonzero_parity_and_contiguity():
+    """hq_nonzero matches np.nonzero in row-major order, survives the
+    capacity-retry path, and refuses non-contiguous/non-int32 input."""
+    np_mod = pytest.importorskip("numpy")
+    from hyperqueue_tpu.utils.native import load_native, native_nonzero
+
+    if load_native() is None:
+        pytest.skip("native lib unavailable")
+    rng = np_mod.random.default_rng(7)
+    # dense enough to overflow the initial 65536 capacity
+    counts = (rng.random((256, 2, 1024)) < 0.2).astype(np_mod.int32)
+    counts *= rng.integers(1, 9, size=counts.shape).astype(np_mod.int32)
+    flat, vals = native_nonzero(counts)
+    ref_b, ref_v, ref_w = np_mod.nonzero(counts)
+    ref_flat = np_mod.ravel_multi_index((ref_b, ref_v, ref_w), counts.shape)
+    assert np_mod.array_equal(flat, ref_flat)
+    assert np_mod.array_equal(vals, counts[ref_b, ref_v, ref_w])
+    # strided views and wrong dtypes are rejected, not silently copied
+    assert native_nonzero(counts[:, :1, :]) is None or counts[:, :1, :].flags.c_contiguous
+    assert native_nonzero(counts.astype(np_mod.int64)) is None
