@@ -712,8 +712,11 @@ def cmd_job_list(args) -> None:
     if args.output_mode == "json":
         out.value(jobs)
         return
+    headers = ["id", "name", "status", "tasks", "finished", "failed"]
+    if args.verbose:
+        headers.append("cancel reason")
     out.table(
-        ["id", "name", "status", "tasks", "finished", "failed"],
+        headers,
         [
             [
                 j["id"],
@@ -722,7 +725,7 @@ def cmd_job_list(args) -> None:
                 j["n_tasks"],
                 j["counters"]["finished"],
                 j["counters"]["failed"],
-            ]
+            ] + ([j.get("cancel_reason", "")] if args.verbose else [])
             for j in sorted(jobs, key=lambda j: j["id"])
         ],
     )
@@ -1485,6 +1488,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--filter", default=None,
                    help="comma-separated job states to show "
                         "(opened,running,finished,failed,canceled)")
+    p.add_argument("--verbose", action="store_true",
+                   help="additional columns (cancel reason)")
     p.set_defaults(fn=cmd_job_list)
     for name, fn, extra in [
         ("info", cmd_job_info, ()),

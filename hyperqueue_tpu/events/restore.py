@@ -66,6 +66,10 @@ def restore_from_journal(server) -> None:
             job = server.jobs.jobs.get(job_id)
             if job is not None:
                 job.is_open = False
+        elif kind == "job-completed":
+            job = server.jobs.jobs.get(job_id)
+            if job is not None and record.get("cancel_reason"):
+                job.cancel_reason = record["cancel_reason"]
         elif kind in TERMINAL:
             task_status[(job_id, record["task"])] = (
                 TERMINAL[kind],

@@ -385,7 +385,11 @@ class Server:
         if job is None:
             return
         if job.is_terminated():
-            self.emit_event("job-completed", {"job": job_id, "status": job.status()})
+            self.emit_event(
+                "job-completed",
+                {"job": job_id, "status": job.status(),
+                 "cancel_reason": job.cancel_reason},
+            )
         # waiters are satisfied when every task submitted SO FAR is terminal —
         # for open jobs that is the useful "wait" semantics (the job itself
         # terminates only when closed)
@@ -768,6 +772,8 @@ class Server:
                 for t in job.tasks.values()
                 if t.status in ("waiting", "running")
             ]
+            if task_ids:
+                job.cancel_reason = "canceled by user"
             out = reactor.on_cancel_tasks(
                 self.core, self.comm, self.events, task_ids
             )

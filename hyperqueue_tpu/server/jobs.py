@@ -44,6 +44,7 @@ class Job:
     submit_dir: str
     max_fails: int | None = None
     is_open: bool = False
+    cancel_reason: str = ""  # why tasks were canceled (user / max_fails)
     submitted_at: float = field(default_factory=time.time)
     tasks: dict[int, JobTaskInfo] = field(default_factory=dict)  # job_task_id ->
     counters: dict[str, int] = field(
@@ -102,6 +103,7 @@ class Job:
             "is_open": self.is_open,
             "submit_dir": self.submit_dir,
             "submitted_at": self.submitted_at,
+            "cancel_reason": self.cancel_reason,
         }
 
     def to_detail(self) -> dict:
@@ -209,6 +211,10 @@ class JobManager:
         if job is None:
             return []
         if job.max_fails is not None and job.counters["failed"] > job.max_fails:
+            job.cancel_reason = (
+                f"max_fails={job.max_fails} exceeded "
+                f"({job.counters['failed']} tasks failed)"
+            )
             return [
                 make_task_id(job.job_id, t.job_task_id)
                 for t in job.tasks.values()

@@ -89,3 +89,25 @@ def test_priority_order_e2e(env):
     env.start_worker(cpus=1)
     env.command(["job", "wait", "all"], timeout=40)
     assert (env.work_dir / "order.txt").read_text().splitlines()[0] == "high"
+
+
+def test_job_cancel_reason_verbose(env):
+    """`hq job list --verbose` shows why a job's tasks were canceled
+    (reference JobListOpts verbose: <Cancel Reason>)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    # max-fails abort
+    env.command(["submit", "--array", "1-10", "--max-fails", "0",
+                 "--", "false"])
+    env.command(["job", "wait", "1"], expect_fail=True)
+    # user cancel
+    env.command(["submit", "--", "sleep", "60"])
+    env.command(["job", "cancel", "2"])
+    jobs = {j["id"]: j for j in json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )}
+    assert "max_fails=0 exceeded" in jobs[1]["cancel_reason"]
+    assert jobs[2]["cancel_reason"] == "canceled by user"
+    table = env.command(["job", "list", "--all", "--verbose"])
+    assert "cancel reason" in table and "canceled by user" in table

@@ -158,3 +158,24 @@ def test_restore_preserves_array_entries(env, tmp_path):
     env.command(["job", "wait", "all"], timeout=40)
     out = env.command(["job", "cat", "1", "stdout"])
     assert sorted(out.split()) == ["got=alpha", "got=beta", "got=gamma"]
+
+
+def test_live_journal_prune_and_restore(env, tmp_path):
+    """`hq journal prune` against a live server drops completed jobs from
+    the journal; a later restore only resurrects what was kept."""
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--name", "done-job", "--", "true"])
+    env.command(["submit", "--name", "live-job", "--", "sleep", "60"])
+    size_before = journal.stat().st_size
+    env.command(["journal", "prune"])
+    assert journal.stat().st_size < size_before
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    jobs = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    names = {j["name"] for j in jobs}
+    assert "live-job" in names and "done-job" not in names

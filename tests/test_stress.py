@@ -1,0 +1,69 @@
+"""Soak: concurrent clients + worker churn against one server.
+
+A fast race-shaker (reference stresses this shape via
+benchmarks/experiment-scalability-stress.py and tests killing workers):
+many interleaved submits from parallel client processes while workers die
+and rejoin mid-flight; every job must still converge, with crash retries
+absorbing the churn.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from utils_e2e import HqEnv, _env_base, wait_until
+
+N_JOBS = 12
+TASKS_PER_JOB = 20
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def test_soak_concurrent_clients_and_worker_churn(env):
+    env.start_server()
+    for _ in range(3):
+        env.start_worker(cpus=4)
+    env.wait_workers(3)
+
+    # N_JOBS submits racing from parallel client processes
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "hyperqueue_tpu", "submit",
+             "--name", f"soak-{i}", "--array", f"1-{TASKS_PER_JOB}",
+             "--", "bash", "-c", "sleep 0.0$((RANDOM % 5)); true"],
+            env={**_env_base(), "HQ_SERVER_DIR": str(env.server_dir)},
+            cwd=env.work_dir,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        for i in range(N_JOBS)
+    ]
+    # churn: kill a worker while submits are in flight, twice, replacing it
+    env.kill_process("worker0")
+    env.start_worker(cpus=4)
+    for p in procs[: N_JOBS // 2]:
+        assert p.wait(timeout=60) == 0, p.stderr.read()
+    env.kill_process("worker1")
+    env.start_worker(cpus=4)
+    for p in procs[N_JOBS // 2:]:
+        assert p.wait(timeout=60) == 0, p.stderr.read()
+
+    env.command(["job", "wait", "all"], timeout=90)
+    jobs = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    assert len(jobs) == N_JOBS
+    assert all(j["status"] == "finished" for j in jobs), [
+        (j["id"], j["status"]) for j in jobs
+    ]
+    assert sum(j["counters"]["finished"] for j in jobs) == N_JOBS * TASKS_PER_JOB
+
+    # the server survived the churn with a consistent core
+    dump = json.loads(env.command(["server", "debug-dump"]))
+    assert dump["tasks"]["by_state"].get("finished", 0) == N_JOBS * TASKS_PER_JOB
+    assert dump["tasks"]["ready_queued"] == 0
