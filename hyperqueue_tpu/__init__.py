@@ -31,4 +31,4 @@ Layout:
 __version__ = "0.3.0"
 
 JOURNAL_VERSION = 1
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2  # v2: shared compute-message bodies + never-restart=-1

@@ -461,9 +461,15 @@ def _parse_duration(text: str) -> float:
     (reference parse_hms_or_human_time, common/parser2.rs)."""
     text = text.strip()
     try:
-        return float(text)  # plain seconds
+        value = float(text)  # plain seconds
     except ValueError:
         pass
+    else:
+        if value < 0:
+            raise argparse.ArgumentTypeError(
+                f"duration must be non-negative, got {text!r}"
+            )
+        return value
     if ":" in text:  # [HH:]MM:SS
         parts = text.split(":")
         if len(parts) in (2, 3) and all(p.isdigit() for p in parts):
@@ -491,22 +497,12 @@ def _parse_duration(text: str) -> float:
 
 
 def _parse_crash_limit(text: str) -> int:
-    """Positive integer, `never-restart`, or `unlimited` (reference
-    CrashLimit, gateway.rs:96-106). 0 encodes unlimited on the wire."""
-    if text == "never-restart":
-        return 1  # fail on the first crash, never reschedule
-    if text == "unlimited":
-        return 0
-    try:
-        value = int(text)
-    except ValueError:
-        raise argparse.ArgumentTypeError(
-            f"crash limit must be a positive integer, 'never-restart' or "
-            f"'unlimited', got {text!r}"
-        )
-    if value <= 0:
-        raise argparse.ArgumentTypeError("crash limit must be positive")
-    return value
+    """Positive integer, `never-restart` (-1 on the wire: fails on any
+    worker loss while running, even clean stops — reference reactor.rs:166),
+    or `unlimited` (0). Shared encoding: utils/parsing.py."""
+    from hyperqueue_tpu.utils.parsing import parse_crash_limit
+
+    return parse_crash_limit(text, exc_type=argparse.ArgumentTypeError)
 
 
 class _NotifyRunner:

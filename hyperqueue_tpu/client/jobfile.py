@@ -29,10 +29,15 @@ import tomllib
 from pathlib import Path
 
 from hyperqueue_tpu.resources.amount import amount_from_str
+from hyperqueue_tpu.utils.parsing import parse_crash_limit
 
 
 class JobFileError(ValueError):
     pass
+
+
+def _parse_crash_limit(value) -> int:
+    return parse_crash_limit(value, exc_type=JobFileError)
 
 
 def _request_to_wire(requests: list[dict]) -> dict:
@@ -96,7 +101,7 @@ def load_job_file(path: str | Path, submit_dir: str) -> dict:
                 "request": _request_to_wire(t.get("request", [])),
                 "deps": deps,
                 "priority": int(t.get("priority", 0)),
-                "crash_limit": int(t.get("crash_limit", 5)),
+                "crash_limit": _parse_crash_limit(t.get("crash_limit", 5)),
             }
         )
     if not tasks:

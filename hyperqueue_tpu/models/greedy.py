@@ -126,7 +126,22 @@ class GreedyCutScanModel:
         if self._use_numpy is None:
             import jax
 
-            if jax.default_backend() == "cpu":
+            try:
+                backend = jax.default_backend()
+            except RuntimeError:
+                # the configured accelerator backend failed to initialize
+                # (e.g. an unhealthy TPU relay at process start): the solve
+                # must keep working on the host — and the choice is sticky,
+                # because jax caches the failed init for the process anyway
+                self._use_numpy = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "jax backend unavailable; solving on the host (numpy)",
+                    exc_info=True,
+                )
+                return True
+            if backend == "cpu":
                 # the XLA while-loop overhead loses to numpy on CPU hosts
                 self._use_numpy = True
             else:

@@ -43,7 +43,18 @@ class MultichipModel(GreedyCutScanModel):
 
             from hyperqueue_tpu.parallel.solve import make_worker_mesh
 
-            available = len(jax.devices())
+            try:
+                available = len(jax.devices())
+            except RuntimeError:
+                # accelerator backend failed to initialize (e.g. unhealthy
+                # TPU relay): degrade to the single-chip host fallback
+                # instead of killing the scheduler loop
+                available = 1
+                logger.warning(
+                    "multichip scheduler: jax backend unavailable, "
+                    "falling back to the single-chip host solve",
+                    exc_info=True,
+                )
             n = (
                 min(self._requested_devices, available)
                 if self._requested_devices

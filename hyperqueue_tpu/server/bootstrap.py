@@ -316,12 +316,10 @@ class Server:
 
         self.autoalloc = AutoAllocService(self, instance_dir / "autoalloc")
         self.autoalloc.start()
-        self._tasks.append(asyncio.create_task(self._scheduler_loop()))
-        self._tasks.append(asyncio.create_task(self._heartbeat_reaper()))
+        self._tasks.append(self._spawn_loop(self._scheduler_loop()))
+        self._tasks.append(self._spawn_loop(self._heartbeat_reaper()))
         if self.journal is not None and self.journal_flush_period > 0:
-            self._tasks.append(
-                asyncio.create_task(self._journal_flush_loop())
-            )
+            self._tasks.append(self._spawn_loop(self._journal_flush_loop()))
         logger.info(
             "server started uid=%s client=%s:%d worker=%s:%d",
             self.access.server_uid,
@@ -396,6 +394,26 @@ class Server:
         if job.all_tasks_done():
             for event in self._job_waiters.pop(job_id, []):
                 event.set()
+
+    def _spawn_loop(self, coro) -> "asyncio.Task":
+        """Background loops must never die silently: an unhandled exception
+        in an asyncio task is held unreported while the server keeps a
+        reference — the server would turn into a zombie that accepts
+        submits but never schedules. Log the crash loudly instead."""
+        task = asyncio.create_task(coro)
+
+        def _report(t: "asyncio.Task") -> None:
+            if t.cancelled():
+                return
+            exc = t.exception()
+            if exc is not None:
+                logger.critical(
+                    "server background loop %s crashed", t.get_coro(),
+                    exc_info=exc,
+                )
+
+        task.add_done_callback(_report)
+        return task
 
     # --- scheduler loop ------------------------------------------------
     async def _scheduler_loop(self) -> None:

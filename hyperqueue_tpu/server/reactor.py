@@ -127,6 +127,16 @@ def on_remove_worker(
         was_running = task.state is TaskState.RUNNING
         task.assigned_worker = 0
         task.increment_instance()
+        # never-restart tasks fail on ANY worker loss while running, even a
+        # deliberate stop (reference reactor.rs:166, outside the
+        # reason.is_failure() gate)
+        if was_running and task.never_restart:
+            task.state = TaskState.FAILED
+            _propagate_failure(
+                core, events, task,
+                "task was running on a lost worker while never-restart was set",
+            )
+            continue
         # a deliberate stop (hq worker stop, idle/time limit) restarts the
         # task without charging its crash counter (reference CrashLimit)
         if was_running and not worker.clean_stop and task.crashed():
@@ -179,11 +189,21 @@ def _teardown_gang(
                 comm.send_cancel(wid, [task.task_id])
     task.mn_workers = ()
     task.increment_instance()
-    if (lost_worker == root and task.state is TaskState.RUNNING
-            and not clean and task.crashed()):
-        task.state = TaskState.FAILED
-        _propagate_failure(core, events, task, "gang root lost too many times")
-        return
+    if lost_worker == root and task.state is TaskState.RUNNING:
+        if task.never_restart:
+            task.state = TaskState.FAILED
+            _propagate_failure(
+                core, events, task,
+                "task was running on a lost worker while never-restart was "
+                "set",
+            )
+            return
+        if not clean and task.crashed():
+            task.state = TaskState.FAILED
+            _propagate_failure(
+                core, events, task, "gang root lost too many times"
+            )
+            return
     if task.state is TaskState.RUNNING:
         events.on_task_restarted(task.task_id)
     task.state = TaskState.WAITING

@@ -66,6 +66,14 @@ class Task:
         self.instance_id += 1
         return self.instance_id
 
+    @property
+    def never_restart(self) -> bool:
+        """crash_limit encodes CRASH_LIMIT_NEVER_RESTART (utils/parsing.py):
+        fail whenever the worker is lost while the task runs, even on clean
+        stops (reference CrashLimit::NeverRestart, reactor.rs:166 — outside
+        the reason.is_failure() gate)."""
+        return self.crash_limit < 0
+
     def crashed(self) -> bool:
         """Register a crash (worker lost while running); True if over limit."""
         self.crash_counter += 1

@@ -281,10 +281,10 @@ def test_duration_and_crash_limit_parsers():
     assert _parse_duration("01:30:00") == 5400.0
     assert _parse_duration("2:05") == 125.0
     assert _parse_duration("500ms") == 0.5
-    for bad in ("abc", "10parsecs", "1:2:3:4"):
+    for bad in ("abc", "10parsecs", "1:2:3:4", "-5", "-0.5"):
         with pytest.raises(argparse.ArgumentTypeError):
             _parse_duration(bad)
-    assert _parse_crash_limit("never-restart") == 1
+    assert _parse_crash_limit("never-restart") == -1
     assert _parse_crash_limit("unlimited") == 0
     assert _parse_crash_limit("7") == 7
     for bad in ("0", "-1", "sometimes"):

@@ -180,17 +180,91 @@ def test_gang_scheduling_all_or_nothing():
     assert all(env.state(i) is TaskState.READY for i in ids)
 
 
-def test_gang_non_root_loss_restarts_without_fail():
+def test_gang_non_root_loss_keeps_running_on_root():
+    """Reference reactor.rs RunningMultiNode ws.retain (CHANGELOG v0.25.1):
+    a RUNNING gang that loses a NON-root member keeps running on the root
+    with the member dropped — the user's launcher decides what a dead node
+    means."""
+    env = TestEnv()
+    workers = [env.worker(cpus=2, group="g1") for _ in range(3)]
+    (t,) = env.submit(rqv=env.rqv(n_nodes=3))
+    env.schedule()
+    env.start_all_assigned()
+    task = env.core.tasks[t]
+    root, mid, last = task.mn_workers
+    instance = task.instance_id
+    env.lose_worker(mid)
+    assert env.state(t) is TaskState.RUNNING
+    assert task.mn_workers == (root, last)
+    assert task.crash_counter == 0
+    assert task.instance_id == instance  # same incarnation keeps running
+    # the task still completes normally on the survivors
+    env.finish(t)
+    assert env.state(t) is TaskState.FINISHED
+    for w in env.core.workers.values():
+        assert w.mn_task == 0
+
+
+def test_gang_root_loss_tears_down_and_requeues():
+    """Root loss while RUNNING tears the gang down, cancels on survivors,
+    and requeues with the crash counter charged."""
     env = TestEnv()
     workers = [env.worker(cpus=2, group="g1") for _ in range(2)]
     (t,) = env.submit(rqv=env.rqv(n_nodes=2))
     env.schedule()
     env.start_all_assigned()
     task = env.core.tasks[t]
-    non_root = task.mn_workers[1]
-    env.lose_worker(non_root)
-    assert env.state(t) is TaskState.READY  # rescheduled, not failed
-    assert task.crash_counter == 0
+    root, member = task.mn_workers
+    env.lose_worker(root)
+    assert env.state(t) is TaskState.READY
+    assert task.crash_counter == 1
+    assert task.mn_workers == ()
+    # the surviving member was told to cancel and is free again
+    assert any(t in tids for wid, tids in env.comm.cancels if wid == member)
+    assert all(w.mn_task == 0 for w in env.core.workers.values())
+
+
+def test_never_restart_fails_even_on_clean_stop():
+    """Reference reactor.rs:166 — a NeverRestart task running on a lost
+    worker fails regardless of the loss reason, OUTSIDE the
+    reason.is_failure() gate that exempts deliberate stops."""
+    env = TestEnv()
+    w = env.worker(cpus=4)
+    (t,) = env.submit(crash_limit=-1)
+    env.schedule()
+    env.start_all_assigned()
+    env.lose_worker(w.worker_id, clean=True)
+    assert env.state(t) is TaskState.FAILED
+
+    # but an ASSIGNED (never ran) never-restart task just requeues
+    env = TestEnv()
+    w = env.worker(cpus=4)
+    (t,) = env.submit(crash_limit=-1)
+    env.schedule()
+    env.lose_worker(w.worker_id, clean=True)
+    assert env.state(t) is TaskState.READY
+
+
+def test_never_restart_gang_root_clean_loss_fails():
+    env = TestEnv()
+    [env.worker(cpus=2, group="g1") for _ in range(2)]
+    (g,) = env.submit(rqv=env.rqv(n_nodes=2), crash_limit=-1)
+    env.schedule()
+    env.start_all_assigned()
+    root = env.core.tasks[g].mn_workers[0]
+    env.lose_worker(root, clean=True)
+    assert env.state(g) is TaskState.FAILED
+
+
+def test_clean_stop_does_not_charge_crash_counter():
+    env = TestEnv()
+    w = env.worker(cpus=4)
+    (t,) = env.submit()
+    env.schedule()
+    env.start_all_assigned()
+    env.lose_worker(w.worker_id, clean=True)
+    assert env.state(t) is TaskState.READY
+    assert env.core.tasks[t].crash_counter == 0
 
 
 def test_worker_added_after_submit_triggers_assignment():

@@ -237,3 +237,30 @@ def test_numpy_backend_matches_jax(seed):
     jax_counts = GreedyCutScanModel(backend="jax").solve(**args)
     np_counts = GreedyCutScanModel(backend="numpy").solve(**args)
     assert (jax_counts == np_counts).all()
+
+
+def test_backend_init_failure_falls_back_to_host(monkeypatch):
+    """A jax backend that fails to initialize (e.g. an unhealthy TPU relay
+    at process start) must not raise out of the solve — the scheduler loop
+    dies silently otherwise. The model falls back to the host numpy path
+    and sticks with it."""
+    import jax
+
+    model = GreedyCutScanModel(backend="auto")
+    monkeypatch.setattr(
+        jax, "default_backend",
+        lambda: (_ for _ in ()).throw(
+            RuntimeError("Unable to initialize backend 'axon'")
+        ),
+    )
+    assert model._numpy_path() is True
+    assert model._use_numpy is True  # sticky: jax caches the failed init
+    counts = model.solve(
+        free=np.full((1, 1), 10_000, dtype=np.int32),
+        nt_free=np.array([4], dtype=np.int32),
+        lifetime=np.array([INF], dtype=np.int32),
+        needs=np.full((1, 1, 1), 10_000, dtype=np.int32),
+        sizes=np.array([1], dtype=np.int32),
+        min_time=np.zeros((1, 1), dtype=np.int32),
+    )
+    assert counts.sum() == 1

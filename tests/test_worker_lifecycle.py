@@ -183,12 +183,12 @@ def test_worker_list_all_shows_offline(env):
 
 def test_worker_stop_does_not_charge_crash_counter(env):
     """`hq worker stop` is a deliberate stop: the interrupted task restarts
-    without a crash-counter charge, so even --crash-limit never-restart
-    survives it (reference CrashLimit: stops/time limits don't count)."""
+    without a crash-counter charge (reference CrashLimit: stops/time limits
+    don't count toward MaxCrashes)."""
     env.start_server()
     env.start_worker()
     env.wait_workers(1)
-    env.command(["submit", "--crash-limit", "never-restart", "--",
+    env.command(["submit", "--crash-limit", "1", "--",
                  "bash", "-c", "sleep 3 && echo finally-done"])
 
     def running():
@@ -205,3 +205,26 @@ def test_worker_stop_does_not_charge_crash_counter(env):
     jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
     assert jobs[0]["status"] == "finished"
     assert env.command(["job", "cat", "1", "stdout"]).strip() == "finally-done"
+
+
+def test_never_restart_fails_on_worker_stop(env):
+    """--crash-limit never-restart fails the task on ANY worker loss while
+    it runs, even a deliberate `hq worker stop` (reference reactor.rs:166 —
+    the NeverRestart check sits outside the reason.is_failure() gate)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--crash-limit", "never-restart", "--",
+                 "bash", "-c", "sleep 30"])
+
+    def running():
+        jobs = json.loads(
+            env.command(["job", "list", "--all", "--output-mode", "json"])
+        )
+        return jobs and jobs[0]["counters"]["running"] >= 1
+
+    wait_until(running, timeout=20, message="task running")
+    env.command(["worker", "stop", "1"])
+    env.command(["job", "wait", "1"], expect_fail=True, timeout=40)
+    jobs = json.loads(env.command(["job", "list", "--all", "--output-mode", "json"]))
+    assert jobs[0]["status"] == "failed"

@@ -144,11 +144,13 @@ class TestEnv:
             )
         return ResourceRequest(entries=tuple(entries), min_time_secs=min_time)
 
-    def submit(self, n=1, rqv=None, deps=(), priority=(0, 0), job=1, body=None):
+    def submit(self, n=1, rqv=None, deps=(), priority=(0, 0), job=1, body=None,
+               crash_limit=None):
         """Create n tasks; returns their ids."""
         if rqv is None:
             rqv = self.rqv()
         rq_id = self.core.intern_rqv(rqv)
+        extra = {} if crash_limit is None else {"crash_limit": crash_limit}
         tasks = []
         for _ in range(n):
             self._task_seq += 1
@@ -159,6 +161,7 @@ class TestEnv:
                     priority=priority,
                     deps=tuple(deps),
                     body=body or {},
+                    **extra,
                 )
             )
         reactor.on_new_tasks(self.core, self.comm, tasks)
@@ -205,9 +208,14 @@ class TestEnv:
         )
         self.core.sanity_check()
 
-    def lose_worker(self, worker_id):
+    def lose_worker(self, worker_id, clean=False):
+        """clean=True simulates a deliberate stop (hq worker stop /
+        idle-timeout / time-limit) — crash counters are not charged."""
+        if clean:
+            self.core.workers[worker_id].clean_stop = True
         reactor.on_remove_worker(
-            self.core, self.comm, self.events, worker_id, "connection lost"
+            self.core, self.comm, self.events, worker_id,
+            "stopped" if clean else "connection lost",
         )
         self.core.sanity_check()
 
