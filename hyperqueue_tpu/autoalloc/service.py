@@ -14,18 +14,17 @@ import logging
 import time
 from pathlib import Path
 
-import numpy as np
-
 from hyperqueue_tpu.autoalloc.handlers import SubmitError, make_handler
+from hyperqueue_tpu.autoalloc.query import (
+    WorkerTypeQuery,
+    compute_new_worker_query,
+)
 from hyperqueue_tpu.autoalloc.state import (
     Allocation,
     AutoAllocState,
     QueueParams,
 )
-from hyperqueue_tpu.ops.assign import INF_TIME
-from hyperqueue_tpu.resources.request import AllocationPolicy
 from hyperqueue_tpu.resources.worker_resources import WorkerResources
-from hyperqueue_tpu.scheduler.tick import WorkerRow, create_batches
 from hyperqueue_tpu.worker.hwdetect import detect_resources
 
 logger = logging.getLogger("hq.autoalloc")
@@ -42,6 +41,10 @@ class AutoAllocService:
         # queue params are immutable after `alloc add`; the parsed worker
         # descriptor (which probes host hardware as its base) is cached
         self._queue_descriptors: dict[int, object] = {}
+        # exact resources of a worker that connected from this queue's
+        # allocation — once known, demand queries use them verbatim
+        # (partial=False; reference queue.get_worker_resources())
+        self._queue_known_resources: dict[int, WorkerResources] = {}
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
@@ -55,6 +58,7 @@ class AutoAllocService:
         """Drop per-queue caches after `alloc remove`."""
         self._handlers.pop(queue_id, None)
         self._queue_descriptors.pop(queue_id, None)
+        self._queue_known_resources.pop(queue_id, None)
 
     def handler_for(self, queue):
         handler = self._handlers.get(queue.queue_id)
@@ -122,17 +126,10 @@ class AutoAllocService:
             )
 
     # ------------------------------------------------------------------
-    def _queue_worker_descriptor(self, queue):
-        """Resource descriptor of the workers this queue would spawn.
-
-        Parsed from the queue's worker args (--cpus / --resource overrides
-        applied over host detection, exactly as `hq worker start` would
-        apply them) — the reference stores the same thing as the queue's
-        cli_resource_descriptor (autoalloc/queue/mod.rs:32). Falls back to
-        plain host detection when the queue declares nothing."""
-        cached = self._queue_descriptors.get(queue.queue_id)
-        if cached is not None:
-            return cached
+    @staticmethod
+    def _parse_worker_args(queue):
+        """(cpus, {name: item}) declared by the queue's worker args —
+        the same --cpus / --resource parsing `hq worker start` applies."""
         from hyperqueue_tpu.worker.parser import parse_resource_definition
 
         args = list(queue.params.worker_args or [])
@@ -163,7 +160,21 @@ class AutoAllocService:
                     except ValueError:
                         pass
             i += 1
+        return cpus, overrides
 
+    def _queue_worker_descriptor(self, queue):
+        """Resource descriptor of the workers this queue would spawn.
+
+        Parsed from the queue's worker args (--cpus / --resource overrides
+        applied over host detection, exactly as `hq worker start` would
+        apply them) — the reference stores the same thing as the queue's
+        cli_resource_descriptor (autoalloc/queue/mod.rs:32). Falls back to
+        plain host detection when the queue declares nothing. Used for the
+        mn gang-hosting check; the sn demand query uses _build_query."""
+        cached = self._queue_descriptors.get(queue.queue_id)
+        if cached is not None:
+            return cached
+        cpus, overrides = self._parse_worker_args(queue)
         base = detect_resources(n_cpus=cpus)
         if overrides:
             from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
@@ -173,6 +184,53 @@ class AutoAllocService:
             base = ResourceDescriptor(items=tuple(items.values()))
         self._queue_descriptors[queue.queue_id] = base
         return base
+
+    def _build_query(self, queue) -> WorkerTypeQuery:
+        """Reference process.rs:416 create_queue_worker_query — exact
+        resources from a previously connected worker of this queue when
+        known (partial=False); else the CLI-declared items with everything
+        undeclared padded as unknown-best (partial=True); else an empty
+        fully-partial descriptor."""
+        core = self.server.core
+        wpa = max(queue.params.workers_per_alloc, 1)
+        known = self._queue_known_resources.get(queue.queue_id)
+        if known is not None:
+            resources, partial, declared = known, False, frozenset()
+        else:
+            from hyperqueue_tpu.resources.descriptor import (
+                ResourceDescriptor,
+                ResourceDescriptorItem,
+            )
+
+            cpus, overrides = self._parse_worker_args(queue)
+            items = []
+            if "cpus" in overrides:
+                # an explicit `--resource cpus=...` declaration wins, like
+                # `hq worker start` resource overrides
+                items.append(overrides["cpus"])
+            elif cpus is not None:
+                items.append(
+                    ResourceDescriptorItem.range("cpus", 0, cpus - 1)
+                )
+            items.extend(
+                item for item in overrides.values() if item.name != "cpus"
+            )
+            resources = WorkerResources.from_descriptor(
+                ResourceDescriptor(items=tuple(items)), core.resource_map
+            )
+            partial = True
+            declared = frozenset(
+                core.resource_map.get_or_create(item.name) for item in items
+            )
+        return WorkerTypeQuery(
+            resources=resources,
+            partial=partial,
+            time_limit_secs=queue.params.time_limit_secs,
+            max_sn_workers=queue.params.backlog * wpa,
+            max_workers_per_allocation=wpa,
+            min_utilization=self._queue_min_utilization(queue),
+            declared_ids=declared,
+        )
 
     @staticmethod
     def _queue_min_utilization(queue) -> float:
@@ -194,113 +252,16 @@ class AutoAllocService:
         return 0.0
 
     def _fake_worker_demand(self, queue) -> int:
-        """How many NEW single-node workers would receive load right now?
-
-        Reference scheduler/query.rs:12-80 — create fake workers per queue
-        descriptor and rerun batches+solver against them; the count of fake
-        workers that got tasks is the demand. Simulates
-        backlog*workers_per_alloc fake workers with the queue's DECLARED
-        worker resources and runs the dense solve over (real + fake)
-        workers non-destructively.
-        """
-        core = self.server.core
-        n_fake = queue.params.backlog * queue.params.workers_per_alloc
-        if n_fake <= 0:
+        """How many NEW single-node workers of this queue's shape would
+        receive load right now?  Single-queue convenience wrapper over the
+        joint compute_new_worker_query (autoalloc/query.py — reference
+        scheduler/query.rs:12-80)."""
+        if queue.params.backlog * queue.params.workers_per_alloc <= 0:
             return 0
-        if not core.queues.total_ready():
-            return 0
-        fake_resources = WorkerResources.from_descriptor(
-            self._queue_worker_descriptor(queue), core.resource_map
+        response = compute_new_worker_query(
+            self.server.core, self.server.model, [self._build_query(queue)]
         )
-        # Real min-utilization workers are carved out of the production
-        # solve and may leave ANY load unserved (all-or-nothing floors,
-        # scheduler/tick.py run_tick) — counting their capacity here would
-        # absorb demand that production won't serve and starve the queue,
-        # so the demand estimate drops them (conservative: may spawn a
-        # worker a mu-host would in fact have taken).
-        rows = [r for r in core.worker_rows() if r.cpu_floor <= 0]
-        first_fake = len(rows)
-        for i in range(n_fake):
-            rows.append(
-                WorkerRow(
-                    worker_id=-(i + 1),
-                    free=list(fake_resources.amounts),
-                    nt_free=fake_resources.task_max_count(),
-                    lifetime_secs=min(
-                        int(queue.params.time_limit_secs), int(INF_TIME)
-                    ),
-                )
-            )
-        batches = create_batches(core.queues)
-        if not batches:
-            return 0
-        n_r = len(core.resource_map)
-        free = np.zeros((len(rows), n_r), dtype=np.int64)
-        total = np.zeros((len(rows), n_r), dtype=np.int64)
-        nt_free = np.zeros(len(rows), dtype=np.int32)
-        lifetime = np.zeros(len(rows), dtype=np.int32)
-        for i, row in enumerate(rows):
-            free[i, : len(row.free)] = row.free
-            src = row.total if row.total is not None else row.free
-            total[i, : len(src)] = src
-            nt_free[i] = max(row.nt_free, 0)
-            lifetime[i] = row.lifetime_secs
-        n_b = len(batches)
-        n_v = max(
-            len(core.rq_map.get_variants(b.rq_id).variants) for b in batches
-        )
-        needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
-        all_mask = np.zeros((n_b, n_v, n_r), dtype=np.int32)
-        sizes = np.zeros(n_b, dtype=np.int32)
-        min_time = np.full((n_b, n_v), int(INF_TIME), dtype=np.int32)
-        for bi, batch in enumerate(batches):
-            sizes[bi] = min(batch.size, 2**30)
-            for vi, variant in enumerate(
-                core.rq_map.get_variants(batch.rq_id).variants
-            ):
-                min_time[bi, vi] = min(int(variant.min_time_secs), int(INF_TIME))
-                for entry in variant.entries:
-                    if entry.policy is AllocationPolicy.ALL:
-                        # ALL takes the worker's whole pool; without the
-                        # mask the zero amount would read as "variant
-                        # absent" and the class would generate no demand
-                        all_mask[bi, vi, entry.resource_id] = 1
-                    else:
-                        needs[bi, vi, entry.resource_id] = entry.amount
-        extra = {}
-        if all_mask.any():
-            extra = {
-                "total": total.astype(np.int32),
-                "all_mask": all_mask,
-            }
-        counts = self.server.model.solve(
-            free=free.astype(np.int32),
-            nt_free=nt_free,
-            lifetime=lifetime,
-            needs=needs.astype(np.int32),
-            sizes=sizes,
-            min_time=min_time,
-            priorities=[b.priority for b in batches],
-            **extra,
-        )
-        counts = np.asarray(counts)
-        fake_load = counts.sum(axis=(0, 1))[first_fake:]
-        mu = self._queue_min_utilization(queue)
-        if mu > 0.001:
-            # a projected worker is only worth spawning if the work it
-            # would attract keeps it above its utilization floor (reference
-            # query.rs min_utilization, test_query.rs:273-342)
-            cpu_fr = np.einsum(
-                "bvw,bv->w", counts[:, :, first_fake:], needs[:, :, 0]
-            ).astype(np.float64)
-            # an ALL-policy cpu task occupies the whole pool (its needs
-            # row is zero; the amount lives in the mask)
-            cpu_fr += np.einsum(
-                "bvw,bv->w", counts[:, :, first_fake:], all_mask[:, :, 0]
-            ) * float(fake_resources.amounts[0])
-            floor = mu * float(fake_resources.amounts[0])
-            return int((cpu_fr >= floor).sum())
-        return int((fake_load > 0).sum())
+        return response.single_node_workers_per_query[0]
 
     def _mn_demand(self, queue) -> list[int]:
         """n_nodes of each pending multi-node task this queue should cover.
@@ -341,11 +302,24 @@ class AutoAllocService:
         return out
 
     async def perform_submits(self) -> None:
-        for queue in list(self.state.queues.values()):
-            if not queue.can_submit_now():
-                continue
+        # all eligible queues are planned in ONE joint query: an earlier
+        # queue's projected workers absorb demand so a later queue only
+        # provisions for the leftovers (reference process.rs:380-407 —
+        # queries built per queue and solved together in query.rs)
+        eligible = [
+            q for q in self.state.queues.values() if q.can_submit_now()
+        ]
+        if not eligible:
+            return
+        response = compute_new_worker_query(
+            self.server.core,
+            self.server.model,
+            [self._build_query(q) for q in eligible],
+        )
+        for queue, sn_workers in zip(
+            eligible, response.single_node_workers_per_query
+        ):
             wpa = max(queue.params.workers_per_alloc, 1)
-            sn_workers = self._fake_worker_demand(queue)
             mn_nodes = self._mn_demand(queue)
             # queued allocations first satisfy mn demand (a whole alloc per
             # gang), their remaining workers count against sn demand
@@ -408,6 +382,11 @@ class AutoAllocService:
         queue, alloc = self.state.find_allocation(alloc_id)
         if alloc is not None:
             alloc.connected_workers.add(worker_id)
+            worker = self.server.core.workers.get(worker_id)
+            if worker is not None:
+                self._queue_known_resources[queue.queue_id] = (
+                    worker.resources
+                )
             if alloc.status == "queued":
                 self._transition(queue, alloc, "running")
 

@@ -43,6 +43,10 @@ logger = logging.getLogger(__name__)
 class MilpModel:
     """Same interface as GreedyCutScanModel.solve; joint lexicographic MILP."""
 
+    # run_tick routes min-utilization workers through the joint program
+    # instead of the greedy carve-out (reference solver.rs:479-518)
+    supports_cpu_floor = True
+
     def __init__(self, time_limit_secs: float = 10.0):
         # budget for the WHOLE tick (split across priority levels): the
         # solve runs synchronously inside the server's scheduler loop, so it

@@ -79,7 +79,10 @@ def _range_compress(
     `total` is present (ALL-policy requests in this tick) it shifts with
     free, and a partially-used pool is kept STRICTLY below its shifted total
     so the kernel's free == total idle check can never go optimistic.
+    Returns the per-column shifts (callers scaling other cpu-denominated
+    vectors, e.g. cpu_floor, must apply column 0's shift).
     """
+    shifts = [0] * free.shape[1]
     for r in range(free.shape[1]):
         peak = max(
             int(free[:, r].max(initial=0)), int(needs[:, :, r].max(initial=0))
@@ -89,6 +92,7 @@ def _range_compress(
         shift = 0
         while (peak >> shift) >= MAX_SAFE_AMOUNT:
             shift += 1
+        shifts[r] = shift
         if shift:
             nonzero = needs[:, :, r] > 0
             needs[:, :, r] = np.where(
@@ -107,6 +111,7 @@ def _range_compress(
                     np.where(was_partial, total[:, r] - 1, free[:, r]),
                     out=free[:, r],
                 )
+    return shifts
 
 
 def run_tick(
@@ -135,14 +140,27 @@ def run_tick(
         return []
 
     # min-utilization workers take tasks all-or-nothing (enough to clear
-    # their cpu floor, or none); the dense water-fill cannot express that,
-    # so they are carved out of the main solve and each gets an exact
-    # host-side search over whatever the main solve left in the queues.
-    # Deviation from the reference (one joint MILP, solver.rs:479-518):
-    # a task never chooses BETWEEN a normal and a mu worker in one decision
-    # — mu workers only see the leftovers. The joint trade-off is restored
-    # in the MilpModel oracle.
+    # their cpu floor, or none).  A model that can express that jointly
+    # (MilpModel.supports_cpu_floor, `--scheduler=milp`) solves normal and
+    # mu workers in one program, the reference semantics.  The dense
+    # water-fill cannot, so under greedy/multichip the mu workers are
+    # carved out of the main solve and each gets an exact host-side search
+    # over the leftovers — a DOCUMENTED deviation (docs/scheduler.md
+    # "Min-utilization workers"; pinned by tests/test_makespan.py
+    # test_mu_carveout_vs_joint_oracle_disagree): a task never chooses
+    # BETWEEN a normal and a mu worker in one decision.
     mu_workers = [w for w in workers if w.cpu_floor > 0]
+    if mu_workers and getattr(model, "supports_cpu_floor", False):
+        # joint path (reference solver.rs:479-518 add_min_utilization): the
+        # model expresses the all-or-nothing floor itself, so normal and mu
+        # workers are solved in ONE program — no carve-out deviation
+        return _run_main_solve(
+            queues, workers, rq_map, resource_map, model, batches,
+            cpu_floor=np.fromiter(
+                (max(w.cpu_floor, 0) for w in workers), dtype=np.int64,
+                count=len(workers),
+            ),
+        )
     workers = [w for w in workers if w.cpu_floor <= 0]
     if not workers:
         return _solve_mu_workers(queues, mu_workers, rq_map, resource_map)
@@ -156,7 +174,19 @@ def run_tick(
     return assignments
 
 
-def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
+def assemble_solve_inputs(workers, batches, rq_map, resource_map,
+                          cpu_floor=None):
+    """Build the dense model.solve inputs for `batches` over `workers`.
+
+    Sorts `batches` IN PLACE into the production solve order (priority,
+    scarcity, achievable objective) and applies _range_compress so every
+    amount is float32-exact for the jitted kernel.  This is the ONE
+    assembly path, used by both the production tick (_run_main_solve) and
+    the autoalloc demand query (autoalloc/query.py compute_new_worker_query)
+    — sharing it guarantees the demand estimate can never diverge from
+    what production would solve.  Returns the kwargs dict for
+    model.solve().
+    """
     n_w = len(workers)
     n_r = len(resource_map)
     n_b = len(batches)
@@ -342,7 +372,7 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
         if wt is not None:
             weighted_rows.append((bi, k, wt))
 
-    _range_compress(needs, free, total)
+    shifts = _range_compress(needs, free, total)
     free32 = free.astype(np.int32)
     extra = {}
     if all_mask is not None and all_mask.any():
@@ -356,15 +386,32 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches):
         for bi, k, wt in weighted_rows:
             w_arr[bi, :k] = wt
         extra["weights"] = w_arr
-    counts = model.solve(
-        free=free32,
-        nt_free=nt_free,
-        lifetime=lifetime,
-        needs=needs.astype(np.int32),
-        sizes=sizes,
-        min_time=min_time,
-        priorities=[b.priority for b in batches],
+    if cpu_floor is not None:
+        # joint mu path (run_tick): if _range_compress shifted the cpu
+        # column, ceil-shift the floors the same way (a floor must never
+        # become EASIER to meet than the unshifted program)
+        if shifts[0]:
+            s = shifts[0]
+            cpu_floor = (cpu_floor + (1 << s) - 1) >> s
+        extra["cpu_floor"] = cpu_floor
+    return {
+        "free": free32,
+        "nt_free": nt_free,
+        "lifetime": lifetime,
+        "needs": needs.astype(np.int32),
+        "sizes": sizes,
+        "min_time": min_time,
+        "priorities": [b.priority for b in batches],
         **extra,
+    }
+
+
+def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
+                    cpu_floor=None):
+    counts = model.solve(
+        **assemble_solve_inputs(
+            workers, batches, rq_map, resource_map, cpu_floor=cpu_floor
+        )
     )
 
     assignments: list[Assignment] = []
@@ -601,6 +648,18 @@ def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):
         group_left = dict(group_left0)
         dfs(0, free0, nt0, 0, [0.0] * len(levels), [])
 
+        if nodes > 50_000:
+            # budget exhausted: the best solution FOUND so far still ships
+            # (the first dive is a greedy max-take seed, so one is almost
+            # always in hand); log so an idle mu worker is explainable
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "min-utilization solve for worker %d hit the %d-node "
+                "budget; shipping the best fill found (%s)",
+                row.worker_id, 50_000,
+                "non-empty" if best_take and any(best_take) else "empty",
+            )
         if not best_take or not any(best_take):
             continue
         for c, x in zip(cands, best_take):

@@ -649,6 +649,7 @@ def schedule(
                     if not taken:
                         break
                     progress = True
+                    batch.size -= len(taken)  # keeps leftover sizes true
                     for task_id in taken:
                         task = core.tasks[task_id]
                         task.state = TaskState.ASSIGNED
@@ -660,6 +661,71 @@ def schedule(
                         per_worker_msgs.setdefault(
                             worker.worker_id, []
                         ).append(_compute_message(core, task, variant))
+
+    # --- displacement: strictly-higher-user-priority READY work must not
+    # sit in the queues while lower-priority prefilled backlog holds the
+    # workers that could run it.  Retract the lowest-priority settled
+    # victims; once they answer, the next tick prefills in global priority
+    # order (reference redirects the prefilled task on submit,
+    # test_reactor.rs test_prefill_submit_high_priority) ---
+    if prefill and core.queues.total_ready():
+        # per-worker victim lists are built ONCE (ascending priority, with
+        # this tick's sends and in-flight retracts excluded), then consumed
+        # across the batch loop — not rebuilt per (batch x worker).  The
+        # common saturated case (all leftover and backlog at one user
+        # priority) exits on the first victim comparison per worker.
+        victim_lists: dict[int, list] = {}
+        for worker in core.workers.values():
+            if worker.mn_task or worker.mn_reserved:
+                continue
+            if not worker.prefilled_tasks:
+                continue
+            just_sent = {
+                m["id"] for m in per_worker_msgs.get(worker.worker_id, ())
+            }
+            victims = sorted(
+                (
+                    core.tasks[tid]
+                    for tid in worker.prefilled_tasks
+                    if tid not in just_sent
+                    and not core.tasks[tid].retract_pending
+                ),
+                key=lambda t: t.priority,
+            )
+            if victims:
+                victims.reverse()  # pop() consumes lowest-priority first
+                victim_lists[worker.worker_id] = victims
+        if victim_lists:
+            # leftover_batches already carries the post-solve post-prefill
+            # sizes (both phases decrement batch.size) — no third
+            # create_batches walk
+            if leftover_batches is None:
+                leftover_batches = create_batches(core.queues)
+            retract_by_worker: dict[int, list[tuple[int, int]]] = {}
+            for batch in leftover_batches:
+                if batch.size <= 0:
+                    continue
+                rqv = core.rq_map.get_variants(batch.rq_id)
+                need = batch.size
+                for worker_id, victims in victim_lists.items():
+                    if need <= 0:
+                        break
+                    if not victims:
+                        continue
+                    worker = core.workers[worker_id]
+                    if not worker.resources.is_capable_of_rqv(rqv):
+                        continue
+                    while victims and need > 0:
+                        if victims[-1].priority[0] >= batch.priority[0]:
+                            break  # ascending: nothing lower remains
+                        victim = victims.pop()
+                        victim.retract_pending = True
+                        retract_by_worker.setdefault(
+                            worker_id, []
+                        ).append((victim.task_id, victim.instance_id))
+                        need -= 1
+            for wid, refs in retract_by_worker.items():
+                comm.send_retract(wid, refs)
 
     # --- retract: steal prefilled backlog back from loaded workers
     # whenever idle capacity appears that the backlog could use — not only

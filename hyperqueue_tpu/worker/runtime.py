@@ -203,21 +203,34 @@ class WorkerRuntime:
             else:
                 logger.warning("unknown server message %r", op)
 
+    def _park(self, sig: tuple, task_msg: dict) -> None:
+        """Park a task in its signature group, ordered by priority
+        (descending, stable): a later high-priority compute message must
+        start before earlier low-priority backlog once resources free up —
+        the server-side analog is the displacement retract (reference
+        test_reactor.rs test_prefill_submit_high_priority)."""
+        group = self.blocked.setdefault(sig, [])
+        priority = tuple(task_msg.get("priority") or (0, 0))
+        idx = len(group)
+        while idx > 0 and tuple(group[idx - 1].get("priority") or (0, 0)) < priority:
+            idx -= 1
+        group.insert(idx, task_msg)
+        self._n_blocked += 1
+
     def _try_start(self, task_msg: dict) -> bool:
         """Returns False if the task was parked in the blocked queue."""
         entries = task_msg.get("entries", [])
         sig = self._entries_sig(task_msg) if entries else ()
         if entries and sig in self.blocked:
-            # peers with the same signature are already waiting: FIFO order
-            # means this one cannot allocate either — park without probing
-            self.blocked[sig].append(task_msg)
-            self._n_blocked += 1
+            # peers with the same signature are already waiting: the head
+            # could not allocate, so this one cannot either — park without
+            # probing
+            self._park(sig, task_msg)
             return False
         allocation = self.allocator.try_allocate(entries)
         if allocation is None and entries:
             logger.debug("task %d blocked on resources", task_msg["id"])
-            self.blocked.setdefault(sig, []).append(task_msg)
-            self._n_blocked += 1
+            self._park(sig, task_msg)
             return False
         self._start_with_allocation(task_msg, allocation)
         return True
@@ -373,8 +386,14 @@ class WorkerRuntime:
         signatures fail identically, so each release only probes one head
         per signature group — O(#signatures), not O(#blocked), per release
         (the deep prefill queue made the naive scan the worker's dominant
-        cost at 50k+ short tasks)."""
-        for sig in list(self.blocked):
+        cost at 50k+ short tasks).  Signature groups are probed in
+        head-priority order so a freed resource goes to the
+        highest-priority waiter."""
+        for sig in sorted(
+            self.blocked,
+            key=lambda s: tuple(self.blocked[s][0].get("priority") or (0, 0)),
+            reverse=True,
+        ):
             group = self.blocked.get(sig)
             while group:
                 task_msg = group[0]

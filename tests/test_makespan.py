@@ -266,9 +266,29 @@ def test_mu_carveout_vs_joint_oracle_disagree():
     got = run_tick(
         queues, rows, rq_map, rmap, GreedyCutScanModel(backend="numpy")
     )
-    # production: 4 to the normal worker, 2 leftovers < floor -> mu idle
+    # production greedy: 4 to the normal worker, 2 leftovers < floor ->
+    # mu idle (the carve-out deviation, docs/scheduler.md)
     assert len(got) == 4
     assert all(w == 1 for _t, w, _rq, _v in got)
+
+    # production MILP (`--scheduler=milp`): run_tick routes the SAME tick
+    # through the joint program (supports_cpu_floor) and assigns all six
+    queues2 = TaskQueues()
+    for t in range(1, 7):
+        queues2.add(rq, (0, 0), t)
+    rows2 = [
+        WorkerRow(worker_id=1, free=[4 * U], nt_free=64,
+                  lifetime_secs=int(INF_TIME), total=[4 * U]),
+        WorkerRow(worker_id=2, free=[4 * U], nt_free=64,
+                  lifetime_secs=int(INF_TIME), total=[4 * U],
+                  cpu_floor=4 * U),
+    ]
+    joint = run_tick(queues2, rows2, rq_map, rmap, MilpModel())
+    assert len(joint) == 6
+    by_worker = {}
+    for _t, w, _rq, _v in joint:
+        by_worker[w] = by_worker.get(w, 0) + 1
+    assert by_worker[2] == 4  # the floor is exactly met
 
     # the joint oracle assigns all six (2 normal + 4 on the mu worker)
     free = np.array([[4 * U], [4 * U]], dtype=np.int64)

@@ -225,3 +225,239 @@ def test_prefill_spreads_across_workers():
     env.schedule(prefill=True)
     backlogs = sorted(len(w.prefilled_tasks) for w in workers)
     assert backlogs[0] >= 20, backlogs  # roughly even split of 100
+
+
+# ---------------------------------------------------------------------------
+# Reference test_reactor.rs steal/prefill matrix (":798-1160") ported onto
+# this design's retract protocol.  Mapping notes where the designs differ:
+# the reference pre-picks a redirect target and keeps the task in a
+# `Retracting` state; here a retract is a plain give-it-back request — the
+# task stays prefilled on the donor until the worker answers, then requeues
+# and the next tick re-places it.  RejectRequest/EnableRequest
+# (test_task_reject1-3, test_prefill_rejected, test_steal_rejected) have no
+# server-side analog: capability is static, the server never prefills a
+# class the worker cannot host (test_prefill_only_capable_classes), and a
+# worker that cannot allocate *right now* parks the task in its blocked
+# queue and answers retracts with ok=False
+# (test_retract_response_not_ok_keeps_task).
+# ---------------------------------------------------------------------------
+
+from utils_env import TestEnv as _TestEnv
+
+
+def _setup_prefill():
+    """Reference setup_prefill (test_reactor.rs:778): one busy 1-cpu worker
+    holding an assigned task and prefilled backlog."""
+    env = _TestEnv()
+    w1 = env.worker(cpus=1)
+    ids = env.submit(n=3)
+    env.schedule(prefill=True)
+    assigned = next(t for t in ids if not env.core.tasks[t].prefilled)
+    prefilled = next(t for t in ids if env.core.tasks[t].prefilled)
+    return env, w1, assigned, prefilled
+
+
+def _setup_retracting():
+    """Reference setup_retracting (test_reactor.rs:995): a retract is in
+    flight from donor w1 after idle w2 appeared.  Also returns the task
+    RUNNING on the donor (reference reads it from sn_assignment)."""
+    env = _TestEnv()
+    w1 = env.worker(cpus=1)
+    ids = env.submit(n=8)
+    env.schedule(prefill=True)
+    env.start_all_assigned()
+    w2 = env.worker(cpus=1)
+    env.schedule(prefill=True)
+    pending = [t for t in ids if env.core.tasks[t].retract_pending]
+    assert pending, "setup: no retract in flight"
+    running = next(iter(w1.assigned_tasks))
+    return env, w1, w2, pending[0], running
+
+
+def test_prefill_submit_high_priority_displaces_backlog():
+    """test_reactor.rs:798 (cpus=1 arm) — a strictly-higher-priority
+    runnable task arriving when the worker's prefill budget is exhausted
+    retracts lower-priority prefilled backlog to make room.  (With budget
+    to spare the high-priority task is instead prefilled directly and the
+    worker's priority-ordered blocked queue starts it first — same
+    outcome, no retract needed.)"""
+    from hyperqueue_tpu.server import reactor
+
+    env = _TestEnv()
+    w1 = env.worker(cpus=1)
+    env.submit(n=reactor.PREFILL_MAX + 1)
+    env.schedule(prefill=True)
+    assert len(w1.prefilled_tasks) == reactor.PREFILL_MAX
+    env.submit(n=1, priority=(10, 0), job=2)
+    before = len(env.comm.retracts)
+    env.schedule(prefill=True)
+    assert len(env.comm.retracts) > before
+    donor_id, refs = env.comm.retracts[-1]
+    assert donor_id == w1.worker_id
+    retracted_ids = {t for t, _ in refs}
+    assert retracted_ids <= {
+        t for t in env.core.tasks if env.core.tasks[t].retract_pending
+    }
+    # victims are the lowest-priority prefilled tasks
+    assert all(env.core.tasks[t].priority[0] == 0 for t in retracted_ids)
+    # once a victim answers, the next tick prefills the high-priority task
+    victim = next(iter(retracted_ids))
+    reactor.on_retract_response(
+        env.core, env.comm, victim, True, env.core.tasks[victim].instance_id
+    )
+    env.schedule(prefill=True)
+    high = [
+        t for t, task in env.core.tasks.items()
+        if task.priority == (10, 0)
+    ]
+    assert all(env.core.tasks[t].assigned_worker == w1.worker_id
+               for t in high)
+
+
+def test_prefill_submit_high_priority_unrunnable_no_churn():
+    """test_reactor.rs:798 (cpus=2 arm) — DEVIATION: the reference retracts
+    backlog even for a higher-priority task the worker could never run;
+    here displacement only fires for classes the worker can host, so an
+    impossible task causes no churn."""
+    env, w1, assigned, prefilled = _setup_prefill()
+    env.submit(n=1, rqv=env.rqv(cpus=2), priority=(10, 0), job=2)
+    before = len(env.comm.retracts)
+    env.schedule(prefill=True)
+    assert len(env.comm.retracts) == before
+
+
+def test_prefill_submit_same_priority_no_displacement():
+    """test_reactor.rs:829 — a same-priority submit leaves the prefilled
+    backlog alone (both cpus variants)."""
+    for cpus in (1, 2):
+        env, w1, assigned, prefilled = _setup_prefill()
+        env.submit(n=1, rqv=env.rqv(cpus=cpus), job=2)
+        before = len(env.comm.retracts)
+        env.schedule(prefill=True)
+        assert len(env.comm.retracts) == before
+        assert env.core.tasks[prefilled].prefilled
+        assert env.core.tasks[prefilled].assigned_worker == w1.worker_id
+
+
+def test_prefill_worker_lost_requeues_all():
+    """test_reactor.rs:851 — losing the worker requeues assigned and
+    prefilled alike, no crash charge for the never-started backlog."""
+    env, w1, assigned, prefilled = _setup_prefill()
+    env.lose_worker(w1.worker_id)
+    assert env.state(assigned) is TaskState.READY
+    assert env.state(prefilled) is TaskState.READY
+    assert env.core.tasks[prefilled].crash_counter == 0
+    assert not env.core.tasks[prefilled].prefilled
+
+
+def test_prefill_started_while_retract_in_flight():
+    """test_reactor.rs:866 test_prefill_started_on_same_worker — the
+    worker starts the prefilled task while the server's retract crosses it
+    on the wire: the running report wins, the late answer is a no-op."""
+    env, w1, w2, victim, _running = _setup_retracting()
+    from hyperqueue_tpu.server import reactor
+
+    task = env.core.tasks[victim]
+    instance = task.instance_id
+    reactor.on_task_running(env.core, env.events, victim, instance)
+    assert task.state is TaskState.RUNNING
+    assert not task.retract_pending
+    assert not task.prefilled
+    assert victim in w1.assigned_tasks  # resources accounted on start
+    # the crossing answer (ok=False, as the worker started it) is a no-op
+    reactor.on_retract_response(env.core, env.comm, victim, False, instance)
+    assert task.state is TaskState.RUNNING
+    env.finish(victim)
+    assert env.state(victim) is TaskState.FINISHED
+
+
+def test_steal_finished():
+    """test_reactor.rs:1009 — the donor finishes the task before honoring
+    the retract: finished wins, bookkeeping clean, late answer dropped."""
+    env, w1, w2, victim, _running = _setup_retracting()
+    from hyperqueue_tpu.server import reactor
+
+    task = env.core.tasks[victim]
+    instance = task.instance_id
+    env.finish(victim)
+    assert env.state(victim) is TaskState.FINISHED
+    assert victim not in w1.prefilled_tasks
+    assert not task.prefilled
+    reactor.on_retract_response(env.core, env.comm, victim, False, instance)
+    assert env.state(victim) is TaskState.FINISHED
+    env.core.sanity_check()
+
+
+def test_steal_running():
+    """test_reactor.rs:1022 — the task starts on the donor while the
+    retract is pending: it keeps running there."""
+    env, w1, w2, victim, running = _setup_retracting()
+    from hyperqueue_tpu.server import reactor
+
+    env.finish(running)  # frees the cpu; the donor starts the victim
+    task = env.core.tasks[victim]
+    reactor.on_task_running(env.core, env.events, victim, task.instance_id)
+    assert task.state is TaskState.RUNNING
+    assert task.assigned_worker == w1.worker_id
+    env.core.sanity_check()
+
+
+def test_steal_failed():
+    """test_reactor.rs:1051 — the task fails on the donor while the
+    retract is pending: failure propagates, donor is clean."""
+    env, w1, w2, victim, _running = _setup_retracting()
+    task = env.core.tasks[victim]
+    env.fail(victim)
+    assert env.state(victim) is TaskState.FAILED
+    assert victim not in w1.prefilled_tasks
+    assert not task.prefilled and not task.retract_pending
+    env.core.sanity_check()
+
+
+def test_steal_cancel():
+    """test_reactor.rs:1078 — cancelling mid-retract cancels on the donor
+    and cleans up."""
+    env, w1, w2, victim, _running = _setup_retracting()
+    out = env.cancel([victim])
+    assert out == [victim]
+    assert env.state(victim) is TaskState.CANCELED
+    assert victim not in w1.prefilled_tasks
+    assert any(
+        victim in tids for wid, tids in env.comm.cancels
+        if wid == w1.worker_id
+    )
+    env.core.sanity_check()
+
+
+def test_steal_source_worker_lost_task_reaches_new_worker():
+    """test_reactor.rs:1096 — the donor dies mid-retract: the task must
+    end up on the other worker (the reference redirects instantly; here it
+    requeues and the next tick assigns it)."""
+    env, w1, w2, victim, _running = _setup_retracting()
+    env.lose_worker(w1.worker_id)
+    task = env.core.tasks[victim]
+    assert task.state is TaskState.READY
+    assert not task.retract_pending
+    env.schedule(prefill=True)
+    assert task.assigned_worker == w2.worker_id
+    env.core.sanity_check()
+
+
+def test_steal_target_worker_lost_task_stays_on_donor():
+    """test_reactor.rs:1141 — the idle worker that motivated the steal
+    dies: the task stays with the donor; the eventual ok answer requeues
+    it and it lands back on the donor."""
+    env, w1, w2, victim, _running = _setup_retracting()
+    from hyperqueue_tpu.server import reactor
+
+    task = env.core.tasks[victim]
+    instance = task.instance_id
+    env.lose_worker(w2.worker_id)
+    assert task.prefilled
+    assert task.assigned_worker == w1.worker_id
+    assert task.retract_pending  # the request is still out
+    reactor.on_retract_response(env.core, env.comm, victim, True, instance)
+    assert task.state is TaskState.READY
+    env.schedule(prefill=True)
+    assert task.assigned_worker == w1.worker_id
+    env.core.sanity_check()
