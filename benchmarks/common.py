@@ -144,4 +144,17 @@ def measure_submit_wait(cluster, n_tasks, calibrate=True, extra=()):
 
 
 def emit(record: dict) -> None:
+    """Print one JSON result line AND store it in the durable result
+    database (benchmarks/results/db.jsonl, keyed by experiment+params+git
+    rev — reference benchmarks/src/benchmark/database.py).  Set
+    HQ_BENCH_NO_DB=1 to skip the store (throwaway runs)."""
     print(json.dumps(record), flush=True)
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            from database import Database
+        except ImportError:
+            from benchmarks.database import Database
+        try:
+            Database().store_emit(record)
+        except OSError as e:  # a read-only checkout must not kill the run
+            print(f"# result-db store failed: {e}", file=sys.stderr)

@@ -1,0 +1,167 @@
+"""Benchmark result database: durable, diffable, keyed by config + rev.
+
+Reference: benchmarks/src/benchmark/database.py (DatabaseRecord keyed by a
+BenchmarkIdentifier; `has_record_for` enables resume) and
+src/postprocessing/{overview,monitor}.py (comparisons over stored runs).
+This is the scaled-down equivalent: one JSONL file checked into the repo
+(`benchmarks/results/db.jsonl`), one record per measurement, keyed by
+(experiment, params, git_rev).  `benchmarks/report.py` renders comparison
+tables and regenerates BASELINE.json's `published` section from it, so
+every number in BENCH/COVERAGE/CHANGELOG traces to a stored run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import time
+import uuid
+from pathlib import Path
+from typing import Any
+
+DEFAULT_DB = Path(__file__).resolve().parent / "results" / "db.jsonl"
+
+# Fields that identify a benchmark CONFIG (everything else numeric in an
+# emitted record is a measured value; strings are always config).
+PARAM_KEYS = {
+    "experiment", "n_tasks", "n_workers", "n_layers", "width", "cpus",
+    "mode", "backend", "scheduler", "encryption", "n_entries", "variant",
+    "seed", "n_jobs", "entries", "payload_kb", "reference_claim_ms",
+    "n_resources", "workload", "depth",
+}
+
+
+@dataclasses.dataclass
+class Record:
+    uuid: str
+    experiment: str
+    params: dict[str, Any]
+    values: dict[str, float]
+    git_rev: str
+    timestamp: float
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def key(self) -> tuple:
+        return (self.experiment, config_key(self.params))
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, data: dict) -> "Record":
+        return cls(**{
+            f.name: data.get(f.name) for f in dataclasses.fields(cls)
+        })
+
+
+def config_key(params: dict) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in params.items()))
+
+
+def current_git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=Path(__file__).resolve().parent.parent,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 - the db must work outside a checkout
+        return "unknown"
+
+
+def split_emit_record(raw: dict) -> tuple[str, dict, dict]:
+    """(experiment, params, values) from an experiment's emitted record."""
+    experiment = str(raw.get("experiment", "unknown"))
+    params: dict[str, Any] = {}
+    values: dict[str, float] = {}
+    for k, v in raw.items():
+        if k == "experiment":
+            continue
+        if k in PARAM_KEYS or isinstance(v, str) or isinstance(v, bool):
+            params[k] = v
+        elif isinstance(v, (int, float)):
+            values[k] = v
+        else:
+            params[k] = v  # lists/dicts describe config, not measurements
+    return experiment, params, values
+
+
+class Database:
+    def __init__(self, path: Path | str = DEFAULT_DB):
+        self.path = Path(path)
+        self._records: list[Record] | None = None
+
+    def records(self) -> list[Record]:
+        if self._records is None:
+            out: list[Record] = []
+            if self.path.exists():
+                with open(self.path) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if line:
+                            out.append(Record.from_json(json.loads(line)))
+            self._records = out
+        return self._records
+
+    def append(self, record: Record) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(record.to_json()) + "\n")
+        if self._records is not None:
+            self._records.append(record)
+
+    def store_emit(self, raw: dict, metadata: dict | None = None) -> Record:
+        """Store one experiment `emit` record under the current git rev."""
+        experiment, params, values = split_emit_record(raw)
+        record = Record(
+            uuid=uuid.uuid4().hex[:12],
+            experiment=experiment,
+            params=params,
+            values=values,
+            git_rev=current_git_rev(),
+            timestamp=time.time(),
+            metadata=metadata or {},
+        )
+        self.append(record)
+        return record
+
+    def query(
+        self,
+        experiment: str | None = None,
+        git_rev: str | None = None,
+        **param_filters,
+    ) -> list[Record]:
+        out = []
+        for r in self.records():
+            if experiment is not None and r.experiment != experiment:
+                continue
+            if git_rev is not None and r.git_rev != git_rev:
+                continue
+            if any(
+                str(r.params.get(k)) != str(v)
+                for k, v in param_filters.items()
+            ):
+                continue
+            out.append(r)
+        return out
+
+    def has_record_for(
+        self, experiment: str, params: dict, git_rev: str | None = None
+    ) -> bool:
+        """Resume support (reference database.py has_record_for)."""
+        rev = git_rev or current_git_rev()
+        key = config_key(params)
+        return any(
+            r.experiment == experiment
+            and r.git_rev == rev
+            and config_key(r.params) == key
+            for r in self.records()
+        )
+
+    def latest(
+        self, experiment: str, value: str, **param_filters
+    ) -> Record | None:
+        matches = self.query(experiment, **param_filters)
+        matches = [m for m in matches if value in m.values]
+        return max(matches, key=lambda r: r.timestamp, default=None)

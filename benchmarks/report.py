@@ -1,0 +1,198 @@
+"""Benchmark comparison report over the result database.
+
+Reference: benchmarks/src/postprocessing/overview.py (summaries over a
+Database) + src/analysis/chart.py (comparison rendering), scaled down to a
+terminal tool with no extra dependencies.
+
+Usage:
+    python benchmarks/report.py table [experiment]   # comparison tables
+    python benchmarks/report.py trend <experiment> <value> [param=value...]
+    python benchmarks/report.py baseline             # rewrite
+                                                     # BASELINE.json.published
+                                                     # from stored runs
+
+`table` groups records by (experiment, params) and shows each config's
+measured values per git rev (latest run per rev), with the delta against
+the oldest rev — a regression that worsens a metric shows up as a signed
+percentage.  `baseline` regenerates the published-numbers section of
+BASELINE.json so BENCH/COVERAGE/CHANGELOG all cite one source.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from database import DEFAULT_DB, Database, config_key  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.4g}"
+    return str(int(v))
+
+
+def _param_str(params: dict) -> str:
+    return " ".join(
+        f"{k}={v}" for k, v in sorted(params.items()) if k != "experiment"
+    ) or "-"
+
+
+def render_tables(db: Database, experiment: str | None = None) -> str:
+    by_config: dict = defaultdict(list)
+    for r in db.records():
+        if experiment and r.experiment != experiment:
+            continue
+        by_config[(r.experiment, config_key(r.params))].append(r)
+    lines = []
+    for (exp, _key), records in sorted(by_config.items()):
+        records.sort(key=lambda r: r.timestamp)
+        params = records[-1].params
+        lines.append(f"== {exp}  [{_param_str(params)}]")
+        # latest record per rev, oldest rev first
+        per_rev: dict[str, object] = {}
+        for r in records:
+            per_rev[r.git_rev] = r
+        base = next(iter(per_rev.values()))
+        metrics = sorted(
+            {m for r in per_rev.values() for m in r.values}
+        )
+        header = ["rev".ljust(10)] + [m.rjust(14) for m in metrics]
+        lines.append("  " + " ".join(header))
+        for rev, r in per_rev.items():
+            row = [rev.ljust(10)]
+            for m in metrics:
+                v = r.values.get(m)
+                if v is None:
+                    row.append("-".rjust(14))
+                    continue
+                cell = _fmt(v)
+                b = base.values.get(m)
+                if b not in (None, 0) and r is not base:
+                    cell += f" ({(v - b) / b * 100:+.0f}%)"
+                row.append(cell.rjust(14))
+            lines.append("  " + " ".join(row))
+        lines.append("")
+    return "\n".join(lines) if lines else "no records"
+
+
+def render_trend(
+    db: Database, experiment: str, value: str, **params
+) -> str:
+    """ASCII trend of one metric over time for one config."""
+    records = [
+        r for r in db.query(experiment, **params) if value in r.values
+    ]
+    records.sort(key=lambda r: r.timestamp)
+    if not records:
+        return "no records"
+    vals = [r.values[value] for r in records]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    bars = "▁▂▃▄▅▆▇█"
+    spark = "".join(
+        bars[min(int((v - lo) / span * (len(bars) - 1)), len(bars) - 1)]
+        for v in vals
+    )
+    lines = [f"{experiment}.{value}  ({_param_str(params)})", f"  {spark}"]
+    for r in records:
+        lines.append(
+            f"  {r.git_rev:<10} {_fmt(r.values[value]):>12}  "
+            f"{r.params.get('n_tasks', '')}"
+        )
+    return "\n".join(lines)
+
+
+def build_published(db: Database) -> dict:
+    """The BASELINE.json `published` section, entirely from stored runs."""
+    published: dict = {}
+
+    # per-task-overhead curve: n_tasks -> marginal ms (latest per size)
+    curve = {}
+    for r in db.query("per-task-overhead"):
+        if "per_task_ms" in r.values:
+            n = int(r.params.get("n_tasks", 0))
+            cur = curve.get(n)
+            if cur is None or r.timestamp > cur.timestamp:
+                curve[n] = r
+    if curve:
+        published["per_task_overhead_ms"] = {
+            str(n): {
+                "per_task_ms": curve[n].values["per_task_ms"],
+                "rev": curve[n].git_rev,
+            }
+            for n in sorted(curve)
+        }
+
+    # tick latency (bench.py's headline metric)
+    tick = db.latest("tick-latency", "value_ms")
+    if tick is not None:
+        published["tick_latency"] = {
+            **{k: v for k, v in tick.params.items()},
+            "ms": tick.values["value_ms"],
+            "vs_baseline": tick.values.get("vs_baseline"),
+            "rev": tick.git_rev,
+        }
+
+    # stress-DAG makespan: greedy vs the exact MILP oracle, per seed
+    oracle_rows = {}
+    for r in db.query("makespan-oracle"):
+        seed = int(r.params.get("seed", -1))
+        cur = oracle_rows.get(seed)
+        if cur is None or r.timestamp > cur.timestamp:
+            oracle_rows[seed] = r
+    if oracle_rows:
+        published["stress_dag_makespan_vs_oracle"] = {
+            str(seed): {
+                "greedy_s": row.values.get("greedy_s"),
+                "milp_s": row.values.get("milp_s"),
+                "ratio": row.values.get("ratio"),
+                "rev": row.git_rev,
+            }
+            for seed, row in sorted(oracle_rows.items())
+        }
+
+    # end-to-end throughput (stress-dag through the real server)
+    dag = db.latest("stress-dag", "tasks_per_s")
+    if dag is not None:
+        published["stress_dag_e2e"] = {
+            "n_tasks": dag.params.get("n_tasks"),
+            "wall_s": dag.values.get("wall_s"),
+            "tasks_per_s": dag.values.get("tasks_per_s"),
+            "rev": dag.git_rev,
+        }
+    return published
+
+
+def update_baseline(db: Database) -> dict:
+    path = REPO / "BASELINE.json"
+    data = json.loads(path.read_text())
+    data["published"] = build_published(db)
+    path.write_text(json.dumps(data, indent=2) + "\n")
+    return data["published"]
+
+
+def main(argv: list[str]) -> int:
+    db = Database(DEFAULT_DB)
+    cmd = argv[0] if argv else "table"
+    if cmd == "table":
+        print(render_tables(db, argv[1] if len(argv) > 1 else None))
+    elif cmd == "trend":
+        params = dict(p.split("=", 1) for p in argv[3:])
+        print(render_trend(db, argv[1], argv[2], **params))
+    elif cmd == "baseline":
+        published = update_baseline(db)
+        print(json.dumps(published, indent=2))
+    else:
+        print(__doc__)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
