@@ -422,6 +422,34 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    # Store the run in the durable result database so report.py's
+    # `tick_latency` published number traces to an actual stored run
+    # (reference benchmarks/src/benchmark/database.py; set HQ_BENCH_NO_DB=1
+    # for throwaway runs).
+    import os as _os
+
+    if not _os.environ.get("HQ_BENCH_NO_DB") and median_ms > 0:
+        try:
+            sys.path.insert(
+                0, str(__import__("pathlib").Path(__file__).parent / "benchmarks")
+            )
+            from database import Database
+
+            Database().store_emit({
+                "experiment": "tick-latency",
+                "mode": "kernel" if args.kernel else "full-tick",
+                "n_workers": args.workers,
+                "n_tasks": args.tasks,
+                "device": device.platform,
+                "backend": solve_backend or "device-jax",
+                "value_ms": round(median_ms, 3),
+                "vs_baseline": round(BASELINE_MS / median_ms, 2),
+                "min_ms": round(min(times), 3),
+                "max_ms": round(max(times), 3),
+            })
+        except Exception as e:  # noqa: BLE001 - the bench must still print
+            print(f"# result-db store failed: {e}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

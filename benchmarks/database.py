@@ -28,7 +28,8 @@ PARAM_KEYS = {
     "experiment", "n_tasks", "n_workers", "n_layers", "width", "cpus",
     "mode", "backend", "scheduler", "encryption", "n_entries", "variant",
     "seed", "n_jobs", "entries", "payload_kb", "reference_claim_ms",
-    "n_resources", "workload", "depth",
+    "n_resources", "workload", "depth", "gpu_share", "sleep_ms",
+    "task_sleep_ms", "cores", "device", "metric", "unit",
 }
 
 

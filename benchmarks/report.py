@@ -129,8 +129,10 @@ def build_published(db: Database) -> dict:
             for n in sorted(curve)
         }
 
-    # tick latency (bench.py's headline metric)
-    tick = db.latest("tick-latency", "value_ms")
+    # tick latency (bench.py's headline metric) — the published number is
+    # the END-TO-END full tick; --kernel runs are stored too but must not
+    # replace the headline (they'd silently change its meaning)
+    tick = db.latest("tick-latency", "value_ms", mode="full-tick")
     if tick is not None:
         published["tick_latency"] = {
             **{k: v for k, v in tick.params.items()},

@@ -60,11 +60,19 @@ def parse_selector(text: str, last_id: int | None = None) -> list[int]:
             fail("no jobs submitted yet")
         return [last_id]
     ids: list[int] = []
-    for part in text.split(","):
+    # underscore separators are readability sugar: 1-1000_000 == 1-1000000
+    # (reference cli/shortcuts.md); steps via <start>-<end>:<step>
+    for part in text.replace("_", "").split(","):
         part = part.strip()
         if "-" in part:
+            step = 1
+            if ":" in part:
+                part, step_s = part.rsplit(":", 1)
+                step = int(step_s)
+                if step <= 0:
+                    fail(f"selector step must be positive: {text!r}")
             lo, hi = part.split("-", 1)
-            ids.extend(range(int(lo), int(hi) + 1))
+            ids.extend(range(int(lo), int(hi) + 1, step))
         elif part:
             ids.append(int(part))
     return ids
@@ -124,7 +132,7 @@ def cmd_server_start(args) -> None:
         print(
             f"+-- HyperQueue TPU server [{access.server_uid}] --\n"
             f"| clients: {access.host}:{access.client_port}\n"
-            f"| workers: {access.host}:{access.worker_port}\n"
+            f"| workers: {access.host_for_workers()}:{access.worker_port}\n"
             f"+--",
             flush=True,
         )
@@ -153,15 +161,35 @@ def cmd_server_info(args) -> None:
 
 
 def cmd_server_generate_access(args) -> None:
+    client_host = args.client_host or args.host
+    worker_host = args.worker_host or args.host
+    if not client_host or not worker_host:
+        fail("provide --host, or both --client-host and --worker-host")
     record = serverdir.generate_access(
-        host=args.host,
+        host=client_host,
         client_port=args.client_port,
         worker_port=args.worker_port,
+        worker_host=worker_host if worker_host != client_host else None,
     )
-    with open(args.access_file, "w") as f:
-        json.dump(record.to_json(), f, indent=2)
-    os.chmod(args.access_file, 0o600)
-    make_output(args.output_mode).message(f"access file written to {args.access_file}")
+
+    def write(path, role=None):
+        with open(path, "w") as f:
+            json.dump(record.to_json(role), f, indent=2)
+        os.chmod(path, 0o600)
+
+    write(args.access_file)
+    written = [args.access_file]
+    # split access: a client-only and/or worker-only record, each usable as
+    # access.json by just that role (reference generate_access.rs splitting)
+    if args.client_file:
+        write(args.client_file, "client")
+        written.append(args.client_file)
+    if args.worker_file:
+        write(args.worker_file, "worker")
+        written.append(args.worker_file)
+    make_output(args.output_mode).message(
+        f"access file(s) written to {', '.join(written)}"
+    )
 
 
 # ---------------------------------------------------------------- worker cmds
@@ -218,8 +246,10 @@ def cmd_worker_start(args) -> None:
         alloc_id=os.environ.get("HQ_ALLOC_ID", ""),
     )
     profile_out = os.environ.get("HQ_PROFILE")
+    if not access.worker_port:
+        fail("access record has no worker plane (client-only split file?)")
     coro_args = (
-        access.host,
+        access.host_for_workers(),
         access.worker_port,
         access.worker_key_bytes(),
         config,
@@ -629,9 +659,16 @@ def cmd_submit(args) -> None:
         "max_fails": args.max_fails,
     }
     if entry_values is not None:
-        ids = task_ids or list(range(len(entry_values)))
-        if len(ids) != len(entry_values):
-            fail("--array size does not match number of entries")
+        # --array selects a SUBSET of lines/items: task id = entry index
+        # (0-based), ids beyond the entry count are silently removed
+        # (reference docs/jobs/arrays.md "Combining --each-line/--from-json
+        # with --array"; submit/command.rs entry subsetting). `--array all`
+        # parses to [] = every id, i.e. every entry.
+        if task_ids:
+            ids = [i for i in task_ids if 0 <= i < len(entry_values)]
+            entry_values = [entry_values[i] for i in ids]
+        else:
+            ids = list(range(len(entry_values)))
         job_desc["array"] = {
             "ids": ids, "entries": entry_values, "body": body_base,
             "request": request, "priority": args.priority,
@@ -876,15 +913,20 @@ def cmd_job_task_ids(args) -> None:
 def cmd_doc(args) -> None:
     docs_root = Path(__file__).resolve().parent.parent.parent / "docs"
     topic = args.topic or "index"
-    for candidate in (
-        docs_root / f"{topic}.md",
-        docs_root / "jobs" / f"{topic}.md",
-        docs_root / "deployment" / f"{topic}.md",
-    ):
+    # `hq doc arrays` or `hq doc jobs/arrays` — search every docs subtree
+    # (reference: cli/documentation.md, `hq doc` opens a topic index)
+    candidates = [docs_root / f"{topic}.md"]
+    if "/" not in topic:
+        # bare names search every subtree; explicit paths must match
+        # exactly (a typo'd path should error, not print a random page)
+        candidates += sorted(docs_root.rglob(f"{topic}.md"))
+    for candidate in candidates:
         if candidate.exists():
             print(candidate.read_text())
             return
-    available = sorted(p.stem for p in docs_root.rglob("*.md"))
+    available = sorted(
+        str(p.relative_to(docs_root))[:-3] for p in docs_root.rglob("*.md")
+    )
     fail(f"unknown topic {topic!r}; available: {', '.join(available)}")
 
 
@@ -1353,9 +1395,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = ssub.add_parser("generate-access")
     _add_common(p)
     p.add_argument("access_file")
-    p.add_argument("--host", required=True)
+    p.add_argument("--host", default=None,
+                   help="hostname for both planes (or set per-role hosts)")
+    p.add_argument("--client-host", default=None)
+    p.add_argument("--worker-host", default=None)
     p.add_argument("--client-port", type=int, required=True)
     p.add_argument("--worker-port", type=int, required=True)
+    p.add_argument("--client-file", default=None,
+                   help="also write a client-only access file")
+    p.add_argument("--worker-file", default=None,
+                   help="also write a worker-only access file")
     p.set_defaults(fn=cmd_server_generate_access)
 
     # worker

@@ -26,6 +26,10 @@ class ClientSession:
 
     def __init__(self, server_dir: Path):
         self.access = serverdir.load_access(Path(server_dir))
+        if not self.access.client_port:
+            raise RuntimeError(
+                "access record has no client plane (worker-only split file?)"
+            )
         self._loop = asyncio.new_event_loop()
         self._conn = self._loop.run_until_complete(self._connect())
 

@@ -285,7 +285,21 @@ class Server:
             import json as _json
 
             with open(self.access_file) as f:
-                preshared = serverdir.AccessRecord.from_json(_json.load(f))
+                raw = _json.load(f)
+            # the server needs BOTH planes: a split client-only/worker-only
+            # file (generate-access --client-file/--worker-file) would
+            # silently disable auth + bind an ephemeral port on the missing
+            # plane — reject it loudly (reference: only FullAccessRecord is
+            # accepted by server start)
+            missing = [p for p in ("client", "worker") if p not in raw]
+            if missing:
+                raise ValueError(
+                    f"access file {self.access_file} is a split "
+                    f"{'/'.join(sorted(set(('client', 'worker')) - set(missing)))}"
+                    f"-only record; `server start --access-file` needs the "
+                    f"full record (missing plane: {', '.join(missing)})"
+                )
+            preshared = serverdir.AccessRecord.from_json(raw)
             self.client_port = preshared.client_port
             self.worker_port = preshared.worker_port
 

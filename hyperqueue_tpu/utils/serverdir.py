@@ -28,25 +28,45 @@ class AccessRecord:
     client_key: str | None  # hex; None = auth disabled on that plane
     worker_key: str | None
     version: int = 1
+    # server visible under a different hostname to workers than to clients
+    # (reference serverdir.rs FullAccessRecord: per-plane host)
+    worker_host: str | None = None
 
-    def to_json(self) -> dict:
-        return {
-            "version": self.version,
-            "server_uid": self.server_uid,
-            "client": {"host": self.host, "port": self.client_port, "key": self.client_key},
-            "worker": {"host": self.host, "port": self.worker_port, "key": self.worker_key},
-        }
+    def host_for_workers(self) -> str:
+        return self.worker_host or self.host
+
+    def to_json(self, role: str | None = None) -> dict:
+        """Full record, or a split single-plane record when role is
+        "client"/"worker" (reference `generate-access --client-file/
+        --worker-file` splitting)."""
+        out: dict = {"version": self.version, "server_uid": self.server_uid}
+        if role in (None, "client"):
+            out["client"] = {
+                "host": self.host, "port": self.client_port,
+                "key": self.client_key,
+            }
+        if role in (None, "worker"):
+            out["worker"] = {
+                "host": self.host_for_workers(), "port": self.worker_port,
+                "key": self.worker_key,
+            }
+        return out
 
     @classmethod
     def from_json(cls, data: dict) -> "AccessRecord":
+        client = data.get("client")
+        worker = data.get("worker")
+        if client is None and worker is None:
+            raise ValueError("access record has neither client nor worker plane")
         return cls(
             server_uid=data["server_uid"],
-            host=data["client"]["host"],
-            client_port=data["client"]["port"],
-            worker_port=data["worker"]["port"],
-            client_key=data["client"].get("key"),
-            worker_key=data["worker"].get("key"),
+            host=(client or worker)["host"],
+            client_port=client["port"] if client else 0,
+            worker_port=worker["port"] if worker else 0,
+            client_key=client.get("key") if client else None,
+            worker_key=worker.get("key") if worker else None,
             version=data.get("version", 1),
+            worker_host=worker["host"] if worker else None,
         )
 
     def client_key_bytes(self) -> bytes | None:
@@ -69,6 +89,7 @@ def generate_access(
     worker_port: int,
     disable_client_auth: bool = False,
     disable_worker_auth: bool = False,
+    worker_host: str | None = None,
 ) -> AccessRecord:
     return AccessRecord(
         server_uid=secrets.token_hex(8),
@@ -77,6 +98,7 @@ def generate_access(
         worker_port=worker_port,
         client_key=None if disable_client_auth else secrets.token_hex(32),
         worker_key=None if disable_worker_auth else secrets.token_hex(32),
+        worker_host=worker_host,
     )
 
 

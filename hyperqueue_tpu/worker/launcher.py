@@ -196,6 +196,7 @@ async def launch_task(
         cpu_claim = allocation.claim_for("cpus")
         if cpu_claim is not None and cpu_claim.indices:
             cpu_list = ",".join(cpu_claim.indices)
+            env["HQ_PIN"] = pin_mode  # reference program.rs sets HQ_PIN
             if pin_mode == "taskset":
                 cmd = ["taskset", "-c", cpu_list, *cmd]
             elif pin_mode == "omp":

@@ -1,0 +1,108 @@
+"""Split access files and per-role hosts (reference
+common/serverdir.rs FullAccessRecord + generate_access.rs splitting:
+client-only / worker-only records, per-plane hostnames)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hyperqueue_tpu.utils.serverdir import AccessRecord, generate_access
+
+from utils_e2e import HqEnv
+
+
+def test_generate_access_per_role_hosts():
+    rec = generate_access(
+        host="clients.example", client_port=1, worker_port=2,
+        worker_host="workers.example",
+    )
+    assert rec.host == "clients.example"
+    assert rec.host_for_workers() == "workers.example"
+    data = rec.to_json()
+    assert data["client"]["host"] == "clients.example"
+    assert data["worker"]["host"] == "workers.example"
+    # same host -> worker plane mirrors it
+    rec2 = generate_access(host="h", client_port=1, worker_port=2)
+    assert rec2.host_for_workers() == "h"
+
+
+def test_split_records_round_trip():
+    rec = generate_access(host="h", client_port=10, worker_port=20)
+    client_only = AccessRecord.from_json(rec.to_json("client"))
+    worker_only = AccessRecord.from_json(rec.to_json("worker"))
+    assert client_only.client_port == 10
+    assert client_only.worker_port == 0          # no worker plane
+    assert client_only.worker_key is None
+    assert worker_only.worker_port == 20
+    assert worker_only.client_port == 0          # no client plane
+    assert worker_only.client_key is None
+    assert worker_only.worker_key == rec.worker_key
+
+
+def test_from_json_rejects_empty_record():
+    with pytest.raises(ValueError):
+        AccessRecord.from_json({"server_uid": "x", "version": 1})
+
+
+def test_server_start_rejects_split_access_file(tmp_path):
+    """A client-only file fed to `server start --access-file` must fail
+    loudly, not bind an unauthenticated ephemeral worker port."""
+    rec = generate_access(host="127.0.0.1", client_port=0, worker_port=0)
+    split = tmp_path / "client.json"
+    split.write_text(json.dumps(rec.to_json("client")))
+    proc = subprocess.run(
+        [sys.executable, "-m", "hyperqueue_tpu", "server", "start",
+         "--server-dir", str(tmp_path / "sd"),
+         "--access-file", str(split)],
+        capture_output=True, text=True, timeout=60,
+        env={"PYTHONPATH": str(Path(__file__).resolve().parent.parent),
+             "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"},
+    )
+    assert proc.returncode != 0
+    assert "split" in (proc.stdout + proc.stderr)
+
+
+def test_split_files_drive_worker_and_client(tmp_path):
+    """generate-access --client-file/--worker-file: each role connects
+    with just its own plane's record."""
+    with HqEnv(tmp_path) as env:
+        import socket
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        full = env.work_dir / "full.json"
+        client_f = env.work_dir / "client.json"
+        worker_f = env.work_dir / "worker.json"
+        cp, wp = free_port(), free_port()
+        env.command([
+            "server", "generate-access", str(full),
+            "--host", "127.0.0.1",
+            "--client-port", str(cp), "--worker-port", str(wp),
+            "--client-file", str(client_f),
+            "--worker-file", str(worker_f),
+        ])
+        for role, src in (("client", client_f), ("worker", worker_f)):
+            d = env.work_dir / f"sd-{role}"
+            d.mkdir()
+            (d / "access.json").write_text(src.read_text())
+
+        env.start_server("--access-file", str(full))
+        env.start_worker("--server-dir", str(env.work_dir / "sd-worker"))
+        env.wait_workers(1)
+        out = env.command([
+            "submit", "--server-dir", str(env.work_dir / "sd-client"),
+            "--wait", "--", "echo", "ok",
+        ])
+        assert "submitted" in out.lower() or "finished" in out.lower()
+        # the worker-only record cannot submit (no client plane)
+        env.command(
+            ["submit", "--server-dir", str(env.work_dir / "sd-worker"),
+             "--", "echo", "nope"],
+            expect_fail=True,
+        )

@@ -1,0 +1,166 @@
+"""Benchmark result database + report layer (reference
+benchmarks/src/benchmark/database.py DatabaseRecord/has_record_for and
+src/postprocessing/overview.py summaries)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+BENCH = Path(__file__).resolve().parent.parent / "benchmarks"
+sys.path.insert(0, str(BENCH))
+
+from database import Database, Record, config_key, split_emit_record  # noqa: E402
+import report  # noqa: E402
+
+
+@pytest.fixture
+def db(tmp_path):
+    return Database(tmp_path / "db.jsonl")
+
+
+def test_split_emit_record_separates_config_from_values():
+    exp, params, values = split_emit_record({
+        "experiment": "per-task-overhead",
+        "n_tasks": 10_000,
+        "mode": "zero-worker",
+        "wall_s": 1.25,
+        "per_task_ms": 0.05,
+        "reference_claim_ms": 0.1,
+        "encrypted": True,
+        "sizes": [1, 2, 3],
+    })
+    assert exp == "per-task-overhead"
+    assert params == {
+        "n_tasks": 10_000, "mode": "zero-worker",
+        "reference_claim_ms": 0.1, "encrypted": True, "sizes": [1, 2, 3],
+    }
+    assert values == {"wall_s": 1.25, "per_task_ms": 0.05}
+
+
+def test_store_and_reload_round_trip(db):
+    rec = db.store_emit({"experiment": "x", "n_tasks": 5, "wall_s": 1.0})
+    assert db.path.exists()
+    fresh = Database(db.path)
+    loaded = fresh.records()
+    assert len(loaded) == 1
+    assert loaded[0].to_json() == rec.to_json()
+    assert loaded[0].key() == rec.key()
+
+
+def test_append_keeps_cache_coherent(db):
+    db.records()  # prime the cache
+    db.store_emit({"experiment": "x", "n_tasks": 1, "v": 1.0})
+    db.store_emit({"experiment": "y", "n_tasks": 1, "v": 2.0})
+    assert {r.experiment for r in db.records()} == {"x", "y"}
+    # and the on-disk file agrees
+    lines = [json.loads(l) for l in db.path.read_text().splitlines()]
+    assert len(lines) == 2
+
+
+def test_query_filters(db):
+    db.store_emit({"experiment": "x", "n_tasks": 5, "v": 1.0})
+    db.store_emit({"experiment": "x", "n_tasks": 9, "v": 2.0})
+    db.store_emit({"experiment": "y", "n_tasks": 5, "v": 3.0})
+    assert len(db.query("x")) == 2
+    assert len(db.query("x", n_tasks=5)) == 1
+    assert db.query("x", n_tasks=5)[0].values["v"] == 1.0
+    assert db.query("z") == []
+
+
+def test_has_record_for_resume(db):
+    assert not db.has_record_for("x", {"n_tasks": 5})
+    db.store_emit({"experiment": "x", "n_tasks": 5, "v": 1.0})
+    assert db.has_record_for("x", {"n_tasks": 5})
+    # different config -> no resume hit
+    assert not db.has_record_for("x", {"n_tasks": 6})
+    # different rev -> no resume hit
+    assert not db.has_record_for("x", {"n_tasks": 5}, git_rev="deadbeef")
+
+
+def test_latest_picks_newest_by_timestamp(db):
+    db.records()  # prime the cache so the mutation below is observed
+    a = db.store_emit({"experiment": "x", "n_tasks": 5, "v": 1.0})
+    b = db.store_emit({"experiment": "x", "n_tasks": 5, "v": 2.0})
+    a.timestamp = b.timestamp + 100  # make the OLDER insert the newest run
+    got = db.latest("x", "v", n_tasks=5)
+    assert got is a
+
+
+def test_config_key_is_order_insensitive():
+    assert config_key({"a": 1, "b": "x"}) == config_key({"b": "x", "a": 1})
+
+
+def test_render_tables_shows_delta_between_revs(db):
+    r1 = Record(uuid="1", experiment="x", params={"n_tasks": 5},
+                values={"wall_s": 2.0}, git_rev="aaa", timestamp=1.0)
+    r2 = Record(uuid="2", experiment="x", params={"n_tasks": 5},
+                values={"wall_s": 1.0}, git_rev="bbb", timestamp=2.0)
+    db.append(r1)
+    db.append(r2)
+    out = report.render_tables(db)
+    assert "== x" in out
+    assert "aaa" in out and "bbb" in out
+    assert "(-50%)" in out  # bbb halved wall_s vs the base rev
+
+
+def test_render_tables_empty(db):
+    assert report.render_tables(db) == "no records"
+
+
+def test_render_trend(db):
+    for i, v in enumerate([1.0, 2.0, 4.0]):
+        rec = db.store_emit({"experiment": "x", "n_tasks": 5, "v": v})
+        rec.timestamp = float(i)
+    out = report.render_trend(db, "x", "v", n_tasks=5)
+    assert "x.v" in out
+    for mark in ("▁", "█"):
+        assert mark in out
+
+
+def test_build_published_sections(db):
+    db.store_emit({"experiment": "per-task-overhead", "n_tasks": 10_000,
+                   "per_task_ms": 0.05, "reference_claim_ms": 0.1})
+    db.store_emit({"experiment": "tick-latency", "mode": "full-tick",
+                   "n_workers": 1024, "n_tasks": 1_000_000,
+                   "value_ms": 4.5, "vs_baseline": 11.1})
+    db.store_emit({"experiment": "makespan-oracle", "seed": 0,
+                   "greedy_s": 10.0, "milp_s": 9.9, "ratio": 1.01})
+    db.store_emit({"experiment": "stress-dag", "n_tasks": 2000,
+                   "wall_s": 0.5, "tasks_per_s": 4000.0})
+    pub = report.build_published(db)
+    assert pub["per_task_overhead_ms"]["10000"]["per_task_ms"] == 0.05
+    assert pub["tick_latency"]["ms"] == 4.5
+    assert pub["stress_dag_makespan_vs_oracle"]["0"]["ratio"] == 1.01
+    assert pub["stress_dag_e2e"]["tasks_per_s"] == 4000.0
+
+
+def test_checked_in_database_has_records_for_every_experiment():
+    """The result database shipped in the repo must actually hold the
+    matrix — an empty db.jsonl means the perf story is untraceable."""
+    db = Database()  # DEFAULT_DB = benchmarks/results/db.jsonl
+    experiments = {r.experiment for r in db.records()}
+    required = {
+        "per-task-overhead", "scalability", "fractional-resources",
+        "alternative-resources", "numa-coupling", "encryption-overhead",
+        "io-streaming", "server-cpu-util", "stress-dag", "total-overhead",
+        "dask-comparison", "makespan-oracle",
+    }
+    missing = required - experiments
+    assert not missing, f"experiments with zero stored records: {missing}"
+    # the per-task-overhead curve spans 10k -> 1M
+    sizes = {int(r.params.get("n_tasks", 0))
+             for r in db.query("per-task-overhead")}
+    assert {10_000, 50_000, 200_000, 1_000_000} <= sizes
+
+
+def test_published_baseline_is_regenerated_and_nonempty():
+    """BASELINE.json's published section must trace to stored runs."""
+    baseline = json.loads(
+        (Path(__file__).resolve().parent.parent / "BASELINE.json").read_text()
+    )
+    pub = baseline.get("published", {})
+    assert pub.get("per_task_overhead_ms"), "published section is empty"
+    db = Database()
+    assert report.build_published(db).keys() == pub.keys()

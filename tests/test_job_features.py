@@ -111,3 +111,43 @@ def test_job_cancel_reason_verbose(env):
     assert jobs[2]["cancel_reason"] == "canceled by user"
     table = env.command(["job", "list", "--all", "--verbose"])
     assert "cancel reason" in table and "canceled by user" in table
+
+
+def test_each_line_array_subset(env):
+    """--array selects a subset of --each-line entries: task id = line
+    index, out-of-range ids silently dropped; `--array all` keeps every
+    entry (reference docs/jobs/arrays.md combining section)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    data = env.work_dir / "lines.txt"
+    data.write_text("a\nb\nc\nd\n")
+    env.command(
+        ["submit", "--each-line", str(data), "--array", "1,3-9", "--wait",
+         "--", "bash", "-c", "echo e=$HQ_ENTRY"]
+    )
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert sorted(out.strip().splitlines()) == ["e=b", "e=d"]
+    # --array all == no subsetting
+    env.command(
+        ["submit", "--each-line", str(data), "--array", "all", "--wait",
+         "--", "bash", "-c", "echo e=$HQ_ENTRY"]
+    )
+    out = env.command(["job", "cat", "2", "stdout"])
+    assert sorted(out.strip().splitlines()) == ["e=a", "e=b", "e=c", "e=d"]
+
+
+def test_stepped_array_selector(env):
+    """<start>-<end>:<step> + underscore separators (reference
+    cli/shortcuts.md)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(
+        ["submit", "--array", "0-1_0:2", "--wait", "--",
+         "bash", "-c", "echo id=$HQ_TASK_ID"]
+    )
+    out = env.command(["job", "cat", "1", "stdout"])
+    assert sorted(out.strip().splitlines()) == [
+        f"id={i}" for i in (0, 10, 2, 4, 6, 8)
+    ]
