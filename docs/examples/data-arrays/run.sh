@@ -1,0 +1,52 @@
+#!/bin/bash
+# Data-arrays example: parameter sweep via --from-json.
+# HQ_EXAMPLE_LOCAL=1 starts a private server+worker in a temp dir.
+set -euo pipefail
+
+REPO=$(cd "$(dirname "$0")/../../.." && pwd)
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+HQ="${HQ:-python -m hyperqueue_tpu}"
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+cd "$WORK"
+
+if [ "${HQ_EXAMPLE_LOCAL:-0}" = "1" ]; then
+    export HQ_SERVER_DIR="$WORK/sd" JAX_PLATFORMS=cpu
+    $HQ server start > server.log 2>&1 &
+    SERVER_PID=$!
+    trap 'kill $SERVER_PID 2>/dev/null; rm -rf "$WORK"' EXIT
+    for _ in $(seq 100); do
+        [ -e "$HQ_SERVER_DIR/hq-current/access.json" ] && break
+        sleep 0.2
+    done
+    $HQ worker start --cpus 4 > worker.log 2>&1 &
+fi
+
+# 1. the parameter grid
+python - <<'EOF'
+import itertools, json
+grid = [{"lr": lr, "batch": b}
+        for lr, b in itertools.product([0.1, 0.01, 0.001], [16, 64])]
+json.dump(grid, open("grid.json", "w"))
+EOF
+
+# 2. a stub trainer: score = lr * batch
+cat > train.py <<'EOF'
+import json, os, sys
+cfg = json.loads(os.environ["HQ_ENTRY"])
+print(json.dumps({"config": cfg, "score": cfg["lr"] * cfg["batch"]}))
+EOF
+
+# 3. one task per grid point
+$HQ submit --from-json grid.json --wait -- \
+    bash -c 'python train.py > "$HQ_SUBMIT_DIR/result-$HQ_TASK_ID.json"'
+
+# 4. pick the best
+python - <<'EOF'
+import glob, json
+results = [json.load(open(p)) for p in glob.glob("result-*.json")]
+best = max(results, key=lambda r: r["score"])
+print("best:", best)
+assert len(results) == 6, results
+EOF
+echo "data-arrays example OK"

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Iterative-computation example: Monte-Carlo pi until converged.
+
+Each round submits one job with 8 parallel sampling tasks (a task array
+through the Python API); the driver reads the outputs, refines the
+estimate, and stops when two consecutive estimates agree to 3 decimals.
+
+HQ_EXAMPLE_LOCAL=1 runs against a private throwaway cluster.
+"""
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[3]
+sys.path.insert(0, str(REPO))
+
+SAMPLER = r"""
+import json, random, sys
+n = 200_000
+hits = sum(random.random()**2 + random.random()**2 <= 1.0 for _ in range(n))
+print(json.dumps({"n": n, "hits": hits}))
+"""
+
+
+def main() -> int:
+    import json
+
+    from hyperqueue_tpu.api import Client, Job, LocalCluster
+
+    work = Path(tempfile.mkdtemp(prefix="hq-iterate-"))
+    ctx = (
+        LocalCluster(n_workers=1, cpus_per_worker=8)
+        if os.environ.get("HQ_EXAMPLE_LOCAL") == "1"
+        else None
+    )
+    client = ctx.client() if ctx else Client()
+    try:
+        total_n = total_hits = 0
+        prev_estimate = None
+        for round_no in range(20):
+            job = Job(name=f"pi-round-{round_no}")
+            for i in range(8):
+                job.program(
+                    [sys.executable, "-c", SAMPLER],
+                    stdout=str(work / f"r{round_no}-{i}.json"),
+                )
+            client.wait_for_jobs([client.submit(job)])
+            for i in range(8):
+                rec = json.loads((work / f"r{round_no}-{i}.json").read_text())
+                total_n += rec["n"]
+                total_hits += rec["hits"]
+            estimate = 4.0 * total_hits / total_n
+            print(f"round {round_no}: pi ~= {estimate:.5f} "
+                  f"({total_n:,} samples)")
+            if prev_estimate is not None and abs(estimate - prev_estimate) < 1e-3:
+                print(f"converged: {estimate:.5f}")
+                return 0
+            prev_estimate = estimate
+        print("did not converge in 20 rounds")
+        return 1
+    finally:
+        client.close()
+        if ctx:
+            ctx.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
