@@ -227,10 +227,19 @@ def cmd_worker_start(args) -> None:
     time_limit = args.time_limit or 0.0
     if not time_limit and manager_info.remaining_secs:
         time_limit = manager_info.remaining_secs
+    # group defaults to the manager allocation id under PBS/Slurm so gang
+    # members land on one allocation (reference worker.rs:440)
+    group = args.group
+    if group is None:
+        group = (
+            manager_info.job_id
+            if manager_info.manager != "none" and manager_info.job_id
+            else "default"
+        )
     config = WorkerConfiguration(
         descriptor=descriptor,
         hostname=os.uname().nodename,
-        group=args.group,
+        group=group,
         heartbeat_secs=args.heartbeat,
         time_limit_secs=time_limit,
         # None = flag not given -> adopt the server default at registration;
@@ -611,6 +620,53 @@ class _NotifyRunner:
             # post-subscription errors: stream teardown at process exit
 
 
+_KNOWN_PLACEHOLDERS = {"JOB_ID", "TASK_ID", "INSTANCE_ID", "SUBMIT_DIR",
+                       "SERVER_UID", "CWD"}
+# a stream dir is shared by the whole job (the format multiplexes tasks),
+# so only job-scope placeholders resolve there
+_STREAM_PLACEHOLDERS = {"JOB_ID", "SUBMIT_DIR", "SERVER_UID"}
+
+
+def _check_submit_placeholders(args, is_array: bool) -> None:
+    """Submit-time placeholder validation (reference
+    tests/test_placeholders.py): a recursive %{CWD} in --cwd is an error;
+    unknown placeholders and an array job whose output paths lack
+    %{TASK_ID} get loud warnings (the tasks would clobber one file).
+    Warnings go to stderr so --output-mode quiet/json stdout stays
+    machine-parseable."""
+    import re
+
+    pattern = re.compile(r"%\{([^}]*)\}")
+    if args.cwd and "%{CWD}" in args.cwd:
+        fail("--cwd cannot contain the %{CWD} placeholder")
+    for label, value, known in (
+        ("stdout", args.stdout, _KNOWN_PLACEHOLDERS),
+        ("stderr", args.stderr, _KNOWN_PLACEHOLDERS),
+        ("working directory", args.cwd, _KNOWN_PLACEHOLDERS),
+        ("stream log", args.stream, _STREAM_PLACEHOLDERS),
+    ):
+        if not value:
+            continue
+        unknown = sorted(set(pattern.findall(value)) - known)
+        if unknown:
+            plural = "s" if len(unknown) > 1 else ""
+            print(f"WARNING: unknown placeholder{plural} "
+                  f"{', '.join(unknown)} in {label} path", file=sys.stderr)
+    if is_array:
+        for channel in ("stdout", "stderr"):
+            value = getattr(args, channel)
+            if value is None:
+                continue  # the default path carries %{TASK_ID}
+            covered = "%{TASK_ID}" in value or (
+                "%{CWD}" in value and args.cwd and "%{TASK_ID}" in args.cwd
+            )
+            if not covered:
+                print(f"WARNING: array job, but the {channel} path has no "
+                      f"%{{TASK_ID}} placeholder — tasks will overwrite "
+                      f"each other's output. Consider adding %{{TASK_ID}} "
+                      f"to --{channel}.", file=sys.stderr)
+
+
 def cmd_submit(args) -> None:
     if not args.command:
         fail("no command given")
@@ -641,6 +697,11 @@ def cmd_submit(args) -> None:
     entry_values: list[str] | None = None
     if args.array:
         task_ids = parse_selector(args.array)
+    _check_submit_placeholders(
+        args,
+        is_array=args.array is not None or args.each_line is not None
+        or args.from_json is not None,
+    )
     if args.each_line:
         with open(args.each_line) as f:
             entry_values = [line.rstrip("\n") for line in f]
@@ -733,14 +794,16 @@ def cmd_job_list(args) -> None:
     # everything; --filter selects explicit states
     if args.filter:
         wanted = set(args.filter.split(","))
-        unknown = wanted - {"opened", "running", "finished", "failed",
-                            "canceled"}
+        unknown = wanted - {"opened", "waiting", "running", "finished",
+                            "failed", "canceled"}
         if unknown:
             fail(f"unknown job state(s) {sorted(unknown)}; valid: "
-                 "opened, running, finished, failed, canceled")
+                 "opened, waiting, running, finished, failed, canceled")
         jobs = [j for j in jobs if j["status"] in wanted]
     elif not args.all:
-        jobs = [j for j in jobs if j["status"] in ("opened", "running")]
+        # reference hq.rs:95 default: waiting + running + opened
+        jobs = [j for j in jobs if j["status"] in ("opened", "waiting",
+                                                   "running")]
     out = make_output(args.output_mode)
     if args.output_mode == "json":
         out.value(jobs)
@@ -762,6 +825,22 @@ def cmd_job_list(args) -> None:
             for j in sorted(jobs, key=lambda j: j["id"])
         ],
     )
+
+
+def cmd_job_summary(args) -> None:
+    """Per-status job counts (reference cli.rs:514 print_job_summary,
+    JOB_SUMMARY_STATUS_ORDER rows even when a count is zero)."""
+    with _session(args) as session:
+        jobs = session.request({"op": "job_list"})["jobs"]
+    order = ["running", "waiting", "opened", "finished", "failed", "canceled"]
+    counts = {status: 0 for status in order}
+    for j in jobs:
+        counts[j["status"]] = counts.get(j["status"], 0) + 1
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(counts)
+        return
+    out.table(["status", "count"], [[s, counts[s]] for s in counts])
 
 
 def cmd_job_info(args) -> None:
@@ -915,7 +994,7 @@ def cmd_doc(args) -> None:
     topic = args.topic or "index"
     # `hq doc arrays` or `hq doc jobs/arrays` — search every docs subtree
     # (reference: cli/documentation.md, `hq doc` opens a topic index)
-    candidates = [docs_root / f"{topic}.md"]
+    candidates = [docs_root / f"{topic}.md", docs_root / topic / "README.md"]
     if "/" not in topic:
         # bare names search every subtree; explicit paths must match
         # exactly (a typo'd path should error, not print a random page)
@@ -1216,6 +1295,13 @@ def cmd_output_log(args) -> None:
     out = make_output(args.output_mode)
     if args.log_cmd == "summary":
         out.record(log.summary())
+    elif args.log_cmd == "jobs":
+        # reference outputlog.rs:349 — one job id per line
+        if args.output_mode == "json":
+            out.value(log.job_ids())
+        else:
+            for job_id in log.job_ids():
+                print(job_id)
     elif args.log_cmd == "cat":
         from hyperqueue_tpu.ids import task_id_task
 
@@ -1418,7 +1504,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coupling", default=None,
                    help='comma-separated group resources allocated together, '
                         'e.g. "cpus,gpus"')
-    p.add_argument("--group", default="default")
+    p.add_argument("--group", default=None,
+                   help="multi-node gang group; defaults to the manager "
+                        "allocation id under PBS/Slurm, else 'default'")
     p.add_argument("--no-hyper-threading", action="store_true")
     p.add_argument("--heartbeat", type=_parse_duration, default=8.0)
     p.add_argument("--time-limit", type=_parse_duration, default=None)
@@ -1532,7 +1620,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="include finished/failed/canceled jobs")
     p.add_argument("--filter", default=None,
                    help="comma-separated job states to show "
-                        "(opened,running,finished,failed,canceled)")
+                        "(opened,waiting,running,finished,failed,canceled)")
     p.add_argument("--verbose", action="store_true",
                    help="additional columns (cancel reason)")
     p.set_defaults(fn=cmd_job_list)
@@ -1548,6 +1636,9 @@ def build_parser() -> argparse.ArgumentParser:
         _add_common(p)
         p.add_argument("selector")
         p.set_defaults(fn=fn)
+    p = jsub.add_parser("summary", help="job counts per status")
+    _add_common(p)
+    p.set_defaults(fn=cmd_job_summary)
     p = jsub.add_parser("submit", help="alias of top-level `hq submit`")
     _add_submit_args(p)
     p = jsub.add_parser("task-ids", help="print task ids of selected jobs")
@@ -1698,7 +1789,7 @@ def build_parser() -> argparse.ArgumentParser:
     # output-log
     olog = sub.add_parser("output-log", help="read streamed task output")
     osub = olog.add_subparsers(dest="log_cmd", required=True)
-    for name in ("summary", "cat", "show", "export"):
+    for name in ("summary", "jobs", "cat", "show", "export"):
         p = osub.add_parser(name)
         _add_common(p)
         p.add_argument("stream_dir")

@@ -97,6 +97,13 @@ class OutputLog:
     def task_ids(self) -> list[int]:
         return sorted(self.chunks)
 
+    def job_ids(self) -> list[int]:
+        """Job ids present in the stream dir (reference outputlog.rs:349
+        `jobs()` — prints the index's job keys)."""
+        from hyperqueue_tpu.ids import task_id_job
+
+        return sorted({task_id_job(t) for t in self.chunks})
+
     def cat(self, task_id: int, channel: int) -> bytes:
         inst = self._live_instance(task_id)
         if inst is None:

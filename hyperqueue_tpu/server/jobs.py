@@ -83,15 +83,22 @@ class Job:
         return done == self.n_tasks()
 
     def status(self) -> str:
-        if not self.is_terminated():
-            return "opened" if self.is_open and not self.counters["running"] else "running"
-        # failures dominate: a max-fails abort cancels the remainder but the
-        # job's outcome is "failed"
-        if self.counters["failed"]:
+        # reference client/status.rs:18 job_status precedence: running >
+        # waiting > failed > canceled > opened/finished (failures dominate
+        # once nothing is left to run: a max-fails abort cancels the
+        # remainder but the job's outcome is "failed")
+        c = self.counters
+        waiting = (self.n_tasks() - c["finished"] - c["failed"]
+                   - c["canceled"] - c["running"])
+        if c["running"]:
+            return "running"
+        if waiting > 0:
+            return "waiting"
+        if c["failed"]:
             return "failed"
-        if self.counters["canceled"]:
+        if c["canceled"]:
             return "canceled"
-        return "finished"
+        return "opened" if self.is_open else "finished"
 
     def to_info(self) -> dict:
         return {

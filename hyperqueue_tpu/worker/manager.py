@@ -67,9 +67,16 @@ def _slurm_remaining(job_id: str) -> float:
     except (OSError, subprocess.TimeoutExpired):
         return 0.0
     m = re.search(r"TimeLeft=(\S+)", out)
-    if not m or m.group(1) in ("UNLIMITED", "NOT_SET"):
-        return 0.0
-    return _parse_walltime(m.group(1))
+    if m and m.group(1) not in ("UNLIMITED", "NOT_SET"):
+        return _parse_walltime(m.group(1))
+    # older scontrol prints RunTime/TimeLimit instead of TimeLeft
+    # (reference common/manager/slurm.rs parse_slurm_duration)
+    limit = re.search(r"TimeLimit=(\S+)", out)
+    run = re.search(r"RunTime=(\S+)", out)
+    if limit and limit.group(1) not in ("UNLIMITED", "NOT_SET"):
+        used = _parse_walltime(run.group(1)) if run else 0.0
+        return max(_parse_walltime(limit.group(1)) - used, 0.0)
+    return 0.0
 
 
 def detect_manager(mode: str = "auto") -> ManagerInfo:

@@ -273,12 +273,34 @@ class WorkerRuntime:
         instance = task_msg.get("instance", 0)
         try:
             streamer = None
-            stream_dir = (task_msg.get("body") or {}).get("stream")
+            body = task_msg.get("body") or {}
+            stream_dir = body.get("stream")
             if stream_dir:
+                # stream paths carry JOB-scope placeholders (reference
+                # test_placeholders.py stream_submit_placeholder); task-
+                # scope ones are rejected at submit — a stream dir is
+                # shared by the whole job
+                import os as _os
+
+                from hyperqueue_tpu.ids import task_id_job
+                from hyperqueue_tpu.utils.placeholders import (
+                    fill_placeholders,
+                )
+
+                stream_dir = fill_placeholders(stream_dir, {
+                    "JOB_ID": str(task_id_job(task_id)),
+                    "SUBMIT_DIR": body.get("submit_dir") or _os.getcwd(),
+                    "SERVER_UID": self.server_uid,
+                })
                 streamer = self._streamers.get(stream_dir)
                 if streamer is None:
                     from hyperqueue_tpu.events.outputlog import StreamWriter
 
+                    # bound open fds: per-job stream dirs accumulate on a
+                    # long-lived worker; evict the oldest writer
+                    while len(self._streamers) >= 64:
+                        oldest = next(iter(self._streamers))
+                        self._streamers.pop(oldest).close()
                     streamer = StreamWriter(
                         stream_dir, self.worker_id, self.server_uid
                     )

@@ -384,6 +384,28 @@ def test_job_list_default_hides_finished(env):
     assert [j["id"] for j in finished] == [1]
 
 
+def test_job_summary(env):
+    """`hq job summary` prints per-status counts over ALL jobs, including
+    zero rows (reference cli.rs:514 print_job_summary +
+    JOB_SUMMARY_STATUS_ORDER)."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    env.command(["submit", "--wait", "--", "true"])
+    env.command(["submit", "--", "sleep", "30"])
+    summary = json.loads(
+        env.command(["job", "summary", "--output-mode", "json"])
+    )
+    assert summary["finished"] == 2
+    # the sleep job is waiting until the worker picks it up, running after
+    assert summary["running"] + summary["waiting"] == 1
+    assert summary["failed"] == 0
+    assert summary["canceled"] == 0
+    text = env.command(["job", "summary"])
+    assert "finished" in text and "canceled" in text
+
+
 def test_job_list_filter_validates_states(env):
     env.start_server()
     env.command(["job", "list", "--filter", "queued"], expect_fail=True)

@@ -1,0 +1,123 @@
+"""Task-array entry edge cases.
+
+Reference: tests/test_entries.py — --each-line / --from-json feeding
+$HQ_ENTRY, trailing-newline handling, invalid JSON top-level, and the
+--array subsetting matrix (out-of-range ids silently removed).
+"""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _outputs(env, job_id=1):
+    return env.work_dir / f"job-{job_id}"
+
+
+def _started(env):
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+
+
+def test_entries_no_trailing_newline(env):
+    """test_entries.py test_entries_no_newline: the last line without a
+    newline is still an entry."""
+    _started(env)
+    (env.work_dir / "input").write_text("One\nTwo\nThree\nFour")
+    env.command(["submit", "--each-line", "input", "--wait", "--",
+                 "bash", "-c", "echo $HQ_ENTRY"])
+    for i, expected in enumerate(["One", "Two", "Three", "Four"]):
+        out = (_outputs(env) / f"{i}.stdout").read_text()
+        assert out == expected + "\n"
+    assert not (_outputs(env) / "4.stdout").exists()
+
+
+def test_entries_with_trailing_newline(env):
+    """test_entries.py test_entries_with_newline: a trailing newline does
+    NOT create an empty fifth entry."""
+    _started(env)
+    (env.work_dir / "input").write_text("One\nTwo\nThree\nFour\n")
+    env.command(["submit", "--each-line", "input", "--wait", "--",
+                 "bash", "-c", "echo $HQ_ENTRY"])
+    for i, expected in enumerate(["One", "Two", "Three", "Four"]):
+        out = (_outputs(env) / f"{i}.stdout").read_text()
+        assert out == expected + "\n"
+    assert not (_outputs(env) / "4.stdout").exists()
+
+
+def test_entries_from_json_values(env):
+    """test_entries.py test_entries_from_json_entry: each array element is
+    JSON-encoded into $HQ_ENTRY (numbers, nested objects, floats)."""
+    _started(env)
+    (env.work_dir / "input").write_text('[123, {"x":\n[1,2,3]}, 2.5]')
+    env.command(["submit", "--from-json", "input", "--wait", "--",
+                 "bash", "-c", "echo $HQ_ENTRY"])
+    outs = [
+        (_outputs(env) / f"{i}.stdout").read_text().strip() for i in range(3)
+    ]
+    assert json.loads(outs[0]) == 123
+    assert json.loads(outs[1]) == {"x": [1, 2, 3]}
+    assert json.loads(outs[2]) == 2.5
+    assert not (_outputs(env) / "3.stdout").exists()
+
+
+def test_entries_invalid_from_json_top_level(env):
+    """test_entries.py test_entries_invalid_from_json_entry: a non-array
+    top level is rejected at submit time."""
+    _started(env)
+    (env.work_dir / "input").write_text('{"x":\n[1,2,3]}')
+    env.command(["submit", "--from-json", "input", "--",
+                 "bash", "-c", "echo $HQ_ENTRY"], expect_fail=True)
+
+
+def test_each_line_with_array_subset(env):
+    """test_entries.py test_each_line_with_array: --array picks entry
+    INDICES; unselected lines spawn no task."""
+    _started(env)
+    (env.work_dir / "input").write_text(
+        "One\nTwo\nThree\nFour\nFive\nSix\nSeven"
+    )
+    env.command(["submit", "--each-line", "input", "--array", "2-4,6",
+                 "--wait", "--", "bash", "-c", "echo $HQ_ENTRY,$HQ_TASK_ID"])
+    expected = [None, None, "Three,2", "Four,3", "Five,4", None, "Seven,6"]
+    for i, want in enumerate(expected):
+        path = _outputs(env) / f"{i}.stdout"
+        if want is None:
+            assert not path.exists(), i
+        else:
+            assert path.read_text() == want + "\n"
+    info = json.loads(env.command(["job", "info", "1",
+                                   "--output-mode", "json"]))
+    assert info[0]["counters"]["finished"] == 4
+
+
+def test_from_json_with_array_out_of_range(env):
+    """test_entries.py test_json_with_array: --array ids beyond the entry
+    count are silently dropped (id 1000 creates no task)."""
+    _started(env)
+    (env.work_dir / "input").write_text(
+        '["One", "Two", "Three", "Four", "Five", "Six", "Seven"]'
+    )
+    env.command(["submit", "--from-json", "input", "--array", "2-3,5,6,1000",
+                 "--wait", "--", "bash", "-c", "echo $HQ_ENTRY,$HQ_TASK_ID"])
+    expected = [None, None, '"Three",2', '"Four",3', None, '"Six",5',
+                '"Seven",6']
+    for i, want in enumerate(expected):
+        path = _outputs(env) / f"{i}.stdout"
+        if want is None:
+            assert not path.exists(), i
+        else:
+            assert path.read_text() == want + "\n"
+    info = json.loads(env.command(["job", "info", "1",
+                                   "--output-mode", "json"]))
+    assert info[0]["counters"]["finished"] == 4
+    assert info[0]["n_tasks"] == 4

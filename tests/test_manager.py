@@ -1,0 +1,154 @@
+"""Batch-manager detection corners.
+
+Reference: tests/test_manager.py — PBS/Slurm autodetection from env,
+--manager none/pbs/slurm overrides, walltime lookup through mocked
+qstat/scontrol, group defaulting to the manager job id, and hard failure
+when a forced manager is absent from the environment.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+SCONTROL_OUT = """JobId={job_id} JobName=bash
+   JobState=RUNNING Reason=None Dependency=(null)
+   RunTime=00:01:34 TimeLimit=00:15:00 TimeMin=N/A
+   NodeList=login06
+   NumNodes=1 NumCPUs=4 NumTasks=1 CPUs/Task=1
+"""
+
+QSTAT_PY = """\
+import json, sys
+assert "{job_id}" in sys.argv
+print("Resource_List.walltime = 01:12:34")
+print("resources_used.walltime = 00:13:45")
+"""
+
+
+def _mock_manager_bins(bin_dir, job_id):
+    bin_dir.mkdir(parents=True, exist_ok=True)
+    qstat = bin_dir / "qstat"
+    qstat.write_text(
+        "#!/bin/bash\npython3 - \"$@\" <<'EOF'\n"
+        + QSTAT_PY.format(job_id=job_id)
+        + "EOF\n"
+    )
+    scontrol = bin_dir / "scontrol"
+    scontrol.write_text(
+        "#!/bin/bash\ncat <<'EOF'\n"
+        + SCONTROL_OUT.format(job_id=job_id)
+        + "EOF\n"
+    )
+    for path in (qstat, scontrol):
+        path.chmod(0o755)
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+@pytest.fixture
+def manager_path(tmp_path):
+    bin_dir = tmp_path / "bin"
+    _mock_manager_bins(bin_dir, "x1234")
+    old = os.environ["PATH"]
+    os.environ["PATH"] = f"{bin_dir}:{old}"
+    yield bin_dir
+    os.environ["PATH"] = old
+
+
+def _worker_infos(env, n):
+    env.wait_workers(n)
+    workers = json.loads(
+        env.command(["worker", "list", "--output-mode", "json"])
+    )
+    infos = {}
+    for w in workers:
+        infos[w["id"]] = json.loads(
+            env.command(["worker", "info", str(w["id"]),
+                         "--output-mode", "json"])
+        )
+    return infos
+
+
+def test_manager_autodetect(env, manager_path):
+    """test_manager.py test_manager_autodetect: env vars pick the manager;
+    walltime becomes the worker time limit (PBS 1:12:34-0:13:45 = 58m49s;
+    Slurm TimeLimit-RunTime = 13m26s)."""
+    env.start_server()
+    env.start_worker(cpus=1)
+    env.wait_workers(1)  # ids follow connection order — serialize starts
+    os.environ.update({"PBS_ENVIRONMENT": "PBS_BATCH", "PBS_JOBID": "x1234"})
+    try:
+        env.start_worker(cpus=1)
+        env.wait_workers(2)
+    finally:
+        os.environ.pop("PBS_ENVIRONMENT"), os.environ.pop("PBS_JOBID")
+    os.environ["SLURM_JOB_ID"] = "x1234"
+    try:
+        env.start_worker(cpus=1)
+    finally:
+        os.environ.pop("SLURM_JOB_ID")
+    infos = _worker_infos(env, 3)
+    assert infos[1]["manager"] == "none"
+    assert infos[1]["manager_job_id"] == ""
+    assert infos[2]["manager"] == "pbs"
+    assert infos[2]["manager_job_id"] == "x1234"
+    assert infos[2]["time_limit_secs"] == pytest.approx(3529.0)  # 58m49s
+    assert infos[3]["manager"] == "slurm"
+    assert infos[3]["time_limit_secs"] == pytest.approx(806.0)  # 13m26s
+
+
+def test_manager_set_none(env, manager_path):
+    """test_manager.py test_manager_set_none: --manager none ignores the
+    PBS/Slurm environment entirely."""
+    env.start_server()
+    os.environ.update({"PBS_ENVIRONMENT": "PBS_BATCH", "PBS_JOBID": "x1234",
+                       "SLURM_JOB_ID": "y5678"})
+    try:
+        env.start_worker("--manager", "none", cpus=1)
+        infos = _worker_infos(env, 1)
+    finally:
+        for key in ("PBS_ENVIRONMENT", "PBS_JOBID", "SLURM_JOB_ID"):
+            os.environ.pop(key)
+    assert infos[1]["manager"] == "none"
+    assert infos[1]["group"] == "default"
+
+
+def test_manager_group_defaults_to_job_id(env, manager_path):
+    """test_manager.py test_manager_pbs: without --group, the worker's
+    group is the manager job id (gangs land on one allocation)."""
+    env.start_server()
+    os.environ.update({"PBS_ENVIRONMENT": "PBS_BATCH", "PBS_JOBID": "x1234"})
+    try:
+        env.start_worker("--manager", "pbs", cpus=1)
+        infos = _worker_infos(env, 1)
+    finally:
+        os.environ.pop("PBS_ENVIRONMENT"), os.environ.pop("PBS_JOBID")
+    assert infos[1]["manager"] == "pbs"
+    assert infos[1]["group"] == "x1234"
+    # an explicit --group still wins
+    os.environ.update({"PBS_ENVIRONMENT": "PBS_BATCH", "PBS_JOBID": "x1234"})
+    try:
+        env.start_worker("--manager", "pbs", "--group", "mine", cpus=1)
+        infos = _worker_infos(env, 2)
+    finally:
+        os.environ.pop("PBS_ENVIRONMENT"), os.environ.pop("PBS_JOBID")
+    assert infos[2]["group"] == "mine"
+
+
+@pytest.mark.parametrize("manager", ("pbs", "slurm"))
+def test_manager_forced_without_env_fails(env, manager):
+    """test_manager.py test_manager_{pbs,slurm}_no_env: forcing a manager
+    outside its environment is a startup error."""
+    env.start_server()
+    process = env.start_worker("--manager", manager, cpus=1)
+    wait_until(lambda: process.poll() is not None,
+               message="worker exit")
+    assert process.returncode != 0

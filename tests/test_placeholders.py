@@ -1,0 +1,119 @@
+"""Output-path placeholder matrix.
+
+Reference: tests/test_placeholders.py — %{CWD} recursion rejected, task-
+and worker-level placeholder resolution (%{TASK_ID}, %{INSTANCE_ID},
+%{SERVER_UID}, %{CWD}), stream-dir placeholders, array-without-TASK_ID
+warnings, unknown-placeholder warnings.
+"""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _started(env):
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+
+
+def test_cwd_recursive_placeholder_rejected(env):
+    """test_placeholders.py test_cwd_recursive_placeholder: %{CWD} inside
+    --cwd can never resolve."""
+    env.start_server()
+    env.command(["submit", "--cwd", "%{CWD}/foo", "--", "true"],
+                expect_fail=True)
+
+
+def test_task_and_instance_placeholders_resolve(env, tmp_path):
+    """test_placeholders.py test_task_resolve_worker_placeholders:
+    %{INSTANCE_ID} in cwd/stdout/stderr resolves on the worker."""
+    _started(env)
+    env.command(["submit", "--wait",
+                 "--cwd", str(tmp_path / "work" / "%{INSTANCE_ID}-dir"),
+                 "--stdout", "%{CWD}/%{INSTANCE_ID}.out",
+                 "--stderr", "%{CWD}/%{INSTANCE_ID}.err",
+                 "--", "bash", "-c", "echo out; echo err >&2"])
+    base = tmp_path / "work" / "0-dir"
+    assert (base / "0.out").read_text() == "out\n"
+    assert (base / "0.err").read_text() == "err\n"
+
+
+def test_server_uid_placeholder(env):
+    """test_placeholders.py test_server_uid_placeholder: %{SERVER_UID}
+    resolves in output paths."""
+    _started(env)
+    info = json.loads(
+        env.command(["server", "info", "--output-mode", "json"])
+    )
+    uid = info["server_uid"]
+    env.command(["submit", "--wait",
+                 "--stdout", "out-%{SERVER_UID}-%{JOB_ID}",
+                 "--", "bash", "-c", "echo Hello"])
+    assert (env.work_dir / f"out-{uid}-1").read_text() == "Hello\n"
+
+
+def test_stream_dir_placeholder(env, tmp_path):
+    """test_placeholders.py test_stream_submit_placeholder: %{JOB_ID} in a
+    --stream dir resolves per job."""
+    _started(env)
+    stream = tmp_path / "log-%{JOB_ID}"
+    env.command(["submit", "--stream", str(stream), "--wait",
+                 "--", "bash", "-c", "echo Hello"])
+    out = env.command(["output-log", "cat", str(tmp_path / "log-1"),
+                       "stdout"])
+    assert out == "Hello\n"
+
+
+@pytest.mark.parametrize("channel", ("stdout", "stderr"))
+def test_array_without_task_id_placeholder_warns(env, channel):
+    """test_placeholders.py test_warning_missing_placeholder_in_output: an
+    array whose output path lacks %{TASK_ID} would clobber one file."""
+    env.start_server()
+    out = env.command(["submit", "--array", "1-4", f"--{channel}", "foo",
+                       "--", "true"], with_stderr=True)
+    assert "%{TASK_ID}" in out and "WARNING" in out
+    # warnings stay off stdout so quiet/json output is machine-parseable
+    quiet = env.command(["submit", "--array", "1-4", f"--{channel}", "foo",
+                         "--output-mode", "quiet", "--", "true"])
+    assert "WARNING" not in quiet
+
+
+@pytest.mark.parametrize("channel", ("stdout", "stderr"))
+def test_task_id_via_cwd_suppresses_warning(env, channel):
+    """test_placeholders.py test_missing_placeholder_in_output_present_in_cwd:
+    %{CWD} + a TASK_ID-bearing cwd covers per-task uniqueness."""
+    env.start_server()
+    out = env.command(["submit", "--array", "1-4",
+                       "--cwd", "task-%{TASK_ID}",
+                       f"--{channel}", "%{CWD}/foo", "--", "true"],
+                      with_stderr=True)
+    assert "WARNING" not in out
+
+
+def test_unknown_placeholder_warnings(env):
+    """test_placeholders.py test_unknown_placeholder: every path names its
+    unknown placeholders."""
+    env.start_server()
+    out = env.command(["submit",
+                       "--stream", "log-%{FOO}",
+                       "--stdout", "dir/%{BAR}/%{BAZ}",
+                       "--stderr", "dir/%{TAS_ID}",
+                       "--cwd", "%{BAR}",
+                       "--", "true"], with_stderr=True)
+    assert "FOO" in out and "stream log" in out
+    assert "BAR, BAZ" in out and "stdout" in out
+    assert "TAS_ID" in out and "stderr" in out
+    assert "working directory" in out
+    # task-scope placeholders can't resolve in a job-shared stream dir
+    out = env.command(["submit", "--stream", "log-%{TASK_ID}", "--",
+                       "true"], with_stderr=True)
+    assert "TASK_ID" in out and "stream log" in out

@@ -44,6 +44,28 @@ def test_stream_roundtrip(env, tmp_path):
     assert {r["channel"] for r in records} == {"stdout", "stderr"}
 
 
+def test_output_log_jobs(env, tmp_path):
+    """`hq output-log jobs` lists job ids present in a stream dir
+    (reference outputlog.rs:349 jobs())."""
+    stream_dir = tmp_path / "stream"
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    for _ in range(2):
+        env.command(
+            ["submit", "--stream", str(stream_dir), "--wait",
+             "--", "bash", "-c", "echo hi"]
+        )
+    jobs = env.command(["output-log", "jobs", str(stream_dir)])
+    assert jobs.split() == ["1", "2"]
+    jobs_json = json.loads(
+        env.command(
+            ["output-log", "jobs", str(stream_dir), "--output-mode", "json"]
+        )
+    )
+    assert jobs_json == [1, 2]
+
+
 def test_python_api_program_and_function(tmp_path, monkeypatch):
     import os
     import sys

@@ -98,7 +98,8 @@ class HqEnv:
         return self._spawn(f"worker{n}", args)
 
     def command(
-        self, args: list[str], cwd=None, expect_fail=False, timeout=60.0
+        self, args: list[str], cwd=None, expect_fail=False, timeout=60.0,
+        with_stderr=False,
     ) -> str:
         result = subprocess.run(
             [sys.executable, "-m", "hyperqueue_tpu", *args],
@@ -116,6 +117,8 @@ class HqEnv:
             assert result.returncode == 0, (
                 f"command {args} failed:\n{result.stdout}\n{result.stderr}"
             )
+        if with_stderr:
+            return result.stdout + result.stderr
         return result.stdout
 
     def read_log(self, name: str) -> str:
