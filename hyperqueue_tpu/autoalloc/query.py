@@ -21,7 +21,10 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from hyperqueue_tpu.ops.assign import INF_TIME
-from hyperqueue_tpu.resources.worker_resources import WorkerResources
+from hyperqueue_tpu.resources.worker_resources import (
+    TASK_MAX_COUNT_CAP,
+    WorkerResources,
+)
 from hyperqueue_tpu.scheduler.tick import (
     WorkerRow,
     assemble_solve_inputs,
@@ -36,8 +39,12 @@ from hyperqueue_tpu.scheduler.tick import (
 # single-task request, never above the compression threshold.
 PARTIAL_MAX_FRACTIONS = 2**23 - 1
 # Concurrency bound for a padded fake worker (WorkerResources would derive
-# it from real pool sizes, which padding distorts).
-PARTIAL_TASK_CAP = 512
+# it from real pool sizes, which padding distorts).  Equal to the bound
+# every REAL worker gets (worker_resources.TASK_MAX_COUNT_CAP), so a
+# partial fake worker is never more constrained than the worker the
+# allocation would actually spawn; demand beyond it spills into the next
+# fake worker of the same query (max_sn_workers permitting).
+PARTIAL_TASK_CAP = TASK_MAX_COUNT_CAP
 
 
 @dataclass
@@ -75,7 +82,11 @@ class WorkerQueryResponse:
     multi_node_allocations: list[MultiNodeAllocation]
 
 
-def _fake_rows(queries: list[WorkerTypeQuery], n_r: int) -> list[WorkerRow]:
+def _fake_rows(
+    queries: list[WorkerTypeQuery],
+    n_r: int,
+    pad_floor: list[int] | None = None,
+) -> list[WorkerRow]:
     rows: list[WorkerRow] = []
     fake_id = 0
     for query in queries:
@@ -84,7 +95,15 @@ def _fake_rows(queries: list[WorkerTypeQuery], n_r: int) -> list[WorkerRow]:
         if query.partial:
             for rid in range(n_r):
                 if rid not in query.declared_ids:
-                    amounts[rid] = PARTIAL_MAX_FRACTIONS
+                    # a task requesting MORE than the stand-in "unlimited"
+                    # pad must still register demand (reference uses
+                    # ResourceAmount::MAX): raise the pad to the peak
+                    # pending need and let _range_compress shift that
+                    # column (sound: needs ceil, free floor)
+                    amounts[rid] = max(
+                        PARTIAL_MAX_FRACTIONS,
+                        pad_floor[rid] if pad_floor else 0,
+                    )
             nt = PARTIAL_TASK_CAP
         else:
             nt = query.resources.task_max_count()
@@ -120,10 +139,16 @@ def compute_new_worker_query(
     # mu-host would in fact have taken).
     real_rows = [r for r in core.worker_rows() if r.cpu_floor <= 0]
     first_fake = len(real_rows)
-    rows = real_rows + _fake_rows(queries, n_r)
+    batches = create_batches(core.queues)
+    pad_floor = [0] * n_r
+    for batch in batches:
+        for variant in core.rq_map.get_variants(batch.rq_id).variants:
+            for entry in variant.entries:
+                if entry.amount > pad_floor[entry.resource_id]:
+                    pad_floor[entry.resource_id] = entry.amount
+    rows = real_rows + _fake_rows(queries, n_r, pad_floor)
 
     sn_counts = np.zeros(max(sum(q.max_sn_workers for q in queries), 1))
-    batches = create_batches(core.queues)
     if batches and len(rows) > first_fake:
         # the EXACT production assembly (dense rows, scarcity batch order,
         # range compression for float32-exactness, weights) — the fake

@@ -263,8 +263,11 @@ class AutoAllocService:
         )
         return response.single_node_workers_per_query[0]
 
-    def _mn_demand(self, queue) -> list[int]:
-        """n_nodes of each pending multi-node task this queue should cover.
+    def _mn_demand_joint(self, queues) -> dict[int, list[int]]:
+        """n_nodes of each pending multi-node task, assigned to the FIRST
+        eligible queue (first-query-wins dedup, reference query.rs:97-125):
+        two queues that could both host a pending gang must not each
+        provision an allocation for it.
 
         Reference process.rs:500 (compute_submission_permit) counts mn
         allocations separately from sn workers: a pending gang that no
@@ -273,32 +276,41 @@ class AutoAllocService:
         from hyperqueue_tpu.server.reactor import _mn_member_eligible
 
         core = self.server.core
-        wpa = max(queue.params.workers_per_alloc, 1)
-        queue_worker = WorkerResources.from_descriptor(
-            self._queue_worker_descriptor(queue), core.resource_map
-        )
-        out: list[int] = []
+        out: dict[int, list[int]] = {q.queue_id: [] for q in queues}
+        shapes = {
+            q.queue_id: (
+                max(q.params.workers_per_alloc, 1),
+                WorkerResources.from_descriptor(
+                    self._queue_worker_descriptor(q), core.resource_map
+                ),
+            )
+            for q in queues
+        }
         for task_id in core.mn_queue:
             task = core.tasks.get(task_id)
             if task is None or task.is_done:
                 continue
             req = core.rq_map.get_variants(task.rq_id).variants[0]
-            if req.n_nodes > wpa:
-                continue  # one allocation of this queue can never host it
-            if req.min_time_secs > queue.params.time_limit_secs:
-                continue
-            if any(
-                queue_worker.amount(e.resource_id) < e.amount
-                for e in req.entries
-            ):
-                continue  # this queue's workers could never be members
             groups: dict[str, int] = {}
             for w in core.workers.values():
                 if w.mn_task or not _mn_member_eligible(w, req):
                     continue
                 groups[w.group] = groups.get(w.group, 0) + 1
-            if not any(n >= req.n_nodes for n in groups.values()):
-                out.append(req.n_nodes)
+            if any(n >= req.n_nodes for n in groups.values()):
+                continue  # an existing worker group can already host it
+            for queue in queues:
+                wpa, queue_worker = shapes[queue.queue_id]
+                if req.n_nodes > wpa:
+                    continue  # one allocation of this queue can't host it
+                if req.min_time_secs > queue.params.time_limit_secs:
+                    continue
+                if any(
+                    queue_worker.amount(e.resource_id) < e.amount
+                    for e in req.entries
+                ):
+                    continue  # this queue's workers can't be members
+                out[queue.queue_id].append(req.n_nodes)
+                break
         return out
 
     async def perform_submits(self) -> None:
@@ -316,11 +328,12 @@ class AutoAllocService:
             self.server.model,
             [self._build_query(q) for q in eligible],
         )
+        mn_by_queue = self._mn_demand_joint(eligible)
         for queue, sn_workers in zip(
             eligible, response.single_node_workers_per_query
         ):
             wpa = max(queue.params.workers_per_alloc, 1)
-            mn_nodes = self._mn_demand(queue)
+            mn_nodes = mn_by_queue[queue.queue_id]
             # queued allocations first satisfy mn demand (a whole alloc per
             # gang), their remaining workers count against sn demand
             # (reference process.rs:500 step 1)
@@ -374,7 +387,8 @@ class AutoAllocService:
         )
         self.server.emit_event(
             "alloc-queued",
-            {"queue_id": queue.queue_id, "alloc": allocation_id},
+            {"queue_id": queue.queue_id, "alloc": allocation_id,
+             "worker_count": queue.params.workers_per_alloc},
         )
 
     # ------------------------------------------------------------------

@@ -24,6 +24,7 @@ class WorkerState:
     worker_id: int
     hostname: str = ""
     group: str = "default"
+    resources: dict = field(default_factory=dict)  # name -> units
     connected_at: float = 0.0
     lost_at: float = 0.0
     lost_reason: str = ""
@@ -89,6 +90,7 @@ class AllocationView:
     queued_at: float = 0.0
     started_at: float = 0.0
     ended_at: float = 0.0
+    worker_count: int = 1
 
 
 @dataclass
@@ -134,6 +136,7 @@ class DashboardData:
                 worker_id=wid,
                 hostname=record.get("hostname", ""),
                 group=record.get("group", "default"),
+                resources=record.get("resources") or {},
                 connected_at=t,
             )
             self._mark_worker_count(t)
@@ -223,7 +226,8 @@ class DashboardData:
             )
             aid = record.get("alloc", "")
             q.allocations[aid] = AllocationView(
-                allocation_id=aid, queued_at=t
+                allocation_id=aid, queued_at=t,
+                worker_count=int(record.get("worker_count", 1)),
             )
         elif kind in ("alloc-started", "alloc-finished", "alloc-failed"):
             q = self.queues.get(record.get("queue_id", 0))
@@ -287,6 +291,12 @@ def seed_from_server(data: DashboardData, session) -> None:
             worker_id=w["id"],
             hostname=w.get("hostname", ""),
             group=w.get("group", "default"),
+            # worker_list carries raw fraction amounts; the
+            # worker-connected event carries whole units — normalize so
+            # config grouping agrees across both paths
+            resources={
+                k: v / 10_000 for k, v in (w.get("resources") or {}).items()
+            },
             connected_at=now,
         )
         overview = w.get("overview") or {}

@@ -1,11 +1,15 @@
 """`hq journal report` — static HTML analytics from a journal file.
 
 Reference: crates/hyperqueue/src/client/commands/journal/report.rs — traces
-of running tasks and connected workers over time, per-job task-duration
-statistics, per-worker utilization, resource summaries, and a time window
-(--start-time/--end-time offsets) — rendered as one self-contained HTML
-page. Charts are inline SVG (no external assets; this environment has zero
-egress and the reference's page is likewise self-contained).
+of running tasks and connected workers over time (global and per resource
+config, report.rs running_workers/ResCount), per-request-class duration
+box plots and finished/failed counts with a T1..Tn legend
+(durationsChart/countsChart), queue-wait distributions per class, per-job
+task-duration statistics, per-worker utilization, failure breakdowns,
+allocation-queue economics, and a time window (--start-time/--end-time
+offsets) — rendered as one self-contained HTML page. Charts are inline
+SVG (the reference uses a plotly CDN; this environment has zero egress so
+the page must carry its own pixels).
 
 State reduction reuses the dashboard's event-sourced reducer
 (client/dashboard_data.py) so the report and the TUI agree on semantics.
@@ -55,6 +59,26 @@ def _svg_line(series: list[tuple[float, float]], width=640, height=120,
     )
 
 
+def _request_sig(request: dict | None) -> str:
+    """Human request-class key (reference report.rs resource_rq_to_string:
+    durations/counts are grouped per distinct ResourceRequest T1..Tn)."""
+    parts = []
+    for v in (request or {}).get("variants") or [{}]:
+        if v.get("n_nodes"):
+            parts.append(f"nodes: {v['n_nodes']}")
+            continue
+        entries = v.get("entries") or []
+        if not entries:
+            parts.append("cpus: 1")
+            continue
+        parts.append(", ".join(
+            f"{e['name']}: all" if e.get("policy") == "all"
+            else f"{e['name']}: {int(e['amount']) / 10_000:g}"
+            for e in entries
+        ))
+    return " | ".join(parts)
+
+
 def _collect(journal_path: Path, start_time: float | None,
              end_time: float | None):
     """Reduce the journal into DashboardData + report-only traces.
@@ -66,6 +90,23 @@ def _collect(journal_path: Path, start_time: float | None,
     per_minute: Counter = Counter()
     running = 0
     first_ts = None
+    # request-class machinery (reference report.rs JournalStats.durations):
+    # job -> shared request sig, (job, task) -> per-task sig override
+    job_sig: dict[int, str] = {}
+    task_sig: dict[tuple[int, int], str] = {}
+    classes: dict[str, dict] = {}  # sig -> {finished: [], failed: [], waits: []}
+    task_started_at: dict[tuple[int, int], float] = {}
+    job_submitted_at: dict[int, float] = {}
+    # open jobs accrete tasks over multiple submits: waits are measured
+    # from the task's OWN submit event, not the job's first
+    task_submitted_at: dict[tuple[int, int], float] = {}
+
+    def class_of(job_id: int, task_id: int) -> dict:
+        sig = task_sig.get((job_id, task_id)) or job_sig.get(job_id, "cpus: 1")
+        cls = classes.get(sig)
+        if cls is None:
+            cls = classes[sig] = {"finished": [], "failed": [], "waits": []}
+        return cls
 
     for rec in Journal.read_all(journal_path):
         ts = float(rec.get("time", 0.0))
@@ -78,22 +119,102 @@ def _collect(journal_path: Path, start_time: float | None,
             continue
         data.add_event(rec)
         kind = rec.get("event", "")
-        if kind == "task-started":
+        if kind == "job-submitted":
+            job_id = rec.get("job", 0)
+            job_submitted_at.setdefault(job_id, ts)
+            desc = rec.get("desc") or {}
+            array = desc.get("array")
+            if array is not None:
+                job_sig[job_id] = _request_sig(array.get("request"))
+                for tid in array.get("ids") or ():
+                    task_submitted_at[(job_id, tid)] = ts
+            for t in desc.get("tasks") or ():
+                tid = t.get("id", 0)
+                task_sig[(job_id, tid)] = _request_sig(t.get("request"))
+                task_submitted_at[(job_id, tid)] = ts
+        elif kind == "task-started":
             running += 1
             running_trace.append((ts, float(running)))
+            key = (rec.get("job", 0), rec.get("task", 0))
+            task_started_at[key] = ts
+            submitted = task_submitted_at.get(
+                key, job_submitted_at.get(key[0])
+            )
+            if submitted is not None:
+                class_of(*key)["waits"].append(ts - submitted)
         elif kind in ("task-finished", "task-failed", "task-canceled",
                       "task-restarted"):
-            if running > 0:
+            key = (rec.get("job", 0), rec.get("task", 0))
+            started = task_started_at.pop(key, None)
+            # only tasks that actually STARTED decrement the running trace
+            # (canceling a waiting task must not push the chart below the
+            # true running count)
+            if started is not None and running > 0:
                 running -= 1
                 running_trace.append((ts, float(running)))
             if kind == "task-finished":
                 per_minute[int(ts // 60)] += 1
-    return data, running_trace, per_minute
+                if started is not None:
+                    class_of(*key)["finished"].append(ts - started)
+            elif kind == "task-failed" and started is not None:
+                class_of(*key)["failed"].append(ts - started)
+    return data, running_trace, per_minute, classes
+
+
+def _percentile(values: list[float], p: int) -> str:
+    if not values:
+        return "-"
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(round(p / 100 * (len(vs) - 1))))
+    return f"{vs[idx]:.2f}s"
+
+
+def _quartiles(values: list[float]) -> tuple[float, float, float, float, float]:
+    vs = sorted(values)
+    q = statistics.quantiles(vs, n=4) if len(vs) >= 2 else [vs[0]] * 3
+    return (vs[0], q[0], q[1], q[2], vs[-1])
+
+
+def _svg_boxes(groups: list[tuple[str, list[float]]], width=640) -> str:
+    """Horizontal box plots (min, q1, median, q3, max) — the reference's
+    plotly box traces (report.rs durationsChart) rendered as inline SVG."""
+    groups = [(label, vs) for label, vs in groups if vs]
+    if not groups:
+        return "<p>(no data)</p>"
+    vmax = max(max(vs) for _, vs in groups) or 1.0
+    row_h, pad_l = 34, 110
+    height = row_h * len(groups) + 24
+    scale = (width - pad_l - 16) / vmax
+    out = [
+        f'<svg viewBox="0 0 {width} {height}" width="{width}" '
+        f'height="{height}" style="background:#f8f8f8;border:1px solid #ddd">'
+    ]
+    for i, (label, vs) in enumerate(groups):
+        lo, q1, med, q3, hi = _quartiles(vs)
+        y = i * row_h + 20
+        x = lambda v: pad_l + v * scale  # noqa: E731
+        out.append(
+            f'<text x="4" y="{y + 4}" font-size="11">'
+            f'{html.escape(label)} (n={len(vs)})</text>'
+            f'<line x1="{x(lo):.1f}" y1="{y}" x2="{x(hi):.1f}" y2="{y}" '
+            f'stroke="#888"/>'
+            f'<rect x="{x(q1):.1f}" y="{y - 8}" '
+            f'width="{max(x(q3) - x(q1), 1):.1f}" height="16" '
+            f'fill="#9cf" stroke="#36c"/>'
+            f'<line x1="{x(med):.1f}" y1="{y - 8}" x2="{x(med):.1f}" '
+            f'y2="{y + 8}" stroke="#036" stroke-width="2"/>'
+        )
+    out.append(
+        f'<text x="{pad_l}" y="{height - 6}" font-size="10">0s</text>'
+        f'<text x="{width - 60}" y="{height - 6}" font-size="10">'
+        f'{vmax:.2f}s</text></svg>'
+    )
+    return "".join(out)
 
 
 def build_report(journal_path: str | Path, start_time: float | None = None,
                  end_time: float | None = None) -> str:
-    data, running_trace, per_minute = _collect(
+    data, running_trace, per_minute, classes = _collect(
         Path(journal_path), start_time, end_time
     )
     lo, hi = data.time_span()
@@ -179,14 +300,41 @@ def build_report(journal_path: str | Path, start_time: float | None = None,
         else "<p>none</p>"
     )
 
-    # ---- allocation queues --------------------------------------------
+    # ---- allocation-queue economics (reference report.rs tracks the
+    # queued→running worker traces; here per queue: counts, manager-queue
+    # latency, lifetime, and worker-seconds actually provisioned) ----------
     alloc_rows = []
     for qid, q in sorted(data.queues.items()):
         by_status = Counter(a.status for a in q.allocations.values())
+        latencies = [
+            a.started_at - a.queued_at
+            for a in q.allocations.values()
+            if a.started_at and a.queued_at
+        ]
+        lifetimes = [
+            a.ended_at - a.started_at
+            for a in q.allocations.values()
+            if a.started_at and a.ended_at
+        ]
+        provisioned = sum(
+            (a.ended_at - a.started_at) * a.worker_count
+            for a in q.allocations.values()
+            if a.started_at and a.ended_at
+        )
+        mean_latency = (
+            f"{statistics.mean(latencies):.1f}s" if latencies else "-"
+        )
+        mean_lifetime = (
+            f"{statistics.mean(lifetimes):.1f}s" if lifetimes else "-"
+        )
+        statuses = " ".join(
+            f"{k}={v}" for k, v in sorted(by_status.items())
+        ) or "-"
         alloc_rows.append(
             f"<tr><td>{qid}</td><td>{html.escape(q.manager)}</td>"
-            f"<td>{q.state}</td>"
-            f"<td>{' '.join(f'{k}={v}' for k, v in sorted(by_status.items())) or '-'}</td></tr>"
+            f"<td>{q.state}</td><td>{statuses}</td>"
+            f"<td>{mean_latency}</td><td>{mean_lifetime}</td>"
+            f"<td>{provisioned:.0f}s</td></tr>"
         )
 
     # ---- charts --------------------------------------------------------
@@ -197,6 +345,52 @@ def build_report(journal_path: str | Path, start_time: float | None = None,
     throughput_chart = _svg_line(
         [(m * 60.0, float(per_minute[m])) for m in sorted(per_minute)],
         color="#a44",
+    )
+
+    # running workers grouped by resource config (reference report.rs
+    # running_workers traces keyed on ResCount)
+    def config_key(w) -> str:
+        return ", ".join(
+            f"{name}: {units:g}" for name, units in sorted(w.resources.items())
+        ) or "(no resources)"
+
+    config_events: dict[str, list[tuple[float, int]]] = {}
+    for w in data.workers.values():
+        key = config_key(w)
+        config_events.setdefault(key, []).append((w.connected_at, +1))
+        if w.lost_at:
+            config_events[key].append((w.lost_at, -1))
+    config_sections = []
+    for key in sorted(config_events):
+        series, n = [], 0
+        for t, delta in sorted(config_events[key]):
+            n += delta
+            series.append((t, float(n)))
+        config_sections.append(
+            f"<h3>workers [{html.escape(key)}]</h3>"
+            + _svg_line(series, height=80, color="#383")
+        )
+
+    # per-request-class duration boxes + counts + queue waits (reference
+    # report.rs durationsChart/countsChart T1..Tn legend)
+    class_names = {sig: f"T{i + 1}" for i, sig in enumerate(sorted(classes))}
+    duration_boxes = _svg_boxes(
+        [(f"{class_names[sig]} finished", cls["finished"])
+         for sig, cls in sorted(classes.items())]
+        + [(f"{class_names[sig]} failed", cls["failed"])
+           for sig, cls in sorted(classes.items())]
+    )
+    wait_boxes = _svg_boxes(
+        [(class_names[sig], cls["waits"])
+         for sig, cls in sorted(classes.items())]
+    )
+    class_count_rows = "".join(
+        f"<tr><td>{class_names[sig]}</td><td>{html.escape(sig)}</td>"
+        f"<td>{len(cls['finished'])}</td><td>{len(cls['failed'])}</td>"
+        f"<td>{_percentile(cls['waits'], 50)}</td>"
+        f"<td>{_percentile(cls['waits'], 90)}</td>"
+        f"<td>{_percentile(cls['waits'], 99)}</td></tr>"
+        for sig, cls in sorted(classes.items())
     )
 
     task_totals = Counter()
@@ -223,8 +417,15 @@ h2 {{ margin-top: 2rem; }}
 <p>{len(data.jobs)} job(s), {len(data.workers)} worker(s), tasks: {totals}
 over {span:.0f}s{window} &mdash; {html.escape(str(journal_path))}</p>
 <h2>Connected workers over time</h2>{worker_chart}
+<h2>Running workers by resource config</h2>{"".join(config_sections) or "<p>(no data)</p>"}
 <h2>Running tasks over time</h2>{running_chart}
 <h2>Throughput (finished tasks per minute)</h2>{throughput_chart}
+<h2>Task classes</h2>
+<table><tr><th>class</th><th>request</th><th>finished</th><th>failed</th>
+<th>wait p50</th><th>wait p90</th><th>wait p99</th></tr>
+{class_count_rows or "<tr><td colspan=7>none</td></tr>"}</table>
+<h2>Task durations per class</h2>{duration_boxes}
+<h2>Queue wait per class (submit &rarr; start)</h2>{wait_boxes}
 <h2>Jobs</h2>
 <table><tr><th>id</th><th>name</th><th>tasks</th><th>status</th>
 <th>finished</th><th>failed</th><th>canceled</th><th>submitted</th>
@@ -236,6 +437,7 @@ over {span:.0f}s{window} &mdash; {html.escape(str(journal_path))}</p>
 {"".join(worker_rows)}</table>
 <h2>Failed tasks</h2>{failures}
 <h2>Allocation queues</h2>
-<table><tr><th>queue</th><th>manager</th><th>state</th><th>allocations</th></tr>
-{"".join(alloc_rows) or "<tr><td colspan=4>none</td></tr>"}</table>
+<table><tr><th>queue</th><th>manager</th><th>state</th><th>allocations</th>
+<th>mean queue latency</th><th>mean lifetime</th><th>worker-seconds</th></tr>
+{"".join(alloc_rows) or "<tr><td colspan=7>none</td></tr>"}</table>
 </body></html>"""

@@ -22,6 +22,10 @@ from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
 from hyperqueue_tpu.scheduler.queues import Priority, TaskQueues
 
 MAX_CUTS_PER_QUEUE = 32
+# Node budget for the per-worker min-utilization branch-and-bound
+# (_solve_mu_workers): past this the best fill FOUND still ships (the
+# first dive is a greedy max-take seed) and a warning names the worker.
+MU_DFS_NODE_BUDGET = 50_000
 # Values above this get range-compressed before entering the kernel — the
 # kernel requires amounts to be float32-exact (ops/assign.MAX_KERNEL_AMOUNT).
 MAX_SAFE_AMOUNT = 2**23
@@ -489,9 +493,11 @@ def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):
     solver.rs:520-549) subject to the worker's resources and the cpu floor.
 
     Candidates are capped at the 32 best (priority, value) classes and the
-    search at ~50k nodes — beyond that the worker just stays idle this tick
-    and retries next tick (mu workers are rare; exactness on small instances
-    matters more than scale here).
+    search at MU_DFS_NODE_BUDGET nodes — past the budget the best fill
+    found so far ships (usually the greedy first dive; possibly empty, in
+    which case the worker stays idle this tick, a warning names it, and it
+    retries next tick). mu workers are rare; exactness on small instances
+    matters more than scale here.
     """
     from hyperqueue_tpu.resources.request import AllocationPolicy
 
@@ -597,7 +603,7 @@ def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):
         def dfs(i, free, nt, cpu_used, score, take):
             nonlocal best_score, best_take, nodes
             nodes += 1
-            if nodes > 50_000:
+            if nodes > MU_DFS_NODE_BUDGET:
                 return
             # prune: even everything remaining cannot beat the best
             if best_score is not None:
@@ -648,7 +654,7 @@ def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):
         group_left = dict(group_left0)
         dfs(0, free0, nt0, 0, [0.0] * len(levels), [])
 
-        if nodes > 50_000:
+        if nodes > MU_DFS_NODE_BUDGET:
             # budget exhausted: the best solution FOUND so far still ships
             # (the first dive is a greedy max-take seed, so one is almost
             # always in hand); log so an idle mu worker is explainable
@@ -657,7 +663,7 @@ def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):
             logging.getLogger(__name__).warning(
                 "min-utilization solve for worker %d hit the %d-node "
                 "budget; shipping the best fill found (%s)",
-                row.worker_id, 50_000,
+                row.worker_id, MU_DFS_NODE_BUDGET,
                 "non-empty" if best_take and any(best_take) else "empty",
             )
         if not best_take or not any(best_take):

@@ -181,10 +181,18 @@ class EventBridge:
         self.server.check_job_completion(task_id_job(task_id))
 
     def on_worker_new(self, worker):
+        # resources ride along so report/dashboard can group workers by
+        # config (reference report.rs running_workers keyed on ResCount)
+        names = self.server.core.resource_map.names()
+        resources = {
+            names[rid]: amount / 10_000
+            for rid, amount in enumerate(worker.resources.amounts)
+            if amount > 0 and rid < len(names)
+        }
         self.server.emit_event(
             "worker-connected",
             {"id": worker.worker_id, "hostname": worker.configuration.hostname,
-             "group": worker.group},
+             "group": worker.group, "resources": resources},
         )
 
     def on_worker_lost(self, worker_id, reason):
@@ -330,10 +338,10 @@ class Server:
 
         self.autoalloc = AutoAllocService(self, instance_dir / "autoalloc")
         self.autoalloc.start()
-        self._tasks.append(self._spawn_loop(self._scheduler_loop()))
-        self._tasks.append(self._spawn_loop(self._heartbeat_reaper()))
+        self._tasks.append(self._spawn_loop(self._scheduler_loop))
+        self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
         if self.journal is not None and self.journal_flush_period > 0:
-            self._tasks.append(self._spawn_loop(self._journal_flush_loop()))
+            self._tasks.append(self._spawn_loop(self._journal_flush_loop))
         logger.info(
             "server started uid=%s client=%s:%d worker=%s:%d",
             self.access.server_uid,
@@ -409,22 +417,53 @@ class Server:
             for event in self._job_waiters.pop(job_id, []):
                 event.set()
 
-    def _spawn_loop(self, coro) -> "asyncio.Task":
+    # consecutive-crash budget per background loop before the server gives
+    # up and stops (so clients fail fast instead of submitting into a
+    # server that never schedules); a loop that then stays healthy for
+    # LOOP_HEALTHY_SECS earns its budget back
+    LOOP_CRASH_RESTARTS = 3
+    LOOP_HEALTHY_SECS = 60.0
+
+    def _spawn_loop(self, factory, _restarts: int = 0) -> "asyncio.Task":
         """Background loops must never die silently: an unhandled exception
         in an asyncio task is held unreported while the server keeps a
         reference — the server would turn into a zombie that accepts
-        submits but never schedules. Log the crash loudly instead."""
-        task = asyncio.create_task(coro)
+        submits but never schedules. Log the crash loudly, restart the loop
+        up to LOOP_CRASH_RESTARTS consecutive times, then stop the
+        server."""
+        started = time.time()
+        task = asyncio.create_task(factory())
+        name = getattr(factory, "__name__", repr(factory))
 
         def _report(t: "asyncio.Task") -> None:
             if t.cancelled():
                 return
             exc = t.exception()
-            if exc is not None:
+            if exc is None:
+                return
+            logger.critical(
+                "server background loop %s crashed", name, exc_info=exc,
+            )
+            if self._stop_event.is_set():
+                # shutting down: a respawn would run against resources
+                # shutdown() is already closing
+                return
+            restarts = (
+                0 if time.time() - started >= self.LOOP_HEALTHY_SECS
+                else _restarts
+            )
+            if restarts < self.LOOP_CRASH_RESTARTS:
                 logger.critical(
-                    "server background loop %s crashed", t.get_coro(),
-                    exc_info=exc,
+                    "restarting %s (attempt %d/%d)",
+                    name, restarts + 1, self.LOOP_CRASH_RESTARTS,
                 )
+                self._tasks.append(self._spawn_loop(factory, restarts + 1))
+            else:
+                logger.critical(
+                    "%s exceeded its restart budget; stopping the server",
+                    name,
+                )
+                self.stop()
 
         task.add_done_callback(_report)
         return task

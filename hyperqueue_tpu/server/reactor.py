@@ -366,6 +366,28 @@ def _mn_member_eligible(worker: Worker, req) -> bool:
     return True
 
 
+def _rqv_fit_count(resources, rqv) -> int:
+    """How many tasks of this request class the worker could run AT ONCE
+    on empty resources — the best variant's min over entries of
+    pool // amount. ALL-policy entries (amount 0) take a whole pool:
+    count 1. Used to bound displacement retraction to what a worker
+    could plausibly absorb from the displacing batch."""
+    best = 0
+    for req in rqv.variants:
+        fit: int | None = None
+        for entry in req.entries:
+            if entry.amount <= 0:
+                fit = 1
+                break
+            count = resources.amount(entry.resource_id) // entry.amount
+            fit = count if fit is None else min(fit, count)
+        if fit is None:
+            # no resource entries: bounded only by the task-count slots
+            fit = resources.task_max_count()
+        best = max(best, fit)
+    return max(best, 1)
+
+
 def _top_sn_priority(core: Core) -> Priority_t | None:
     """Highest priority among ready single-node tasks that at least one
     worker is capable of running (an unschedulable high-priority task must
@@ -702,6 +724,15 @@ def schedule(
             if leftover_batches is None:
                 leftover_batches = create_batches(core.queues)
             retract_by_worker: dict[int, list[tuple[int, int]]] = {}
+            # per-worker retract cap: one large leftover batch must not
+            # strip every lower-priority prefilled task from every capable
+            # worker in a single tick (far more than those workers could
+            # run) — that just churns retract/re-prefill under deep
+            # backlogs. Per displacing batch, a worker gives up at most
+            # 2× the batch tasks it could simultaneously RUN (the extra
+            # factor leaves backlog headroom), within a PREFILL_MAX
+            # overall budget.
+            retract_budget = {wid: PREFILL_MAX for wid in victim_lists}
             for batch in leftover_batches:
                 if batch.size <= 0:
                     continue
@@ -710,12 +741,16 @@ def schedule(
                 for worker_id, victims in victim_lists.items():
                     if need <= 0:
                         break
-                    if not victims:
+                    if not victims or retract_budget[worker_id] <= 0:
                         continue
                     worker = core.workers[worker_id]
                     if not worker.resources.is_capable_of_rqv(rqv):
                         continue
-                    while victims and need > 0:
+                    allowance = min(
+                        retract_budget[worker_id],
+                        2 * _rqv_fit_count(worker.resources, rqv),
+                    )
+                    while victims and need > 0 and allowance > 0:
                         if victims[-1].priority[0] >= batch.priority[0]:
                             break  # ascending: nothing lower remains
                         victim = victims.pop()
@@ -724,6 +759,8 @@ def schedule(
                             worker_id, []
                         ).append((victim.task_id, victim.instance_id))
                         need -= 1
+                        allowance -= 1
+                        retract_budget[worker_id] -= 1
             for wid, refs in retract_by_worker.items():
                 comm.send_retract(wid, refs)
 

@@ -331,8 +331,11 @@ def test_mn_demand_counts_unhostable_gangs(tmp_path):
     too_small = AllocationQueue(
         2, QueueParams(manager="slurm", workers_per_alloc=1)
     )
-    assert service._mn_demand(fits) == [2]
-    assert service._mn_demand(too_small) == []
+    assert service._mn_demand_joint([fits])[1] == [2]
+    assert service._mn_demand_joint([too_small])[2] == []
+    # joint: the first (and only) eligible queue wins the gang
+    joint = service._mn_demand_joint([too_small, fits])
+    assert joint[2] == [] and joint[1] == [2]
 
 
 def test_mn_demand_respects_time_limit(tmp_path):
@@ -347,8 +350,8 @@ def test_mn_demand_respects_time_limit(tmp_path):
         2, QueueParams(manager="slurm", workers_per_alloc=2,
                        time_limit_secs=86400.0)
     )
-    assert service._mn_demand(short) == []
-    assert service._mn_demand(long) == [2]
+    assert service._mn_demand_joint([short])[1] == []
+    assert service._mn_demand_joint([long])[2] == [2]
 
 
 def test_queued_allocations_absorb_demand(tmp_path):
@@ -436,8 +439,27 @@ def test_mn_demand_skips_resource_impossible_gangs(tmp_path):
         2, QueueParams(manager="slurm", workers_per_alloc=2,
                        worker_args=["--resource", "fpga=[a]"])
     )
-    assert service._mn_demand(plain) == []
-    assert service._mn_demand(with_fpga) == [2]
+    assert service._mn_demand_joint([plain])[1] == []
+    assert service._mn_demand_joint([with_fpga])[2] == [2]
+
+
+def test_mn_demand_dedups_gang_across_queues(tmp_path):
+    """Two eligible queues that can BOTH host a pending gang must not each
+    provision an allocation for it: first-query-wins (reference
+    query.rs:97-125 multi_node_allocations dedup)."""
+    service = _service(tmp_path)
+    core = service.server.core
+    _ready_task(core, 1, None, n_nodes=2)
+
+    first = AllocationQueue(
+        1, QueueParams(manager="slurm", workers_per_alloc=2)
+    )
+    second = AllocationQueue(
+        2, QueueParams(manager="slurm", workers_per_alloc=4)
+    )
+    joint = service._mn_demand_joint([first, second])
+    assert joint[1] == [2]
+    assert joint[2] == []
 
 
 # --------------------------------------------- worker-query transliterations
@@ -983,6 +1005,33 @@ def test_query_padding_covers_only_known_resources(tmp_path):
     from hyperqueue_tpu.autoalloc.query import _fake_rows
     rows = _fake_rows([q], len(core.resource_map))
     assert all(len(r.free) == len(core.resource_map) for r in rows)
+
+
+def test_query_partial_oversized_request(tmp_path):
+    """A task requesting MORE of an undeclared resource than the partial
+    pad stand-in (~838 units) must still register demand — the reference
+    pads with ResourceAmount::MAX (query.rs:35-47); here the pad is raised
+    to the peak pending need and _range_compress absorbs the overflow."""
+    service = _service(tmp_path)
+    core = service.server.core
+    # 2000 units = 2e7 fractions, well above PARTIAL_MAX_FRACTIONS (2^23-1)
+    _ready_task(core, 1, [("bigmem", 2000 * 10_000)])
+    q = _query(core, partial=True, max_sn=2)
+    assert _run_queries(service, [q]) == [1]
+
+
+def test_query_partial_demand_above_task_cap(tmp_path):
+    """Demand beyond one padded fake worker's concurrency cap
+    (PARTIAL_TASK_CAP == TASK_MAX_COUNT_CAP, the same bound every real
+    worker has) spills into the NEXT fake worker instead of vanishing."""
+    from hyperqueue_tpu.autoalloc.query import PARTIAL_TASK_CAP
+
+    service = _service(tmp_path)
+    core = service.server.core
+    for seq in range(PARTIAL_TASK_CAP + 50):
+        _ready_task(core, seq + 1, [("cpus", 10_000)])
+    q = _query(core, partial=True, max_sn=2)
+    assert _run_queries(service, [q]) == [2]
 
 
 def test_query_after_task_cancel(tmp_path):

@@ -128,6 +128,71 @@ def test_journal_report_analytics(tmp_path):
     assert "2.00" not in windowed  # task 0's duration is outside the window
 
 
+def test_journal_report_class_and_alloc_analytics(tmp_path):
+    """Reference report.rs feature set on a replayed fixture: per-request-
+    class duration boxes and counts (T1..Tn = distinct ResourceRequest),
+    queue-wait percentiles, per-config running-worker traces, and
+    allocation-queue economics (latency/lifetime/worker-seconds)."""
+    from hyperqueue_tpu.client.report import build_report
+    from hyperqueue_tpu.events.journal import Journal
+
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"time": 100.0, "event": "worker-connected", "id": 1,
+             "hostname": "a", "group": "g", "resources": {"cpus": 8}})
+    j.write({"time": 100.0, "event": "worker-connected", "id": 2,
+             "hostname": "b", "group": "g",
+             "resources": {"cpus": 4, "gpus": 2}})
+    # two request classes: a 2-cpu array and a 1-gpu task graph
+    j.write({"time": 101.0, "event": "job-submitted", "job": 1,
+             "desc": {"name": "arr", "array": {"ids": [0, 1], "request": {
+                 "variants": [{"entries": [
+                     {"name": "cpus", "amount": 20000}]}]}}},
+             "n_tasks": 2})
+    j.write({"time": 101.0, "event": "job-submitted", "job": 2,
+             "desc": {"name": "gpu", "tasks": [{"id": 0, "request": {
+                 "variants": [{"entries": [
+                     {"name": "gpus", "amount": 10000}]}]}}]},
+             "n_tasks": 1})
+    j.write({"time": 102.0, "event": "task-started", "job": 1, "task": 0,
+             "workers": [1]})
+    j.write({"time": 104.0, "event": "task-started", "job": 1, "task": 1,
+             "workers": [1]})
+    j.write({"time": 105.0, "event": "task-finished", "job": 1, "task": 0})
+    j.write({"time": 105.0, "event": "task-started", "job": 2, "task": 0,
+             "workers": [2]})
+    j.write({"time": 106.0, "event": "task-finished", "job": 1, "task": 1})
+    j.write({"time": 107.0, "event": "task-failed", "job": 2, "task": 0,
+             "error": "oom"})
+    # allocation lifecycle: queued 100 -> started 110 -> finished 140,
+    # 4 workers = 120 worker-seconds
+    j.write({"time": 100.0, "event": "alloc-queue-created", "queue_id": 1,
+             "manager": "slurm"})
+    j.write({"time": 100.0, "event": "alloc-queued", "queue_id": 1,
+             "alloc": "a1", "worker_count": 4})
+    j.write({"time": 110.0, "event": "alloc-started", "queue_id": 1,
+             "alloc": "a1"})
+    j.write({"time": 140.0, "event": "alloc-finished", "queue_id": 1,
+             "alloc": "a1"})
+    j.close()
+
+    html_text = build_report(path)
+    # the two request classes are named and described
+    assert "cpus: 2" in html_text
+    assert "gpus: 1" in html_text
+    assert "T1" in html_text and "T2" in html_text
+    # per-config worker sections
+    assert "cpus: 8" in html_text
+    assert "cpus: 4, gpus: 2" in html_text
+    # wait percentiles present (job 1 waits: 1s and 3s -> p50 shows)
+    assert "wait p50" in html_text
+    # alloc economics: 10s latency, 30s lifetime, 120 worker-seconds
+    assert "10.0s" in html_text
+    assert "30.0s" in html_text
+    assert "120s" in html_text
+
+
 def test_gpu_stat_parsers():
     from hyperqueue_tpu.worker.hwmonitor import (
         parse_nvidia_smi_csv,
@@ -214,3 +279,47 @@ def test_trace_spans_record_tick_phases():
     assert snap["scheduler/gangs"]["count"] >= 1
     assert snap["scheduler/prefill"]["count"] >= 1
     assert snap["scheduler/solve"]["mean_ms"] > 0
+
+
+def test_spawn_loop_restarts_then_stops():
+    """A crashed background loop is restarted up to LOOP_CRASH_RESTARTS
+    times, then the server stops so clients fail fast instead of
+    submitting into a server that never schedules (a crash previously
+    only logged, leaving a zombie)."""
+    import asyncio
+
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    class _NeverSet:
+        @staticmethod
+        def is_set():
+            return False
+
+    class Dummy:
+        LOOP_CRASH_RESTARTS = Server.LOOP_CRASH_RESTARTS
+        LOOP_HEALTHY_SECS = Server.LOOP_HEALTHY_SECS
+        _spawn_loop = Server._spawn_loop
+
+        def __init__(self):
+            self._tasks = []
+            self._stop_event = _NeverSet()
+            self.stopped = False
+
+        def stop(self):
+            self.stopped = True
+
+    async def run():
+        dummy = Dummy()
+        runs = []
+
+        async def crashing():
+            runs.append(1)
+            raise RuntimeError("boom")
+
+        dummy._tasks.append(dummy._spawn_loop(crashing))
+        for _ in range(40):  # drain the crash → restart callback chain
+            await asyncio.sleep(0)
+        assert len(runs) == 1 + Server.LOOP_CRASH_RESTARTS
+        assert dummy.stopped
+
+    asyncio.run(run())

@@ -461,3 +461,27 @@ def test_steal_target_worker_lost_task_stays_on_donor():
     env.schedule(prefill=True)
     assert task.assigned_worker == w1.worker_id
     env.core.sanity_check()
+
+
+def test_displacement_retract_capped_by_worker_fit():
+    """Displacement is bounded per worker by what it could absorb from the
+    displacing batch (2x its simultaneous fit), not the batch's full size:
+    a deep high-priority backlog must not strip every prefilled task from
+    a small worker in one tick (retract/re-prefill churn)."""
+    from hyperqueue_tpu.server import reactor
+
+    env = _TestEnv()
+    w1 = env.worker(cpus=4)
+    # fill the worker's prefill backlog with low-priority 1-cpu tasks
+    env.submit(n=reactor.PREFILL_MAX + 20)
+    env.schedule(prefill=True)
+    assert len(w1.prefilled_tasks) == reactor.PREFILL_MAX
+    # a huge strictly-higher-priority batch of 3-cpu tasks: the worker fits
+    # one at a time (4 // 3), so at most 2 retractions despite need >> 2
+    env.submit(n=200, rqv=env.rqv(cpus=3), priority=(10, 0), job=2)
+    before = len(env.comm.retracts)
+    env.schedule(prefill=True)
+    new_refs = [
+        ref for _, refs in env.comm.retracts[before:] for ref in refs
+    ]
+    assert 0 < len(new_refs) <= 2

@@ -679,6 +679,47 @@ def test_min_utilization_zero_cpu_tasks_always_allowed():
     assert got == [1]
 
 
+def test_min_utilization_dfs_budget_boundary(monkeypatch, caplog):
+    """Behavior AT the MU_DFS_NODE_BUDGET cliff (reference solver.rs is
+    exact LP; the budget is this framework's documented divergence,
+    docs/scheduler.md): (a) a budget too small for even the greedy first
+    dive leaves the worker idle this tick WITH a warning naming it; (b) a
+    budget that fits the greedy dive ships the greedy fill; (c) the normal
+    budget solves the same case fully — an idle tick is transient, not
+    starvation."""
+    import logging
+
+    from hyperqueue_tpu.scheduler import tick
+
+    case = dict(workers=[12], classes=[(0, 4, 3), (0, 4, 2), (0, 4, 1)],
+                mu=[0.5])
+
+    monkeypatch.setattr(tick, "MU_DFS_NODE_BUDGET", 1)
+    with caplog.at_level(logging.WARNING,
+                         logger="hyperqueue_tpu.scheduler.tick"):
+        got, _, _ = schedule_case(case["workers"], case["classes"],
+                                  mu=case["mu"])
+    assert got == [0, 0, 0]
+    assert any("node budget" in r.getMessage() and "empty" in r.getMessage()
+               for r in caplog.records)
+
+    caplog.clear()
+    monkeypatch.setattr(tick, "MU_DFS_NODE_BUDGET", 12)
+    with caplog.at_level(logging.WARNING,
+                         logger="hyperqueue_tpu.scheduler.tick"):
+        got, per_w, _ = schedule_case(case["workers"], case["classes"],
+                                      mu=case["mu"])
+    # a truncated-but-seeded search ships SOME fill that respects the floor
+    assert sum(got) > 0 and per_w[0] >= 6
+    assert any("non-empty" in r.getMessage() for r in caplog.records)
+
+    monkeypatch.setattr(tick, "MU_DFS_NODE_BUDGET", 50_000)
+    got, per_w, _ = schedule_case(case["workers"], case["classes"],
+                                  mu=case["mu"])
+    # full budget: the exact optimum (max task count at 12/12 busy)
+    assert got == [0, 4, 4] and per_w[0] == 12
+
+
 # ---------------------------------------------------------------------------
 # test_scheduler_sn.rs:333 test_schedule_some_tasks_running
 # ---------------------------------------------------------------------------
