@@ -80,8 +80,20 @@ class JsonOutput(Output):
 
 class QuietOutput(Output):
     def table(self, header, rows):
+        # id + status per line for single-key entity listings (reference
+        # output/test_quiet.py: "1 FINISHED"); full rows for multi-key
+        # tables (task lists, alloc info) where dropping columns would
+        # lose the identifying ids
+        lowered = [str(h).lower() for h in header]
+        status_idx = (
+            lowered.index("status") if "status" in lowered else None
+        )
+        compact = status_idx not in (None, 0) and lowered[0] == "id"
         for row in rows:
-            print(" ".join(str(c) for c in row))
+            if compact:
+                print(f"{row[0]} {row[status_idx]}")
+            else:
+                print(" ".join(str(c) for c in row))
 
     def record(self, data):
         pass

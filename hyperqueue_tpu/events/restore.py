@@ -14,7 +14,11 @@ import logging
 from hyperqueue_tpu.events.journal import Journal
 from hyperqueue_tpu.ids import make_task_id
 from hyperqueue_tpu.server import reactor
-from hyperqueue_tpu.server.protocol import expand_desc_tasks, rqv_from_wire
+from hyperqueue_tpu.server.protocol import (
+    expand_desc_tasks,
+    rqv_from_wire,
+    submit_record,
+)
 from hyperqueue_tpu.server.task import Task
 
 logger = logging.getLogger("hq.restore")
@@ -53,6 +57,7 @@ def restore_from_journal(server) -> None:
             expanded = expand_desc_tasks(desc)
             for t in expanded:
                 server.jobs.attach_task(job, t.get("id", 0))
+            job.submits.append(submit_record(desc, len(expanded)))
             job_descs.setdefault(job_id, []).extend(expanded)
         elif kind == "job-opened":
             if job_id not in server.jobs.jobs:

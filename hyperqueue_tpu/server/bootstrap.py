@@ -26,7 +26,7 @@ from hyperqueue_tpu.models.multichip import MultichipModel
 from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
-from hyperqueue_tpu.server.protocol import rqv_from_wire
+from hyperqueue_tpu.server.protocol import rqv_from_wire, submit_record
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
 from hyperqueue_tpu.utils.trace import TRACER
@@ -134,14 +134,18 @@ class EventBridge:
     def __init__(self, server: "Server"):
         self.server = server
 
-    def on_task_started(self, task_id, instance_id, worker_ids):
+    def on_task_started(self, task_id, instance_id, worker_ids, variant=0):
         self.server.jobs.on_task_started(
             task_id_job(task_id), task_id, worker_ids
         )
+        # instance + chosen variant ride along (reference task-started
+        # events carry instance/worker/variant, tests/test_events.py
+        # test_event_running_variant)
         self.server.emit_event(
             "task-started",
             {"job": task_id_job(task_id), "task": task_id_task(task_id),
-             "workers": worker_ids},
+             "workers": worker_ids, "instance": instance_id,
+             "variant": variant},
         )
 
     def on_task_restarted(self, task_id):
@@ -192,7 +196,8 @@ class EventBridge:
         self.server.emit_event(
             "worker-connected",
             {"id": worker.worker_id, "hostname": worker.configuration.hostname,
-             "group": worker.group, "resources": resources},
+             "group": worker.group, "resources": resources,
+             "alloc_id": worker.configuration.alloc_id},
         )
 
     def on_worker_lost(self, worker_id, reason):
@@ -322,6 +327,7 @@ class Server:
         self.worker_port = worker_srv.sockets[0].getsockname()[1]
 
         instance_dir = serverdir.create_instance_dir(self.server_dir)
+        self._instance_dir = instance_dir
         if preshared is not None:
             self.access = preshared
         else:
@@ -378,6 +384,23 @@ class Server:
             conn.close()
         if self.journal is not None:
             self.journal.close()
+        # a clean stop retires the hq-current symlink so clients see "no
+        # server" instead of a dead address (reference server stop removes
+        # the symlink; test_server.py delete_symlink_after_server_stop).
+        # Only if it still points at THIS instance — a newer server owns it
+        # otherwise.
+        link = self.server_dir / serverdir.CURRENT_LINK
+        try:
+            instance_dir = getattr(self, "_instance_dir", None)
+            if (
+                instance_dir is not None
+                and link.is_symlink()
+                and (self.server_dir / os.readlink(link)).resolve()
+                == instance_dir.resolve()
+            ):
+                link.unlink()
+        except OSError:
+            pass  # cleanup is best-effort; a dead link is still harmless
 
     # --- events out ----------------------------------------------------
     def emit_event(self, kind: str, payload: dict) -> None:
@@ -578,10 +601,17 @@ class Server:
             if worker_id:
                 self._worker_conns.pop(worker_id, None)
                 self.comm.unregister_worker(worker_id)
-                if worker_id in self.core.workers:
-                    self._record_past_worker(worker_id, "connection lost")
+                worker = self.core.workers.get(worker_id)
+                if worker is not None:
+                    # a requested stop disconnects too — record the true
+                    # reason, not a generic connection loss (reference
+                    # LostWorkerReason::Stopped vs ConnectionLost)
+                    reason = (
+                        "stopped" if worker.clean_stop else "connection lost"
+                    )
+                    self._record_past_worker(worker_id, reason)
                     reactor.on_remove_worker(
-                        self.core, self.comm, self.events, worker_id, "connection lost"
+                        self.core, self.comm, self.events, worker_id, reason
                     )
             writer.close()
 
@@ -728,6 +758,7 @@ class Server:
                 job_id=job_id,
             )
         new_tasks = self._build_tasks(job, job_desc)
+        job.submits.append(submit_record(job_desc, len(new_tasks)))
         self.emit_event(
             "job-submitted", {"job": job.job_id, "desc": job_desc,
                               "n_tasks": len(new_tasks)}

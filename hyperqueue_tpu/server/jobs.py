@@ -46,6 +46,9 @@ class Job:
     is_open: bool = False
     cancel_reason: str = ""  # why tasks were canceled (user / max_fails)
     submitted_at: float = field(default_factory=time.time)
+    # one record per submit: {"n_tasks": N, "request": wire request dict}
+    # echoed in job detail (reference JobDetail.submit_descs)
+    submits: list = field(default_factory=list)
     tasks: dict[int, JobTaskInfo] = field(default_factory=dict)  # job_task_id ->
     counters: dict[str, int] = field(
         default_factory=lambda: {
@@ -115,6 +118,7 @@ class Job:
 
     def to_detail(self) -> dict:
         info = self.to_info()
+        info["submits"] = self.submits
         info["tasks"] = [
             {
                 "id": t.job_task_id,

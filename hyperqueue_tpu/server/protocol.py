@@ -17,6 +17,26 @@ from hyperqueue_tpu.resources.request import (
 )
 
 
+def submit_record(job_desc: dict, n_tasks: int) -> dict:
+    """Summary of one submit echoed in job detail (reference
+    JobDetail.submit_descs): the wire resource request plus task count.
+    A graph submit with heterogeneous per-task requests echoes the deduped
+    list under "requests" instead of misreporting tasks[0]'s as THE
+    request."""
+    array = job_desc.get("array")
+    if array:
+        return {"n_tasks": n_tasks, "request": array.get("request") or {}}
+    distinct: list[dict] = []
+    for t in job_desc.get("tasks") or []:
+        request = t.get("request") or {}
+        if request not in distinct:
+            distinct.append(request)
+    if len(distinct) <= 1:
+        return {"n_tasks": n_tasks,
+                "request": distinct[0] if distinct else {}}
+    return {"n_tasks": n_tasks, "requests": distinct}
+
+
 def expand_desc_tasks(job_desc: dict) -> list[dict]:
     """Expand a submit description into per-task dicts (array or graph form).
 

@@ -47,7 +47,8 @@ class EventSink(Protocol):
     task-graph news reaches jobs/journal/clients.
     """
 
-    def on_task_started(self, task_id: int, instance_id: int, worker_ids: list[int]) -> None: ...
+    def on_task_started(self, task_id: int, instance_id: int,
+                        worker_ids: list[int], variant: int = 0) -> None: ...
     def on_task_restarted(self, task_id: int) -> None: ...
     def on_task_finished(self, task_id: int) -> None: ...
     def on_task_failed(self, task_id: int, message: str) -> None: ...
@@ -232,7 +233,9 @@ def on_task_running(
             task.retract_pending = False
         task.state = TaskState.RUNNING
         workers = list(task.mn_workers) or [task.assigned_worker]
-        events.on_task_started(task_id, instance_id, workers)
+        events.on_task_started(
+            task_id, instance_id, workers, task.assigned_variant
+        )
 
 
 def on_task_finished(

@@ -1,0 +1,91 @@
+"""Server event-stream contents.
+
+Reference: tests/test_events.py — worker connected/lost events, overview
+on/off via --overview-interval, and the task-started event carrying the
+chosen resource VARIANT, all observed through `hq journal export`.
+"""
+
+import json
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _events(env, journal):
+    env.command(["journal", "flush"])
+    out = env.command(["journal", "export", str(journal)])
+    return [json.loads(line) for line in out.strip().splitlines()]
+
+
+def test_worker_connected_and_lost_events(env, tmp_path):
+    """test_events.py test_worker_connected_event / worker_lost_event."""
+    journal = tmp_path / "j.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker(cpus=3)
+    env.wait_workers(1)
+    env.command(["worker", "stop", "1"])
+    wait_until(lambda: any(
+        e.get("event") == "worker-lost" for e in _events(env, journal)
+    ), message="worker-lost event")
+    events = _events(env, journal)
+    connected = [e for e in events if e.get("event") == "worker-connected"]
+    assert connected and connected[0]["id"] == 1
+    assert connected[0]["resources"]["cpus"] == 3
+    lost = [e for e in events if e.get("event") == "worker-lost"]
+    assert lost and lost[0]["id"] == 1
+    assert "stop" in lost[0]["reason"]
+
+
+def test_overview_interval_zero_disables_overview(env, tmp_path):
+    """test_events.py test_worker_disable_overview: --overview-interval 0
+    emits no worker-overview events; a short interval emits them."""
+    journal = tmp_path / "j.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker("--overview-interval", "0", cpus=1)
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--", "true"])
+    assert not any(
+        e.get("event") == "worker-overview" for e in _events(env, journal)
+    )
+    env.start_worker("--overview-interval", "0.1", cpus=1)
+    env.wait_workers(2)
+    wait_until(lambda: any(
+        e.get("event") == "worker-overview" and e.get("id") == 2
+        for e in _events(env, journal)
+    ), message="worker-overview event")
+
+
+def test_task_started_event_carries_variant(env, tmp_path):
+    """test_events.py test_event_running_variant: when a task offers
+    variants, the event records which one ran."""
+    journal = tmp_path / "j.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker(cpus=4, *["--resource", "gpus=[0,1]"])
+    env.wait_workers(1)
+    jobfile = env.work_dir / "job.toml"
+    jobfile.write_text(
+        """
+[[task]]
+id = 0
+command = ["true"]
+
+[[task.request]]
+resources = { "cpus" = "8" }
+
+[[task.request]]
+resources = { "cpus" = "2", "gpus" = "1" }
+"""
+    )
+    env.command(["job", "submit-file", str(jobfile), "--wait"])
+    events = _events(env, journal)
+    started = [e for e in events if e.get("event") == "task-started"]
+    # the 8-cpu variant can't fit a 4-cpu worker: variant 1 must run
+    assert started and started[0]["variant"] == 1
+    assert started[0]["instance"] == 0

@@ -110,3 +110,95 @@ def test_quiet_mode_emits_bare_ids(env):
         ["submit", "--output-mode", "quiet", "--", "true"]
     ).strip()
     assert job_id == "1"
+
+
+def test_json_job_summary_schema(env):
+    """reference output/test_json.py test_print_job_summary: every status
+    key present even on an empty server, all zero."""
+    env.start_server()
+    summary = json.loads(
+        env.command(["job", "summary", "--output-mode", "json"])
+    )
+    assert summary == {"running": 0, "waiting": 0, "opened": 0,
+                       "finished": 0, "failed": 0, "canceled": 0}
+
+
+def test_json_hwdetect_schema(env):
+    """reference output/test_json.py test_print_hw: hw-detect emits the
+    resource descriptor as JSON."""
+    env.start_server()
+    hw = json.loads(
+        env.command(["worker", "hw-detect", "--output-mode", "json"])
+    )
+    assert "items" in hw
+    names = [item["name"] for item in hw["items"]]
+    assert "cpus" in names and "mem" in names
+
+
+def test_json_job_detail_resources_echo(env):
+    """reference output/test_json.py test_print_job_detail_resources: the
+    submitted resource request is echoed in job detail."""
+    env.start_server()
+    env.command(["submit", "--cpus", "2", "--resource", "gpus=1",
+                 "--", "true"])
+    detail = json.loads(
+        env.command(["job", "info", "1", "--output-mode", "json"])
+    )[0]
+    assert len(detail["submits"]) == 1
+    submit = detail["submits"][0]
+    assert submit["n_tasks"] == 1
+    entries = {
+        e["name"]: e["amount"]
+        for e in submit["request"]["variants"][0]["entries"]
+    }
+    assert entries == {"cpus": 2 * 10_000, "gpus": 1 * 10_000}
+
+
+def test_json_job_detail_multiple_jobs(env):
+    """reference output/test_json.py test_print_job_detail_multiple_jobs:
+    a selector spanning jobs returns one detail per job."""
+    env.start_server()
+    env.command(["submit", "--", "true"])
+    env.command(["submit", "--", "true"])
+    details = json.loads(
+        env.command(["job", "info", "1-2", "--output-mode", "json"])
+    )
+    assert [d["id"] for d in details] == [1, 2]
+    assert all("tasks" in d and "submits" in d for d in details)
+
+
+def test_json_task_list_schema(env):
+    """reference output/test_json.py test_print_job_tasks: task list
+    groups tasks by job with waiting state before any worker exists."""
+    env.start_server()
+    env.command(["submit", "--array", "1-4", "--", "true"])
+    listing = json.loads(
+        env.command(["task", "list", "1", "--output-mode", "json"])
+    )
+    (entry,) = listing
+    assert entry["job"] == 1
+    assert sorted(t["id"] for t in entry["tasks"]) == [1, 2, 3, 4]
+    assert all(t["status"] == "waiting" for t in entry["tasks"])
+
+
+def test_quiet_job_and_worker_list(env):
+    """reference output/test_quiet.py: quiet lists are bare id-per-line."""
+    env.start_server()
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--", "sleep", "30"])
+    jobs = env.command(["job", "list", "--output-mode", "quiet"])
+    (job_line,) = jobs.strip().splitlines()
+    assert job_line.split()[0] == "1"
+    assert job_line.split()[1] in ("waiting", "running")
+    workers = env.command(["worker", "list", "--output-mode", "quiet"])
+    assert workers.strip().splitlines() == ["1 running"]
+
+
+def test_alloc_add_json_clean_stdout(env):
+    """reference output/test_json.py test_add_queue_json_output_nonempty:
+    alloc add in json mode emits valid JSON on stdout."""
+    env.start_server()
+    out = env.command(["alloc", "add", "slurm", "--no-dry-run",
+                       "--output-mode", "json"])
+    json.loads(out)  # must parse clean

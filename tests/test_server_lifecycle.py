@@ -1,0 +1,110 @@
+"""Server lifecycle corners.
+
+Reference: tests/test_server.py — explicit host/ports, hq-current symlink
+cleanup on stop, `server wait` semantics, protocol-version rejection of a
+mismatched peer.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from utils_e2e import HqEnv, _env_base, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_server_explicit_host_and_ports(env):
+    """test_server.py test_server_client_port/worker_port/host: chosen
+    ports and host land in the access record and server info."""
+    cp, wp = _free_port(), _free_port()
+    env.start_server("--host", "127.0.0.1",
+                     "--client-port", str(cp), "--worker-port", str(wp))
+    info = json.loads(
+        env.command(["server", "info", "--output-mode", "json"])
+    )
+    assert info["host"] == "127.0.0.1"
+    assert info["client_port"] == cp
+    assert info["worker_port"] == wp
+    access = json.loads(
+        (env.server_dir / "hq-current" / "access.json").read_text()
+    )
+    assert access["client"]["port"] == cp
+    assert access["worker"]["port"] == wp
+
+
+def test_server_stop_removes_current_symlink(env):
+    """test_server.py test_delete_symlink_after_server_stop."""
+    env.start_server()
+    link = env.server_dir / "hq-current"
+    assert link.exists()
+    env.command(["server", "stop"])
+    wait_until(lambda: not link.exists(), message="hq-current removal")
+
+
+def test_server_wait_reachable(env, tmp_path):
+    """test_server.py test_server_wait_*: `server wait` blocks until a
+    server is reachable; with none it times out nonzero."""
+    missing_dir = tmp_path / "nowhere"
+    result = subprocess.run(
+        [sys.executable, "-m", "hyperqueue_tpu", "server", "wait",
+         "--timeout", "1", "--server-dir", str(missing_dir)],
+        env=_env_base(), capture_output=True, text=True, timeout=30,
+    )
+    assert result.returncode != 0
+
+    env.start_server()
+    env.command(["server", "wait", "--timeout", "5"])
+
+    # delayed start: wait in the background, start the server after
+    waiter = subprocess.Popen(
+        [sys.executable, "-m", "hyperqueue_tpu", "server", "wait",
+         "--timeout", "20", "--server-dir", str(tmp_path / "late")],
+        env=_env_base(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    time.sleep(0.5)
+    late = HqEnv(tmp_path / "late-env")
+    late.server_dir = tmp_path / "late"
+    try:
+        late.start_server()
+        assert waiter.wait(timeout=30) == 0
+    finally:
+        late.close()
+        waiter.kill()
+
+
+def test_protocol_version_mismatch_rejected(env):
+    """test_server.py test_version_mismatch: a peer speaking a different
+    protocol version is refused at the handshake, with a clear error."""
+    env.start_server()
+    # run a client whose transport speaks version+1: the handshake must
+    # refuse it with a version error, not hang or garble
+    code = (
+        "from pathlib import Path\n"
+        "from hyperqueue_tpu.transport import auth\n"
+        "auth.PROTOCOL_VERSION += 1\n"
+        "from hyperqueue_tpu.client.connection import ClientSession\n"
+        "session = ClientSession(Path(%r))\n"
+        "print(session.request({'op': 'server_info'}))\n"
+    ) % str(env.server_dir)
+    result = subprocess.run(
+        [sys.executable, "-c", code],
+        env=_env_base(), capture_output=True, text=True, timeout=30,
+    )
+    assert result.returncode != 0
+    assert "version" in (result.stdout + result.stderr).lower()

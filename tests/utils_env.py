@@ -63,7 +63,7 @@ class TestEvents:
     workers_new: list[int] = field(default_factory=list)
     workers_lost: list[tuple[int, str]] = field(default_factory=list)
 
-    def on_task_started(self, task_id, instance_id, worker_ids):
+    def on_task_started(self, task_id, instance_id, worker_ids, variant=0):
         self.started.append(task_id)
 
     def on_task_restarted(self, task_id):
