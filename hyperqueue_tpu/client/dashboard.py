@@ -39,7 +39,7 @@ def _sparkline(series: list[tuple[float, float]], width: int,
     if not series:
         return ""
     ticks = "▁▂▃▄▅▆▇█"
-    values = [v for _, v in series[-width:]]
+    values = [v for _, v in series[-max(width, 1):]]
     top = maximum if maximum is not None else max(values) or 1.0
     return "".join(
         ticks[min(int(v / top * (len(ticks) - 1)), len(ticks) - 1)]
@@ -102,6 +102,20 @@ def render_worker_detail(data: DashboardData, worker_id: int,
     ]
     for job_id, task_id in sorted(w.running)[:8]:
         lines.append(f"   job {job_id} task {task_id}")
+    # task timeline: concurrent running tasks over time + recent spans
+    # (reference dashboard worker screen timeline charts)
+    series = w.running_series()
+    if series:
+        lines.append(
+            "task timeline: " + _sparkline(series, width - 17)
+        )
+        recent_spans = list(w.task_history)[-6:]
+        for span in reversed(recent_spans):
+            end = span.ended_at or data.last_time
+            lines.append(
+                f"   {span.job_id}@{span.task_id:<6} {span.status:<9} "
+                f"{end - span.started_at:6.1f}s"
+            )
     hw = w.last_hw
     if hw:
         mem_total = hw.get("mem_total_bytes", 0)
@@ -207,16 +221,30 @@ def render_autoalloc(data: DashboardData, selected: int, width: int = 78,
         q = queues[selected]
         lines.append("-" * width)
         lines.append(f"ALLOCATIONS of queue {q.queue_id}")
+        # per-allocation drill-down: member workers joined via HQ_ALLOC_ID
+        # (reference dashboard allocation detail screen)
+        members: dict[str, list] = {}
+        for w in data.workers.values():
+            if w.alloc_id:
+                members.setdefault(w.alloc_id, []).append(w)
         allocs = sorted(q.allocations.values(), key=lambda a: -a.queued_at)
-        for a in allocs[: height - len(lines) - 1]:
+        for a in allocs[: max(height - len(lines) - 1, 0)]:
             span = ""
             if a.started_at:
                 end = a.ended_at or data.last_time
-                span = f" ran {end - a.started_at:6.0f}s"
+                span = (f" waited {a.started_at - a.queued_at:5.0f}s"
+                        f" ran {end - a.started_at:6.0f}s")
             lines.append(
                 f"   {a.allocation_id[:20]:<20} {a.status:<9} "
-                f"queued {_fmt_t(a.queued_at)}{span}"
+                f"workers={a.worker_count} queued {_fmt_t(a.queued_at)}{span}"
             )
+            for w in members.get(a.allocation_id, ()):
+                state = "up" if w.is_connected else "lost"
+                lines.append(
+                    f"      worker #{w.worker_id} {w.hostname[:16]:<16} "
+                    f"{state:<5} running={len(w.running)} "
+                    f"done={w.tasks_done}"
+                )
     return lines[:height]
 
 

@@ -20,11 +20,23 @@ OVERVIEW_HISTORY = 512  # per-worker (t, cpu%) samples kept for the chart
 
 
 @dataclass
+class TaskSpan:
+    """One task's stay on a worker (timeline chart fodder)."""
+
+    job_id: int
+    task_id: int
+    started_at: float
+    ended_at: float = 0.0  # 0 = still running
+    status: str = "running"
+
+
+@dataclass
 class WorkerState:
     worker_id: int
     hostname: str = ""
     group: str = "default"
     resources: dict = field(default_factory=dict)  # name -> units
+    alloc_id: str = ""  # autoalloc allocation this worker belongs to
     connected_at: float = 0.0
     lost_at: float = 0.0
     lost_reason: str = ""
@@ -32,6 +44,23 @@ class WorkerState:
     cpu_history: deque = field(default_factory=lambda: deque(maxlen=OVERVIEW_HISTORY))
     running: set = field(default_factory=set)  # (job, task)
     tasks_done: int = 0
+    # recent task spans on this worker, newest last (worker-detail timeline)
+    task_history: deque = field(
+        default_factory=lambda: deque(maxlen=OVERVIEW_HISTORY)
+    )
+
+    def running_series(self) -> list[tuple[float, float]]:
+        """(t, concurrent running tasks) step series from the span history."""
+        deltas: list[tuple[float, int]] = []
+        for span in self.task_history:
+            deltas.append((span.started_at, +1))
+            if span.ended_at:
+                deltas.append((span.ended_at, -1))
+        series, n = [], 0
+        for t, d in sorted(deltas):
+            n += d
+            series.append((t, float(n)))
+        return series
 
     @property
     def is_connected(self) -> bool:
@@ -137,6 +166,7 @@ class DashboardData:
                 hostname=record.get("hostname", ""),
                 group=record.get("group", "default"),
                 resources=record.get("resources") or {},
+                alloc_id=record.get("alloc_id", ""),
                 connected_at=t,
             )
             self._mark_worker_count(t)
@@ -192,11 +222,17 @@ class DashboardData:
                 w = self.workers.get(wid)
                 if w is not None:
                     w.running.add((job.job_id, record.get("task", 0)))
+                    w.task_history.append(TaskSpan(
+                        job_id=job.job_id,
+                        task_id=record.get("task", 0),
+                        started_at=t,
+                    ))
         elif kind == "task-restarted":
             job = self.jobs.get(record.get("job", 0))
             if job is not None:
                 task = job.tasks.setdefault(record.get("task", 0), TaskView())
-                self._release_task(job.job_id, record.get("task", 0), task)
+                self._release_task(job.job_id, record.get("task", 0), task,
+                                   at=t, status="restarted")
                 task.status = "waiting"
         elif kind in ("task-finished", "task-failed", "task-canceled"):
             job = self.jobs.setdefault(
@@ -204,7 +240,8 @@ class DashboardData:
             )
             task = job.tasks.setdefault(record.get("task", 0), TaskView())
             self._release_task(job.job_id, record.get("task", 0), task,
-                               count_done=kind == "task-finished")
+                               count_done=kind == "task-finished",
+                               at=t, status=kind.removeprefix("task-"))
             task.status = kind.removeprefix("task-")
             task.finished_at = t
             task.error = record.get("error", "")
@@ -244,13 +281,20 @@ class DashboardData:
                     a.ended_at = t
 
     def _release_task(self, job_id, task_id, task: TaskView,
-                      count_done: bool = False) -> None:
+                      count_done: bool = False, at: float = 0.0,
+                      status: str = "finished") -> None:
         for wid in task.workers:
             w = self.workers.get(wid)
             if w is not None:
                 w.running.discard((job_id, task_id))
                 if count_done:
                     w.tasks_done += 1
+                for span in reversed(w.task_history):
+                    if (span.job_id, span.task_id) == (job_id, task_id) \
+                            and not span.ended_at:
+                        span.ended_at = at or self.last_time
+                        span.status = status
+                        break
 
     def _mark_worker_count(self, t: float) -> None:
         n = sum(1 for w in self.workers.values() if w.is_connected)

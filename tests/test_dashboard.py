@@ -250,3 +250,61 @@ def test_overview_override_forces_hw_telemetry(tmp_path):
         assert not seen_after.is_set(), (
             "worker kept sending overviews after the dashboard detached"
         )
+
+
+def test_worker_detail_task_timeline():
+    """Worker detail shows a task timeline (concurrent-running sparkline +
+    recent spans with durations and outcomes) built from span history."""
+    data = DashboardData()
+    feed(
+        data,
+        {"event": "worker-connected", "id": 1, "hostname": "nodeA",
+         "group": "default"},
+        {"event": "job-submitted", "job": 1,
+         "desc": {"name": "tl"}, "n_tasks": 3},
+        {"event": "task-started", "job": 1, "task": 0, "workers": [1]},
+        {"event": "task-started", "job": 1, "task": 1, "workers": [1]},
+        {"event": "task-finished", "job": 1, "task": 0},
+        {"event": "task-failed", "job": 1, "task": 1, "error": "x"},
+        {"event": "task-started", "job": 1, "task": 2, "workers": [1]},
+    )
+    w = data.workers[1]
+    assert len(w.task_history) == 3
+    spans = {(s.job_id, s.task_id): s for s in w.task_history}
+    assert spans[(1, 0)].status == "finished" and spans[(1, 0)].ended_at
+    assert spans[(1, 1)].status == "failed"
+    assert spans[(1, 2)].status == "running" and not spans[(1, 2)].ended_at
+    # series peaks at 2 concurrent tasks
+    assert max(n for _, n in w.running_series()) == 2
+    detail = "\n".join(render_worker_detail(data, 1))
+    assert "task timeline" in detail
+    assert "1@0" in detail and "finished" in detail
+    assert "1@1" in detail and "failed" in detail
+
+
+def test_autoalloc_allocation_drilldown():
+    """The autoalloc screen drills into each allocation: queue latency,
+    runtime, declared worker count, and the member workers that joined
+    with its HQ_ALLOC_ID."""
+    data = DashboardData()
+    feed(
+        data,
+        {"event": "alloc-queue-created", "queue_id": 1, "manager": "slurm"},
+        {"event": "alloc-queued", "queue_id": 1, "alloc": "sb-7",
+         "worker_count": 2},
+        {"event": "alloc-started", "queue_id": 1, "alloc": "sb-7"},
+        {"event": "worker-connected", "id": 1, "hostname": "n0",
+         "group": "sb-7", "alloc_id": "sb-7"},
+        {"event": "worker-connected", "id": 2, "hostname": "n1",
+         "group": "sb-7", "alloc_id": "sb-7"},
+        {"event": "job-submitted", "job": 1, "desc": {"name": "j"},
+         "n_tasks": 1},
+        {"event": "task-started", "job": 1, "task": 0, "workers": [1]},
+        {"event": "task-finished", "job": 1, "task": 0},
+    )
+    screen = "\n".join(render_autoalloc(data, 0))
+    assert "sb-7" in screen
+    assert "workers=2" in screen
+    assert "waited" in screen and "ran" in screen
+    assert "worker #1 n0" in screen and "worker #2 n1" in screen
+    assert "done=1" in screen
