@@ -329,16 +329,19 @@ def _run_extra(cmd_args, env_extra, timeout_s):
         )
     except subprocess.TimeoutExpired:
         return {"error": f"timeout after {timeout_s:.0f}s"}
-    if done.returncode != 0:
-        return {"error": f"exit {done.returncode}",
-                "stderr": (done.stderr or "")[-300:]}
-    for line in done.stdout.splitlines():
+    # scan stdout for a JSON line FIRST: a wedged child exits nonzero but
+    # still prints its diagnosis JSON (the SIGALRM watchdog) — that
+    # diagnosis is the artifact we want
+    for line in (done.stdout or "").splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
                 return json.loads(line)
             except json.JSONDecodeError:
                 pass
+    if done.returncode != 0:
+        return {"error": f"exit {done.returncode}",
+                "stderr": (done.stderr or "")[-300:]}
     return {"error": "no JSON line", "stdout": (done.stdout or "")[-300:]}
 
 
@@ -519,7 +522,9 @@ def main() -> None:
                        "--tasks", str(args.tasks)]
         if on_cpu:
             kernel_args.append("--cpu")
-        result["kernel"] = _run_extra(kernel_args, {}, timeout_s=480)
+        # parent timeout must outlast the child's own 480s SIGALRM wedge
+        # watchdog, or the diagnosis JSON is killed before it prints
+        result["kernel"] = _run_extra(kernel_args, {}, timeout_s=600)
         probe_flags = "--xla_force_host_platform_device_count=8"
         existing_flags = os.environ.get("XLA_FLAGS", "")
         result["sharded_probe"] = _run_extra(

@@ -57,7 +57,9 @@ class WorkerState:
             if span.ended_at:
                 deltas.append((span.ended_at, -1))
         series, n = [], 0
-        for t, d in sorted(deltas):
+        # starts before ends at equal timestamps (-d): a zero-duration
+        # span must never dip the count negative
+        for t, d in sorted(deltas, key=lambda td: (td[0], -td[1])):
             n += d
             series.append((t, float(n)))
         return series
@@ -341,6 +343,7 @@ def seed_from_server(data: DashboardData, session) -> None:
             resources={
                 k: v / 10_000 for k, v in (w.get("resources") or {}).items()
             },
+            alloc_id=w.get("alloc_id", ""),
             connected_at=now,
         )
         overview = w.get("overview") or {}
@@ -398,6 +401,7 @@ def seed_from_server(data: DashboardData, session) -> None:
                 queued_at=a.get("queued_at", 0.0),
                 started_at=a.get("started_at", 0.0),
                 ended_at=a.get("ended_at", 0.0),
+                worker_count=int(a.get("worker_count", 1)),
             )
         data.queues[qs.queue_id] = qs
     data.last_time = now

@@ -1086,6 +1086,7 @@ class Server:
                 "id": w.worker_id,
                 "hostname": w.configuration.hostname,
                 "group": w.group,
+                "alloc_id": w.configuration.alloc_id,
                 "status": "running",
                 "n_running": len(w.assigned_tasks),
                 "resources": {

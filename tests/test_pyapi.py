@@ -1,0 +1,176 @@
+"""Python API breadth.
+
+Reference: tests/pyapi/test_job.py and test_function.py — env/cwd/stdio
+options, per-task resources and priorities, failed-task reporting, forget,
+and function tasks with resources; all through Client/Job/LocalCluster.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv(
+        "PYTHONPATH", REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from hyperqueue_tpu.api import LocalCluster
+
+    with LocalCluster(n_workers=1, cpus_per_worker=4,
+                      server_dir=str(tmp_path / "cluster")) as lc:
+        yield lc
+
+
+def test_submit_env_cwd_stdio(cluster, tmp_path):
+    """pyapi/test_job.py test_submit_env/cwd/stdio: options land on the
+    spawned process."""
+    from hyperqueue_tpu.api import Job
+
+    workdir = tmp_path / "inner"
+    workdir.mkdir()
+    with cluster.client() as client:
+        job = Job(name="opts")
+        job.program(
+            ["bash", "-c", "echo $FOO-$(pwd); echo err >&2"],
+            env={"FOO": "bar"},
+            cwd=str(workdir),
+            stdout=str(tmp_path / "o.txt"),
+            stderr=str(tmp_path / "e.txt"),
+        )
+        client.wait_for_jobs([client.submit(job)])
+    assert (tmp_path / "o.txt").read_text() == f"bar-{workdir}\n"
+    assert (tmp_path / "e.txt").read_text() == "err\n"
+
+
+def test_stdin_bytes(cluster, tmp_path):
+    from hyperqueue_tpu.api import Job
+
+    with cluster.client() as client:
+        job = Job(name="stdin")
+        job.program(
+            ["bash", "-c", "cat"],
+            stdin=b"fed-through-stdin",
+            stdout=str(tmp_path / "o.txt"),
+        )
+        client.wait_for_jobs([client.submit(job)])
+    assert (tmp_path / "o.txt").read_text() == "fed-through-stdin"
+
+
+def test_task_resources_respected(cluster, tmp_path):
+    """pyapi/test_job.py test_job_cpus_resources: two 4-cpu tasks cannot
+    overlap on a 4-cpu worker — starts are serialized."""
+    from hyperqueue_tpu.api import Job
+
+    with cluster.client() as client:
+        job = Job(name="res")
+        script = (
+            "python3 -c \"import time,os;"
+            "print(time.time()); time.sleep(0.4); print(time.time())\""
+        )
+        for i in range(2):
+            job.program(
+                ["bash", "-c", script],
+                resources={"cpus": "4"},
+                stdout=str(tmp_path / f"t{i}.txt"),
+            )
+        client.wait_for_jobs([client.submit(job)])
+    spans = []
+    for i in range(2):
+        lines = (tmp_path / f"t{i}.txt").read_text().split()
+        spans.append((float(lines[0]), float(lines[1])))
+    spans.sort()
+    assert spans[0][1] <= spans[1][0] + 0.05  # no overlap
+
+
+def test_priorities_order_start(cluster, tmp_path):
+    """pyapi/test_job.py test_task_priorities: on a single slot, higher
+    priority starts first."""
+    from hyperqueue_tpu.api import Job
+
+    with cluster.client() as client:
+        job = Job(name="prio")
+        order_file = tmp_path / "order.txt"
+        for name, prio in (("low", 0), ("high", 5), ("mid", 2)):
+            job.program(
+                ["bash", "-c", f"echo {name} >> {order_file}"],
+                priority=prio,
+                resources={"cpus": "4"},  # one at a time
+            )
+        client.wait_for_jobs([client.submit(job)])
+    assert order_file.read_text().split() == ["high", "mid", "low"]
+
+
+def test_failed_tasks_reported_and_forget(cluster, tmp_path):
+    """pyapi/test_job.py test_get_failed_tasks + test_job_forget."""
+    from hyperqueue_tpu.api import FailedJobsException, Job
+
+    with cluster.client() as client:
+        job = Job(name="fails")
+        job.program(["bash", "-c", "true"])
+        job.program(["bash", "-c", "exit 7"])
+        job_id = client.submit(job)
+        with pytest.raises(FailedJobsException):
+            client.wait_for_jobs([job_id])
+        failed = client.get_failed_tasks([job_id])
+        assert list(failed) == [job_id]
+        (task_errors,) = failed.values()
+        assert any("7" in err for err in task_errors.values())
+        # a terminal job can be forgotten; its id disappears
+        assert client.forget([job_id]) == 1
+        assert client.job_info([job_id]) == []
+
+
+def test_wait_progress_callback(cluster):
+    """Reference pyhq wait progress callback: monotone (done, total)."""
+    from hyperqueue_tpu.api import Job
+
+    calls = []
+    with cluster.client() as client:
+        job = Job(name="prog")
+        for _ in range(3):
+            job.program(["bash", "-c", "sleep 0.1"])
+        client.wait_for_jobs(
+            [client.submit(job)],
+            progress=lambda done, total: calls.append((done, total)),
+        )
+    assert calls[-1] == (3, 3)
+    assert all(t == 3 for _, t in calls)
+    assert [d for d, _ in calls] == sorted(d for d, _ in calls)
+
+
+def test_function_with_resources_and_failure_traceback(cluster, tmp_path):
+    """pyapi/test_function.py test_function_resources +
+    test_submit_pyfunction_fail: function tasks carry resources; failures
+    surface the traceback."""
+    from hyperqueue_tpu.api import FailedJobsException, Job
+
+    marker = tmp_path / "ran.txt"
+
+    def work(path):
+        with open(path, "w") as f:
+            f.write("function-ran")
+
+    def explode():
+        raise ValueError("deliberate-pyfn-boom")
+
+    with cluster.client() as client:
+        job = Job(name="fn")
+        job.function(work, args=(str(marker),), resources={"cpus": "2"})
+        client.wait_for_jobs([client.submit(job)])
+        assert marker.read_text() == "function-ran"
+
+        bad = Job(name="fn-bad")
+        bad.function(explode)
+        bad_id = client.submit(bad)
+        with pytest.raises(FailedJobsException) as excinfo:
+            client.wait_for_jobs([bad_id])
+        (errors,) = excinfo.value.failed.values()
+        err = list(errors.values())[0]
+        assert "deliberate-pyfn-boom" in err and "explode" in err
