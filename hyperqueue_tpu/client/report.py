@@ -79,6 +79,15 @@ def _request_sig(request: dict | None) -> str:
     return " | ".join(parts)
 
 
+def _config_key(resources: dict) -> str:
+    """One string per worker resource config — the grouping key shared by
+    the running-workers sections and the utilization traces (they must
+    agree or traces silently vanish from the report)."""
+    return ", ".join(
+        f"{name}: {units:g}" for name, units in sorted(resources.items())
+    ) or "(no resources)"
+
+
 def _collect(journal_path: Path, start_time: float | None,
              end_time: float | None):
     """Reduce the journal into DashboardData + report-only traces.
@@ -108,6 +117,52 @@ def _collect(journal_path: Path, start_time: float | None,
             cls = classes[sig] = {"finished": [], "failed": [], "waits": []}
         return cls
 
+    # normalized per-resource utilization per worker config over time
+    # (reference report.rs w_utilization traces: 1.0 = fully allocated)
+    job_request: dict[int, dict] = {}
+    task_request: dict[tuple[int, int], dict] = {}
+    cfg_of_worker: dict[int, str] = {}
+    wres_of_worker: dict[int, dict] = {}
+    cfg_totals: dict[str, Counter] = {}
+    cfg_alloc: dict[str, Counter] = {}
+    util_traces: dict[tuple[str, str], list] = {}
+    # (job, task) -> [(wid, cfg, name, units)] charges to undo on release
+    task_alloc: dict[tuple[int, int], list] = {}
+
+    def _mark_util(cfg: str, name: str, t: float) -> None:
+        total = cfg_totals.get(cfg, Counter())[name]
+        if total > 0:
+            util_traces.setdefault((cfg, name), []).append(
+                (t, cfg_alloc[cfg][name] / total)
+            )
+
+    def _chosen_variant(job_id: int, tid: int, variant: int) -> dict:
+        request = task_request.get((job_id, tid)) or job_request.get(job_id)
+        variants = (request or {}).get("variants") or []
+        if not variants:
+            return {}
+        return variants[min(variant, len(variants) - 1)]
+
+    def _charge(key, wid: int, entries: list, t: float) -> None:
+        cfg = cfg_of_worker.get(wid)
+        if cfg is None:
+            return
+        for name, units in entries:
+            cfg_alloc[cfg][name] += units
+            task_alloc.setdefault(key, []).append((wid, cfg, name, units))
+            _mark_util(cfg, name, t)
+
+    def _release(key, t: float, only_wid: int | None = None) -> None:
+        remaining = []
+        for wid, cfg, name, units in task_alloc.pop(key, ()):
+            if only_wid is not None and wid != only_wid:
+                remaining.append((wid, cfg, name, units))
+                continue
+            cfg_alloc[cfg][name] -= units
+            _mark_util(cfg, name, t)
+        if remaining:
+            task_alloc[key] = remaining
+
     for rec in Journal.read_all(journal_path):
         ts = float(rec.get("time", 0.0))
         if first_ts is None:
@@ -126,12 +181,36 @@ def _collect(journal_path: Path, start_time: float | None,
             array = desc.get("array")
             if array is not None:
                 job_sig[job_id] = _request_sig(array.get("request"))
+                job_request[job_id] = array.get("request") or {}
                 for tid in array.get("ids") or ():
                     task_submitted_at[(job_id, tid)] = ts
             for t in desc.get("tasks") or ():
                 tid = t.get("id", 0)
                 task_sig[(job_id, tid)] = _request_sig(t.get("request"))
+                task_request[(job_id, tid)] = t.get("request") or {}
                 task_submitted_at[(job_id, tid)] = ts
+        elif kind == "worker-connected":
+            wid = rec.get("id", 0)
+            wres = rec.get("resources") or {}
+            cfg = _config_key(wres)
+            cfg_of_worker[wid] = cfg
+            wres_of_worker[wid] = wres
+            totals = cfg_totals.setdefault(cfg, Counter())
+            cfg_alloc.setdefault(cfg, Counter())
+            for name, units in wres.items():
+                totals[name] += units
+                _mark_util(cfg, name, ts)
+        elif kind == "worker-lost":
+            wid = rec.get("id", 0)
+            # release the lost worker's task charges FIRST, then shrink
+            # the pool — the other order records >100% utilization spikes
+            for key in list(task_alloc):
+                _release(key, ts, only_wid=wid)
+            cfg = cfg_of_worker.pop(wid, None)
+            if cfg is not None:
+                for name, units in wres_of_worker.pop(wid, {}).items():
+                    cfg_totals[cfg][name] -= units
+                    _mark_util(cfg, name, ts)
         elif kind == "task-started":
             running += 1
             running_trace.append((ts, float(running)))
@@ -142,6 +221,30 @@ def _collect(journal_path: Path, start_time: float | None,
             )
             if submitted is not None:
                 class_of(*key)["waits"].append(ts - submitted)
+            workers = rec.get("workers") or ()
+            if workers:
+                v = _chosen_variant(*key, rec.get("variant", 0))
+                if v.get("n_nodes"):
+                    # a gang occupies each member worker WHOLE
+                    for wid in workers:
+                        pools = wres_of_worker.get(wid, {})
+                        _charge(key, wid, list(pools.items()), ts)
+                else:
+                    wid = workers[0]
+                    pools = wres_of_worker.get(wid, {})
+                    entries = []
+                    for e in v.get("entries") or [{"name": "cpus",
+                                                   "amount": 10_000}]:
+                        if e.get("policy") == "all":
+                            # ALL-policy drains the worker's whole pool
+                            entries.append(
+                                (e["name"], pools.get(e["name"], 0.0))
+                            )
+                        else:
+                            entries.append(
+                                (e["name"], int(e["amount"]) / 10_000)
+                            )
+                    _charge(key, wid, entries, ts)
         elif kind in ("task-finished", "task-failed", "task-canceled",
                       "task-restarted"):
             key = (rec.get("job", 0), rec.get("task", 0))
@@ -158,7 +261,8 @@ def _collect(journal_path: Path, start_time: float | None,
                     class_of(*key)["finished"].append(ts - started)
             elif kind == "task-failed" and started is not None:
                 class_of(*key)["failed"].append(ts - started)
-    return data, running_trace, per_minute, classes
+            _release(key, ts)
+    return data, running_trace, per_minute, classes, util_traces
 
 
 def _percentile(values: list[float], p: int) -> str:
@@ -214,7 +318,7 @@ def _svg_boxes(groups: list[tuple[str, list[float]]], width=640) -> str:
 
 def build_report(journal_path: str | Path, start_time: float | None = None,
                  end_time: float | None = None) -> str:
-    data, running_trace, per_minute, classes = _collect(
+    data, running_trace, per_minute, classes, util_traces = _collect(
         Path(journal_path), start_time, end_time
     )
     lo, hi = data.time_span()
@@ -349,14 +453,9 @@ def build_report(journal_path: str | Path, start_time: float | None = None,
 
     # running workers grouped by resource config (reference report.rs
     # running_workers traces keyed on ResCount)
-    def config_key(w) -> str:
-        return ", ".join(
-            f"{name}: {units:g}" for name, units in sorted(w.resources.items())
-        ) or "(no resources)"
-
     config_events: dict[str, list[tuple[float, int]]] = {}
     for w in data.workers.values():
-        key = config_key(w)
+        key = _config_key(w.resources)
         config_events.setdefault(key, []).append((w.connected_at, +1))
         if w.lost_at:
             config_events[key].append((w.lost_at, -1))
@@ -366,10 +465,22 @@ def build_report(journal_path: str | Path, start_time: float | None = None,
         for t, delta in sorted(config_events[key]):
             n += delta
             series.append((t, float(n)))
-        config_sections.append(
+        section = (
             f"<h3>workers [{html.escape(key)}]</h3>"
             + _svg_line(series, height=80, color="#383")
         )
+        # normalized per-resource utilization on this config (reference
+        # report.rs "<RESOURCE> alloc on <RESOURCES>" traces; 1.0 = full)
+        for (cfg, name), trace in sorted(util_traces.items()):
+            if cfg != key:
+                continue
+            section += (
+                f"<h4>{html.escape(name)} utilization "
+                f"(% of the config's pool)</h4>"
+                + _svg_line([(t, v * 100.0) for t, v in trace],
+                            height=70, color="#66a")
+            )
+        config_sections.append(section)
 
     # per-request-class duration boxes + counts + queue waits (reference
     # report.rs durationsChart/countsChart T1..Tn legend)

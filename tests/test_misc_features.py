@@ -178,6 +178,18 @@ def test_journal_report_class_and_alloc_analytics(tmp_path):
     j.close()
 
     html_text = build_report(path)
+    # normalized utilization traces per config: the 2-cpu tasks allocate
+    # 2/8 then 4/8 of the cpus-8 worker's pool
+    from hyperqueue_tpu.client.report import _collect
+
+    _, _, _, _, util = _collect(path, None, None)
+    cpu_trace = util[("cpus: 8", "cpus")]
+    assert [round(v, 3) for _, v in cpu_trace] == [
+        0.0, 0.25, 0.5, 0.25, 0.0
+    ]
+    gpu_trace = util[("cpus: 4, gpus: 2", "gpus")]
+    assert [round(v, 3) for _, v in gpu_trace] == [0.0, 0.5, 0.0]
+    assert "utilization" in html_text
     # the two request classes are named and described
     assert "cpus: 2" in html_text
     assert "gpus: 1" in html_text
@@ -323,3 +335,49 @@ def test_spawn_loop_restarts_then_stops():
         assert dummy.stopped
 
     asyncio.run(run())
+
+
+def test_utilization_trace_corners(tmp_path):
+    """Utilization corners: ALL-policy tasks drain the whole pool, gangs
+    charge every member worker, and a lost worker's charges release
+    BEFORE its pool shrinks (no >100% spike)."""
+    from hyperqueue_tpu.client.report import _collect
+    from hyperqueue_tpu.events.journal import Journal
+
+    path = tmp_path / "j.bin"
+    j = Journal(path)
+    j.open_for_append()
+    for wid in (1, 2):
+        j.write({"time": 100.0, "event": "worker-connected", "id": wid,
+                 "hostname": f"n{wid}", "group": "g",
+                 "resources": {"cpus": 8}})
+    # ALL-policy task on worker 1
+    j.write({"time": 101.0, "event": "job-submitted", "job": 1,
+             "desc": {"name": "all", "tasks": [{"id": 0, "request": {
+                 "variants": [{"entries": [
+                     {"name": "cpus", "amount": 0, "policy": "all"}]}]}}]},
+             "n_tasks": 1})
+    j.write({"time": 102.0, "event": "task-started", "job": 1, "task": 0,
+             "workers": [1]})
+    j.write({"time": 103.0, "event": "task-finished", "job": 1, "task": 0})
+    # a 2-node gang occupies both workers whole
+    j.write({"time": 104.0, "event": "job-submitted", "job": 2,
+             "desc": {"name": "gang", "tasks": [{"id": 0, "request": {
+                 "variants": [{"n_nodes": 2}]}}]}, "n_tasks": 1})
+    j.write({"time": 105.0, "event": "task-started", "job": 2, "task": 0,
+             "workers": [1, 2]})
+    # worker 2 dies while the gang runs; the gang restarts
+    j.write({"time": 106.0, "event": "worker-lost", "id": 2,
+             "reason": "heartbeat"})
+    j.write({"time": 106.0, "event": "task-restarted", "job": 2, "task": 0})
+    j.close()
+
+    _, _, _, _, util = _collect(path, None, None)
+    trace = util[("cpus: 8", "cpus")]
+    values = [round(v, 3) for _, v in trace]
+    # connects (0, 0), ALL task 8/16, done, gang 16/16, lost-worker
+    # release + pool shrink, restart release — never above 1.0
+    assert max(values) == 1.0
+    assert 0.5 in values          # the ALL task drains one of two workers
+    assert all(v >= 0.0 for v in values)
+    assert values[-1] == 0.0
