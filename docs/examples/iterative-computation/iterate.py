@@ -45,6 +45,9 @@ def main() -> int:
                 job.program(
                     [sys.executable, "-c", SAMPLER],
                     stdout=str(work / f"r{round_no}-{i}.json"),
+                    # keep stderr in the workdir too: the default path
+                    # would litter job-N/ dirs into the caller's cwd
+                    stderr=str(work / f"r{round_no}-{i}.err"),
                 )
             client.wait_for_jobs([client.submit(job)])
             for i in range(8):
