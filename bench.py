@@ -310,9 +310,11 @@ def bench_sharded_probe(args):
 
 
 def _run_extra(cmd_args, env_extra, timeout_s):
-    """Run a bench sub-mode in a subprocess; return its parsed JSON line or
-    a diagnosis dict. Keeps the main artifact intact when the extra wedges
-    (the device evidence must not go stale just because one probe hangs)."""
+    """Run a bench sub-mode in a subprocess, SEQUENTIALLY — concurrent
+    probes contend for the host cores and inflate each other's timings;
+    published numbers must come from an otherwise-idle machine. Returns
+    the parsed JSON line or a diagnosis dict, so a wedged probe becomes
+    a diagnosis in the artifact instead of a hang."""
     import os
     import subprocess
 
@@ -522,18 +524,20 @@ def main() -> None:
                        "--tasks", str(args.tasks)]
         if on_cpu:
             kernel_args.append("--cpu")
+        probe_flags = "--xla_force_host_platform_device_count=8"
+        existing_flags = os.environ.get("XLA_FLAGS", "")
         # parent timeout must outlast the child's own 480s SIGALRM wedge
         # watchdog, or the diagnosis JSON is killed before it prints
         result["kernel"] = _run_extra(kernel_args, {}, timeout_s=600)
-        probe_flags = "--xla_force_host_platform_device_count=8"
-        existing_flags = os.environ.get("XLA_FLAGS", "")
+        result["kernel"].setdefault("repeats", 10)
         result["sharded_probe"] = _run_extra(
             ["--sharded-probe", "--repeats", "5"],
             {"JAX_PLATFORMS": "cpu",
              "XLA_FLAGS": f"{existing_flags} {probe_flags}".strip(),
              "PALLAS_AXON_POOL_IPS": None},
-            timeout_s=480,
+            timeout_s=600,
         )
+        result["sharded_probe"].setdefault("repeats", 5)
     print(json.dumps(result))
     print(
         f"# device={device.platform} assigned={n_assigned} "
