@@ -184,6 +184,14 @@ def render_jobs(data: DashboardData, selected: int, width: int = 78,
                 f"{k}={v}" for k, v in c.items() if v
             )
         )
+        # running-task timeline for the job (reference job timeline
+        # chart), from the data layer's span history so restarted
+        # instances count like on the worker-detail screen
+        series = data.job_running_series(job.job_id)
+        if series:
+            lines.append(
+                "  running over time: " + _sparkline(series, width - 22)
+            )
         recent = sorted(
             job.tasks.items(),
             key=lambda kv: -(kv[1].finished_at or kv[1].started_at),

@@ -51,22 +51,27 @@ class WorkerState:
 
     def running_series(self) -> list[tuple[float, float]]:
         """(t, concurrent running tasks) step series from the span history."""
-        deltas: list[tuple[float, int]] = []
-        for span in self.task_history:
-            deltas.append((span.started_at, +1))
-            if span.ended_at:
-                deltas.append((span.ended_at, -1))
-        series, n = [], 0
-        # starts before ends at equal timestamps (-d): a zero-duration
-        # span must never dip the count negative
-        for t, d in sorted(deltas, key=lambda td: (td[0], -td[1])):
-            n += d
-            series.append((t, float(n)))
-        return series
+        return fold_spans(self.task_history)
 
     @property
     def is_connected(self) -> bool:
         return self.lost_at == 0.0
+
+
+def fold_spans(spans) -> list[tuple[float, float]]:
+    """TaskSpans -> (t, concurrent count) step series. Starts sort before
+    ends at equal timestamps (-d) so a zero-duration span never dips the
+    count negative."""
+    deltas: list[tuple[float, int]] = []
+    for span in spans:
+        deltas.append((span.started_at, +1))
+        if span.ended_at:
+            deltas.append((span.ended_at, -1))
+    series, n = [], 0
+    for t, d in sorted(deltas, key=lambda td: (td[0], -td[1])):
+        n += d
+        series.append((t, float(n)))
+    return series
 
 
 @dataclass
@@ -301,6 +306,17 @@ class DashboardData:
     def _mark_worker_count(self, t: float) -> None:
         n = sum(1 for w in self.workers.values() if w.is_connected)
         self.worker_series.append((t, n))
+
+    def job_running_series(self, job_id: int) -> list[tuple[float, float]]:
+        """(t, running tasks) series for ONE job, from the per-worker span
+        history — restart-aware (every instance's span counts), so the
+        jobs screen agrees with the worker-detail timelines."""
+        return fold_spans(
+            span
+            for w in self.workers.values()
+            for span in w.task_history
+            if span.job_id == job_id
+        )
 
     # ------------------------------------------------------------------
     def at(self, t: float) -> "DashboardData":

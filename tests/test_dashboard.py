@@ -308,3 +308,31 @@ def test_autoalloc_allocation_drilldown():
     assert "waited" in screen and "ran" in screen
     assert "worker #1 n0" in screen and "worker #2 n1" in screen
     assert "done=1" in screen
+
+
+def test_jobs_screen_running_timeline():
+    """The selected job shows a running-tasks-over-time sparkline
+    (reference job timeline chart), restart-aware: the FIRST run of a
+    restarted task still counts in the series."""
+    data = sample_data()
+    screen = "\n".join(render_jobs(data, 0))
+    assert "running over time" in screen
+    series = data.job_running_series(1)
+    # t=103 started, t=104 started, t=105 finished, t=107 failed
+    assert max(n for _, n in series) == 2
+
+    restarted = DashboardData()
+    feed(
+        restarted,
+        {"event": "worker-connected", "id": 1, "hostname": "n",
+         "group": "g"},
+        {"event": "job-submitted", "job": 1, "desc": {"name": "r"},
+         "n_tasks": 1},
+        {"event": "task-started", "job": 1, "task": 0, "workers": [1]},
+        {"event": "task-restarted", "job": 1, "task": 0},
+        {"event": "task-started", "job": 1, "task": 0, "workers": [1]},
+        {"event": "task-finished", "job": 1, "task": 0},
+    )
+    series = restarted.job_running_series(1)
+    # both instances' spans appear: run, gap at restart, run again, done
+    assert [n for _, n in series] == [1.0, 0.0, 1.0, 0.0]
