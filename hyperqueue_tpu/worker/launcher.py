@@ -129,8 +129,12 @@ async def launch_task(
                 env["HQ_CPUS"] = value
                 # CPU pinning hint for OpenMP-style programs (reference
                 # program.rs:350 additionally taskset-pins; we export the
-                # portable subset)
-                env["OMP_NUM_THREADS"] = str(max(len(claim.indices), 1))
+                # portable subset). A user-supplied --env OMP_NUM_THREADS
+                # wins (reference test_do_not_override_set_omp_num_threads)
+                if "OMP_NUM_THREADS" not in (body.get("env") or {}):
+                    env["OMP_NUM_THREADS"] = str(
+                        max(len(claim.indices), 1)
+                    )
 
     cleanup_dirs: list[str] = []
 

@@ -1,0 +1,131 @@
+"""CPU allocation, OMP/taskset pinning, and task-listing corners.
+
+Reference: tests/test_cpus.py (OMP_NUM_THREADS defaulting and user
+override, HQ_CPUS + --pin taskset/omp) and tests/test_task.py (task
+list/info selectors).
+"""
+
+import json
+import shutil
+
+import pytest
+
+from utils_e2e import HqEnv
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _started(env, cpus=4):
+    env.start_server()
+    env.start_worker(cpus=cpus)
+    env.wait_workers(1)
+
+
+def test_omp_num_threads_set_from_cpus(env, tmp_path):
+    """test_cpus.py test_set_omp_num_threads: the claimed cpu count."""
+    _started(env)
+    env.command(["submit", "--cpus", "4", "--wait", "--",
+                 "bash", "-c", "echo $OMP_NUM_THREADS"])
+    out = (env.work_dir / "job-1" / "0.stdout").read_text()
+    assert int(out) == 4
+
+
+def test_omp_num_threads_user_env_wins(env):
+    """test_cpus.py test_do_not_override_set_omp_num_threads: an explicit
+    --env OMP_NUM_THREADS survives the launcher's default."""
+    _started(env)
+    env.command(["submit", "--cpus", "4", "--env", "OMP_NUM_THREADS=100",
+                 "--wait", "--", "bash", "-c", "echo $OMP_NUM_THREADS"])
+    out = (env.work_dir / "job-1" / "0.stdout").read_text()
+    assert int(out) == 100
+
+
+@pytest.mark.skipif(shutil.which("taskset") is None, reason="no taskset")
+@pytest.mark.skipif(
+    len(__import__("os").sched_getaffinity(0)) < 2,
+    reason="host is pre-pinned to <2 cpus (reference RUNNING_IN_CI skip)",
+)
+def test_pin_taskset_affinity_matches_hq_cpus(env):
+    """test_cpus.py test_job_pin_taskset: the process affinity equals the
+    claimed HQ_CPUS indices."""
+    _started(env, cpus=2)
+    env.command(["submit", "--pin", "taskset", "--cpus", "2", "--wait",
+                 "--", "bash", "-c",
+                 "echo $HQ_CPUS; taskset -c -p $$; echo $HQ_PIN"])
+    lines = (env.work_dir / "job-1" / "0.stdout").read_text().splitlines()
+
+    def cpu_set(spec: str) -> set[int]:
+        out: set[int] = set()
+        for part in spec.split(","):
+            if "-" in part:
+                lo, hi = part.split("-")
+                out.update(range(int(lo), int(hi) + 1))
+            else:
+                out.add(int(part))
+        return out
+
+    hq_cpus = cpu_set(lines[0])
+    affinity = cpu_set(lines[1].rstrip().split(" ")[-1])
+    assert hq_cpus == affinity
+    assert lines[2] == "taskset"
+
+
+def test_pin_omp_places(env):
+    """test_cpus.py test_job_pin_openmp: OMP_PLACES lists the claimed
+    indices, OMP_PROC_BIND binds close."""
+    _started(env, cpus=2)
+    env.command(["submit", "--pin", "omp", "--cpus", "2", "--wait",
+                 "--", "bash", "-c", "echo $OMP_PLACES; echo $OMP_PROC_BIND"])
+    lines = (env.work_dir / "job-1" / "0.stdout").read_text().splitlines()
+    assert lines[0].startswith("{") and lines[0].endswith("}")
+    numbers = sorted(
+        int(n) for n in lines[0].replace("{", " ").replace("}", " ")
+        .replace(",", " ").split()
+    )
+    assert numbers == [0, 1]
+    assert lines[1] == "close"
+
+
+def test_task_list_single_and_multi(env):
+    """test_task.py test_task_list_single/multi: per-job grouping over a
+    job-id selector, every task with state and empty error."""
+    _started(env)
+    env.command(["submit", "--array", "5-10", "--wait", "--", "true"])
+    env.command(["submit", "--array", "0-3", "--wait", "--", "true"])
+    listing = json.loads(
+        env.command(["task", "list", "1-2", "--output-mode", "json"])
+    )
+    assert [entry["job"] for entry in listing] == [1, 2]
+    assert sorted(t["id"] for t in listing[0]["tasks"]) == [5, 6, 7, 8, 9, 10]
+    assert sorted(t["id"] for t in listing[1]["tasks"]) == [0, 1, 2, 3]
+    for entry in listing:
+        assert all(t["status"] == "finished" for t in entry["tasks"])
+        assert all(not t["error"] for t in entry["tasks"])
+
+
+def test_task_info_selectors(env):
+    """test_task.py test_task_info: single id, ranges, the `last` job
+    selector, and a missing task id."""
+    _started(env)
+    env.command(["submit", "--array", "5-7", "--wait", "--", "true"])
+    single = json.loads(
+        env.command(["task", "info", "1", "5", "--output-mode", "json"])
+    )
+    assert [t["id"] for t in single] == [5]
+    ranged = json.loads(
+        env.command(["task", "info", "1", "5-6", "--output-mode", "json"])
+    )
+    assert [t["id"] for t in ranged] == [5, 6]
+    missing = json.loads(
+        env.command(["task", "info", "1", "4", "--output-mode", "json"])
+    )
+    assert missing == []
+    env.command(["submit", "--wait", "--", "true"])
+    last = json.loads(
+        env.command(["task", "info", "last", "0", "--output-mode", "json"])
+    )
+    assert [t["id"] for t in last] == [0]
