@@ -768,3 +768,19 @@ def test_gang_defers_to_any_higher_priority_sn_class():
     env.schedule()
     assert env.state(s) is TaskState.ASSIGNED
     assert env.core.tasks[g].mn_workers == ()
+
+
+def test_default_compact_scheduling():
+    """reference tests/test_server.py test_server_compact_scheduling: the
+    default placement packs small tasks onto few workers (8 one-cpu tasks
+    over 8 four-cpu workers land on exactly 2) instead of spreading."""
+    env = TestEnv()
+    for _ in range(8):
+        env.worker(cpus=4)
+    tasks = env.submit(n=8)
+    env.schedule()
+    assigned = [
+        t for t in env.core.tasks.values() if t.assigned_worker
+    ]
+    assert len(assigned) == len(tasks)  # nothing stranded
+    assert len({t.assigned_worker for t in assigned}) == 2
