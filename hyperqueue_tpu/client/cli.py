@@ -1010,9 +1010,11 @@ def cmd_doc(args) -> None:
 
 
 def cmd_generate_completion(args) -> None:
-    """Emit a bash completion script for the hq CLI (top-level commands,
-    their subcommands, and per-command long options, walked from the real
-    parser tree — reference uses clap_complete)."""
+    """Emit a completion script for the hq CLI (top-level commands, their
+    subcommands, and per-command long options, walked from the real parser
+    tree — reference uses clap_complete with a shell argument). zsh reuses
+    the bash script through bashcompinit; fish gets native complete
+    lines."""
     parser = build_parser()
 
     def sub_actions(p):
@@ -1027,6 +1029,41 @@ def cmd_generate_completion(args) -> None:
 
     subs = sub_actions(parser)
     top_choices = subs[0].choices if subs else {}
+
+    if args.shell == "fish":
+        lines = [
+            f'complete -c hq -f -n "__fish_use_subcommand" '
+            f'-a "{" ".join(top_choices)}"'
+        ]
+        for name, sub_parser in top_choices.items():
+            nested = sub_actions(sub_parser)
+            if nested:
+                nested_names = " ".join(nested[0].choices)
+                # suggest verbs only until one is typed; afterwards fall
+                # through to per-verb options + default file completion
+                lines.append(
+                    f'complete -c hq -f '
+                    f'-n "__fish_seen_subcommand_from {name}; and not '
+                    f'__fish_seen_subcommand_from {nested_names}" '
+                    f'-a "{nested_names}"'
+                )
+                for nname, nparser in nested[0].choices.items():
+                    for opt in sorted(set(long_opts(nparser))):
+                        lines.append(
+                            f'complete -c hq '
+                            f'-n "__fish_seen_subcommand_from {name}; and '
+                            f'__fish_seen_subcommand_from {nname}" '
+                            f'-l {opt.lstrip("-")}'
+                        )
+            for opt in sorted(set(long_opts(sub_parser))):
+                lines.append(
+                    f'complete -c hq '
+                    f'-n "__fish_seen_subcommand_from {name}" '
+                    f'-l {opt.lstrip("-")}'
+                )
+        print("\n".join(lines))
+        return
+
     lines = [
         "_hq_complete() {",
         '  local cur=${COMP_WORDS[COMP_CWORD]}',
@@ -1077,6 +1114,14 @@ def cmd_generate_completion(args) -> None:
         'complete -o default -F _hq_complete "python -m hyperqueue_tpu"'
         " 2>/dev/null || true",
     ]
+    if args.shell == "zsh":
+        # zsh consumes the bash script through its compatibility layer;
+        # compinit must load first or bashcompinit's complete shim has no
+        # compdef to call
+        lines = [
+            "autoload -U +X compinit && compinit",
+            "autoload -U +X bashcompinit && bashcompinit",
+        ] + lines
     print("\n".join(lines))
 
 
@@ -1811,8 +1856,11 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("topic", nargs="?", default=None)
     p.set_defaults(fn=cmd_doc)
-    p = sub.add_parser("generate-completion", help="bash completion script")
+    p = sub.add_parser("generate-completion",
+                       help="shell completion script")
     _add_common(p)
+    p.add_argument("shell", nargs="?", default="bash",
+                   choices=["bash", "zsh", "fish"])
     p.set_defaults(fn=cmd_generate_completion)
 
     return parser

@@ -82,6 +82,15 @@ def test_doc_and_completion_cli(capsys):
     out = capsys.readouterr().out
     assert "_hq_complete" in out
     assert "submit" in out
+    # zsh wraps the bash script via bashcompinit; fish gets native lines
+    main(["generate-completion", "zsh"])
+    out = capsys.readouterr().out
+    assert out.startswith("autoload -U +X compinit")
+    assert "bashcompinit" in out and "_hq_complete" in out
+    main(["generate-completion", "fish"])
+    out = capsys.readouterr().out
+    assert "__fish_use_subcommand" in out
+    assert '__fish_seen_subcommand_from job' in out
 
 
 def test_journal_report_analytics(tmp_path):
