@@ -173,6 +173,186 @@ def build_tick_state(n_workers=1024, n_tasks=1_000_000, n_classes=128,
     return queues, worker_rows, rq_map, resource_map, priority_of
 
 
+def build_core_state(n_workers=1024, n_tasks=1_000_000, n_classes=128,
+                     seed=42):
+    """Server-Core-backed tick state: real Worker objects (the dirty-
+    tracking epoch lives on them), interned rq classes and populated
+    TaskQueues — the state `reactor.schedule` actually ticks over, so the
+    incremental snapshot cache (scheduler/tick_cache.py) is exercised
+    exactly as in production."""
+    from hyperqueue_tpu.ids import make_task_id, task_id_task
+    from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT as U
+    from hyperqueue_tpu.resources.descriptor import (
+        ResourceDescriptor,
+        ResourceDescriptorItem,
+    )
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.server.core import Core
+    from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+
+    rng = np.random.default_rng(seed)
+    core = Core()
+    cpus = core.resource_map.get_or_create("cpus")
+    gpus = core.resource_map.get_or_create("gpus")
+    mem = core.resource_map.get_or_create("mem")
+
+    rq_ids = []
+    for _ in range(n_classes):
+        n_cpus = int(rng.choice([1, 2, 4, 8]))
+        entries = [ResourceRequestEntry(cpus, n_cpus * U)]
+        if rng.random() < 0.3:
+            entries.append(
+                ResourceRequestEntry(gpus, int(rng.choice([U // 2, U])))
+            )
+        entries.append(
+            ResourceRequestEntry(mem, int(rng.choice([1, 4, 16])) * U)
+        )
+        primary = ResourceRequest(entries=tuple(sorted(
+            entries, key=lambda e: e.resource_id)))
+        if rng.random() < 0.5:
+            fallback = ResourceRequest(entries=(
+                ResourceRequestEntry(cpus, 2 * n_cpus * U),
+                ResourceRequestEntry(mem, primary.entries[-1].amount),
+            ))
+            rqv = ResourceRequestVariants(variants=(primary, fallback))
+        else:
+            rqv = ResourceRequestVariants.single(primary)
+        rq_ids.append(core.intern_rqv(rqv))
+
+    class_of = rng.integers(0, n_classes, size=n_tasks)
+    prio_of = rng.integers(0, 4, size=n_tasks)
+    for t in range(n_tasks):
+        core.queues.add(rq_ids[class_of[t]], (int(prio_of[t]), 0),
+                        make_task_id(1, t))
+
+    for _ in range(n_workers):
+        n_cpus = int(rng.choice([32, 64, 128]))
+        items = [ResourceDescriptorItem.range("cpus", 0, n_cpus - 1)]
+        n_gpus = int(rng.choice([0, 0, 0, 4, 8]))
+        if n_gpus:
+            items.append(ResourceDescriptorItem.list(
+                "gpus", [str(i) for i in range(n_gpus)]
+            ))
+        items.append(ResourceDescriptorItem.sum(
+            "mem", int(rng.choice([256, 512, 1024])) * U
+        ))
+        config = WorkerConfiguration(
+            descriptor=ResourceDescriptor(items=tuple(items))
+        )
+        worker = Worker.create(
+            core.worker_id_counter.next(), config, core.resource_map
+        )
+        core.workers[worker.worker_id] = worker
+
+    def priority_of(task_id):
+        return (int(prio_of[task_id_task(task_id)]), 0)
+
+    return core, rq_ids, priority_of
+
+
+def bench_phases(args, on_cpu, scratch=False):
+    """Per-phase tick breakdown over the production Core state.
+
+    Each measured tick runs: snapshot (cache sync or from-scratch
+    WorkerRows with --scratch) -> batches -> run_tick (assemble /
+    solve-dispatch / device-sync / mapping) -> apply (worker resource
+    accounting, marking rows dirty like the reactor does).  Between reps
+    the assignments are reverted OUTSIDE the timed span so every rep
+    solves the same steady heavy-load tick.
+    """
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+    from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
+
+    core, _rq_ids, priority_of = build_core_state(
+        n_workers=args.workers, n_tasks=args.tasks,
+        n_classes=args.classes,
+    )
+    model = GreedyCutScanModel(backend="numpy" if on_cpu else "auto")
+    if not on_cpu:
+        from hyperqueue_tpu.models.greedy import device_sync_ms
+
+        device_sync_ms(wait_s=45)
+
+    import gc
+
+    gc.collect()
+    gc.set_threshold(100_000, 50, 25)
+
+    def one_tick(phases):
+        t0 = time.perf_counter()
+        if scratch:
+            rows = core.worker_rows()
+            snap = None
+        else:
+            rows = None
+            snap = core.tick_cache.sync(core)
+        t1 = time.perf_counter()
+        phases["snapshot"] = (t1 - t0) * 1e3
+        batches = create_batches(core.queues)
+        t2 = time.perf_counter()
+        phases["batches"] = (t2 - t1) * 1e3
+        assignments = run_tick(
+            core.queues, rows, core.rq_map, core.resource_map, model,
+            batches=batches, dense=snap, phases=phases,
+            key_cache=None if scratch else core.tick_cache,
+        )
+        t3 = time.perf_counter()
+        for task_id, worker_id, rq_id, variant in assignments:
+            worker = core.workers[worker_id]
+            worker.assign(
+                task_id, core.variant_amounts(rq_id, variant, worker)
+            )
+        phases["apply"] = (time.perf_counter() - t3) * 1e3
+        phases["total"] = (time.perf_counter() - t0) * 1e3
+        return assignments
+
+    def restore(assignments):
+        for task_id, worker_id, rq_id, variant in assignments:
+            worker = core.workers[worker_id]
+            worker.unassign(
+                task_id, core.variant_amounts(rq_id, variant, worker)
+            )
+            core.queues.add(rq_id, priority_of(task_id), task_id)
+
+    warm = one_tick({})  # compile + first-population of every cache
+    n_assigned = len(warm)
+    restore(warm)
+    rebuilds_after_warm = core.tick_cache.full_rebuilds
+    shapes_after_warm = model.shape_allocations
+
+    reps = []
+    for _ in range(args.repeats):
+        phases: dict = {}
+        out = one_tick(phases)
+        reps.append(phases)
+        restore(out)
+
+    keys = sorted({k for p in reps for k in p})
+    medians = {
+        k: float(np.median([p.get(k, 0.0) for p in reps])) for k in keys
+    }
+    steady_rebuilds = core.tick_cache.full_rebuilds - rebuilds_after_warm
+    steady_shapes = model.shape_allocations - shapes_after_warm
+    host_ms = sum(
+        medians.get(k, 0.0)
+        for k in ("snapshot", "batches", "assemble", "mapping", "apply")
+    )
+    return {
+        "phases_ms": {k: round(v, 3) for k, v in medians.items()},
+        "host_ms": round(host_ms, 3),
+        "n_assigned": n_assigned,
+        "steady_full_rebuilds": steady_rebuilds,
+        "steady_shape_allocations": steady_shapes,
+        "cache": core.tick_cache.counters(),
+        "backend": model.last_backend,
+        "mode": "scratch" if scratch else "incremental",
+    }
+
+
 def bench_full_tick(args, on_cpu):
     from hyperqueue_tpu.models.greedy import GreedyCutScanModel
     from hyperqueue_tpu.scheduler.tick import run_tick
@@ -347,6 +527,65 @@ def _run_extra(cmd_args, env_extra, timeout_s):
     return {"error": "no JSON line", "stdout": (done.stdout or "")[-300:]}
 
 
+def run_smoke() -> None:
+    """Small-shape CPU gate, runnable inside tier-1: asserts the per-phase
+    breakdown accounts for the wall tick time, that steady-state ticks
+    perform zero full (W, R) rebuilds and zero new solver shape
+    allocations (i.e. no recompilation), and that the incremental
+    assembly is bit-identical to from-scratch on this state."""
+    import argparse as _argparse
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    small = _argparse.Namespace(workers=16, tasks=2000, classes=8, repeats=5)
+    res = bench_phases(small, on_cpu=True)
+    failures = []
+    if res["steady_full_rebuilds"] != 0:
+        failures.append(
+            f"steady-state ticks performed "
+            f"{res['steady_full_rebuilds']} full (W, R) rebuilds"
+        )
+    if res["steady_shape_allocations"] != 0:
+        failures.append(
+            f"steady-state ticks allocated "
+            f"{res['steady_shape_allocations']} new solver shapes "
+            "(would recompile on the jit path)"
+        )
+    ph = res["phases_ms"]
+    total = ph.get("total", 0.0)
+    parts = sum(v for k, v in ph.items() if k != "total")
+    if abs(parts - total) > max(0.35 * total, 0.5):
+        failures.append(
+            f"phase breakdown ({parts:.3f} ms) does not account for the "
+            f"wall tick time ({total:.3f} ms)"
+        )
+
+    # incremental-vs-scratch bit-identity on a fresh state (the same
+    # check `--paranoid-tick` runs in production)
+    from hyperqueue_tpu.scheduler.tick import create_batches
+    from hyperqueue_tpu.scheduler.tick_cache import paranoid_check
+
+    core, _rq_ids, _prio = build_core_state(
+        n_workers=16, n_tasks=2000, n_classes=8
+    )
+    snap = core.tick_cache.sync(core)
+    batches = create_batches(core.queues)
+    try:
+        paranoid_check(core, snap, batches, core.rq_map, core.resource_map)
+    except AssertionError as e:
+        failures.append(f"paranoid check failed: {e}")
+
+    print(json.dumps({
+        "metric": "smoke_tick",
+        "ok": not failures,
+        "failures": failures,
+        **{k: res[k] for k in ("phases_ms", "host_ms", "n_assigned",
+                               "backend", "cache")},
+    }))
+    sys.exit(1 if failures else 0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
@@ -356,11 +595,28 @@ def main() -> None:
                         help="virtual-8-device sharded solve at W=8192 "
                              "(set JAX_PLATFORMS=cpu + "
                              "xla_force_host_platform_device_count=8)")
+    parser.add_argument("--phases", action="store_true",
+                        help="per-phase tick latency breakdown over the "
+                             "production Core state (incremental snapshot "
+                             "cache engaged)")
+    parser.add_argument("--scratch", action="store_true",
+                        help="with --phases: force the legacy from-scratch "
+                             "snapshot path (the pre-cache baseline)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="small-shape CPU gate: phase breakdown sums to "
+                             "wall time, zero steady-state rebuilds/"
+                             "recompiles, incremental == scratch")
+    parser.add_argument("--classes", type=int, default=128,
+                        help="distinct request classes for --phases")
     parser.add_argument("--workers", type=int, default=None,
                         help="default 1024 (8192 for --sharded-probe)")
     parser.add_argument("--tasks", type=int, default=1_000_000)
     parser.add_argument("--repeats", type=int, default=30)
     args = parser.parse_args()
+
+    if args.smoke:
+        run_smoke()
+        return
 
     if args.workers is None:
         args.workers = 8192 if args.sharded_probe else 1024
@@ -464,6 +720,26 @@ def main() -> None:
 
     on_cpu = args.cpu or device_fallback or jax.default_backend() == "cpu"
     device = jax.devices()[0]
+
+    if args.phases:
+        res = bench_phases(args, on_cpu, scratch=args.scratch)
+        if watchdog:
+            signal.alarm(0)
+        print(json.dumps({
+            "metric": "tick_phases_1M_tasks_x_1k_workers",
+            "value": res["host_ms"],
+            "unit": "ms-host",
+            "vs_baseline": round(BASELINE_MS / max(res["host_ms"], 1e-9), 2),
+            "device": device.platform,
+            **res,
+        }))
+        print(
+            f"# phases mode={res['mode']} host={res['host_ms']:.2f}ms "
+            f"assigned={res['n_assigned']} "
+            f"rebuilds={res['steady_full_rebuilds']}",
+            file=sys.stderr,
+        )
+        return
 
     solve_backend = None
     if args.kernel:

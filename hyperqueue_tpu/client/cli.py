@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 from pathlib import Path
@@ -61,20 +62,26 @@ def parse_selector(text: str, last_id: int | None = None) -> list[int]:
         return [last_id]
     ids: list[int] = []
     # underscore separators are readability sugar: 1-1000_000 == 1-1000000
-    # (reference cli/shortcuts.md); steps via <start>-<end>:<step>
-    for part in text.replace("_", "").split(","):
-        part = part.strip()
-        if "-" in part:
-            step = 1
-            if ":" in part:
-                part, step_s = part.rsplit(":", 1)
-                step = int(step_s)
-                if step <= 0:
-                    fail(f"selector step must be positive: {text!r}")
-            lo, hi = part.split("-", 1)
-            ids.extend(range(int(lo), int(hi) + 1, step))
-        elif part:
-            ids.append(int(part))
+    # (reference cli/shortcuts.md); steps via <start>-<end>:<step>.  Only
+    # underscores BETWEEN digits are digit grouping — stripping them all
+    # made typos like "_5" or "5_" silently parse
+    cleaned = re.sub(r"(?<=\d)_(?=\d)", "", text)
+    try:
+        for part in cleaned.split(","):
+            part = part.strip()
+            if "-" in part:
+                step = 1
+                if ":" in part:
+                    part, step_s = part.rsplit(":", 1)
+                    step = int(step_s)
+                    if step <= 0:
+                        fail(f"selector step must be positive: {text!r}")
+                lo, hi = part.split("-", 1)
+                ids.extend(range(int(lo), int(hi) + 1, step))
+            elif part:
+                ids.append(int(part))
+    except ValueError:
+        fail(f"invalid selector: {text!r}")
     return ids
 
 
@@ -96,11 +103,14 @@ def cmd_server_start(args) -> None:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
 
-    # Enforce the scheduler's JAX platform via jax.config: site preloads may
-    # hard-set the platform (e.g. a TPU plugin overriding jax_platforms after
-    # reading its own env), which both ignores JAX_PLATFORMS=cpu and makes
-    # every test server contend for one real TPU chip.
-    import jax
+    # Enforce the scheduler's JAX platform: site preloads may hard-set the
+    # platform (e.g. a TPU plugin overriding jax_platforms after reading
+    # its own env), which both ignores JAX_PLATFORMS=cpu and makes every
+    # test server contend for one real TPU chip.  jax itself is imported
+    # lazily by the solver (ops/assign._load_jax) — when it has NOT been
+    # preloaded, setting the env var suffices and the server start avoids
+    # the multi-second jax import on the cpu path entirely.
+    import sys as _sys
 
     if args.scheduler == "tpu":
         pass  # keep the environment default (the TPU platform)
@@ -108,7 +118,11 @@ def cmd_server_start(args) -> None:
         args.scheduler in ("cpu", "milp")
         or os.environ.get("JAX_PLATFORMS") == "cpu"
     ):
-        jax.config.update("jax_platforms", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "jax" in _sys.modules:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
 
     from hyperqueue_tpu.server.bootstrap import Server
 
@@ -127,6 +141,7 @@ def cmd_server_start(args) -> None:
             idle_timeout=args.idle_timeout,
             journal_flush_period=args.journal_flush_period,
             access_file=Path(args.access_file) if args.access_file else None,
+            paranoid_tick=args.paranoid_tick,
         )
         access = await server.start()
         print(
@@ -158,6 +173,39 @@ def cmd_server_info(args) -> None:
         info = session.request({"op": "server_info"})
     info.pop("op", None)
     make_output(args.output_mode).record(info)
+
+
+def cmd_server_stats(args) -> None:
+    """Per-phase tick latency breakdown + incremental-cache counters."""
+    with _session(args) as session:
+        stats = session.request({"op": "server_stats"})
+    stats.pop("op", None)
+    if args.output_mode != "cli":
+        make_output(args.output_mode).record(stats)
+        return
+    tick = stats.get("tick") or {}
+    print(f"scheduler: {stats.get('scheduler')} "
+          f"(backend {stats.get('solve_backend')})")
+    print(f"ticks: {tick.get('ticks', 0)}")
+    phase_rows = tick.get("phases") or {}
+    if phase_rows:
+        print(f"{'phase':<16}{'mean ms':>10}{'last ms':>10}{'max ms':>10}")
+        for name, row in phase_rows.items():
+            print(f"{name:<16}{row['mean_ms']:>10.3f}"
+                  f"{row['last_ms']:>10.3f}{row['max_ms']:>10.3f}")
+    cache = stats.get("tick_cache") or {}
+    print(
+        "tick cache: "
+        f"{cache.get('workers', 0)} workers x "
+        f"{cache.get('resources', 0)} resources, "
+        f"{cache.get('full_rebuilds', 0)} full rebuilds, "
+        f"{cache.get('incremental_syncs', 0)} incremental syncs "
+        f"({cache.get('rows_rewritten_last', 0)} rows rewritten last tick)"
+    )
+    if stats.get("shape_allocations") is not None:
+        print(f"solver shape allocations: {stats['shape_allocations']}")
+    if stats.get("paranoid_tick"):
+        print(f"paranoid-tick: every {stats['paranoid_tick']} ticks")
 
 
 def cmd_server_generate_access(args) -> None:
@@ -633,12 +681,29 @@ def _check_submit_placeholders(args, is_array: bool) -> None:
     unknown placeholders and an array job whose output paths lack
     %{TASK_ID} get loud warnings (the tasks would clobber one file).
     Warnings go to stderr so --output-mode quiet/json stdout stays
-    machine-parseable."""
-    import re
+    machine-parseable.
 
+    A TASK-scope placeholder (%{TASK_ID}, %{INSTANCE_ID}, %{CWD}) in a
+    --stream path is a hard error: the stream dir is shared by the whole
+    job, the worker only expands job-scope placeholders there, and the
+    unexpanded text would become a literal directory name shared by every
+    task (reference behavior; regression-pinned in
+    tests/test_tick_cache.py)."""
     pattern = re.compile(r"%\{([^}]*)\}")
     if args.cwd and "%{CWD}" in args.cwd:
         fail("--cwd cannot contain the %{CWD} placeholder")
+    if args.stream:
+        task_scope = sorted(
+            set(pattern.findall(args.stream))
+            & (_KNOWN_PLACEHOLDERS - _STREAM_PLACEHOLDERS)
+        )
+        if task_scope:
+            plural = "s" if len(task_scope) > 1 else ""
+            fail(
+                f"--stream path cannot contain task-scope placeholder"
+                f"{plural} {', '.join('%{' + p + '}' for p in task_scope)}:"
+                f" the stream directory is shared by the whole job"
+            )
     for label, value, known in (
         ("stdout", args.stdout, _KNOWN_PLACEHOLDERS),
         ("stderr", args.stderr, _KNOWN_PLACEHOLDERS),
@@ -665,6 +730,34 @@ def _check_submit_placeholders(args, is_array: bool) -> None:
                       f"%{{TASK_ID}} placeholder — tasks will overwrite "
                       f"each other's output. Consider adding %{{TASK_ID}} "
                       f"to --{channel}.", file=sys.stderr)
+
+
+def _subset_array_entries(
+    task_ids: list[int] | None, entry_values: list[str]
+) -> tuple[list[int], list[str]]:
+    """--array selects a SUBSET of --each-line/--from-json entries: task
+    id = entry index (0-based).  Ids beyond the entry count are removed —
+    loudly, and an empty intersection is an error (a typo'd selector must
+    not submit zero tasks silently; reference docs/jobs/arrays.md
+    "Combining --each-line/--from-json with --array").  `--array all`
+    parses to [] = every id, i.e. every entry."""
+    if not task_ids:
+        return list(range(len(entry_values))), entry_values
+    ids = [i for i in task_ids if 0 <= i < len(entry_values)]
+    dropped = len(task_ids) - len(ids)
+    if not ids:
+        fail(
+            f"--array selects no tasks: all {len(task_ids)} ids fall "
+            f"outside the {len(entry_values)} provided entries "
+            f"(valid ids: 0-{len(entry_values) - 1})"
+        )
+    if dropped:
+        print(
+            f"WARNING: {dropped} --array id(s) outside the "
+            f"{len(entry_values)} provided entries were dropped",
+            file=sys.stderr,
+        )
+    return ids, [entry_values[i] for i in ids]
 
 
 def cmd_submit(args) -> None:
@@ -720,16 +813,7 @@ def cmd_submit(args) -> None:
         "max_fails": args.max_fails,
     }
     if entry_values is not None:
-        # --array selects a SUBSET of lines/items: task id = entry index
-        # (0-based), ids beyond the entry count are silently removed
-        # (reference docs/jobs/arrays.md "Combining --each-line/--from-json
-        # with --array"; submit/command.rs entry subsetting). `--array all`
-        # parses to [] = every id, i.e. every entry.
-        if task_ids:
-            ids = [i for i in task_ids if 0 <= i < len(entry_values)]
-            entry_values = [entry_values[i] for i in ids]
-        else:
-            ids = list(range(len(entry_values)))
+        ids, entry_values = _subset_array_entries(task_ids, entry_values)
         job_desc["array"] = {
             "ids": ids, "entries": entry_values, "body": body_base,
             "request": request, "priority": args.priority,
@@ -1509,6 +1593,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "none of their own")
     p.add_argument("--access-file", default=None,
                    help="start with pre-shared keys/ports from generate-access")
+    p.add_argument("--paranoid-tick", type=int, default=0, metavar="N",
+                   help="debug: every N ticks, run the incremental and the "
+                        "from-scratch tick assembly and assert they are "
+                        "bit-identical (0 = off)")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)
@@ -1516,6 +1604,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = ssub.add_parser("info")
     _add_common(p)
     p.set_defaults(fn=cmd_server_info)
+    p = ssub.add_parser(
+        "stats", help="scheduler telemetry: per-phase tick latency "
+                      "breakdown + snapshot-cache counters"
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_server_stats)
     p = ssub.add_parser("debug-dump", help="full server state as JSON")
     _add_common(p)
     p.set_defaults(fn=cmd_server_debug_dump)

@@ -25,7 +25,10 @@ OR-variants, described declaratively:
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: the API-compatible backport
+    import tomli as tomllib
 from pathlib import Path
 
 from hyperqueue_tpu.resources.amount import amount_from_str

@@ -42,11 +42,27 @@ from __future__ import annotations
 
 import functools
 
-import jax
-import jax.numpy as jnp
-
 # INF_TIME is re-exported here for kernel callers/tests
 from hyperqueue_tpu.utils.constants import INF_TIME  # noqa: F401
+
+# jax is imported LAZILY: the host-side functions in this module
+# (host_visit_classes, scarcity_weights, greedy_cut_scan_numpy) are pure
+# numpy and serve the CPU production path, where pulling in jax costs
+# several seconds of server/worker startup per process (measured ~4 s
+# cold).  _load_jax() installs jax/jnp into the module globals the first
+# time a kernel entry point actually runs.
+jax = None
+jnp = None
+
+
+def _load_jax() -> None:
+    global jax, jnp
+    if jax is None:
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        jax = _jax
+        jnp = _jnp
 # Quantization of the waste score into the integer sort key: key =
 # waste_q * W + worker_index, waste_q in [0, _WASTE_Q]. With W <= 16384 the
 # key stays well inside int32.
@@ -119,6 +135,7 @@ def _water_fill_classed(
     position. Returns (assign (W,), assigned_total = min(remaining, total
     capacity) — the global total even when workers are sharded).
     """
+    _load_jax()
     cap_c = cap[:, None] * class_onehot  # (W, C)
     per_class = jnp.sum(cap_c, axis=0)  # (C,)
     if per_class_total is None:
@@ -190,6 +207,7 @@ def expand_onehots(class_m, order_ids):
     XLA from fusing this into the scan body (it would re-gather
     class_m[order_ids[i]] every step — a dynamic row gather costing
     ~140us/step; measured 84ms vs 0.1ms for the whole tick)."""
+    _load_jax()
     class_ids = class_m[order_ids]  # (B, V, W)
     onehots = (
         class_ids[..., None]
@@ -216,6 +234,7 @@ def scan_batches(
     (reference solver.rs:120-124). Returns (counts, free_after,
     nt_free_after).
     """
+    _load_jax()
     n_variants = needs.shape[1]
     has_all = all_mask is not None
 
@@ -274,9 +293,21 @@ def greedy_cut_scan_impl(
     )
 
 
-greedy_cut_scan = functools.partial(jax.jit, donate_argnums=(0, 1))(
-    greedy_cut_scan_impl
-)
+_greedy_cut_scan_jit = None
+
+
+def greedy_cut_scan(*args, **kwargs):
+    """Jitted single-chip kernel (donate_argnums=(0, 1): the free/nt_free
+    device buffers are consumed and their storage reused for the outputs).
+    The jit wrapper is built on first call so importing this module never
+    pulls in jax (see _load_jax)."""
+    global _greedy_cut_scan_jit
+    if _greedy_cut_scan_jit is None:
+        _load_jax()
+        _greedy_cut_scan_jit = functools.partial(
+            jax.jit, donate_argnums=(0, 1)
+        )(greedy_cut_scan_impl)
+    return _greedy_cut_scan_jit(*args, **kwargs)
 
 
 def greedy_cut_scan_numpy(

@@ -37,6 +37,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax moved shard_map out of experimental (and renamed check_rep ->
+# check_vma) across the versions this repo must run on; resolve once here
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 from hyperqueue_tpu.ops.assign import (
     _water_fill_classed,
     expand_onehots,
@@ -148,22 +158,32 @@ def sharded_cut_scan(
             total=t, all_mask=m,
         )
 
-    return jax.shard_map(
+    return _shard_map(
         body,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=(P(None, None, "w"), P("w", None), P("w")),
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )(*args)
+
+
+@functools.lru_cache(maxsize=4)
+def _mesh_shardings(mesh: Mesh):
+    """NamedSharding objects per mesh, built once: the production tick
+    places tensors every solve, and re-constructing shardings per call is
+    avoidable host work on the hot path."""
+    return (
+        NamedSharding(mesh, P("w", None)),
+        NamedSharding(mesh, P("w")),
+        NamedSharding(mesh, P()),
+    )
 
 
 def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
                       min_time, class_m, order_ids, total=None,
                       all_mask=None):
     """Device-put the tick tensors with the proper shardings."""
-    w2 = NamedSharding(mesh, P("w", None))
-    w1 = NamedSharding(mesh, P("w"))
-    rep = NamedSharding(mesh, P())
+    w2, w1, rep = _mesh_shardings(mesh)
     out = (
         jax.device_put(free, w2),
         jax.device_put(nt_free, w1),

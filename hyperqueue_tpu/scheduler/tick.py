@@ -13,6 +13,7 @@ jointly, globally ordered by priority.
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass
 
 import numpy as np
@@ -73,18 +74,14 @@ def create_batches(queues: TaskQueues) -> list[Batch]:
     return batches
 
 
-def _range_compress(
+def _compress_shifts(
     needs: np.ndarray, free: np.ndarray, total: np.ndarray | None = None
-) -> None:
-    """Shift down any resource column whose values exceed int32-safe range.
+) -> list[int]:
+    """Per-column shifts needed to keep every amount float32-exact.
 
-    needs are ceil-shifted (request never shrinks to zero) and free floor-
-    shifted, so feasibility decisions stay sound (never optimistic). When
-    `total` is present (ALL-policy requests in this tick) it shifts with
-    free, and a partially-used pool is kept STRICTLY below its shifted total
-    so the kernel's free == total idle check can never go optimistic.
-    Returns the per-column shifts (callers scaling other cpu-denominated
-    vectors, e.g. cpu_floor, must apply column 0's shift).
+    Pure: reads peaks only, mutates nothing.  All-zero in the common case
+    (amounts below MAX_SAFE_AMOUNT), which lets the incremental assemble
+    hand the model the cache-owned arrays without copying them.
     """
     shifts = [0] * free.shape[1]
     for r in range(free.shape[1]):
@@ -97,34 +94,67 @@ def _range_compress(
         while (peak >> shift) >= MAX_SAFE_AMOUNT:
             shift += 1
         shifts[r] = shift
-        if shift:
-            nonzero = needs[:, :, r] > 0
-            needs[:, :, r] = np.where(
-                nonzero,
-                np.maximum((needs[:, :, r] + (1 << shift) - 1) >> shift, 1),
-                0,
+    return shifts
+
+
+def _apply_compression(
+    shifts: list[int],
+    needs: np.ndarray,
+    free: np.ndarray,
+    total: np.ndarray | None = None,
+) -> None:
+    """Apply precomputed column shifts IN PLACE.
+
+    needs are ceil-shifted (request never shrinks to zero) and free floor-
+    shifted, so feasibility decisions stay sound (never optimistic). When
+    `total` is present (ALL-policy requests in this tick) it shifts with
+    free, and a partially-used pool is kept STRICTLY below its shifted total
+    so the kernel's free == total idle check can never go optimistic.
+    """
+    for r, shift in enumerate(shifts):
+        if not shift:
+            continue
+        nonzero = needs[:, :, r] > 0
+        needs[:, :, r] = np.where(
+            nonzero,
+            np.maximum((needs[:, :, r] + (1 << shift) - 1) >> shift, 1),
+            0,
+        )
+        was_partial = (
+            free[:, r] < total[:, r] if total is not None else None
+        )
+        free[:, r] >>= shift
+        if total is not None:
+            total[:, r] >>= shift
+            np.minimum(
+                free[:, r],
+                np.where(was_partial, total[:, r] - 1, free[:, r]),
+                out=free[:, r],
             )
-            was_partial = (
-                free[:, r] < total[:, r] if total is not None else None
-            )
-            free[:, r] >>= shift
-            if total is not None:
-                total[:, r] >>= shift
-                np.minimum(
-                    free[:, r],
-                    np.where(was_partial, total[:, r] - 1, free[:, r]),
-                    out=free[:, r],
-                )
+
+
+def _range_compress(
+    needs: np.ndarray, free: np.ndarray, total: np.ndarray | None = None
+) -> list[int]:
+    """Shift-compress out-of-range columns in place; returns the shifts
+    (callers scaling other cpu-denominated vectors, e.g. cpu_floor, must
+    apply column 0's shift).  Composition of _compress_shifts +
+    _apply_compression."""
+    shifts = _compress_shifts(needs, free, total)
+    _apply_compression(shifts, needs, free, total)
     return shifts
 
 
 def run_tick(
     queues: TaskQueues,
-    workers: list[WorkerRow],
+    workers: list[WorkerRow] | None,
     rq_map: ResourceRqMap,
     resource_map: ResourceIdMap,
     model,
     batches: list[Batch] | None = None,
+    dense=None,
+    phases: dict | None = None,
+    key_cache=None,
 ) -> list[Assignment]:
     """Solve one tick and pop assigned tasks from the queues.
 
@@ -135,11 +165,24 @@ def run_tick(
     `batches` lets the caller pass a precomputed create_batches(queues)
     result (the reactor builds it once per schedule() and reuses it for the
     prefill phase); the caller's list order is left untouched.
+
+    `dense` (a tick_cache.DenseSnapshot) replaces `workers` with the
+    persistent incremental snapshot: the cache only serves ticks with no
+    min-utilization workers, so the mu carve-out below is skipped
+    structurally.  `phases` (optional dict) collects a per-phase latency
+    breakdown in ms; `key_cache` memoizes sort keys across ticks.
     """
     if batches is None:
         batches = create_batches(queues)
     else:
         batches = list(batches)  # sorted in place below; don't reorder caller
+    if dense is not None:
+        if not batches or not dense.worker_ids:
+            return []
+        return _run_main_solve(
+            queues, None, rq_map, resource_map, model, batches,
+            dense=dense, phases=phases, key_cache=key_cache,
+        )
     if not batches or not workers:
         return []
 
@@ -164,12 +207,14 @@ def run_tick(
                 (max(w.cpu_floor, 0) for w in workers), dtype=np.int64,
                 count=len(workers),
             ),
+            phases=phases, key_cache=key_cache,
         )
     workers = [w for w in workers if w.cpu_floor <= 0]
     if not workers:
         return _solve_mu_workers(queues, mu_workers, rq_map, resource_map)
     assignments = _run_main_solve(
-        queues, workers, rq_map, resource_map, model, batches
+        queues, workers, rq_map, resource_map, model, batches,
+        phases=phases, key_cache=key_cache,
     )
     if mu_workers:
         assignments.extend(
@@ -179,61 +224,83 @@ def run_tick(
 
 
 def assemble_solve_inputs(workers, batches, rq_map, resource_map,
-                          cpu_floor=None):
+                          cpu_floor=None, dense=None, key_cache=None):
     """Build the dense model.solve inputs for `batches` over `workers`.
 
     Sorts `batches` IN PLACE into the production solve order (priority,
-    scarcity, achievable objective) and applies _range_compress so every
+    scarcity, achievable objective) and applies range compression so every
     amount is float32-exact for the jitted kernel.  This is the ONE
     assembly path, used by both the production tick (_run_main_solve) and
     the autoalloc demand query (autoalloc/query.py compute_new_worker_query)
     — sharing it guarantees the demand estimate can never diverge from
     what production would solve.  Returns the kwargs dict for
     model.solve().
+
+    Two input forms, bit-identical by contract (tick_cache.paranoid_check):
+
+    - `workers`: a list of WorkerRow — the from-scratch path, rebuilding
+      the (W, R) arrays from Python lists each call;
+    - `dense`: a tick_cache.DenseSnapshot — the incremental path; the
+      persistent cache arrays are used directly (read-only: copied only
+      when a range-compression shift must mutate them).
+
+    `key_cache` (a TickStateCache, optional) memoizes the per-request-class
+    (scarcity, objective) sort keys across ticks: they are pure in the rq
+    class and this tick's free column totals, which steady-state ticks
+    repeat.
     """
-    n_w = len(workers)
     n_r = len(resource_map)
     n_b = len(batches)
     n_v = max(
         len(rq_map.get_variants(b.rq_id).variants) for b in batches
     )
 
-    free_lists = [row.free for row in workers]
-    if all(len(f) == n_r for f in free_lists):
-        # uniform rows (steady state): one C-level conversion instead of a
-        # per-worker Python fill loop (~1.4 ms at 1k workers)
-        free = np.array(free_lists, dtype=np.int64)
-    else:
-        # a worker's dense row can lag the global resource map right after
-        # a new resource name is interned
-        free = np.zeros((n_w, n_r), dtype=np.int64)
-        for i, f in enumerate(free_lists):
-            free[i, : len(f)] = f
+    from hyperqueue_tpu.resources.request import AllocationPolicy
 
     # ALL-policy requests need the pool totals alongside free (the kernel's
     # idle check); only materialized when some batch actually uses ALL
-    from hyperqueue_tpu.resources.request import AllocationPolicy
-
     has_all = any(
         entry.policy is AllocationPolicy.ALL
         for b in batches
         for variant in rq_map.get_variants(b.rq_id).variants
         for entry in variant.entries
     )
-    total = None
-    if has_all:
-        total = np.zeros((n_w, n_r), dtype=np.int64)
-        for i, row in enumerate(workers):
-            src = row.total if row.total is not None else row.free
-            total[i, : min(len(src), n_r)] = src[:n_r]
-    nt_free = np.fromiter(
-        (row.nt_free if row.nt_free > 0 else 0 for row in workers),
-        dtype=np.int32,
-        count=n_w,
-    )
-    lifetime = np.fromiter(
-        (row.lifetime_secs for row in workers), dtype=np.int32, count=n_w
-    )
+
+    if dense is not None:
+        n_w = len(dense.worker_ids)
+        free = dense.free
+        total = dense.total if has_all else None
+        nt_free = dense.nt_free
+        lifetime = dense.lifetime
+        cache_owns_arrays = True
+    else:
+        n_w = len(workers)
+        free_lists = [row.free for row in workers]
+        if all(len(f) == n_r for f in free_lists):
+            # uniform rows (steady state): one C-level conversion instead
+            # of a per-worker Python fill loop (~1.4 ms at 1k workers)
+            free = np.array(free_lists, dtype=np.int64)
+        else:
+            # a worker's dense row can lag the global resource map right
+            # after a new resource name is interned
+            free = np.zeros((n_w, n_r), dtype=np.int64)
+            for i, f in enumerate(free_lists):
+                free[i, : len(f)] = f
+        total = None
+        if has_all:
+            total = np.zeros((n_w, n_r), dtype=np.int64)
+            for i, row in enumerate(workers):
+                src = row.total if row.total is not None else row.free
+                total[i, : min(len(src), n_r)] = src[:n_r]
+        nt_free = np.fromiter(
+            (row.nt_free if row.nt_free > 0 else 0 for row in workers),
+            dtype=np.int32,
+            count=n_w,
+        )
+        lifetime = np.fromiter(
+            (row.lifetime_secs for row in workers), dtype=np.int32, count=n_w
+        )
+        cache_owns_arrays = False
 
     # Most-constrained-first within a priority level: a class that can ONLY
     # run on scarce resources is placed before same-priority classes with
@@ -252,7 +319,8 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
     # the nt_free clamp above.
     from hyperqueue_tpu.ops.assign import scarcity_weights
 
-    weights = scarcity_weights(np.maximum(free, 0).sum(axis=0))
+    col_totals = np.maximum(free, 0).sum(axis=0)
+    weights = scarcity_weights(col_totals)
 
     def _scarcity(batch: Batch) -> float:
         score = float("inf")
@@ -272,11 +340,22 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 
     # plain Python list: the sort key touches these per batch per entry and
     # numpy scalar indexing is ~10x a list index on this path
-    totals_by_r = np.maximum(free, 0).sum(axis=0).tolist()
+    totals_by_r = col_totals.tolist()
     # the (scarcity, objective) key is pure per request class + this tick's
     # totals; distinct classes per tick << batches (priority levels), so
-    # memoize per rq_id for the sort below
-    _key_cache: dict = {}
+    # memoize per rq_id for the sort below — and ACROSS ticks through
+    # `key_cache` when the totals signature repeats (steady state:
+    # releases and re-assignments cancel out tick-over-tick)
+    sig = (n_w, n_r, tuple(totals_by_r))
+    if key_cache is not None:
+        if key_cache.sort_key_sig == sig:
+            _key_cache = key_cache.sort_keys
+        else:
+            _key_cache = {}
+            key_cache.sort_key_sig = sig
+            key_cache.sort_keys = _key_cache
+    else:
+        _key_cache = {}
 
     def _objective_value(rq_id: int) -> list[tuple[float, float]]:
         """Within equal scarcity, emulate the reference LP objective
@@ -335,60 +414,114 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 
     batches.sort(key=_sort_key, reverse=True)
 
-    needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
-    sizes = np.zeros(n_b, dtype=np.int32)
-    min_time = np.zeros((n_b, n_v), dtype=np.int32)
-    min_time[:] = int(INF_TIME)  # absent variants never eligible
-    all_mask = np.zeros((n_b, n_v, n_r), dtype=np.int32) if has_all else None
-    # dense rows per request class are immutable — cache them on the rq_map
-    # (keyed by the resource-map width, which can grow) instead of
-    # re-walking every entry of every batch each tick
-    cache_key, dense_cache = getattr(rq_map, "_dense_cache", (None, None))
-    if cache_key != n_r:
-        dense_cache = {}
-        rq_map._dense_cache = (n_r, dense_cache)
-    weighted_rows: list[tuple[int, int, np.ndarray]] = []
-    for bi, batch in enumerate(batches):
-        sizes[bi] = min(batch.size, 2**30)
-        row = dense_cache.get(batch.rq_id)
-        if row is None:
-            variants = rq_map.get_variants(batch.rq_id).variants
-            k = len(variants)
-            nd = np.zeros((k, n_r), dtype=np.int64)
-            am = np.zeros((k, n_r), dtype=np.int32)
-            mt = np.empty(k, dtype=np.int32)
-            for vi, variant in enumerate(variants):
-                mt[vi] = min(int(variant.min_time_secs), int(INF_TIME))
-                for entry in variant.entries:
-                    if entry.policy is AllocationPolicy.ALL:
-                        am[vi, entry.resource_id] = 1
-                    else:
-                        nd[vi, entry.resource_id] = entry.amount
-            wt = np.array([v.weight for v in variants], dtype=np.float64)
-            row = (k, nd, am if am.any() else None, mt,
-                   wt if (wt != 1.0).any() else None)
-            dense_cache[batch.rq_id] = row
-        k, nd, am, mt, wt = row
-        needs[bi, :k] = nd
-        min_time[bi, :k] = mt
-        if am is not None and all_mask is not None:
-            all_mask[bi, :k] = am
-        if wt is not None:
-            weighted_rows.append((bi, k, wt))
+    # per-tick sizes always refresh; the batch-shaped LAYOUT arrays
+    # (needs/min_time/all_mask/weights) are pure in the sorted rq-id
+    # sequence and reusable across ticks through `key_cache` — steady
+    # state repeats the sequence exactly
+    sizes = np.fromiter(
+        (b.size if b.size < 2**30 else 2**30 for b in batches),
+        dtype=np.int32, count=n_b,
+    )
+    layout = None
+    layout_sig = None
+    if key_cache is not None:
+        layout_sig = (
+            n_b, n_v, n_r, has_all, tuple(b.rq_id for b in batches)
+        )
+        if key_cache.batch_layout_sig == layout_sig:
+            layout = key_cache.batch_layout
+    if layout is not None:
+        needs = layout["needs64"]
+        min_time = layout["min_time"]
+        all_mask = layout["all_mask"]
+        w_arr = layout["w_arr"]
+        needs_cache_owned = True
+    else:
+        needs = np.zeros((n_b, n_v, n_r), dtype=np.int64)
+        min_time = np.zeros((n_b, n_v), dtype=np.int32)
+        min_time[:] = int(INF_TIME)  # absent variants never eligible
+        all_mask = (
+            np.zeros((n_b, n_v, n_r), dtype=np.int32) if has_all else None
+        )
+        # dense rows per request class are immutable — cache them on the
+        # rq_map (keyed by the resource-map width, which can grow) instead
+        # of re-walking every entry of every batch each tick
+        cache_key, dense_cache = getattr(rq_map, "_dense_cache", (None, None))
+        if cache_key != n_r:
+            dense_cache = {}
+            rq_map._dense_cache = (n_r, dense_cache)
+        weighted_rows: list[tuple[int, int, np.ndarray]] = []
+        for bi, batch in enumerate(batches):
+            row = dense_cache.get(batch.rq_id)
+            if row is None:
+                variants = rq_map.get_variants(batch.rq_id).variants
+                k = len(variants)
+                nd = np.zeros((k, n_r), dtype=np.int64)
+                am = np.zeros((k, n_r), dtype=np.int32)
+                mt = np.empty(k, dtype=np.int32)
+                for vi, variant in enumerate(variants):
+                    mt[vi] = min(int(variant.min_time_secs), int(INF_TIME))
+                    for entry in variant.entries:
+                        if entry.policy is AllocationPolicy.ALL:
+                            am[vi, entry.resource_id] = 1
+                        else:
+                            nd[vi, entry.resource_id] = entry.amount
+                wt = np.array([v.weight for v in variants], dtype=np.float64)
+                row = (k, nd, am if am.any() else None, mt,
+                       wt if (wt != 1.0).any() else None)
+                dense_cache[batch.rq_id] = row
+            k, nd, am, mt, wt = row
+            needs[bi, :k] = nd
+            min_time[bi, :k] = mt
+            if am is not None and all_mask is not None:
+                all_mask[bi, :k] = am
+            if wt is not None:
+                weighted_rows.append((bi, k, wt))
+        w_arr = None
+        if weighted_rows:
+            # request weights (from the dense cache — only classes that
+            # carry a non-default weight appear): the greedy model already
+            # consumed them through the batch-order objective; the MILP
+            # folds them into its own
+            w_arr = np.ones((n_b, n_v), dtype=np.float64)
+            for bi, k, wt in weighted_rows:
+                w_arr[bi, :k] = wt
+        needs_cache_owned = False
+        if key_cache is not None:
+            key_cache.batch_layout_sig = layout_sig
+            key_cache.batch_layout = {
+                "needs64": needs,
+                "min_time": min_time,
+                "all_mask": all_mask,
+                "w_arr": w_arr,
+                "needs32": None,
+            }
+            needs_cache_owned = True  # stored: shifts must copy-on-write
 
-    shifts = _range_compress(needs, free, total)
+    shifts = _compress_shifts(needs, free, total)
+    any_shift = any(shifts)
+    if any_shift:
+        # a shift mutates arrays in place — never the cache-owned
+        # persistent ones (the common no-shift tick copies nothing)
+        if cache_owns_arrays:
+            free = free.copy()
+            if total is not None:
+                total = total.copy()
+        if needs_cache_owned:
+            needs = needs.copy()
+    _apply_compression(shifts, needs, free, total)
     free32 = free.astype(np.int32)
+    if not any_shift and needs_cache_owned and key_cache is not None:
+        needs32 = key_cache.batch_layout["needs32"]
+        if needs32 is None:
+            needs32 = needs.astype(np.int32)
+            key_cache.batch_layout["needs32"] = needs32
+    else:
+        needs32 = needs.astype(np.int32)
     extra = {}
     if all_mask is not None and all_mask.any():
         extra = {"total": total.astype(np.int32), "all_mask": all_mask}
-    if weighted_rows:
-        # request weights (from the dense cache — only classes that carry a
-        # non-default weight appear): the greedy model already consumed
-        # them through the batch-order objective; the MILP folds them into
-        # its own
-        w_arr = np.ones((n_b, n_v), dtype=np.float64)
-        for bi, k, wt in weighted_rows:
-            w_arr[bi, :k] = wt
+    if w_arr is not None:
         extra["weights"] = w_arr
     if cpu_floor is not None:
         # joint mu path (run_tick): if _range_compress shifted the cpu
@@ -402,7 +535,7 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
         "free": free32,
         "nt_free": nt_free,
         "lifetime": lifetime,
-        "needs": needs.astype(np.int32),
+        "needs": needs32,
         "sizes": sizes,
         "min_time": min_time,
         "priorities": [b.priority for b in batches],
@@ -411,71 +544,106 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 
 
 def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
-                    cpu_floor=None):
-    counts = model.solve(
-        **assemble_solve_inputs(
-            workers, batches, rq_map, resource_map, cpu_floor=cpu_floor
-        )
+                    cpu_floor=None, dense=None, phases=None, key_cache=None):
+    _t0 = _time.perf_counter()
+    kwargs = assemble_solve_inputs(
+        workers, batches, rq_map, resource_map, cpu_floor=cpu_floor,
+        dense=dense, key_cache=key_cache,
     )
+    _t1 = _time.perf_counter()
+    counts = model.solve(**kwargs)
+    _t2 = _time.perf_counter()
+    if phases is not None:
+        phases["assemble"] = phases.get("assemble", 0.0) + (_t1 - _t0) * 1e3
+        solve_ms = (_t2 - _t1) * 1e3
+        # models that time their own dispatch/readback split report it
+        # (greedy/multichip last_phases); the remainder is host-side
+        # padding + visit-class prep inside solve()
+        model_phases = getattr(model, "last_phases", None) or {}
+        dispatch = model_phases.get("dispatch_ms", solve_ms)
+        sync = model_phases.get("sync_ms", 0.0)
+        phases["solve_dispatch"] = (
+            phases.get("solve_dispatch", 0.0) + dispatch
+        )
+        phases["device_sync"] = phases.get("device_sync", 0.0) + sync
+        phases["solve_host_prep"] = phases.get("solve_host_prep", 0.0) + max(
+            solve_ms - dispatch - sync, 0.0
+        )
 
     assignments: list[Assignment] = []
     counts = np.asarray(counts)
-    # one global nonzero over (B, V, W): row-major order preserves the
-    # per-batch FIFO take semantics of the nested loop it replaces
-    from hyperqueue_tpu.utils.native import native_nonzero
-
-    # only for already-contiguous counts (the native solve's output): a
-    # strided view from the padded device path would force a full copy here
-    nz = (
-        native_nonzero(counts)
-        if counts.dtype == np.int32 and counts.flags.c_contiguous
-        else None
+    worker_ids = (
+        dense.worker_ids if dense is not None
+        else [w.worker_id for w in workers]
     )
-    if nz is not None:
-        flat, vals = nz
-        if flat.size == 0:
-            return assignments
-        bs, vs, ws = np.unravel_index(flat, counts.shape)
-    else:
-        bs, vs, ws = np.nonzero(counts)
-        if bs.size == 0:
-            return assignments
-        vals = counts[bs, vs, ws]
+    try:
+        # one global nonzero over (B, V, W): row-major order preserves the
+        # per-batch FIFO take semantics of the nested loop it replaces
+        from hyperqueue_tpu.utils.native import native_nonzero
 
-    batch_queues = [queues.queue(b.rq_id) for b in batches]
-    native = _native_map_take(batch_queues, batches, bs, vals)
-    append = assignments.append
-    if native is not None:
-        # one C call popped every cell's ids; stitch the tuples here
-        out_ids, cell_n = native
-        pos = 0
-        for ci, (bi, vi, wi) in enumerate(
-            zip(bs.tolist(), vs.tolist(), ws.tolist())
+        # only for already-contiguous counts (the native solve's output): a
+        # strided view from the padded device path would force a full copy
+        nz = (
+            native_nonzero(counts)
+            if counts.dtype == np.int32 and counts.flags.c_contiguous
+            else None
+        )
+        if nz is not None:
+            flat, vals = nz
+            if flat.size == 0:
+                return assignments
+            bs, vs, ws = np.unravel_index(flat, counts.shape)
+        else:
+            bs, vs, ws = np.nonzero(counts)
+            if bs.size == 0:
+                return assignments
+            vals = counts[bs, vs, ws]
+
+        batch_queues = [queues.queue(b.rq_id) for b in batches]
+        native = _native_map_take(batch_queues, batches, bs, vals)
+        extend = assignments.extend
+        if native is not None:
+            # one C call popped every cell's ids; stitch the tuples here
+            # (slice + comprehension per cell: ~2x the indexed inner loop
+            # at 16k+ assignments/tick)
+            out_ids, cell_n = native
+            pos = 0
+            for ci, (bi, vi, wi) in enumerate(
+                zip(bs.tolist(), vs.tolist(), ws.tolist())
+            ):
+                got = cell_n[ci]
+                rq_id = batches[bi].rq_id
+                worker_id = worker_ids[wi]
+                end = pos + got
+                extend(
+                    [(tid, worker_id, rq_id, vi)
+                     for tid in out_ids[pos:end]]
+                )
+                pos = end
+            return assignments
+
+        cur_bi = -1
+        queue = rq_id = priority = None
+        for bi, vi, wi, n in zip(
+            bs.tolist(), vs.tolist(), ws.tolist(), vals.tolist()
         ):
-            got = cell_n[ci]
-            rq_id = batches[bi].rq_id
-            worker_id = workers[wi].worker_id
-            for k in range(pos, pos + got):
-                append((out_ids[k], worker_id, rq_id, vi))
-            pos += got
+            if bi != cur_bi:  # bs is sorted: hoist per-batch lookups per run
+                cur_bi = bi
+                batch = batches[bi]
+                rq_id = batch.rq_id
+                priority = batch.priority
+                queue = batch_queues[bi]
+            task_ids = queue.take(priority, n)
+            worker_id = worker_ids[wi]
+            extend(
+                [(task_id, worker_id, rq_id, vi) for task_id in task_ids]
+            )
         return assignments
-
-    cur_bi = -1
-    queue = rq_id = priority = None
-    for bi, vi, wi, n in zip(
-        bs.tolist(), vs.tolist(), ws.tolist(), vals.tolist()
-    ):
-        if bi != cur_bi:  # bs is sorted: hoist per-batch lookups per run
-            cur_bi = bi
-            batch = batches[bi]
-            rq_id = batch.rq_id
-            priority = batch.priority
-            queue = batch_queues[bi]
-        task_ids = queue.take(priority, n)
-        worker_id = workers[wi].worker_id
-        for task_id in task_ids:
-            append((task_id, worker_id, rq_id, vi))
-    return assignments
+    finally:
+        if phases is not None:
+            phases["mapping"] = phases.get("mapping", 0.0) + (
+                _time.perf_counter() - _t2
+            ) * 1e3
 
 
 def _solve_mu_workers(queues, mu_rows, rq_map, resource_map):

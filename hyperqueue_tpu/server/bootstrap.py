@@ -221,6 +221,7 @@ class Server:
         idle_timeout: float = 0.0,
         journal_flush_period: float = 0.0,
         access_file: Path | None = None,
+        paranoid_tick: int = 0,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -241,6 +242,10 @@ class Server:
         # dead id (reference keeps them in the HQ State worker map)
         self.past_workers: dict[int, dict] = {}
         self.core = Core()
+        # debug: every N ticks, assert the incremental tick assembly is
+        # bit-identical to a from-scratch one (scheduler/tick_cache.py
+        # paranoid_check; `--paranoid-tick N`)
+        self.core.paranoid_tick = paranoid_tick
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
@@ -736,6 +741,25 @@ class Server:
             "n_workers": len(self.core.workers),
             "n_jobs": len(self.jobs.jobs),
             "scheduler": self.scheduler_kind,
+        }
+
+    async def _client_server_stats(self, msg: dict) -> dict:
+        """Scheduler telemetry: per-phase tick latency breakdown plus the
+        incremental snapshot-cache counters (`hq server stats`).  The
+        phase split attributes a tick-latency regression to batches /
+        assemble / solve-dispatch / device-sync / mapping instead of one
+        opaque number."""
+        return {
+            "op": "server_stats",
+            "tick": self.core.tick_stats.snapshot(),
+            "tick_cache": self.core.tick_cache.counters(),
+            "paranoid_tick": self.core.paranoid_tick,
+            "scheduler": self.scheduler_kind,
+            "solve_backend": getattr(self.model, "last_backend", None),
+            "shape_allocations": getattr(
+                self.model, "shape_allocations", None
+            ),
+            "trace": TRACER.snapshot(recent=0),
         }
 
     async def _client_stop_server(self, msg: dict) -> dict:

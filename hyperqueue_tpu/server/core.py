@@ -16,6 +16,7 @@ from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
 from hyperqueue_tpu.resources.request import ResourceRequestVariants
 from hyperqueue_tpu.scheduler.queues import TaskQueues
 from hyperqueue_tpu.scheduler.tick import WorkerRow
+from hyperqueue_tpu.scheduler.tick_cache import TickPhaseStats, TickStateCache
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
 
@@ -34,6 +35,27 @@ class Core:
     # (rq_id, variant) -> (wire entries, n_nodes); rq interning is
     # append-only so entries never change within a Core
     entries_cache: dict = field(default_factory=dict)
+    # (rq_id, variant) -> (has_all, [(resource_id, amount)]) memo for
+    # variant_amounts (per-assignment hot path)
+    amounts_cache: dict = field(default_factory=dict)
+    # persistent dense tick snapshot, updated by dirty-tracking deltas
+    # instead of rebuilt per tick (scheduler/tick_cache.py)
+    tick_cache: TickStateCache = field(default_factory=TickStateCache)
+    # per-phase tick latency breakdown, recorded by reactor.schedule and
+    # surfaced through `hq server stats`
+    tick_stats: TickPhaseStats = field(default_factory=TickPhaseStats)
+    # debug: every N ticks, assert the incremental assembly is
+    # bit-identical to a from-scratch one (0 = off; --paranoid-tick N)
+    paranoid_tick: int = 0
+    tick_counter: int = 0
+    # bumped on every change of the schedulable-worker SET (connect,
+    # disconnect, gang reservation/claim/release): lets the tick cache
+    # skip the O(W) membership walk on the common unchanged tick.
+    # Row CONTENT changes (free/nt_free) ride on Worker.epoch instead.
+    membership_epoch: int = 0
+
+    def bump_membership(self) -> None:
+        self.membership_epoch += 1
 
     def intern_rqv(self, rqv: ResourceRequestVariants) -> int:
         return self.rq_map.get_or_create(rqv)
@@ -62,7 +84,28 @@ class Core:
         solver.rs:120-124 amount_or_none_if_all), so `worker` must be passed
         whenever the request could contain one — assign and release then
         stay symmetric because the pool size is static per worker.
+
+        Classes without ALL entries (the overwhelming majority) get their
+        amount list memoized per (rq_id, variant): this is called once per
+        assignment on the apply path, and rebuilding the list dominated
+        the tick's apply phase at 1M x 1k (callers treat it read-only).
         """
+        key = (rq_id, variant)
+        cached = self.amounts_cache.get(key)
+        if cached is None:
+            from hyperqueue_tpu.resources.request import AllocationPolicy
+
+            entries = self.rq_map.get_variants(rq_id).variants[variant].entries
+            if any(e.policy is AllocationPolicy.ALL for e in entries):
+                cached = (True, None)
+            else:
+                cached = (
+                    False, [(e.resource_id, e.amount) for e in entries]
+                )
+            self.amounts_cache[key] = cached
+        has_all, static = cached
+        if not has_all:
+            return static
         from hyperqueue_tpu.resources.request import AllocationPolicy
 
         rqv = self.rq_map.get_variants(rq_id)

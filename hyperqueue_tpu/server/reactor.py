@@ -90,6 +90,7 @@ def _make_ready(core: Core, task: Task) -> None:
 
 def on_new_worker(core: Core, comm: Comm, events: EventSink, worker: Worker) -> None:
     core.workers[worker.worker_id] = worker
+    core.bump_membership()
     events.on_worker_new(worker)
     comm.ask_for_scheduling()
 
@@ -110,6 +111,7 @@ def on_remove_worker(
     worker = core.workers.pop(worker_id, None)
     if worker is None:
         return
+    core.bump_membership()
     events.on_worker_lost(worker_id, reason)
     for task_id in list(worker.prefilled_tasks):
         task = core.tasks.get(task_id)
@@ -175,6 +177,7 @@ def _teardown_gang(
     clean: bool = False
 ) -> None:
     root = task.mn_workers[0] if task.mn_workers else 0
+    core.bump_membership()
     for wid in task.mn_workers:
         w = core.workers.get(wid)
         if w is not None:
@@ -334,6 +337,7 @@ def on_cancel_tasks(
 
 def _release_task_resources(core: Core, task: Task) -> None:
     if task.mn_workers:
+        core.bump_membership()
         for wid in task.mn_workers:
             w = core.workers.get(wid)
             if w is not None:
@@ -427,6 +431,7 @@ def _clear_mn_reservations(core: Core, task_id: int) -> None:
     for w in core.workers.values():
         if w.mn_reserved == task_id:
             w.mn_reserved = 0
+            core.bump_membership()
 
 
 def schedule(
@@ -441,6 +446,10 @@ def schedule(
     """
     assigned = 0
     per_worker_msgs: dict[int, list[dict]] = {}
+    # per-phase latency breakdown of THIS tick (ms), recorded into
+    # core.tick_stats at the end and surfaced via `hq server stats`
+    phases: dict = {}
+    _t_tick = _time.perf_counter()
 
     # --- multi-node gangs: all-or-nothing N eligible workers from one
     # group.  Per-member eligibility matches the reference's
@@ -521,8 +530,11 @@ def schedule(
                 for w in core.workers.values():
                     if w.mn_reserved == task_id and w.worker_id not in target:
                         w.mn_reserved = 0
+                        core.bump_membership()
                 for w in best[:n_nodes]:
                     newly_reserved = w.mn_reserved != task_id
+                    if newly_reserved:
+                        core.bump_membership()
                     w.mn_reserved = task_id
                     if newly_reserved and w.prefilled_tasks:
                         # steal the queued backlog back so the drain is
@@ -540,6 +552,7 @@ def schedule(
                             comm.send_retract(w.worker_id, refs)
                 continue
             _clear_mn_reservations(core, task_id)
+            core.bump_membership()
             for w in chosen:
                 w.mn_task = task_id
             task.mn_workers = tuple(w.worker_id for w in chosen)
@@ -554,6 +567,7 @@ def schedule(
             per_worker_msgs.setdefault(root.worker_id, []).append(msg)
             assigned += 1
         core.mn_queue = remaining_mn
+        phases["gangs"] = (_time.perf_counter() - _t_phase) * 1e3
         TRACER.record("scheduler/gangs", _time.perf_counter() - _t_phase)
 
     # --- single-node: dense solve ---
@@ -562,14 +576,39 @@ def schedule(
     # subtracted (the queues see no other mutation in between), instead of
     # re-walking every queue's priority levels two more times (measurable
     # host work at 1k queues x 32 cuts).
-    rows = core.worker_rows()
+    #
+    # The dense snapshot is INCREMENTAL: tick_cache.sync applies
+    # dirty-tracking deltas to persistent (W, R) arrays instead of
+    # rebuilding WorkerRows (sync must run AFTER the gang phase — gang
+    # reservations above change row membership).  The cache refuses ticks
+    # with min-utilization workers; those fall back to the from-scratch
+    # WorkerRow path, whose mu carve-out needs per-worker floors.
+    core.tick_counter += 1
+    snapshot = core.tick_cache.sync(core)
+    rows = core.worker_rows() if snapshot is None else None
     leftover_batches = None
     _t_phase = _time.perf_counter()
-    if rows and core.queues.total_ready():
+    have_workers = (
+        bool(snapshot.worker_ids) if snapshot is not None else bool(rows)
+    )
+    if have_workers and core.queues.total_ready():
+        _t_batches = _time.perf_counter()
         batches = create_batches(core.queues)
+        phases["batches"] = (_time.perf_counter() - _t_batches) * 1e3
+        if (
+            snapshot is not None
+            and core.paranoid_tick > 0
+            and core.tick_counter % core.paranoid_tick == 0
+        ):
+            from hyperqueue_tpu.scheduler.tick_cache import paranoid_check
+
+            paranoid_check(
+                core, snapshot, batches, core.rq_map, core.resource_map
+            )
         assignments = run_tick(
             core.queues, rows, core.rq_map, core.resource_map, model,
-            batches=batches,
+            batches=batches, dense=snapshot, phases=phases,
+            key_cache=core.tick_cache,
         )
         taken_by_batch: dict[tuple[int, Priority_t], int] = {}
         for task_id, worker_id, rq_id, variant in assignments:
@@ -825,10 +864,13 @@ def schedule(
                     victims.append((tid, task.instance_id))
                 if victims:
                     comm.send_retract(donor.worker_id, victims)
+        phases["prefill"] = (_time.perf_counter() - _t_phase) * 1e3
         TRACER.record("scheduler/prefill", _time.perf_counter() - _t_phase)
 
     for worker_id, msgs in per_worker_msgs.items():
         comm.send_compute(worker_id, msgs)
+    phases["total"] = (_time.perf_counter() - _t_tick) * 1e3
+    core.tick_stats.record(phases)
     return assigned
 
 

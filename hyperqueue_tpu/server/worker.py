@@ -103,6 +103,11 @@ class Worker:
     # limit): its tasks requeue WITHOUT a crash-counter increment
     # (reference gateway.rs CrashLimit doc: stops don't count)
     clean_stop: bool = False
+    # dirty-tracking epoch for the persistent tick snapshot
+    # (scheduler/tick_cache.TickStateCache): every mutation of the dense
+    # scheduling state (free/nt_free) MUST bump this, or the cache serves
+    # a stale row.  assign/unassign are the only such mutation funnel.
+    epoch: int = 0
 
     @classmethod
     def create(
@@ -159,6 +164,7 @@ class Worker:
             if rid < len(self.free):
                 self.free[rid] -= amount
         self.nt_free -= 1
+        self.epoch += 1
 
     def unassign(self, task_id: int, amounts: list[tuple[int, int]]) -> None:
         self.assigned_tasks.discard(task_id)
@@ -166,6 +172,7 @@ class Worker:
             if rid < len(self.free):
                 self.free[rid] += amount
         self.nt_free += 1
+        self.epoch += 1
 
     def is_idle(self) -> bool:
         return (

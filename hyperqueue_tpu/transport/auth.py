@@ -23,7 +23,13 @@ import hmac
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ModuleNotFoundError:
+    # containers without the cryptography wheel fall back to the pure-
+    # Python RFC 8439 implementation (bit-identical wire format, slower;
+    # control-plane frames are small)
+    from hyperqueue_tpu.transport._chacha import ChaCha20Poly1305
 
 from hyperqueue_tpu import PROTOCOL_VERSION
 from hyperqueue_tpu.transport.framing import (

@@ -59,6 +59,10 @@ class WorkerRuntime:
         self.blocked: dict[tuple, list[dict]] = {}
         self._n_blocked = 0
         self._streamers: dict[str, object] = {}  # stream dir -> StreamWriter
+        # stream dir -> number of RUNNING tasks currently holding the
+        # writer: eviction may only close zero-refcount writers (closing an
+        # in-use one fails its task's next write_chunk/close_task)
+        self._streamer_users: dict[str, int] = {}
         self.last_task_time = time.monotonic()
         self.started_at = time.monotonic()
         self._conn: Connection | None = None
@@ -271,6 +275,7 @@ class WorkerRuntime:
     async def _run_task(self, task_msg: dict, allocation) -> None:
         task_id = task_msg["id"]
         instance = task_msg.get("instance", 0)
+        held_stream_dir = None
         try:
             streamer = None
             body = task_msg.get("body") or {}
@@ -278,7 +283,8 @@ class WorkerRuntime:
             if stream_dir:
                 # stream paths carry JOB-scope placeholders (reference
                 # test_placeholders.py stream_submit_placeholder); task-
-                # scope ones are rejected at submit — a stream dir is
+                # scope ones are a hard submit-time error
+                # (cli._check_submit_placeholders) — a stream dir is
                 # shared by the whole job
                 import os as _os
 
@@ -292,19 +298,8 @@ class WorkerRuntime:
                     "SUBMIT_DIR": body.get("submit_dir") or _os.getcwd(),
                     "SERVER_UID": self.server_uid,
                 })
-                streamer = self._streamers.get(stream_dir)
-                if streamer is None:
-                    from hyperqueue_tpu.events.outputlog import StreamWriter
-
-                    # bound open fds: per-job stream dirs accumulate on a
-                    # long-lived worker; evict the oldest writer
-                    while len(self._streamers) >= 64:
-                        oldest = next(iter(self._streamers))
-                        self._streamers.pop(oldest).close()
-                    streamer = StreamWriter(
-                        stream_dir, self.worker_id, self.server_uid
-                    )
-                    self._streamers[stream_dir] = streamer
+                streamer = self._acquire_streamer(stream_dir)
+                held_stream_dir = stream_dir
             extra_env = {}
             if self.localcomm is not None:
                 extra_env["HQ_LOCAL_SOCKET"] = self.localcomm.socket_path
@@ -387,12 +382,65 @@ class WorkerRuntime:
                 pass
         finally:
             self.last_task_time = time.monotonic()
+            if held_stream_dir is not None:
+                self._release_streamer(held_stream_dir)
             if self.localcomm is not None:
                 self.localcomm.unregister_task(task_id)
             rt = self.running.pop(task_id, None)
             if rt is not None and rt.allocation is not None:
                 self.allocator.release(rt.allocation)
             self._retry_blocked()
+
+    # keep this many stream writers' fds open at most; in-use writers are
+    # never closed, so the bound can be exceeded while > MAX distinct
+    # stream dirs have running tasks
+    MAX_STREAM_WRITERS = 64
+
+    def _acquire_streamer(self, stream_dir: str):
+        """Get-or-open the StreamWriter for a stream dir and hold a
+        refcount on it for a running task.
+
+        Eviction closes only ZERO-refcount writers (closing one under a
+        running task fails that task's next write_chunk/close_task), in
+        least-recently-USED order: reused dirs move to the end of the
+        dict, so insertion order is true LRU order.  Pair every call with
+        _release_streamer."""
+        streamer = self._streamers.get(stream_dir)
+        if streamer is not None:
+            self._streamers.pop(stream_dir)
+            self._streamers[stream_dir] = streamer
+        else:
+            from hyperqueue_tpu.events.outputlog import StreamWriter
+
+            # bound open fds: per-job stream dirs accumulate on a
+            # long-lived worker.  If every writer is in use the bound is
+            # exceeded rather than an in-flight task's writer closed.
+            while len(self._streamers) >= self.MAX_STREAM_WRITERS:
+                victim = next(
+                    (
+                        d for d in self._streamers
+                        if not self._streamer_users.get(d)
+                    ),
+                    None,
+                )
+                if victim is None:
+                    break
+                self._streamers.pop(victim).close()
+            streamer = StreamWriter(
+                stream_dir, self.worker_id, self.server_uid
+            )
+            self._streamers[stream_dir] = streamer
+        self._streamer_users[stream_dir] = (
+            self._streamer_users.get(stream_dir, 0) + 1
+        )
+        return streamer
+
+    def _release_streamer(self, stream_dir: str) -> None:
+        remaining = self._streamer_users.get(stream_dir, 1) - 1
+        if remaining > 0:
+            self._streamer_users[stream_dir] = remaining
+        else:
+            self._streamer_users.pop(stream_dir, None)
 
     @staticmethod
     def _entries_sig(task_msg: dict):

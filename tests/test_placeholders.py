@@ -113,7 +113,9 @@ def test_unknown_placeholder_warnings(env):
     assert "BAR, BAZ" in out and "stdout" in out
     assert "TAS_ID" in out and "stderr" in out
     assert "working directory" in out
-    # task-scope placeholders can't resolve in a job-shared stream dir
+    # task-scope placeholders can't resolve in a job-shared stream dir:
+    # a HARD submit-time error (the unexpanded text would become a
+    # literal directory shared by every task)
     out = env.command(["submit", "--stream", "log-%{TASK_ID}", "--",
-                       "true"], with_stderr=True)
-    assert "TASK_ID" in out and "stream log" in out
+                       "true"], with_stderr=True, expect_fail=True)
+    assert "TASK_ID" in out and "task-scope" in out
