@@ -586,6 +586,77 @@ def run_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_chaos_smoke() -> None:
+    """One seeded kill -9/restart cycle against real processes: submit
+    blocked work to a journaled server, SIGKILL it mid-job, restart it,
+    let the reconnect-mode worker reattach, then assert completion + zero
+    duplicate executions (each task exactly one start line, instance 0).
+    The process-level gate for the fail-safe control plane
+    (docs/fault_tolerance.md)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from utils_e2e import HqEnv, wait_until
+
+    failures = []
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        with HqEnv(tmp) as env:
+            journal = tmp / "journal.bin"
+            marker = env.work_dir / "starts.txt"
+            flag = env.work_dir / "flag"
+            server_args = ("--journal", str(journal),
+                           "--reattach-timeout", "60")
+            env.start_server(*server_args)
+            env.start_worker("--on-server-lost", "reconnect", cpus=4)
+            env.wait_workers(1)
+            env.command([
+                "submit", "--array", "0-3", "--", "bash", "-c",
+                f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; '
+                f"while [ ! -f {flag} ]; do sleep 0.2; done",
+            ])
+
+            def running():
+                out = json.loads(env.command(
+                    ["job", "list", "--all", "--output-mode", "json"]
+                ))
+                return out and out[0]["counters"]["running"] == 4
+
+            wait_until(running, timeout=30, message="tasks running")
+            env.kill_process("server")
+            env.start_server(*server_args)
+            env.command(["server", "wait", "--timeout", "20"])
+            try:
+                wait_until(running, timeout=30, message="tasks reattached")
+            except TimeoutError:
+                failures.append("running tasks were not reattached")
+            flag.touch()
+            env.command(["job", "wait", "all"], timeout=60)
+            out = json.loads(env.command(
+                ["job", "list", "--all", "--output-mode", "json"]
+            ))
+            if out[0]["status"] != "finished":
+                failures.append(f"job status {out[0]['status']!r}")
+            starts = sorted(marker.read_text().splitlines())
+            expected = sorted(f"start:{i}:0" for i in range(4))
+            if starts != expected:
+                failures.append(
+                    f"duplicate/missing executions: {starts}"
+                )
+    print(json.dumps({
+        "metric": "chaos_smoke",
+        "ok": not failures,
+        "failures": failures,
+        "value": round((time.perf_counter() - t0), 2),
+        "unit": "s",
+    }))
+    sys.exit(1 if failures else 0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
@@ -606,6 +677,10 @@ def main() -> None:
                         help="small-shape CPU gate: phase breakdown sums to "
                              "wall time, zero steady-state rebuilds/"
                              "recompiles, incremental == scratch")
+    parser.add_argument("--chaos-smoke", action="store_true",
+                        help="one seeded kill -9/restart cycle: workers "
+                             "reconnect + reattach, job completes, zero "
+                             "duplicate executions")
     parser.add_argument("--classes", type=int, default=128,
                         help="distinct request classes for --phases")
     parser.add_argument("--workers", type=int, default=None,
@@ -616,6 +691,10 @@ def main() -> None:
 
     if args.smoke:
         run_smoke()
+        return
+
+    if args.chaos_smoke:
+        run_chaos_smoke()
         return
 
     if args.workers is None:

@@ -110,8 +110,6 @@ def cmd_server_start(args) -> None:
     # lazily by the solver (ops/assign._load_jax) — when it has NOT been
     # preloaded, setting the env var suffices and the server start avoids
     # the multi-second jax import on the cpu path entirely.
-    import sys as _sys
-
     if args.scheduler == "tpu":
         pass  # keep the environment default (the TPU platform)
     elif (
@@ -119,7 +117,7 @@ def cmd_server_start(args) -> None:
         or os.environ.get("JAX_PLATFORMS") == "cpu"
     ):
         os.environ["JAX_PLATFORMS"] = "cpu"
-        if "jax" in _sys.modules:
+        if "jax" in sys.modules:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
@@ -142,6 +140,11 @@ def cmd_server_start(args) -> None:
             journal_flush_period=args.journal_flush_period,
             access_file=Path(args.access_file) if args.access_file else None,
             paranoid_tick=args.paranoid_tick,
+            journal_fsync=args.journal_fsync,
+            heartbeat_timeout_factor=args.heartbeat_timeout_factor,
+            reattach_timeout=args.reattach_timeout,
+            solver_watchdog_timeout=args.solver_watchdog_timeout,
+            solver_rearm_ticks=args.solver_rearm_ticks,
         )
         access = await server.start()
         print(
@@ -204,6 +207,26 @@ def cmd_server_stats(args) -> None:
     )
     if stats.get("shape_allocations") is not None:
         print(f"solver shape allocations: {stats['shape_allocations']}")
+    wd = stats.get("watchdog") or {}
+    if wd:
+        state = (
+            "armed"
+            if wd.get("armed")
+            else f"DEGRADED (re-arm in {wd.get('bench_remaining', 0)} ticks)"
+        )
+        print(
+            f"solver watchdog: {state} — "
+            f"{wd.get('failures', 0)} failure(s), "
+            f"{wd.get('timeouts', 0)} timeout(s), "
+            f"{wd.get('degraded_ticks', 0)} degraded tick(s), "
+            f"{wd.get('rearms', 0)} re-arm(s)"
+        )
+        if wd.get("last_error"):
+            print(f"  last solver error: {wd['last_error']}")
+    if stats.get("reattach_pending"):
+        print(
+            f"tasks awaiting worker reattach: {stats['reattach_pending']}"
+        )
     if stats.get("paranoid_tick"):
         print(f"paranoid-tick: every {stats['paranoid_tick']} ticks")
 
@@ -296,6 +319,7 @@ def cmd_worker_start(args) -> None:
             args.idle_timeout if args.idle_timeout is not None else -1.0
         ),
         on_server_lost=args.on_server_lost,
+        reconnect_timeout_secs=args.reconnect_timeout,
         overview_interval_secs=args.overview_interval,
         min_utilization=args.min_utilization,
         manager=manager_info.manager,
@@ -311,15 +335,21 @@ def cmd_worker_start(args) -> None:
         access.worker_key_bytes(),
         config,
     )
+    worker_kwargs = {
+        "zero_worker": args.zero_worker,
+        # reconnect re-reads the access record from the server dir (a
+        # restarted server publishes new ports/keys)
+        "server_dir": _server_dir(args),
+    }
     if profile_out:
         import cProfile
 
         cProfile.runctx(
-            "asyncio.run(run_worker(*coro_args, zero_worker=args.zero_worker))",
+            "asyncio.run(run_worker(*coro_args, **worker_kwargs))",
             globals(), locals(), filename=profile_out + ".worker",
         )
     else:
-        asyncio.run(run_worker(*coro_args, zero_worker=args.zero_worker))
+        asyncio.run(run_worker(*coro_args, **worker_kwargs))
 
 
 def cmd_worker_deploy_ssh(args) -> None:
@@ -454,7 +484,8 @@ def cmd_server_wait(args) -> None:
     deadline = time.time() + args.timeout
     while True:
         try:
-            with ClientSession(_server_dir(args)) as session:
+            # retry_window=0: this loop IS the retry policy
+            with ClientSession(_server_dir(args), retry_window=0) as session:
                 session.request({"op": "server_info"})
             make_output(args.output_mode).message("server is running")
             return
@@ -1585,6 +1616,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "multichip shards the cut-scan's worker axis over "
                         "all visible devices (identical semantics)")
     p.add_argument("--journal", default=None)
+    p.add_argument("--journal-fsync", choices=["never", "periodic", "always"],
+                   default="never",
+                   help="fsync policy for the journal: never = fsync only "
+                        "on clean close (flush-to-OS still per event), "
+                        "periodic = fsync on the flush period, always = "
+                        "fsync after every event (survives an OS crash)")
+    p.add_argument("--heartbeat-timeout-factor", type=float, default=4.0,
+                   metavar="X",
+                   help="drop a worker after X missed heartbeat intervals "
+                        "(timeout = heartbeat x X, floor 2s)")
+    p.add_argument("--reattach-timeout", type=_parse_duration, default=15.0,
+                   help="after a journal restore, hold maybe-running tasks "
+                        "this long for their pre-crash worker to reconnect "
+                        "and reclaim them before requeueing (0 = requeue "
+                        "immediately)")
+    p.add_argument("--solver-watchdog-timeout", type=_parse_duration,
+                   default=5.0,
+                   help="per-tick solve deadline before degrading to the "
+                        "host greedy fallback (0 = exception guard only)")
+    p.add_argument("--solver-rearm-ticks", type=int, default=20, metavar="N",
+                   help="clean fallback ticks before re-trying a failed "
+                        "primary solver")
     p.add_argument("--journal-flush-period", type=_parse_duration, default=0.0,
                    help="flush the journal on this period instead of after "
                         "every event (0 = per-event, the default)")
@@ -1650,8 +1703,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heartbeat", type=_parse_duration, default=8.0)
     p.add_argument("--time-limit", type=_parse_duration, default=None)
     p.add_argument("--idle-timeout", type=_parse_duration, default=None)
-    p.add_argument("--on-server-lost", choices=["stop", "finish-running"],
+    p.add_argument("--on-server-lost",
+                   choices=["stop", "finish-running", "reconnect"],
                    default="stop")
+    p.add_argument("--reconnect-timeout", type=_parse_duration, default=60.0,
+                   help="with --on-server-lost reconnect: give up after "
+                        "this long without a successful re-registration "
+                        "(0 = keep retrying forever)")
     p.add_argument("--manager", choices=["auto", "pbs", "slurm", "none"],
                    default="auto",
                    help="batch manager detection (time limit from walltime)")

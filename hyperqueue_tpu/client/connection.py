@@ -2,11 +2,26 @@
 
 Each CLI invocation opens one authenticated connection on the client plane
 (reference client/mod.rs does the same via its async runtime).
+
+Connection failures get bounded retry with jittered exponential backoff so
+CLI commands ride out a server restart window instead of failing on the
+first refused connect. The access record is re-read from the server dir on
+every attempt — a restarted server publishes a NEW instance dir with fresh
+ports and keys, so a cached record would retry against a dead address
+forever. The window is HQ_CLIENT_RETRY_SECS (default 15; 0 disables).
+
+Caveat (documented, deliberate): a request whose connection dies after the
+send is retried against the new connection, so a non-idempotent request
+(submit) can be applied twice if the dying server already processed it —
+the at-least-once window every ack-less RPC has.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
+import random
+import time
 from pathlib import Path
 
 from hyperqueue_tpu.transport.auth import (
@@ -15,6 +30,27 @@ from hyperqueue_tpu.transport.auth import (
     do_authentication,
 )
 from hyperqueue_tpu.utils import serverdir
+from hyperqueue_tpu.utils.retry import jittered_backoff
+
+def _env_retry_secs() -> float:
+    raw = os.environ.get("HQ_CLIENT_RETRY_SECS", "15")
+    try:
+        return float(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger("hq.client").warning(
+            "ignoring malformed HQ_CLIENT_RETRY_SECS=%r; using 15", raw
+        )
+        return 15.0
+
+
+_BACKOFF_BASE = 0.2
+_BACKOFF_CAP = 2.0
+
+# transient transport failures worth retrying; AuthError and malformed
+# access records are NOT here — retrying a bad key never helps
+_RETRIABLE = (ConnectionError, OSError, asyncio.IncompleteReadError)
 
 
 class ClientError(Exception):
@@ -22,36 +58,118 @@ class ClientError(Exception):
 
 
 class ClientSession:
-    """Sync facade: runs its own event loop for request/response exchanges."""
+    """Sync facade: runs its own event loop for request/response exchanges.
 
-    def __init__(self, server_dir: Path):
-        self.access = serverdir.load_access(Path(server_dir))
+    `retry_window`: seconds to keep retrying transient connection failures
+    (None = HQ_CLIENT_RETRY_SECS; 0 = fail on the first error, used by
+    callers with their own polling loop like `hq server wait`).
+    """
+
+    def __init__(self, server_dir: Path, retry_window: float | None = None):
+        self.server_dir = Path(server_dir)
+        # env read per session, not at import: long-lived embedders (API
+        # client, tests) may set HQ_CLIENT_RETRY_SECS after the module is
+        # first imported
+        self.retry_window = (
+            _env_retry_secs() if retry_window is None else retry_window
+        )
+        self._rng = random.Random()
+        self.access = None
+        self._loop = asyncio.new_event_loop()
+        try:
+            self._conn = self._loop.run_until_complete(
+                self._connect_with_retry()
+            )
+        except BaseException:
+            self._loop.close()
+            raise
+
+    async def _connect(self):
+        # re-load per attempt: a restarted server means a new instance dir
+        # (new ports AND new plane keys)
+        self.access = serverdir.load_access(self.server_dir)
         if not self.access.client_port:
             raise RuntimeError(
                 "access record has no client plane (worker-only split file?)"
             )
-        self._loop = asyncio.new_event_loop()
-        self._conn = self._loop.run_until_complete(self._connect())
-
-    async def _connect(self):
         reader, writer = await asyncio.open_connection(
             self.access.host, self.access.client_port
         )
-        return await do_authentication(
-            reader,
-            writer,
-            ROLE_CLIENT,
-            ROLE_SERVER,
-            self.access.client_key_bytes(),
-        )
+        try:
+            return await do_authentication(
+                reader,
+                writer,
+                ROLE_CLIENT,
+                ROLE_SERVER,
+                self.access.client_key_bytes(),
+            )
+        except BaseException:
+            # a failed handshake must not leak its socket — the retry loop
+            # can make a dozen attempts per CLI call during a restart
+            writer.close()
+            raise
+
+    def _retries_exhausted(self, deadline: float) -> bool:
+        return self.retry_window <= 0 or time.monotonic() >= deadline
+
+    async def _connect_with_retry(self, deadline: float | None = None):
+        # `deadline` lets request() span ONE retry window across its
+        # send/reconnect cycles instead of granting each reconnect a fresh
+        # window (which would stack to a multiple of HQ_CLIENT_RETRY_SECS)
+        if deadline is None:
+            deadline = time.monotonic() + self.retry_window
+        delay = _BACKOFF_BASE
+        while True:
+            try:
+                return await self._connect()
+            except FileNotFoundError:
+                # no access record: distinguish "no server was ever started
+                # / it stopped cleanly" (no hq-current symlink — fail fast
+                # with the clear message) from "a new instance dir is being
+                # published right now" (symlink flipped, access file lands
+                # a moment later — a genuine restart window, retry)
+                if not (
+                    self.server_dir / serverdir.CURRENT_LINK
+                ).is_symlink():
+                    raise
+                if self._retries_exhausted(deadline):
+                    raise
+            except _RETRIABLE:
+                if self._retries_exhausted(deadline):
+                    raise
+            sleep_for, delay = jittered_backoff(
+                delay, _BACKOFF_CAP, self._rng,
+                remaining=deadline - time.monotonic(),
+            )
+            await asyncio.sleep(sleep_for)
 
     def request(self, msg: dict, timeout: float | None = None) -> dict:
         async def go():
             await self._conn.send(msg)
             return await self._conn.recv()
 
-        coro = asyncio.wait_for(go(), timeout) if timeout else go()
-        response = self._loop.run_until_complete(coro)
+        deadline = time.monotonic() + self.retry_window
+        while True:
+            coro = asyncio.wait_for(go(), timeout) if timeout else go()
+            try:
+                response = self._loop.run_until_complete(coro)
+                break
+            except asyncio.TimeoutError:
+                # the caller's per-request deadline — never retried (on
+                # 3.11+ TimeoutError subclasses OSError, so this must be
+                # caught BEFORE the retriable set)
+                raise
+            except _RETRIABLE:
+                if self._retries_exhausted(deadline):
+                    raise
+                # server restart window: reconnect (fresh access record)
+                # and re-send — the reconnect shares THIS request's
+                # deadline, so the whole exchange stays bounded by one
+                # retry window
+                self._conn.close()
+                self._conn = self._loop.run_until_complete(
+                    self._connect_with_retry(deadline=deadline)
+                )
         if isinstance(response, dict) and response.get("op") == "error":
             raise ClientError(response.get("message", "server error"))
         return response

@@ -105,7 +105,11 @@ class Journal:
                 if job is not None and job not in keep_jobs:
                     continue
                 if job is None:
-                    continue  # worker/overview events are not restorable state
+                    # worker/overview events are not restorable state — but
+                    # the server-uid lineage records must survive, or a
+                    # post-prune restore could never verify reattach claims
+                    if record.get("event") != "server-uid":
+                        continue
                 data = msgpack.packb(record, use_bin_type=True)
                 out.write(_LEN.pack(len(data)) + data)
                 kept += 1

@@ -28,9 +28,27 @@ TERMINAL = {"task-finished": "finished", "task-failed": "failed",
 
 
 def restore_from_journal(server) -> None:
-    """Replay server.journal_path into server.jobs/server.core."""
+    """Replay server.journal_path into server.jobs/server.core.
+
+    Tasks that were RUNNING at the crash (a task-started with no terminal
+    event) are held in server.reattach_pending instead of being requeued:
+    their pre-crash worker keeps running them through the outage
+    (`--on-server-lost reconnect`) and reclaims them at re-registration
+    with the preserved instance id. Only when no worker reclaims a task
+    within `--reattach-timeout` is it fenced (instance bump) and requeued
+    (see Server._reattach_reaper). With the window disabled the fence +
+    requeue happens here, the pre-reattach behavior.
+    """
     task_status: dict[tuple[int, int], tuple[str, str]] = {}
+    # highest instance id the journal saw per task (last task-started OR
+    # task-restarted — a restart bumps the instance without a new start);
+    # the live pre-crash worker holds at most this instance
     task_instances: dict[tuple[int, int], int] = {}
+    # True while the LAST lifecycle event was a start (the task may still
+    # be running on a reconnecting worker); a later restart clears it
+    task_maybe_running: dict[tuple[int, int], bool] = {}
+    task_variants: dict[tuple[int, int], int] = {}
+    task_crashes: dict[tuple[int, int], int] = {}
     job_descs: dict[int, list[dict]] = {}
     n_events = 0
 
@@ -82,7 +100,22 @@ def restore_from_journal(server) -> None:
             )
         elif kind == "task-started":
             key = (job_id, record["task"])
-            task_instances[key] = task_instances.get(key, 0) + 1
+            task_instances[key] = max(
+                record.get("instance", 0), task_instances.get(key, 0)
+            )
+            task_variants[key] = record.get("variant", 0)
+            task_maybe_running[key] = True
+        elif kind == "task-restarted":
+            key = (job_id, record["task"])
+            task_crashes[key] = record.get(
+                "crash_count", task_crashes.get(key, 0)
+            )
+            task_instances[key] = max(
+                record.get("instance", 0), task_instances.get(key, 0)
+            )
+            task_maybe_running[key] = False
+        elif kind == "server-uid":
+            server.journal_uids.add(record.get("server_uid") or "")
 
     # apply terminal statuses to job counters
     for (job_id, task_id), (status, error) in task_status.items():
@@ -96,6 +129,11 @@ def restore_from_journal(server) -> None:
 
     # re-submit unfinished tasks into the core
     resubmitted = 0
+    held = 0
+    reattach_window = getattr(server, "reattach_timeout", 0.0)
+    import time as _time
+
+    reattach_deadline = _time.monotonic() + reattach_window
     for job_id, descs in job_descs.items():
         job = server.jobs.jobs.get(job_id)
         if job is None:
@@ -103,7 +141,8 @@ def restore_from_journal(server) -> None:
         new_tasks = []
         for t in descs:
             job_task_id = t.get("id", 0)
-            if (job_id, job_task_id) in task_status:
+            key = (job_id, job_task_id)
+            if key in task_status:
                 continue  # already terminal
             rqv = rqv_from_wire(t.get("request") or {}, server.core.resource_map)
             rq_id = server.core.intern_rqv(rqv)
@@ -130,18 +169,43 @@ def restore_from_journal(server) -> None:
                 deps=deps,
                 crash_limit=int(t.get("crash_limit", 5)),
             )
-            # preserved instance counter: stale pre-crash worker messages
-            # carry older instance ids and are dropped (reference
-            # gateway.rs:204 adjust_instance_id_and_crash_counters)
-            task.instance_id = task_instances.get((job_id, job_task_id), 0)
-            new_tasks.append(task)
+            task.crash_counter = task_crashes.get(key, 0)
+            started_instance = task_instances.get(key)
+            if started_instance is None:
+                # never started: a fresh incarnation, nothing to fence
+                new_tasks.append(task)
+                continue
+            # preserved instance id: stale pre-crash worker messages carry
+            # older instance ids and are dropped (reference gateway.rs:204
+            # adjust_instance_id_and_crash_counters)
+            task.instance_id = started_instance
+            task.assigned_variant = task_variants.get(key, 0)
+            if (
+                reattach_window > 0
+                and task_maybe_running.get(key)
+                and not rqv.is_multi_node
+            ):
+                # maybe still running on a reconnecting worker: hold it out
+                # of the queues (state WAITING, deps all finished) until a
+                # worker reclaims it or the window expires. Gangs are never
+                # held — a partial gang reattach is worthless, so they are
+                # fenced + requeued like before.
+                server.core.tasks[task.task_id] = task
+                server.reattach_pending[task.task_id] = reattach_deadline
+                held += 1
+            else:
+                # fence out the pre-crash incarnation and requeue now
+                task.increment_instance()
+                new_tasks.append(task)
         if new_tasks:
             reactor.on_new_tasks(server.core, server.comm, new_tasks)
             resubmitted += len(new_tasks)
     logger.info(
-        "restored %d jobs (%d events, %d tasks resubmitted) from %s",
+        "restored %d jobs (%d events, %d tasks resubmitted, %d held for "
+        "reattach) from %s",
         len(server.jobs.jobs),
         n_events,
         resubmitted,
+        held,
         server.journal_path,
     )

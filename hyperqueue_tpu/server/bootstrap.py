@@ -27,8 +27,10 @@ from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
 from hyperqueue_tpu.server.protocol import rqv_from_wire, submit_record
+from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+from hyperqueue_tpu.utils import chaos
 from hyperqueue_tpu.utils.trace import TRACER
 from hyperqueue_tpu.transport.auth import (
     ROLE_CLIENT,
@@ -150,12 +152,18 @@ class EventBridge:
 
     def on_task_restarted(self, task_id):
         self.server.jobs.on_task_restarted(task_id_job(task_id), task_id)
+        # crash counter + new instance ride along so restore can rebuild
+        # both exactly (tests/test_journal.py counter round-trip)
+        task = self.server.core.tasks.get(task_id)
         self.server.emit_event(
             "task-restarted",
-            {"job": task_id_job(task_id), "task": task_id_task(task_id)},
+            {"job": task_id_job(task_id), "task": task_id_task(task_id),
+             "crash_count": task.crash_counter if task else 0,
+             "instance": task.instance_id if task else 0},
         )
 
     def on_task_finished(self, task_id):
+        self.server.reattach_pending.pop(task_id, None)
         self.server.jobs.on_task_finished(task_id_job(task_id), task_id)
         self.server.emit_event(
             "task-finished",
@@ -164,6 +172,7 @@ class EventBridge:
         self.server.check_job_completion(task_id_job(task_id))
 
     def on_task_failed(self, task_id, message):
+        self.server.reattach_pending.pop(task_id, None)
         to_cancel = self.server.jobs.on_task_failed(
             task_id_job(task_id), task_id, message
         )
@@ -177,6 +186,7 @@ class EventBridge:
         self.server.check_job_completion(task_id_job(task_id))
 
     def on_task_canceled(self, task_id):
+        self.server.reattach_pending.pop(task_id, None)
         self.server.jobs.on_task_canceled(task_id_job(task_id), task_id)
         self.server.emit_event(
             "task-canceled",
@@ -201,8 +211,16 @@ class EventBridge:
         )
 
     def on_worker_lost(self, worker_id, reason):
+        # structured loss record: how stale the last heartbeat was, and
+        # whether the worker may legitimately come back (a deliberate stop
+        # won't; a heartbeat timeout / connection loss might — it would
+        # re-register under a new id, its stale tasks fenced by instance)
+        past = self.server.past_workers.get(worker_id) or {}
         self.server.emit_event(
-            "worker-lost", {"id": worker_id, "reason": reason}
+            "worker-lost",
+            {"id": worker_id, "reason": reason,
+             "heartbeat_age": past.get("heartbeat_age"),
+             "reattach_eligible": reason != "stopped"},
         )
 
 
@@ -222,12 +240,22 @@ class Server:
         journal_flush_period: float = 0.0,
         access_file: Path | None = None,
         paranoid_tick: int = 0,
+        journal_fsync: str = "never",
+        heartbeat_timeout_factor: float = 4.0,
+        reattach_timeout: float = 15.0,
+        solver_watchdog_timeout: float = 5.0,
+        solver_rearm_ticks: int = 20,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
         # tako rpc.rs sync_worker_configuration). journal_flush_period: 0 =
         # flush the journal on every event (stronger than the reference's
         # 30 s default); > 0 = flush on that period instead.
+        # journal_fsync: "never" = fsync only on clean close/explicit
+        # `hq journal flush` (flush-to-OS still happens per policy above);
+        # "periodic" = fsync on the flush period (default 30 s if none);
+        # "always" = fsync after every event (survives an OS crash at the
+        # cost of one fsync per event).
         self.server_dir = Path(server_dir)
         self.host = host or socket.gethostname()
         self.client_port = client_port
@@ -237,6 +265,21 @@ class Server:
         self.access_file = access_file
         self.idle_timeout = idle_timeout
         self.journal_flush_period = journal_flush_period
+        if journal_fsync not in ("never", "periodic", "always"):
+            raise ValueError(f"unknown journal fsync policy {journal_fsync!r}")
+        self.journal_fsync = journal_fsync
+        self.heartbeat_timeout_factor = heartbeat_timeout_factor
+        # restored maybe-running tasks wait this long for their pre-crash
+        # worker to reconnect and reclaim them before being fenced and
+        # requeued (task_id -> monotonic deadline); 0 = requeue immediately
+        self.reattach_timeout = reattach_timeout
+        self.reattach_pending: dict[int, float] = {}
+        # server uids that have written this journal (restored from
+        # server-uid records + this instance's own): a reattach claim must
+        # name one of them, or the worker's tasks belong to a DIFFERENT
+        # server lineage (same dir, different --journal) and task ids could
+        # collide at instance 0
+        self.journal_uids: set[str] = set()
         self.schedule_min_delay = schedule_min_delay
         # disconnected workers, for `worker list --all` / `worker info` on a
         # dead id (reference keeps them in the HQ State worker map)
@@ -250,11 +293,19 @@ class Server:
         self.comm = CommSender()
         self.events = EventBridge(self)
         if scheduler == "milp":
-            self.model = MilpModel()
+            base_model = MilpModel()
         elif scheduler == "multichip":
-            self.model = MultichipModel()
+            base_model = MultichipModel()
         else:
-            self.model = GreedyCutScanModel()
+            base_model = GreedyCutScanModel()
+        # every solve runs behind the watchdog: a solver exception or hang
+        # degrades that tick to the host greedy fallback instead of killing
+        # the scheduling loop (scheduler/watchdog.py)
+        self.model = SolverWatchdog(
+            base_model,
+            timeout_s=solver_watchdog_timeout,
+            rearm_ticks=solver_rearm_ticks,
+        )
         self.scheduler_kind = scheduler
         self.access: serverdir.AccessRecord | None = None
         self.autoalloc = None
@@ -344,6 +395,11 @@ class Server:
                 disable_worker_auth=self.disable_worker_auth,
             )
         serverdir.store_access(instance_dir, self.access)
+        if self.journal is not None:
+            # record this instance's uid in the journal so a future restore
+            # can verify that reattaching workers come from this lineage
+            self.journal_uids.add(self.access.server_uid)
+            self.emit_event("server-uid", {"server_uid": self.access.server_uid})
 
         from hyperqueue_tpu.autoalloc.service import AutoAllocService
 
@@ -351,8 +407,14 @@ class Server:
         self.autoalloc.start()
         self._tasks.append(self._spawn_loop(self._scheduler_loop))
         self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
-        if self.journal is not None and self.journal_flush_period > 0:
+        if self.journal is not None and (
+            self.journal_flush_period > 0 or self.journal_fsync == "periodic"
+        ):
             self._tasks.append(self._spawn_loop(self._journal_flush_loop))
+        if self.reattach_pending:
+            # journal restore held maybe-running tasks for their pre-crash
+            # workers; requeue whatever is unclaimed when the window closes
+            self._tasks.append(self._spawn_loop(self._reattach_reaper))
         logger.info(
             "server started uid=%s client=%s:%d worker=%s:%d",
             self.access.server_uid,
@@ -420,8 +482,16 @@ class Server:
             # process restores everything (fsync-against-OS-crash happens on
             # close and `hq journal flush`). With --journal-flush-period the
             # periodic loop flushes instead (reference 30 s default).
-            if not self.journal_flush_period:
+            # --journal-fsync always additionally fsyncs per event.
+            if self.journal_fsync == "always":
+                self.journal.flush(sync=True)
+            elif not self.journal_flush_period:
                 self.journal.flush()
+        if chaos.ACTIVE:
+            # kill-at-event-K injection sits AFTER the journal write+flush:
+            # a chaos test killing the server here proves exactly what the
+            # configured flush/fsync policy persisted
+            chaos.fire("server.event", event=kind)
         for q in self._event_listeners:
             q.put_nowait(record)
 
@@ -514,19 +584,64 @@ class Server:
 
     async def _journal_flush_loop(self) -> None:
         """Flush the journal on --journal-flush-period instead of per event
-        (reference bootstrap.rs journal_flush_period, default 30 s there)."""
+        (reference bootstrap.rs journal_flush_period, default 30 s there);
+        with --journal-fsync periodic/always the periodic flush also
+        fsyncs, bounding the OS-crash loss window to one period."""
+        period = self.journal_flush_period or 30.0
         while True:
-            await asyncio.sleep(self.journal_flush_period)
-            self.journal.flush()
+            await asyncio.sleep(period)
+            self.journal.flush(sync=self.journal_fsync != "never")
+
+    async def _reattach_reaper(self) -> None:
+        """Requeue restored maybe-running tasks whose pre-crash worker did
+        not reconnect within --reattach-timeout: fence the dead incarnation
+        (instance bump) and make the task schedulable again."""
+        while True:
+            await asyncio.sleep(0.5)
+            if not self.reattach_pending:
+                continue
+            now = time.monotonic()
+            expired = [
+                tid for tid, deadline in self.reattach_pending.items()
+                if deadline <= now
+            ]
+            for task_id in expired:
+                del self.reattach_pending[task_id]
+                task = self.core.tasks.get(task_id)
+                if (
+                    task is None
+                    or task.is_done
+                    or task.state is not TaskState.WAITING
+                ):
+                    continue
+                logger.warning(
+                    "task %d: no worker reclaimed it within the %.0fs "
+                    "reattach window; requeueing",
+                    task_id, self.reattach_timeout,
+                )
+                reactor.requeue_reattach_expired(self.core, self.comm, task)
 
     async def _heartbeat_reaper(self) -> None:
         """Drop workers whose heartbeats stopped (beyond TCP-close detection;
-        reference server/rpc.rs per-connection heartbeat timeout)."""
+        reference server/rpc.rs per-connection heartbeat timeout). The
+        timeout is heartbeat_secs x --heartbeat-timeout-factor (floored at
+        2 s so one delayed frame never reaps a fast-heartbeat worker)."""
         while True:
-            await asyncio.sleep(2.0)
+            before = time.monotonic()
+            await asyncio.sleep(0.5)
             now = time.monotonic()
+            if now - before > 2.0:
+                # the event loop itself stalled (e.g. a solve held at the
+                # watchdog deadline): heartbeats are sitting unprocessed in
+                # the recv buffers, not missing. Give the recv loops one
+                # pass before judging anyone silent.
+                continue
             for worker in list(self.core.workers.values()):
-                limit = max(worker.configuration.heartbeat_secs * 4, 10.0)
+                limit = max(
+                    worker.configuration.heartbeat_secs
+                    * self.heartbeat_timeout_factor,
+                    2.0,
+                )
                 if now - worker.last_heartbeat > limit:
                     logger.warning(
                         "worker %d heartbeat timeout (%.0fs)",
@@ -569,6 +684,17 @@ class Server:
             worker_id = worker.worker_id
             queue = self.comm.register_worker(worker_id)
             self._worker_conns[worker_id] = conn
+            # a reconnecting worker reclaims the restored maybe-running
+            # tasks it still executes; everything it reports that the
+            # server cannot verify (instance mismatch, already terminal,
+            # never held) is echoed back for the worker to kill — both
+            # sides agree on exactly one live incarnation per task.
+            # Processed BEFORE on_new_worker wakes the scheduler, so a held
+            # task can never race onto another worker.
+            reattached, discard = self._process_reattach(
+                register.get("reattach"), worker
+            )
+            reactor.on_new_worker(self.core, self.comm, self.events, worker)
             await conn.send(
                 {
                     "op": "registered",
@@ -578,9 +704,10 @@ class Server:
                     # workers with no own idle timeout adopt the server's
                     # default (reference sync_worker_configuration)
                     "server_idle_timeout": self.idle_timeout,
+                    "reattached": reattached,
+                    "discard": discard,
                 }
             )
-            reactor.on_new_worker(self.core, self.comm, self.events, worker)
             if self._overview_listeners > 0:
                 # a dashboard is attached: the new worker starts under the
                 # forced overview cadence too
@@ -620,20 +747,114 @@ class Server:
                     )
             writer.close()
 
+    def _process_reattach(
+        self, reattach: dict | None, worker: Worker
+    ) -> tuple[list[int], list[int]]:
+        """Reclaim a reconnecting worker's still-running tasks.
+
+        A task is reattached iff the journal restore held it for exactly
+        this incarnation (server.reattach_pending + matching instance id):
+        it becomes RUNNING on the new worker record with resources
+        accounted — NOT requeued, no crash-counter charge. Anything else
+        the worker reports is stale (already terminal, requeued under a
+        newer instance, or this server never knew it) and is returned in
+        `discard` for the worker to kill; its messages would be fenced by
+        the instance check anyway, but killing stops the side effects.
+        """
+        if not reattach:
+            return [], []
+        reattached: list[int] = []
+        discard: list[int] = []
+        # lineage fence: the claimed server_uid must have written this
+        # journal, or the worker's task ids belong to a different server's
+        # numbering (same server dir reused with another --journal) and
+        # could collide at the common instance 0
+        claimed_uid = reattach.get("server_uid") or ""
+        uid_ok = claimed_uid in self.journal_uids
+        if not uid_ok and reattach.get("running"):
+            logger.warning(
+                "reconnecting worker claims unknown server lineage %r; "
+                "discarding its %d running task(s)",
+                claimed_uid, len(reattach.get("running", ())),
+            )
+        for entry in reattach.get("running", ()):
+            task_id = entry.get("id")
+            instance = entry.get("instance", 0)
+            task = self.core.tasks.get(task_id)
+            claimable = (
+                uid_ok
+                and task is not None
+                and not task.is_done
+                and task.instance_id == instance
+            )
+            if claimable and self.reattach_pending.pop(task_id, None) is not None:
+                reactor.on_task_reattached(self.core, self.events, task, worker)
+                reattached.append(task_id)
+            elif (
+                claimable
+                and task.state is TaskState.READY
+                and not self.core.rq_map.get_variants(task.rq_id).is_multi_node
+            ):
+                # the task started pre-crash but its task_running died with
+                # the old connection, so restore re-queued it at the SAME
+                # instance instead of holding it. The worker proves that
+                # incarnation still runs: claim it straight out of the
+                # ready queue — re-issuing it would execute it twice under
+                # one instance id, invisible to the fence. The journal
+                # never saw this start, so the worker's reported variant is
+                # the only truth about which resources it occupies.
+                variant = int(entry.get("variant", 0))
+                if variant < len(
+                    self.core.rq_map.get_variants(task.rq_id).variants
+                ):
+                    task.assigned_variant = variant
+                self.core.queues.remove(task.rq_id, task_id)
+                reactor.on_task_reattached(self.core, self.events, task, worker)
+                reattached.append(task_id)
+            else:
+                discard.append(task_id)
+        # parked-but-never-started tasks are NEVER kept: the server
+        # re-issues them (restore saw no task-started), so a silently kept
+        # local copy would run alongside the re-issue under one instance id
+        for entry in reattach.get("blocked", ()):
+            discard.append(entry.get("id"))
+        if reattached or discard:
+            logger.info(
+                "worker %d reconnected from old worker %s: reattached %d "
+                "task(s), discarded %d stale",
+                worker.worker_id, reattach.get("worker_id"),
+                len(reattached), len(discard),
+            )
+        return reattached, discard
+
     async def _worker_sender(self, conn: Connection, queue: asyncio.Queue):
         while True:
             msg = await queue.get()
+            if chaos.ACTIVE:
+                action = await chaos.on_message(
+                    "server.send", op=msg.get("op")
+                )
+                if action == "drop":
+                    continue
+                if action == "dup":
+                    await conn.send(msg)
             await conn.send(msg)
 
     async def _worker_recv_loop(self, conn: Connection, worker: Worker) -> None:
         while True:
             msg = await conn.recv()
             worker.last_heartbeat = time.monotonic()
-            if msg.get("op") == "batch":
-                for sub in msg["msgs"]:
-                    self._process_worker_message(worker, sub)
-            else:
-                self._process_worker_message(worker, msg)
+            subs = msg["msgs"] if msg.get("op") == "batch" else [msg]
+            for sub in subs:
+                if chaos.ACTIVE:
+                    action = await chaos.on_message(
+                        "server.recv", op=sub.get("op")
+                    )
+                    if action == "drop":
+                        continue
+                    if action == "dup":
+                        self._process_worker_message(worker, sub)
+                self._process_worker_message(worker, sub)
 
     def _process_worker_message(self, worker: Worker, msg: dict) -> None:
             op = msg.get("op")
@@ -759,6 +980,8 @@ class Server:
             "shape_allocations": getattr(
                 self.model, "shape_allocations", None
             ),
+            "watchdog": self.model.stats(),
+            "reattach_pending": len(self.reattach_pending),
             "trace": TRACER.snapshot(recent=0),
         }
 
@@ -1100,6 +1323,9 @@ class Server:
             "overview": None,
             "lost_at": time.time(),
             "reason": reason,
+            # age of the last heartbeat at loss time — for a heartbeat
+            # timeout this is how long the worker was silent
+            "heartbeat_age": round(time.monotonic() - w.last_heartbeat, 3),
         }
         while len(self.past_workers) > 1000:  # bound server memory
             self.past_workers.pop(next(iter(self.past_workers)))

@@ -214,6 +214,40 @@ def _teardown_gang(
     _make_ready(core, task)
 
 
+def on_task_reattached(
+    core: Core, events: EventSink, task: Task, worker: Worker
+) -> None:
+    """A reconnecting worker claimed a restored maybe-running task.
+
+    The task was held out of the queues by restore (server.reattach_pending)
+    with its pre-crash instance id and chosen variant preserved; the worker
+    proved it still runs that exact incarnation, so it is attached to the
+    new worker record as RUNNING — no requeue, no crash-counter charge, no
+    instance bump (the worker's in-flight completion message must still
+    match)."""
+    task.state = TaskState.RUNNING
+    task.assigned_worker = worker.worker_id
+    worker.assign(
+        task.task_id,
+        core.variant_amounts(task.rq_id, task.assigned_variant, worker),
+    )
+    events.on_task_started(
+        task.task_id, task.instance_id, [worker.worker_id],
+        task.assigned_variant,
+    )
+
+
+def requeue_reattach_expired(core: Core, comm: Comm, task: Task) -> None:
+    """No worker reclaimed this restored maybe-running task within the
+    reattach window: fence out the (presumed dead) pre-crash incarnation by
+    bumping the instance id, then queue it like any other ready task. No
+    crash-counter charge — a server restart is not the task's fault."""
+    task.increment_instance()
+    task.state = TaskState.WAITING
+    _make_ready(core, task)
+    comm.ask_for_scheduling()
+
+
 def on_task_running(
     core: Core, events: EventSink, task_id: int, instance_id: int
 ) -> None:
@@ -933,6 +967,7 @@ def _compute_message(core: Core, task: Task, variant: int) -> dict:
         "body": task.body,
         "entries": entries,
         "n_nodes": n_nodes,
+        "variant": variant,
         "priority": list(task.priority),
     }
     if task.entry is not None:

@@ -25,7 +25,10 @@ class WorkerConfiguration:
     heartbeat_secs: float = 8.0
     time_limit_secs: float = 0.0  # 0 = unlimited
     idle_timeout_secs: float = 0.0
-    on_server_lost: str = "stop"  # stop | finish-running
+    on_server_lost: str = "stop"  # stop | finish-running | reconnect
+    # with on_server_lost=reconnect: give up after this many seconds of
+    # failed reconnect attempts (0 = keep retrying forever)
+    reconnect_timeout_secs: float = 60.0
     overview_interval_secs: float = 0.0
     # Scheduler only plans tasks here while at least min_utilization x cpus
     # would be busy afterwards — all-or-nothing per tick (reference worker
@@ -47,6 +50,7 @@ class WorkerConfiguration:
             "time_limit_secs": self.time_limit_secs,
             "idle_timeout_secs": self.idle_timeout_secs,
             "on_server_lost": self.on_server_lost,
+            "reconnect_timeout_secs": self.reconnect_timeout_secs,
             "overview_interval_secs": self.overview_interval_secs,
             "min_utilization": self.min_utilization,
             "listen_address": self.listen_address,
@@ -65,6 +69,7 @@ class WorkerConfiguration:
             time_limit_secs=data.get("time_limit_secs", 0.0),
             idle_timeout_secs=data.get("idle_timeout_secs", 0.0),
             on_server_lost=data.get("on_server_lost", "stop"),
+            reconnect_timeout_secs=data.get("reconnect_timeout_secs", 60.0),
             overview_interval_secs=data.get("overview_interval_secs", 0.0),
             min_utilization=data.get("min_utilization", 0.0),
             listen_address=data.get("listen_address", ""),

@@ -1,0 +1,203 @@
+"""Deterministic fault injection (the chaos harness).
+
+A FaultPlan is a seeded list of rules loaded from the HQ_FAULT_PLAN
+environment variable (inline JSON, or `@/path/to/plan.json`). Each process
+— server, worker, client — loads its own plan from its own environment, so
+a test can fault exactly one side of a connection. Rules fire
+deterministically: `at` fires on the Nth matching call only, `times` caps
+total fires, `prob` draws from a per-rule RNG seeded by (plan seed, rule
+index) — the same plan against the same message sequence always injects
+the same faults.
+
+Rule schema (all keys except site/action optional)::
+
+    {"site": "worker.send",            # injection point, see below
+     "op": "task_finished",            # match only this message op
+     "event": "task-finished",         # match only this event kind (server.event)
+     "action": "drop",                 # drop | dup | delay | kill | raise | hang
+     "at": 3,                          # fire on the 3rd match only
+     "times": 2,                       # fire at most twice
+     "prob": 0.25,                     # else fire per-match with this probability
+     "delay_ms": 50,                   # for action=delay
+     "hang_s": 30}                     # for action=hang
+
+Sites threaded through the control plane:
+
+- ``worker.send`` / ``worker.recv`` — the worker's uplink messages (before
+  batching) and downlink messages;
+- ``server.send`` / ``server.recv`` — the server's worker-plane messages
+  (recv is per logical message, after batch unpacking);
+- ``solve`` — the per-tick scheduler solve (actions raise/hang, guarded by
+  the solver watchdog, scheduler/watchdog.py);
+- ``server.event`` — Server.emit_event, AFTER the journal write+flush (so
+  ``kill`` at event K proves exactly what the flush policy persisted).
+
+Faults are injected at the MESSAGE level, not the raw frame level: the
+encrypted transport seals frames with counter nonces (transport/auth.py),
+so dropping a sealed frame would desynchronize the stream rather than
+model a lost message. Dropping/duplicating the message before sealing (or
+after opening) exercises the same recovery paths without breaking the
+cipher.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import signal
+import threading
+import time
+
+logger = logging.getLogger("hq.chaos")
+
+
+class ChaosInjectedError(RuntimeError):
+    """Raised by an action="raise" rule (e.g. a poisoned solve)."""
+
+
+class _Rule:
+    __slots__ = (
+        "site", "op", "event", "action", "prob", "at", "times",
+        "delay_ms", "hang_s", "_matches", "_fired", "_rng",
+    )
+
+    def __init__(self, spec: dict, index: int, seed: int):
+        self.site = spec["site"]
+        self.op = spec.get("op")
+        self.event = spec.get("event")
+        self.action = spec["action"]
+        self.prob = spec.get("prob")
+        self.at = spec.get("at")
+        self.times = spec.get("times")
+        self.delay_ms = float(spec.get("delay_ms", 25.0))
+        self.hang_s = float(spec.get("hang_s", 30.0))
+        self._matches = 0
+        self._fired = 0
+        self._rng = random.Random(f"{seed}:{index}")
+
+    def check(self, site: str, op, event) -> bool:
+        if site != self.site:
+            return False
+        if self.op is not None and op != self.op:
+            return False
+        if self.event is not None and event != self.event:
+            return False
+        self._matches += 1
+        if self.times is not None and self._fired >= self.times:
+            return False
+        if self.at is not None and self._matches != self.at:
+            return False
+        if self.prob is not None and self._rng.random() >= self.prob:
+            return False
+        self._fired += 1
+        return True
+
+
+class FaultPlan:
+    def __init__(self, spec: dict):
+        self.seed = int(spec.get("seed", 0))
+        self.rules = [
+            _Rule(r, i, self.seed) for i, r in enumerate(spec.get("rules", ()))
+        ]
+        # counters are bumped from the event loop AND the solve thread
+        self._lock = threading.Lock()
+
+    def match(self, site: str, op=None, event=None) -> _Rule | None:
+        with self._lock:
+            for rule in self.rules:
+                if rule.check(site, op, event):
+                    logger.warning(
+                        "chaos: %s at site=%s op=%s event=%s",
+                        rule.action, site, op, event,
+                    )
+                    return rule
+        return None
+
+
+_PLAN: FaultPlan | None = None
+# cheap guard for hot paths: `if chaos.ACTIVE:` costs one global load when
+# no plan is configured (the overwhelmingly common case)
+ACTIVE = False
+
+
+def _load() -> None:
+    global _PLAN, ACTIVE
+    raw = os.environ.get("HQ_FAULT_PLAN")
+    if not raw:
+        return
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    _PLAN = FaultPlan(json.loads(raw))
+    ACTIVE = True
+    logger.warning(
+        "chaos harness active: %d rule(s), seed %d",
+        len(_PLAN.rules), _PLAN.seed,
+    )
+
+
+_load()
+
+
+def _kill_self() -> None:
+    logging.shutdown()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def fire(site: str, op=None, event=None) -> None:
+    """Synchronous injection point (solve, server.event).
+
+    Applies kill/raise/hang/delay inline (delay and hang are BLOCKING
+    sleeps — at server.event that stalls the whole event loop, which is
+    the point of injecting them there). drop/dup have no meaning at a
+    sync site (there is no message to drop); such rules are rejected
+    loudly rather than silently matching and doing nothing."""
+    if _PLAN is None:
+        return
+    rule = _PLAN.match(site, op=op, event=event)
+    if rule is None:
+        return
+    if rule.action == "kill":
+        _kill_self()
+    if rule.action == "raise":
+        raise ChaosInjectedError(f"injected failure at {site}")
+    if rule.action == "hang":
+        time.sleep(rule.hang_s)
+    elif rule.action == "delay":
+        time.sleep(rule.delay_ms / 1000.0)
+    elif rule.action in ("drop", "dup"):
+        logger.error(
+            "chaos: action %r is not applicable at sync site %s; ignored",
+            rule.action, site,
+        )
+
+
+async def on_message(site: str, op=None) -> str | None:
+    """Async injection point for message-plane sites.
+
+    Returns "drop" or "dup" for the caller to apply; applies delay (async
+    sleep) and kill inline; action=raise raises ChaosInjectedError into
+    the connection loop (modeling a poisoned/undecodable message)."""
+    if _PLAN is None:
+        return None
+    rule = _PLAN.match(site, op=op)
+    if rule is None:
+        return None
+    if rule.action == "kill":
+        _kill_self()
+    if rule.action == "raise":
+        raise ChaosInjectedError(f"injected failure at {site}")
+    if rule.action == "delay":
+        await asyncio.sleep(rule.delay_ms / 1000.0)
+        return None
+    if rule.action == "hang":
+        # a hung peer = the message (and everything after it on this
+        # plane) stalls for hang_s; async so the rest of the process lives
+        await asyncio.sleep(rule.hang_s)
+        return None
+    if rule.action in ("drop", "dup"):
+        return rule.action
+    return None

@@ -123,10 +123,18 @@ def create_instance_dir(server_dir: Path) -> Path:
 
 
 def store_access(instance_dir: Path, record: AccessRecord) -> None:
+    # atomic: the hq-current symlink already points at this instance dir
+    # (create_instance_dir flips it first), so reconnecting workers and
+    # retrying clients poll this path — they must see nothing or the whole
+    # record, never a torn write
     path = instance_dir / ACCESS_FILE
-    with open(path, "w") as f:
+    tmp = instance_dir / f".{ACCESS_FILE}.tmp"
+    with open(tmp, "w") as f:
         json.dump(record.to_json(), f, indent=2)
-    os.chmod(path, 0o600)
+        f.flush()
+        os.fsync(f.fileno())
+    os.chmod(tmp, 0o600)
+    tmp.replace(path)
 
 
 def load_access(server_dir: Path) -> AccessRecord:

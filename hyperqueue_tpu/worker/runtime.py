@@ -5,22 +5,46 @@ over the server message stream, heartbeat timer, idle timeout and time limit;
 plus worker/reactor.rs (compute_tasks -> try_start_task -> launch). Tasks that
 cannot allocate resources right now (fractional packing races) sit in a
 blocked queue retried after every release.
+
+Fail-safe extensions beyond the reference:
+
+- ``--on-server-lost reconnect``: a lost server connection no longer
+  strands the worker — running tasks keep running while the worker retries
+  the registration handshake with jittered exponential backoff, re-reading
+  the access record each attempt (a restarted server publishes a new
+  instance dir with fresh ports and keys). The register message carries
+  the still-running (task, instance) set; the server reattaches what its
+  journal restore held for exactly those incarnations and orders the rest
+  killed (stale incarnations requeued elsewhere).
+- Unacked task-state uplinks are never lost to a dead connection: a send
+  failure parks the batch in a replay buffer that is re-sent after
+  reconnect, and a bounded log of final task messages is replayed too
+  (covering completions whose send "succeeded" into a dying socket).
+  Replays are safe because every task message carries its instance id and
+  the server applies each (task, instance) transition at most once.
+- Duplicate compute messages (chaos: duplicated frames, or a replayed
+  server queue) are dropped by a bounded (task, instance) dedup set.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
+from collections import OrderedDict
 from pathlib import Path
 
 from hyperqueue_tpu.server.worker import WorkerConfiguration
 from hyperqueue_tpu.transport.auth import (
     ROLE_SERVER,
     ROLE_WORKER,
+    AuthError,
     Connection,
     do_authentication,
 )
+from hyperqueue_tpu.utils import chaos
+from hyperqueue_tpu.utils.retry import jittered_backoff
 from hyperqueue_tpu.worker.allocator import ResourceAllocator
 from hyperqueue_tpu.worker.launcher import LaunchedTask, launch_task
 
@@ -38,6 +62,18 @@ class RunningTask:
 
 
 class WorkerRuntime:
+    # reconnect backoff: jittered exponential from BASE, capped at CAP;
+    # each handshake attempt gets its own deadline so a blackholed SYN or
+    # a wedged server that accepts but never answers cannot stall the
+    # retry loop past --reconnect-timeout
+    RECONNECT_BACKOFF_BASE = 0.25
+    RECONNECT_BACKOFF_CAP = 5.0
+    RECONNECT_ATTEMPT_TIMEOUT = 10.0
+    # bounded memory for the duplicate-compute guard and the replayed
+    # final-message log
+    RECENT_TASKS_MAX = 8192
+    DONE_LOG_MAX = 4096
+
     def __init__(
         self,
         host: str,
@@ -45,12 +81,16 @@ class WorkerRuntime:
         secret_key: bytes | None,
         configuration: WorkerConfiguration,
         zero_worker: bool = False,
+        server_dir: Path | None = None,
     ):
         self.host = host
         self.port = port
         self.secret_key = secret_key
         self.configuration = configuration
         self.zero_worker = zero_worker
+        # where to re-read the access record from on reconnect (a restarted
+        # server has a new instance dir: new ports, new keys)
+        self.server_dir = Path(server_dir) if server_dir else None
         self.allocator = ResourceAllocator(configuration.descriptor)
         self.worker_id = 0
         self.server_uid = ""
@@ -68,7 +108,28 @@ class WorkerRuntime:
         self._conn: Connection | None = None
         self._send_lock = asyncio.Lock()
         self._sendq: asyncio.Queue = asyncio.Queue()
+        # uplinks that could not be handed to a live connection; re-sent
+        # (ahead of fresh traffic) after the next successful reconnect
+        self._replay: list[dict] = []
+        # bounded log of final task messages already handed to a socket:
+        # a send into a dying connection can "succeed" without the server
+        # ever seeing it, so these replay too (the server drops duplicates
+        # by (task, instance)). Keyed by (id, instance, op) so a replayed
+        # message passing through the drainer again cannot duplicate its
+        # entry; harvested and cleared on reconnect (replayed copies
+        # re-enter when their re-send happens), so it only ever holds the
+        # last session's finals.
+        self._done_log: OrderedDict[tuple, dict] = OrderedDict()
+        # (task_id, instance) -> None for every compute accepted: duplicate
+        # deliveries (chaos dup, replayed server queues) must not run twice
+        self._recent_tasks: OrderedDict[tuple[int, int], None] = OrderedDict()
+        # incarnations the server ordered killed at reconnect: their exit
+        # must NOT be reported — if the server re-issued the task at the
+        # SAME instance (a start it never journaled), a task_failed from
+        # the killed copy would pass the fence and fail the live one
+        self._discarded: set[int] = set()
         self._stop = asyncio.Event()
+        self._rng = random.Random()
         # server-forced overview cadence (None = use configuration)
         self._overview_override: float | None = None
         self._overview_wake = asyncio.Event()
@@ -90,32 +151,46 @@ class WorkerRuntime:
                     batch.append(self._sendq.get_nowait())
                 except asyncio.QueueEmpty:
                     break
-            async with self._send_lock:
-                if len(batch) == 1:
-                    await self._conn.send(batch[0])
-                else:
-                    await self._conn.send({"op": "batch", "msgs": batch})
+            if chaos.ACTIVE:
+                injected = []
+                for m in batch:
+                    action = await chaos.on_message(
+                        "worker.send", op=m.get("op")
+                    )
+                    if action == "drop":
+                        continue
+                    injected.append(m)
+                    if action == "dup":
+                        injected.append(m)
+                batch = injected
+                if not batch:
+                    continue
+            for m in batch:
+                if m.get("op") in ("task_finished", "task_failed"):
+                    key = (m.get("id"), m.get("instance"), m.get("op"))
+                    if key not in self._done_log:
+                        self._done_log[key] = m
+                        while len(self._done_log) > self.DONE_LOG_MAX:
+                            self._done_log.popitem(last=False)
+            try:
+                async with self._send_lock:
+                    if len(batch) == 1:
+                        await self._conn.send(batch[0])
+                    else:
+                        await self._conn.send({"op": "batch", "msgs": batch})
+            except (ConnectionError, OSError, asyncio.CancelledError):
+                # the server never acked these: park them for replay after
+                # the reconnect (CancelledError covers session teardown
+                # catching the drainer mid-send — the popped batch must not
+                # vanish). Re-sending something the server did receive is
+                # safe: every task message is fenced by (task, instance)
+                # and applied at most once.
+                self._replay.extend(batch)
+                raise
 
+    # --- connection lifecycle -------------------------------------------
     async def run(self) -> None:
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        self._conn = await do_authentication(
-            reader, writer, ROLE_WORKER, ROLE_SERVER, self.secret_key
-        )
-        await self._conn.send(
-            {"op": "register", "config": self.configuration.to_wire()}
-        )
-        registered = await self._conn.recv()
-        if registered.get("op") != "registered":
-            raise RuntimeError(f"registration failed: {registered}")
-        self.worker_id = registered["worker_id"]
-        self.server_uid = registered.get("server_uid", "")
-        if self.configuration.idle_timeout_secs < 0:
-            # --idle-timeout not given: adopt the server-wide default
-            # (reference tako rpc.rs:130 sync_worker_configuration). An
-            # explicit --idle-timeout 0 opts out and is left alone.
-            self.configuration.idle_timeout_secs = float(
-                registered.get("server_idle_timeout") or 0.0
-            )
+        await self._connect(reattach=False)
         logger.info("registered as worker %d", self.worker_id)
 
         import tempfile
@@ -125,6 +200,222 @@ class WorkerRuntime:
         self.localcomm = LocalCommListener(self, Path(tempfile.gettempdir()))
         await self.localcomm.start()
 
+        try:
+            while True:
+                outcome = await self._run_session()
+                if outcome == "stop":
+                    return
+                # server lost
+                policy = self.configuration.on_server_lost
+                if policy == "finish-running":
+                    logger.warning(
+                        "server lost; finishing running tasks then exiting"
+                    )
+                    await self._finish_running_then_exit()
+                    return
+                if policy != "reconnect":
+                    logger.warning("server lost; stopping")
+                    return
+                if not await self._reconnect_with_backoff():
+                    logger.error(
+                        "could not reconnect within the reconnect window; "
+                        "stopping"
+                    )
+                    return
+        finally:
+            for rt in self.running.values():
+                if rt.launched is not None:
+                    rt.launched.kill()
+            if self.localcomm is not None:
+                self.localcomm.close()
+            if self._conn:
+                self._conn.close()
+
+    async def _connect(self, reattach: bool) -> None:
+        """One connect + register handshake; sets self._conn on success.
+
+        With `reattach`, the register message carries the previous identity
+        and the still-running (task, instance) set so the server can
+        reattach what it held for us; the `registered` reply then names the
+        stale incarnations to kill."""
+        host, port, key = self.host, self.port, self.secret_key
+        if reattach and self.server_dir is not None:
+            # re-resolve from the server dir: a restarted server lives in a
+            # NEW instance dir with fresh ports and plane keys
+            from hyperqueue_tpu.utils import serverdir
+
+            access = serverdir.load_access(self.server_dir)
+            host = access.host_for_workers()
+            port = access.worker_port
+            key = access.worker_key_bytes()
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            conn, registered = await self._handshake(reader, writer, key, reattach)
+        except BaseException:
+            # covers cancellation by the per-attempt timeout too: never
+            # leak a half-authenticated socket per failed attempt
+            writer.close()
+            raise
+        self._apply_registration(registered, host, port, key, conn, reattach)
+
+    async def _handshake(self, reader, writer, key, reattach: bool):
+        conn = await do_authentication(
+            reader, writer, ROLE_WORKER, ROLE_SERVER, key
+        )
+        register: dict = {
+            "op": "register",
+            "config": self.configuration.to_wire(),
+        }
+        if reattach:
+            register["reattach"] = {
+                "worker_id": self.worker_id,
+                "server_uid": self.server_uid,
+                "running": [
+                    {"id": task_id, "instance": rt.msg.get("instance", 0),
+                     # the variant actually executing: the server needs it
+                     # to account the right resource amounts when it never
+                     # journaled this task's start
+                     "variant": rt.msg.get("variant", 0)}
+                    for task_id, rt in self.running.items()
+                ],
+                # parked-but-never-started tasks must be declared too: the
+                # restored server re-issues them (no task-started was ever
+                # journaled) and a silently-kept local copy would execute
+                # alongside the re-issue under the SAME instance id —
+                # invisible to the fence. The server always discards these.
+                "blocked": [
+                    {"id": t["id"], "instance": t.get("instance", 0)}
+                    for group in self.blocked.values()
+                    for t in group
+                ],
+            }
+        await conn.send(register)
+        registered = await conn.recv()
+        if registered.get("op") != "registered":
+            raise RuntimeError(f"registration failed: {registered}")
+        return conn, registered
+
+    def _apply_registration(
+        self, registered: dict, host, port, key, conn, reattach: bool
+    ) -> None:
+        self.worker_id = registered["worker_id"]
+        self.server_uid = registered.get("server_uid", "")
+        if self.configuration.idle_timeout_secs < 0:
+            # --idle-timeout not given: adopt the server-wide default
+            # (reference tako rpc.rs:130 sync_worker_configuration). An
+            # explicit --idle-timeout 0 opts out and is left alone.
+            self.configuration.idle_timeout_secs = float(
+                registered.get("server_idle_timeout") or 0.0
+            )
+        self.host, self.port, self.secret_key = host, port, key
+        self._conn = conn
+        if reattach:
+            discard = registered.get("discard") or []
+            for task_id in discard:
+                # the server refused this incarnation (requeued under a
+                # newer instance, already terminal, or never held): kill it
+                # so a rescheduled copy elsewhere stays the only execution
+                logger.warning(
+                    "task %d is stale after reconnect; killing it", task_id
+                )
+                if task_id in self.running:
+                    self._discarded.add(task_id)
+                self._cancel_task(task_id)
+            if discard:
+                # forget the discarded incarnations: the restored server
+                # may legitimately re-issue one of these (task, instance)
+                # pairs (it never saw them start), and the dedup guard
+                # must not swallow the re-delivery
+                dropped = set(discard)
+                self._recent_tasks = OrderedDict(
+                    (k, None) for k in self._recent_tasks
+                    if k[0] not in dropped
+                )
+            logger.warning(
+                "reconnected as worker %d (%d task(s) reattached, "
+                "%d stale discarded)",
+                self.worker_id,
+                len(registered.get("reattached") or ()),
+                len(discard),
+            )
+
+    async def _reconnect_with_backoff(self) -> bool:
+        """Retry the handshake with jittered exponential backoff; running
+        tasks keep executing (and queue their results) throughout. Returns
+        False once the reconnect window (`--reconnect-timeout`, 0 = keep
+        trying forever) or the worker time limit is exhausted."""
+        window = self.configuration.reconnect_timeout_secs
+        deadline = time.monotonic() + window if window > 0 else None
+        delay = self.RECONNECT_BACKOFF_BASE
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                await asyncio.wait_for(
+                    self._connect(reattach=True),
+                    timeout=self.RECONNECT_ATTEMPT_TIMEOUT,
+                )
+                return True
+            except (
+                ConnectionError,
+                OSError,
+                RuntimeError,
+                # ValueError covers a torn/corrupt access record mid-publish
+                # (json decode errors subclass it)
+                ValueError,
+                AuthError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,
+            ) as e:
+                now = time.monotonic()
+                limit = self.configuration.time_limit_secs
+                if limit > 0 and now - self.started_at >= limit:
+                    logger.warning("time limit reached while reconnecting")
+                    return False
+                if deadline is not None and now >= deadline:
+                    logger.warning("reconnect attempt %d failed: %s", attempt, e)
+                    return False
+                sleep_for, delay = jittered_backoff(
+                    delay, self.RECONNECT_BACKOFF_CAP, self._rng,
+                    remaining=(
+                        deadline - now if deadline is not None else None
+                    ),
+                )
+                logger.info(
+                    "reconnect attempt %d failed (%s); retrying in %.2fs",
+                    attempt, e, sleep_for,
+                )
+                await asyncio.sleep(sleep_for)
+
+    def _rebuild_sendq(self) -> None:
+        """Order the next session's uplink queue: replayed final messages
+        first (oldest news), then unsent parked messages, then whatever was
+        queued while disconnected. Heartbeats/overviews are dropped — they
+        describe a dead connection's moment in time."""
+        items: list[dict] = list(self._done_log.values())
+        self._done_log.clear()
+        items.extend(self._replay)
+        self._replay = []
+        while True:
+            try:
+                items.append(self._sendq.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        fresh: asyncio.Queue = asyncio.Queue()
+        seen: set[int] = set()
+        for msg in items:
+            if msg.get("op") in ("heartbeat", "overview"):
+                continue
+            if id(msg) in seen:
+                continue  # same dict parked via both _done_log and _replay
+            seen.add(id(msg))
+            fresh.put_nowait(msg)
+        self._sendq = fresh
+
+    async def _run_session(self) -> str:
+        """Drive one connected session; returns "stop" (deliberate exit)
+        or "lost" (connection failure)."""
+        self._rebuild_sendq()
         tasks = [
             asyncio.create_task(self._message_loop()),
             asyncio.create_task(self._send_drainer()),
@@ -136,26 +427,20 @@ class WorkerRuntime:
         tasks.append(asyncio.create_task(self._overview_loop()))
         stop_wait = asyncio.create_task(self._stop.wait())
         try:
-            done, pending = await asyncio.wait(
+            done, _pending = await asyncio.wait(
                 tasks + [stop_wait], return_when=asyncio.FIRST_COMPLETED
             )
             for t in done:
                 if t is not stop_wait and t.exception():
                     raise t.exception()
+            return "stop"
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
-            if self.configuration.on_server_lost == "finish-running":
-                logger.warning("server lost (%s); finishing running tasks", e)
-                await self._finish_running_then_exit()
-            else:
-                logger.warning("server lost (%s); stopping", e)
+            logger.warning("server connection lost (%s)", e)
+            return "lost"
         finally:
             for t in tasks + [stop_wait]:
                 t.cancel()
-            for rt in self.running.values():
-                if rt.launched is not None:
-                    rt.launched.kill()
-            if self.localcomm is not None:
-                self.localcomm.close()
+            await asyncio.gather(*tasks, stop_wait, return_exceptions=True)
             if self._conn:
                 self._conn.close()
 
@@ -166,46 +451,74 @@ class WorkerRuntime:
     async def _message_loop(self) -> None:
         while True:
             msg = await self._conn.recv()
-            op = msg.get("op")
-            if op == "compute":
-                shared = msg.get("shared_bodies")
-                for task_msg in msg["tasks"]:
-                    if shared is not None and "b" in task_msg:
-                        # resolve the shared/separate split; the body dict
-                        # stays shared between tasks (read-only downstream)
-                        task_msg["body"] = shared[task_msg.pop("b")]
-                    self._try_start(task_msg)
-            elif op == "cancel":
-                for task_id in msg["task_ids"]:
-                    self._cancel_task(task_id)
-            elif op == "retract":
-                for task_id, instance in msg["tasks"]:
-                    # retract may only reclaim NOT-YET-STARTED tasks: remove
-                    # from the blocked queue, never touch running ones (the
-                    # server treats ok=False as "it started, leave it be").
-                    # The instance is echoed so the server can discard stale
-                    # answers, like every other task message.
-                    before = self._n_blocked
-                    self._remove_blocked(task_id)
-                    await self._send(
-                        {
-                            "op": "retract_response",
-                            "id": task_id,
-                            "instance": instance,
-                            "ok": self._n_blocked < before,
-                        }
-                    )
-            elif op == "set_overview_override":
-                interval = msg.get("interval")
-                self._overview_override = (
-                    float(interval) if interval is not None else None
+            action = None
+            if chaos.ACTIVE:
+                action = await chaos.on_message(
+                    "worker.recv", op=msg.get("op")
                 )
-                self._overview_wake.set()
-            elif op == "stop":
-                self._stop.set()
+                if action == "drop":
+                    continue
+            if await self._handle_server_message(msg):
                 return
-            else:
-                logger.warning("unknown server message %r", op)
+            if action == "dup" and await self._handle_server_message(msg):
+                return
+
+    async def _handle_server_message(self, msg: dict) -> bool:
+        """Process one server message; True = stop requested."""
+        op = msg.get("op")
+        if op == "compute":
+            shared = msg.get("shared_bodies")
+            for task_msg in msg["tasks"]:
+                if shared is not None and "b" in task_msg:
+                    # resolve the shared/separate split; the body dict
+                    # stays shared between tasks (read-only downstream)
+                    task_msg["body"] = shared[task_msg.pop("b")]
+                key = (task_msg["id"], task_msg.get("instance", 0))
+                if key in self._recent_tasks:
+                    # duplicate delivery of the same incarnation (chaos
+                    # dup, or a replayed server send queue): never run a
+                    # task twice
+                    logger.warning(
+                        "dropping duplicate compute for task %d instance %d",
+                        key[0], key[1],
+                    )
+                    continue
+                self._recent_tasks[key] = None
+                while len(self._recent_tasks) > self.RECENT_TASKS_MAX:
+                    self._recent_tasks.popitem(last=False)
+                self._try_start(task_msg)
+        elif op == "cancel":
+            for task_id in msg["task_ids"]:
+                self._cancel_task(task_id)
+        elif op == "retract":
+            for task_id, instance in msg["tasks"]:
+                # retract may only reclaim NOT-YET-STARTED tasks: remove
+                # from the blocked queue, never touch running ones (the
+                # server treats ok=False as "it started, leave it be").
+                # The instance is echoed so the server can discard stale
+                # answers, like every other task message.
+                before = self._n_blocked
+                self._remove_blocked(task_id)
+                await self._send(
+                    {
+                        "op": "retract_response",
+                        "id": task_id,
+                        "instance": instance,
+                        "ok": self._n_blocked < before,
+                    }
+                )
+        elif op == "set_overview_override":
+            interval = msg.get("interval")
+            self._overview_override = (
+                float(interval) if interval is not None else None
+            )
+            self._overview_wake.set()
+        elif op == "stop":
+            self._stop.set()
+            return True
+        else:
+            logger.warning("unknown server message %r", op)
+        return False
 
     def _park(self, sig: tuple, task_msg: dict) -> None:
         """Park a task in its signature group, ordered by priority
@@ -335,6 +648,13 @@ class WorkerRuntime:
                     code, detail = -1, ""
             else:
                 code, detail = await launched.wait()
+            if task_id in self._discarded:
+                # killed as a stale incarnation at reconnect: exit silently
+                # (a report could pass the fence against a re-issued copy
+                # running elsewhere under the same instance id)
+                if streamer is not None:
+                    streamer.close_task(task_id, instance)
+                return
             if timed_out:
                 if streamer is not None:
                     streamer.close_task(task_id, instance)
@@ -369,18 +689,20 @@ class WorkerRuntime:
             raise
         except Exception as e:  # noqa: BLE001 - report, don't kill the worker
             logger.exception("task %d launch failed", task_id)
-            try:
-                await self._send(
-                    {
-                        "op": "task_failed",
-                        "id": task_id,
-                        "instance": instance,
-                        "error": f"failed to launch: {e}",
-                    }
-                )
-            except (ConnectionError, OSError):
-                pass
+            if task_id not in self._discarded:
+                try:
+                    await self._send(
+                        {
+                            "op": "task_failed",
+                            "id": task_id,
+                            "instance": instance,
+                            "error": f"failed to launch: {e}",
+                        }
+                    )
+                except (ConnectionError, OSError):
+                    pass
         finally:
+            self._discarded.discard(task_id)
             self.last_task_time = time.monotonic()
             if held_stream_dir is not None:
                 self._release_streamer(held_stream_dir)
@@ -583,8 +905,10 @@ async def run_worker(
     secret_key: bytes | None,
     configuration: WorkerConfiguration,
     zero_worker: bool = False,
+    server_dir: Path | None = None,
 ) -> None:
     runtime = WorkerRuntime(
-        host, port, secret_key, configuration, zero_worker=zero_worker
+        host, port, secret_key, configuration, zero_worker=zero_worker,
+        server_dir=server_dir,
     )
     await runtime.run()

@@ -28,3 +28,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (FaultPlan harness)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: long-running tests kept out of tier-1"
+    )

@@ -1,0 +1,415 @@
+"""Chaos harness e2e: deterministic fault injection against real processes.
+
+The FaultPlan (hyperqueue_tpu/utils/chaos.py) is threaded through the
+control plane via the HQ_FAULT_PLAN environment variable; tests here drive
+the failure matrix of docs/fault_tolerance.md end to end:
+
+- kill -9 the journaled server mid-job -> restart -> workers reconnect
+  with backoff and are REATTACHED (running tasks not requeued, no
+  crash-counter charge) -> job completes with zero duplicate executions;
+- a poisoned solve (exception) and a hung solve each degrade that tick to
+  the host greedy fallback, the server keeps scheduling, the degradation
+  shows in `hq server stats`, and the primary re-arms after N clean ticks;
+- --journal-fsync always: an event is on disk before the process can die
+  at that event (kill-at-event-K injection fires AFTER the flush);
+- duplicated messages on both planes never duplicate an execution
+  (worker-side (task, instance) dedup + server-side instance fencing);
+- heartbeat reaper drops a silent worker after heartbeat x factor and
+  emits the structured worker-lost event; a reconnect-mode worker then
+  re-registers and its stale incarnations are discarded.
+
+Everything is state-polled, never timing-guessed: tasks block on flag
+files, so the kill window is controlled exactly.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _jobs(env):
+    return json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+
+
+def _stats(env):
+    return json.loads(
+        env.command(["server", "stats", "--output-mode", "json"])
+    )
+
+
+def _journal_events(path):
+    from hyperqueue_tpu.events.journal import Journal
+
+    return list(Journal.read_all(path))
+
+
+# --------------------------------------------------------------------------
+# THE tentpole e2e: kill -9 the journaled server mid-job; workers reconnect
+# and are reattached; zero duplicate executions.
+# --------------------------------------------------------------------------
+def test_server_kill9_reattach_zero_duplicates(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    marker = env.work_dir / "starts.txt"
+    flag = env.work_dir / "flag"
+    env.start_server("--journal", str(journal), "--reattach-timeout", "60")
+    env.start_worker(
+        "--on-server-lost", "reconnect", "--heartbeat", "1", cpus=4
+    )
+    env.wait_workers(1)
+    # each execution appends one start line; tasks then block on the flag
+    # file, so nothing can complete inside the kill window
+    env.command([
+        "submit", "--array", "0-3", "--", "bash", "-c",
+        f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; '
+        f"while [ ! -f {flag} ]; do sleep 0.2; done; "
+        f'echo "done:$HQ_TASK_ID" >> {marker}',
+    ])
+
+    def all_running():
+        jobs = _jobs(env)
+        return jobs and jobs[0]["counters"]["running"] == 4
+
+    wait_until(all_running, timeout=30, message="4 tasks running")
+    env.kill_process("server")  # SIGKILL — no clean close, no goodbye
+
+    env.start_server("--journal", str(journal), "--reattach-timeout", "60")
+    env.command(["server", "wait", "--timeout", "20"])
+
+    # the worker reconnects with backoff and re-registers; its 4 running
+    # tasks must be REATTACHED: running again, with no restart event and
+    # no instance bump
+    def reattached():
+        jobs = _jobs(env)
+        return jobs and jobs[0]["counters"]["running"] == 4
+
+    wait_until(reattached, timeout=30, message="tasks reattached as running")
+    stats = _stats(env)
+    assert stats["reattach_pending"] == 0
+
+    flag.touch()
+    env.command(["job", "wait", "all"], timeout=40)
+    jobs = _jobs(env)
+    assert jobs[0]["status"] == "finished"
+
+    lines = marker.read_text().splitlines()
+    starts = sorted(l for l in lines if l.startswith("start:"))
+    dones = sorted(l for l in lines if l.startswith("done:"))
+    # zero duplicate executions, asserted via the instance ids the harness
+    # recorded: every task started exactly once, always as instance 0
+    assert starts == [f"start:{i}:0" for i in range(4)], lines
+    assert dones == [f"done:{i}" for i in range(4)], lines
+
+    # no crash-counter charge and no requeue: the journal must contain no
+    # task-restarted events, and each task exactly one task-started
+    env.command(["journal", "flush"])
+    events = _journal_events(journal)
+    assert not [e for e in events if e["event"] == "task-restarted"]
+    # task-started appears once per task from the original run plus once
+    # per reattach — always the SAME instance 0 (never a new incarnation)
+    started = [e for e in events if e["event"] == "task-started"]
+    assert {e["task"] for e in started} == {0, 1, 2, 3}
+    assert all(e["instance"] == 0 for e in started)
+
+
+def test_reattach_window_expiry_requeues_with_fencing(env, tmp_path):
+    """If the pre-crash worker never comes back, the reattach window
+    expires, the task is requeued with a bumped instance (fencing), and a
+    fresh worker completes it."""
+    journal = tmp_path / "journal.bin"
+    marker = env.work_dir / "starts.txt"
+    env.start_server("--journal", str(journal))
+    env.start_worker(cpus=1)  # default --on-server-lost stop: it will die
+    env.wait_workers(1)
+    env.command([
+        "submit", "--", "bash", "-c",
+        f'echo "start:$HQ_INSTANCE_ID" >> {marker}; sleep 600',
+    ])
+    wait_until(
+        lambda: _jobs(env) and _jobs(env)[0]["counters"]["running"] == 1,
+        timeout=30, message="task running",
+    )
+    env.kill_process("server")
+    env.start_server("--journal", str(journal), "--reattach-timeout", "2")
+    env.command(["server", "wait", "--timeout", "20"])
+    # held for reattach first
+    assert _stats(env)["reattach_pending"] == 1
+    # window expires with no reconnecting worker -> requeued
+    wait_until(
+        lambda: _stats(env)["reattach_pending"] == 0,
+        timeout=15, message="reattach window expiry",
+    )
+    env.start_worker(cpus=1)
+    wait_until(
+        lambda: _jobs(env) and _jobs(env)[0]["counters"]["running"] == 1,
+        timeout=30, message="task restarted on the new worker",
+    )
+    # the re-execution runs under instance 1: the dead incarnation (0) is
+    # fenced out
+    lines = marker.read_text().splitlines()
+    assert lines[-1] == "start:1"
+
+
+# --------------------------------------------------------------------------
+# Solver watchdog: poisoned + hung solves degrade the tick, server keeps
+# scheduling, stats show it, primary re-arms after N clean ticks.
+# --------------------------------------------------------------------------
+def test_solver_watchdog_exception_degrades_and_rearms(env):
+    plan = {"rules": [{"site": "solve", "action": "raise", "at": 1}]}
+    env.start_server(
+        "--solver-rearm-ticks", "2",
+        env_extra={"HQ_FAULT_PLAN": json.dumps(plan)},
+    )
+    env.start_worker()
+    env.wait_workers(1)
+    # first solve is poisoned -> greedy fallback completes the job anyway
+    env.command(["submit", "--wait", "--", "true"], timeout=60)
+    stats = _stats(env)
+    assert stats["watchdog"]["failures"] == 1
+    assert stats["watchdog"]["degraded_ticks"] >= 1
+    assert "injected failure" in stats["watchdog"]["last_error"]
+    # more ticks: after 2 clean fallback ticks the primary re-arms
+    for _ in range(3):
+        env.command(["submit", "--wait", "--", "true"], timeout=60)
+    stats = _stats(env)
+    assert stats["watchdog"]["armed"] is True
+    assert stats["watchdog"]["rearms"] == 1
+    # and the re-armed primary serves ticks again without new failures
+    assert stats["watchdog"]["failures"] == 1
+
+
+def test_solver_watchdog_hang_falls_back_within_deadline(env):
+    plan = {
+        "rules": [
+            {"site": "solve", "action": "hang", "at": 1, "hang_s": 3}
+        ]
+    }
+    env.start_server(
+        "--solver-watchdog-timeout", "1", "--solver-rearm-ticks", "1",
+        env_extra={"HQ_FAULT_PLAN": json.dumps(plan)},
+    )
+    env.start_worker()
+    env.wait_workers(1)
+    t0 = time.monotonic()
+    # a 3s hang must NOT block this: the watchdog deadline (1s) degrades
+    # the tick to the fallback and the job completes before the hang ends
+    env.command(["submit", "--wait", "--", "true"], timeout=60)
+    assert time.monotonic() - t0 < 30
+    stats = _stats(env)
+    assert stats["watchdog"]["timeouts"] == 1
+    assert stats["watchdog"]["degraded_ticks"] >= 1
+    # the primary may not re-arm while the stranded solve thread is still
+    # inside the (stateful) model; once it drains, re-arming resumes
+    time.sleep(3.5)
+    env.command(["submit", "--wait", "--", "true"], timeout=60)
+    assert _stats(env)["watchdog"]["armed"] is True
+
+
+# --------------------------------------------------------------------------
+# Journal fsync policy: an event is on disk before a kill -9 AT that event.
+# --------------------------------------------------------------------------
+def test_fsync_always_event_survives_kill9_at_event(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    plan = {
+        "rules": [
+            {"site": "server.event", "event": "task-finished",
+             "action": "kill", "at": 1}
+        ]
+    }
+    server = env.start_server(
+        "--journal", str(journal), "--journal-fsync", "always",
+        env_extra={"HQ_FAULT_PLAN": json.dumps(plan)},
+    )
+    env.start_worker()
+    env.wait_workers(1)
+    env.command(["submit", "--", "true"])
+    # the server SIGKILLs itself at the first task-finished event — after
+    # the write + fsync, so the event must be durable
+    wait_until(
+        lambda: server.poll() is not None,
+        timeout=30, message="server killed itself at the event",
+    )
+    kinds = [e["event"] for e in _journal_events(journal)]
+    assert "task-finished" in kinds
+
+
+# --------------------------------------------------------------------------
+# Duplicate/delayed messages on both planes never duplicate an execution.
+# --------------------------------------------------------------------------
+def test_duplicated_messages_no_duplicate_execution(env, tmp_path):
+    marker = env.work_dir / "starts.txt"
+    # duplicate EVERY compute delivery server->worker and every
+    # task_finished worker->server, and delay a few frames for reorder
+    # pressure; seeded => the same faults every run
+    server_plan = {
+        "seed": 7,
+        "rules": [
+            {"site": "server.send", "op": "compute", "action": "dup"},
+            {"site": "server.recv", "op": "task_finished", "action": "dup"},
+        ],
+    }
+    worker_plan = {
+        "seed": 7,
+        "rules": [
+            {"site": "worker.send", "op": "task_finished", "action": "dup"},
+            {"site": "worker.recv", "op": "compute", "action": "delay",
+             "delay_ms": 20, "prob": 0.5},
+        ],
+    }
+    env.start_server(env_extra={"HQ_FAULT_PLAN": json.dumps(server_plan)})
+    env.start_worker(env_extra={"HQ_FAULT_PLAN": json.dumps(worker_plan)})
+    env.wait_workers(1)
+    env.command([
+        "submit", "--wait", "--array", "0-19", "--", "bash", "-c",
+        f'echo "start:$HQ_TASK_ID" >> {marker}',
+    ], timeout=90)
+    jobs = _jobs(env)
+    assert jobs[0]["counters"]["finished"] == 20
+    starts = sorted(marker.read_text().splitlines())
+    assert starts == sorted(f"start:{i}" for i in range(20)), starts
+
+
+# --------------------------------------------------------------------------
+# Heartbeat reaper: structured worker-lost + live-server reconnect discard.
+# --------------------------------------------------------------------------
+def test_heartbeat_timeout_structured_event_and_reconnect(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    env.start_server(
+        "--journal", str(journal), "--heartbeat-timeout-factor", "4",
+    )
+    worker = env.start_worker(
+        "--heartbeat", "0.5", "--on-server-lost", "reconnect",
+    )
+    env.wait_workers(1)
+    # silence the worker: SIGSTOP freezes heartbeats while the TCP
+    # connection stays open — exactly what the reaper exists for
+    os.kill(worker.pid, signal.SIGSTOP)
+    try:
+        def lost_event():
+            env.command(["journal", "flush"])
+            lost = [
+                e for e in _journal_events(journal)
+                if e["event"] == "worker-lost"
+            ]
+            return lost or None
+
+        lost = wait_until(lost_event, timeout=30, message="worker-lost event")
+        assert lost[0]["reason"] == "heartbeat timeout"
+        # structured fields: how stale the heartbeat was, and that this
+        # loss kind is reattach-eligible (the worker may come back)
+        assert lost[0]["heartbeat_age"] >= 1.5
+        assert lost[0]["reattach_eligible"] is True
+    finally:
+        os.kill(worker.pid, signal.SIGCONT)
+    # the thawed worker notices the dead connection and re-registers under
+    # a new id (live server: no reattach hold, stale tasks discarded)
+    def new_worker():
+        ws = json.loads(
+            env.command(["worker", "list", "--output-mode", "json"])
+        )
+        return [w for w in ws if w["id"] != 1] or None
+
+    wait_until(new_worker, timeout=30, message="worker re-registered")
+
+
+# --------------------------------------------------------------------------
+# Client retry: CLI commands ride out a server restart window.
+# --------------------------------------------------------------------------
+def test_client_request_rides_out_server_restart(env, tmp_path):
+    import threading
+
+    env.start_server()
+    env.command(["job", "list"])  # baseline
+    env.kill_process("server")  # SIGKILL: hq-current symlink survives
+
+    def restart_later():
+        time.sleep(1.5)
+        env.start_server()
+
+    t = threading.Thread(target=restart_later)
+    t.start()
+    try:
+        # issued while the server is DOWN: the bounded retry must carry it
+        # across the restart (new instance dir, new port, new key)
+        out = env.command(
+            ["job", "list", "--output-mode", "json"],
+            timeout=60,
+        )
+        assert json.loads(out) == []
+    finally:
+        t.join()
+
+
+def test_client_retry_is_bounded(env):
+    env.start_server()
+    env.kill_process("server")
+    t0 = time.monotonic()
+    env.command(
+        ["job", "list"], expect_fail=True, timeout=60,
+    )
+    # fails once the (shortened) window closes — not immediately, not ever-
+    # retrying
+    elapsed = time.monotonic() - t0
+    assert elapsed < 45
+
+
+# --------------------------------------------------------------------------
+# Longer chaos cycles, kept out of tier-1.
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_kill_restart_cycles_complete_all_work(env, tmp_path):
+    """Two consecutive kill -9/restart cycles with work in every state
+    (running, queued, finished): everything completes exactly once."""
+    journal = tmp_path / "journal.bin"
+    marker = env.work_dir / "starts.txt"
+    flag = env.work_dir / "flag"
+    args = ["--journal", str(journal), "--reattach-timeout", "60"]
+    env.start_server(*args)
+    env.start_worker(
+        "--on-server-lost", "reconnect", "--reconnect-timeout", "120",
+        cpus=2,
+    )
+    env.wait_workers(1)
+    # 2 cpus, 6 tasks: 2 run, 4 queue
+    env.command([
+        "submit", "--array", "0-5", "--", "bash", "-c",
+        f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; '
+        f"while [ ! -f {flag} ]; do sleep 0.2; done",
+    ])
+    for _ in range(2):
+        wait_until(
+            lambda: _jobs(env) and _jobs(env)[0]["counters"]["running"] >= 2,
+            timeout=30, message="tasks running",
+        )
+        # kill the newest live server
+        for name, proc in reversed(env.processes):
+            if name.startswith("server") and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+                break
+        env.start_server(*args)
+        env.command(["server", "wait", "--timeout", "20"])
+        wait_until(
+            lambda: _jobs(env) and _jobs(env)[0]["counters"]["running"] >= 2,
+            timeout=30, message="tasks reattached",
+        )
+    flag.touch()
+    env.command(["job", "wait", "all"], timeout=60)
+    jobs = _jobs(env)
+    assert jobs[0]["counters"]["finished"] == 6
+    starts = sorted(marker.read_text().splitlines())
+    assert starts == sorted(f"start:{i}:0" for i in range(6)), starts

@@ -49,6 +49,126 @@ def test_journal_torn_tail_truncated(tmp_path):
     assert len(list(Journal.read_all(path))) == 2
 
 
+def _write_journal(path, records):
+    j = Journal(path)
+    j.open_for_append()
+    for r in records:
+        j.write(r)
+    j.close()
+
+
+_RESTORE_RECORDS = [
+    {"event": "job-submitted", "job": 1,
+     "desc": {"name": "j", "tasks": [{"id": 0, "body": {}},
+                                     {"id": 1, "body": {}}]},
+     "n_tasks": 2},
+    {"event": "task-started", "job": 1, "task": 0, "instance": 0,
+     "variant": 0, "workers": [1]},
+    {"event": "task-restarted", "job": 1, "task": 0, "crash_count": 2,
+     "instance": 1},
+    {"event": "task-started", "job": 1, "task": 0, "instance": 1,
+     "variant": 0, "workers": [2]},
+]
+
+
+def _restore_server(tmp_path, journal, reattach_timeout):
+    from hyperqueue_tpu.events.restore import restore_from_journal
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    server = Server(
+        server_dir=tmp_path, journal_path=journal,
+        reattach_timeout=reattach_timeout,
+    )
+    restore_from_journal(server)
+    return server
+
+
+def test_restore_roundtrips_instance_and_crash_counters(tmp_path):
+    """A maybe-running task is restored with its LAST started instance id
+    and its crash counter; with a reattach window it is held out of the
+    queues for its pre-crash worker, without one it is fenced (instance+1)
+    and requeued."""
+    from hyperqueue_tpu.ids import make_task_id
+
+    journal = tmp_path / "j.bin"
+    _write_journal(journal, _RESTORE_RECORDS)
+
+    server = _restore_server(tmp_path, journal, reattach_timeout=30.0)
+    started = server.core.tasks[make_task_id(1, 0)]
+    fresh = server.core.tasks[make_task_id(1, 1)]
+    assert started.instance_id == 1  # last-started, NOT a count
+    assert started.crash_counter == 2
+    assert started.task_id in server.reattach_pending
+    assert server.core.queues.total_ready() == 1  # only the never-started
+    assert fresh.instance_id == 0
+
+    # reattach disabled: the started task is fenced and queued immediately
+    server = _restore_server(tmp_path, journal, reattach_timeout=0.0)
+    started = server.core.tasks[make_task_id(1, 0)]
+    assert started.instance_id == 2  # pre-crash incarnation 1 fenced out
+    assert started.crash_counter == 2
+    assert not server.reattach_pending
+    assert server.core.queues.total_ready() == 2
+
+
+def test_restore_counters_survive_mid_record_truncation(tmp_path):
+    """Kill -9 mid-write leaves a torn tail at ANY byte offset; restore
+    must consume exactly the complete-record prefix (read.rs:60 behavior)
+    — never raise, never double-count instances — and open_for_append must
+    truncate the tail and keep appending."""
+    from hyperqueue_tpu.events.journal import MAGIC
+
+    journal = tmp_path / "j.bin"
+    _write_journal(journal, _RESTORE_RECORDS)
+    blob = journal.read_bytes()
+
+    # record boundaries, to know how many records each cut preserves
+    import struct
+
+    bounds = [len(MAGIC)]
+    pos = len(MAGIC)
+    while pos < len(blob):
+        (length,) = struct.unpack_from("<I", blob, pos)
+        pos += 4 + length
+        bounds.append(pos)
+
+    torn = tmp_path / "torn.bin"
+    for cut in range(len(MAGIC), len(blob)):
+        torn.write_bytes(blob[:cut])
+        n_complete = sum(1 for b in bounds[1:] if b <= cut)
+        records = list(Journal.read_all(torn))
+        assert len(records) == n_complete, f"cut at byte {cut}"
+        # restore over the torn journal: counters reflect the complete
+        # prefix only
+        server = _restore_server(tmp_path, torn, reattach_timeout=30.0)
+        if n_complete >= 2:
+            from hyperqueue_tpu.ids import make_task_id
+
+            task = server.core.tasks[make_task_id(1, 0)]
+            assert task.crash_counter == (2 if n_complete >= 3 else 0)
+            if n_complete == 2:
+                # last complete event: task-started(0) -> maybe running,
+                # held at instance 0
+                assert task.instance_id == 0
+                assert task.task_id in server.reattach_pending
+            elif n_complete == 3:
+                # last complete event: task-restarted(1) -> NOT running
+                # anywhere; fenced past the journal-max instance + queued
+                assert task.instance_id == 2
+                assert task.task_id not in server.reattach_pending
+            else:
+                # full journal: re-started at instance 1, held
+                assert task.instance_id == 1
+                assert task.task_id in server.reattach_pending
+        # appending over the torn tail truncates it cleanly
+        j = Journal(torn)
+        j.open_for_append()
+        assert torn.stat().st_size == bounds[n_complete]
+        j.write({"event": "job-closed", "job": 1})
+        j.close()
+        assert len(list(Journal.read_all(torn))) == n_complete + 1
+
+
 def test_journal_prune(tmp_path):
     path = tmp_path / "j.bin"
     j = Journal(path)

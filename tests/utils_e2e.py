@@ -49,11 +49,13 @@ class HqEnv:
         self.work_dir.mkdir(parents=True, exist_ok=True)
         self.processes: list[tuple[str, subprocess.Popen]] = []
 
-    def _spawn(self, name: str, args: list[str], cwd=None) -> subprocess.Popen:
+    def _spawn(
+        self, name: str, args: list[str], cwd=None, env_extra=None
+    ) -> subprocess.Popen:
         log = open(self.tmp / f"{name}.log", "wb")
         process = subprocess.Popen(
             [sys.executable, "-m", "hyperqueue_tpu", *args],
-            env=_env_base(),
+            env={**_env_base(), **(env_extra or {})},
             cwd=cwd or self.work_dir,
             stdout=log,
             stderr=subprocess.STDOUT,
@@ -61,7 +63,7 @@ class HqEnv:
         self.processes.append((name, process))
         return process
 
-    def start_server(self, *extra: str) -> subprocess.Popen:
+    def start_server(self, *extra: str, env_extra=None) -> subprocess.Popen:
         before = {
             p.name for p in self.server_dir.iterdir() if p.name.isdigit()
         } if self.server_dir.exists() else set()
@@ -69,6 +71,7 @@ class HqEnv:
         process = self._spawn(
             "server" if n == 0 else f"server{n}",
             ["server", "start", "--server-dir", str(self.server_dir), *extra],
+            env_extra=env_extra,
         )
 
         def new_instance_ready():
@@ -89,13 +92,15 @@ class HqEnv:
         )
         return process
 
-    def start_worker(self, *extra: str, cpus: int | None = 4) -> subprocess.Popen:
+    def start_worker(
+        self, *extra: str, cpus: int | None = 4, env_extra=None
+    ) -> subprocess.Popen:
         args = ["worker", "start", "--server-dir", str(self.server_dir)]
         if cpus is not None:
             args += ["--cpus", str(cpus)]
         args += list(extra)
         n = sum(1 for name, _ in self.processes if name.startswith("worker"))
-        return self._spawn(f"worker{n}", args)
+        return self._spawn(f"worker{n}", args, env_extra=env_extra)
 
     def command(
         self, args: list[str], cwd=None, expect_fail=False, timeout=60.0,
