@@ -586,6 +586,97 @@ def run_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_metrics_bench(args) -> None:
+    """End-to-end metrics-plane gate: start a real server (--metrics-port 0)
+    + zero-worker, scrape the Prometheus endpoint before and after a
+    1k-task run, and emit tick-phase histogram summaries alongside the
+    wall-clock timing — the scrape-diff is what later perf PRs report
+    against. Also validates that the exposition parses and contains the
+    tick-phase histograms, solver counters and per-worker gauges the
+    acceptance criteria name."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    from hyperqueue_tpu.utils.metrics import (
+        histogram_summary,
+        parse_exposition,
+        scrape,
+    )
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from utils_e2e import HqEnv
+
+    n_tasks = min(args.tasks, 1000) if args.tasks else 1000
+    failures = []
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        with HqEnv(Path(td)) as env:
+            env.start_server("--metrics-port", "0")
+            env.start_worker("--zero-worker", "--overview-interval", "0.5",
+                             cpus=8)
+            env.wait_workers(1)
+            info = json.loads(env.command(
+                ["server", "info", "--output-mode", "json"]
+            ))
+            port = info.get("metrics_port")
+            if not port:
+                # the very regression this gate guards: report it as a
+                # failure JSON instead of crashing on an unscrapeable port
+                print(json.dumps({
+                    "metric": "metrics_scrape_1k_tasks", "ok": False,
+                    "failures": ["server info reports no metrics_port"],
+                }))
+                sys.exit(1)
+            env.command(["server", "reset-metrics"])
+            before = parse_exposition(scrape("127.0.0.1", port))
+            t_run = time.perf_counter()
+            env.command([
+                "submit", "--array", f"0-{n_tasks - 1}", "--wait", "--",
+                "true",
+            ], timeout=120)
+            run_s = time.perf_counter() - t_run
+            after_text = scrape("127.0.0.1", port)
+            after = parse_exposition(after_text)
+
+            phases = histogram_summary(after, "hq_tick_phase_seconds")
+            if not phases:
+                failures.append("no tick-phase histograms in the scrape")
+            for required in ("hq_scheduler_ticks_total",
+                             "hq_solver_failures_total",
+                             "hq_workers_connected"):
+                if required not in after:
+                    failures.append(f"{required} missing from the scrape")
+            ticks_before = sum(
+                before.get("hq_scheduler_ticks_total", {})
+                .get("samples", {}).values()
+            )
+            ticks_after = sum(
+                after.get("hq_scheduler_ticks_total", {})
+                .get("samples", {}).values()
+            )
+            if ticks_after <= ticks_before:
+                failures.append("tick counter did not advance over the run")
+            timeline = json.loads(env.command(
+                ["job", "timeline", "last", "--output-mode", "json"]
+            ))[0]
+    print(json.dumps({
+        "metric": "metrics_scrape_1k_tasks",
+        "ok": not failures,
+        "failures": failures,
+        "value": round(run_s, 3),
+        "unit": "s",
+        "n_tasks": n_tasks,
+        "ticks": int(ticks_after - ticks_before),
+        "tick_phases": phases,
+        "timeline_phases": timeline.get("phases"),
+        "timeline_makespan": timeline.get("makespan"),
+        "total_s": round(time.perf_counter() - t0, 2),
+    }))
+    sys.exit(1 if failures else 0)
+
+
 def run_chaos_smoke() -> None:
     """One seeded kill -9/restart cycle against real processes: submit
     blocked work to a journaled server, SIGKILL it mid-job, restart it,
@@ -681,6 +772,10 @@ def main() -> None:
                         help="one seeded kill -9/restart cycle: workers "
                              "reconnect + reattach, job completes, zero "
                              "duplicate executions")
+    parser.add_argument("--metrics", action="store_true",
+                        help="end-to-end metrics gate: scrape the server's "
+                             "Prometheus endpoint before/after a 1k-task "
+                             "run and emit tick-phase histogram summaries")
     parser.add_argument("--classes", type=int, default=128,
                         help="distinct request classes for --phases")
     parser.add_argument("--workers", type=int, default=None,
@@ -695,6 +790,10 @@ def main() -> None:
 
     if args.chaos_smoke:
         run_chaos_smoke()
+        return
+
+    if args.metrics:
+        run_metrics_bench(args)
         return
 
     if args.workers is None:

@@ -94,14 +94,20 @@ def _resolve_job_selector(session: ClientSession, text: str) -> list[int]:
 
 
 # ---------------------------------------------------------------- server cmds
-def cmd_server_start(args) -> None:
-    import asyncio
+def _setup_logging() -> None:
+    """Server and worker processes log to stderr at $HQ_LOG level."""
     import logging
 
     logging.basicConfig(
         level=os.environ.get("HQ_LOG", "INFO").upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+
+
+def cmd_server_start(args) -> None:
+    import asyncio
+
+    _setup_logging()
 
     # Enforce the scheduler's JAX platform: site preloads may hard-set the
     # platform (e.g. a TPU plugin overriding jax_platforms after reading
@@ -145,6 +151,8 @@ def cmd_server_start(args) -> None:
             reattach_timeout=args.reattach_timeout,
             solver_watchdog_timeout=args.solver_watchdog_timeout,
             solver_rearm_ticks=args.solver_rearm_ticks,
+            metrics_port=args.metrics_port,
+            metrics_host=args.metrics_host,
         )
         access = await server.start()
         print(
@@ -267,6 +275,10 @@ def cmd_server_generate_access(args) -> None:
 def cmd_worker_start(args) -> None:
     import asyncio
 
+    # without this the runtime's own reporting (reconnects, reattaches,
+    # the bound --metrics-port endpoint) goes nowhere
+    _setup_logging()
+
     from hyperqueue_tpu.server.worker import WorkerConfiguration
     from hyperqueue_tpu.worker.hwdetect import detect_resources
     from hyperqueue_tpu.worker.parser import parse_resource_definition
@@ -340,6 +352,8 @@ def cmd_worker_start(args) -> None:
         # reconnect re-reads the access record from the server dir (a
         # restarted server publishes new ports/keys)
         "server_dir": _server_dir(args),
+        "metrics_port": args.metrics_port,
+        "metrics_host": args.metrics_host,
     }
     if profile_out:
         import cProfile
@@ -990,6 +1004,73 @@ def cmd_job_wait(args) -> None:
     )
     if bad:
         raise SystemExit(1)
+
+
+def cmd_job_timeline(args) -> None:
+    """Task lifecycle timeline of selected jobs: per-phase
+    (pending/queued/dispatch/run) percentiles plus a slowest-task
+    drill-down, aggregated server-side from the same lifecycle stamps the
+    event journal carries."""
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        results = []
+        for job_id in ids:
+            results.append(session.request(
+                {"op": "job_timeline", "job_id": job_id,
+                 "detail": bool(args.tasks)}
+            ))
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        for r in results:
+            r.pop("op", None)
+        out.value(results)
+        return
+    for r in results:
+        out.message(
+            f"job {r['job']}: {r['n_finished']}/{r['n_tasks']} tasks "
+            f"finished, makespan {r['makespan']:.3f}s"
+        )
+        out.table(
+            ["phase", "count", "p50 (s)", "p95 (s)", "max (s)", "mean (s)",
+             "total (s)"],
+            [
+                [
+                    name,
+                    row["count"],
+                    f"{row['p50']:.4f}",
+                    f"{row['p95']:.4f}",
+                    f"{row['max']:.4f}",
+                    f"{row['mean']:.4f}",
+                    f"{row['total']:.3f}",
+                ]
+                for name, row in r["phases"].items()
+            ],
+        )
+        if r.get("slowest"):
+            out.message("slowest tasks:")
+            out.table(
+                ["task", "pending", "queued", "dispatch", "run",
+                 "total (s)"],
+                [
+                    [
+                        t["id"],
+                        f"{t['phases']['pending']:.4f}",
+                        f"{t['phases']['queued']:.4f}",
+                        f"{t['phases']['dispatch']:.4f}",
+                        f"{t['phases']['run']:.4f}",
+                        f"{t['finished'] - t['submitted']:.3f}",
+                    ]
+                    for t in r["slowest"]
+                ],
+            )
+
+
+def cmd_server_reset_metrics(args) -> None:
+    """Zero the server's metrics plane (registry, tracer spans, tick-phase
+    aggregates) so a benchmark can measure a steady-state window."""
+    with _session(args) as session:
+        session.request({"op": "reset_metrics"})
+    make_output(args.output_mode).message("metrics reset")
 
 
 def cmd_job_cancel(args) -> None:
@@ -1650,6 +1731,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="debug: every N ticks, run the incremental and the "
                         "from-scratch tick assembly and assert they are "
                         "bit-identical (0 = off)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve Prometheus metrics on this port (0 = "
+                        "ephemeral, see `hq server info`; off by default)")
+    p.add_argument("--metrics-host", default="0.0.0.0", metavar="HOST",
+                   help="bind address for the (unauthenticated) metrics "
+                        "endpoint; use 127.0.0.1 behind a scraping sidecar")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)
@@ -1666,6 +1753,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = ssub.add_parser("debug-dump", help="full server state as JSON")
     _add_common(p)
     p.set_defaults(fn=cmd_server_debug_dump)
+    p = ssub.add_parser(
+        "reset-metrics",
+        help="zero the metrics plane (registry + tracer + tick aggregates) "
+             "for steady-state benchmark windows",
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_server_reset_metrics)
     p = ssub.add_parser("wait", help="wait until the server is reachable")
     _add_common(p)
     p.add_argument("--timeout", type=float, default=60.0)
@@ -1721,6 +1815,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the worker's cpus would be busy (0.0-1.0)")
     p.add_argument("--zero-worker", action="store_true",
                    help="benchmark mode: tasks succeed instantly, no spawn")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve Prometheus metrics on this port (0 = "
+                        "ephemeral; off by default — worker gauges still "
+                        "piggyback on overview messages)")
+    p.add_argument("--metrics-host", default="0.0.0.0", metavar="HOST",
+                   help="bind address for the (unauthenticated) metrics "
+                        "endpoint; use 127.0.0.1 behind a scraping sidecar")
     p.set_defaults(fn=cmd_worker_start)
     p = wsub.add_parser("hw-detect", help="print detected node resources")
     _add_common(p)
@@ -1836,6 +1937,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = jsub.add_parser("summary", help="job counts per status")
     _add_common(p)
     p.set_defaults(fn=cmd_job_summary)
+    p = jsub.add_parser(
+        "timeline",
+        help="task lifecycle timeline: per-phase percentiles + slowest "
+             "tasks (submit -> queued -> assigned -> spawned -> finished)",
+    )
+    _add_common(p)
+    p.add_argument("selector")
+    p.add_argument("--tasks", action="store_true",
+                   help="include every task's timestamps (json mode)")
+    p.set_defaults(fn=cmd_job_timeline)
     p = jsub.add_parser("submit", help="alias of top-level `hq submit`")
     _add_submit_args(p)
     p = jsub.add_parser("task-ids", help="print task ids of selected jobs")

@@ -183,14 +183,37 @@ class DashboardData:
                 w.lost_at = t
                 w.lost_reason = record.get("reason", "")
                 w.running.clear()
+                # drop the utilization snapshot: a lost worker's last sample
+                # must not render as live load while (and after) its
+                # replacement reconnects under a new id
+                w.last_hw = {}
             self._mark_worker_count(t)
         elif kind == "worker-overview":
-            w = self.workers.get(record.get("id", 0))
-            if w is not None:
-                w.last_hw = record.get("hw", {}) or {}
-                cpu = w.last_hw.get("cpu_usage_percent")
-                if cpu is not None:
-                    w.cpu_history.append((t, float(cpu)))
+            wid = record.get("id", 0)
+            w = self.workers.get(wid)
+            if w is None:
+                # a reconnected worker re-registers under a NEW id and its
+                # first overview can outrun the worker-connected record in
+                # the stream; create the state so fresh utilization is
+                # never attributed to the stale pre-reconnect entry
+                w = self.workers[wid] = WorkerState(
+                    worker_id=wid, connected_at=t
+                )
+                self._mark_worker_count(t)
+            # prefer the structured gauge samples piggybacked by the worker
+            # runtime (the metrics plane); fall back to the raw hw dict for
+            # journals written before the metrics plane existed
+            gauges = {
+                s.get("name"): s.get("value")
+                for s in record.get("metrics") or []
+                if not s.get("labels")
+            }
+            w.last_hw = record.get("hw", {}) or {}
+            cpu = gauges.get(
+                "hq_worker_cpu_percent", w.last_hw.get("cpu_usage_percent")
+            )
+            if cpu is not None:
+                w.cpu_history.append((t, float(cpu)))
         elif kind == "job-submitted":
             job_id = record.get("job", 0)
             desc = record.get("desc", {}) or {}

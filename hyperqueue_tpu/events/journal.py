@@ -14,12 +14,28 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 from pathlib import Path
 
 import msgpack
 
+from hyperqueue_tpu.utils.metrics import REGISTRY
+
 MAGIC = b"hqtpujl1"
 _LEN = struct.Struct("<I")
+
+# fsync stalls are the journal's dominant latency risk (--journal-fsync
+# always puts one on every event); the histogram makes a slow disk visible
+# on the metrics plane instead of as mystery event-loop hiccups
+_FSYNC_SECONDS = REGISTRY.histogram(
+    "hq_journal_fsync_seconds", "journal fsync latency"
+)
+_WRITES_TOTAL = REGISTRY.counter(
+    "hq_journal_writes_total", "journal records appended"
+)
+_BYTES_TOTAL = REGISTRY.counter(
+    "hq_journal_bytes_total", "journal payload bytes appended"
+)
 
 
 class Journal:
@@ -59,12 +75,16 @@ class Journal:
     def write(self, record: dict) -> None:
         data = msgpack.packb(record, use_bin_type=True)
         self._file.write(_LEN.pack(len(data)) + data)
+        _WRITES_TOTAL.inc()
+        _BYTES_TOTAL.inc(len(data))
 
     def flush(self, sync: bool = False) -> None:
         if self._file is not None:
             self._file.flush()
             if sync:
+                t0 = time.perf_counter()
                 os.fsync(self._file.fileno())
+                _FSYNC_SECONDS.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         if self._file is not None:

@@ -40,6 +40,12 @@ def restore_from_journal(server) -> None:
     requeue happens here, the pre-reattach behavior.
     """
     task_status: dict[tuple[int, int], tuple[str, str]] = {}
+    # terminal event wall-clock per task (timeline: finished_at survives)
+    task_finished_at: dict[tuple[int, int], float] = {}
+    # lifecycle stamps of the LAST start per task: (queued, assigned,
+    # started) — `hq job timeline` keeps one unbroken span across a server
+    # restart + reattach instead of restarting the clock
+    task_started_at: dict[tuple[int, int], tuple[float, float, float]] = {}
     # highest instance id the journal saw per task (last task-started OR
     # task-restarted — a restart bumps the instance without a new start);
     # the live pre-crash worker holds at most this instance
@@ -72,9 +78,17 @@ def restore_from_journal(server) -> None:
                     is_open=desc.get("open", False),
                     job_id=job_id,
                 )
+            submit_time = float(record.get("time", 0.0))
+            if submit_time and (
+                not job.tasks or submit_time < job.submitted_at
+            ):
+                job.submitted_at = submit_time
             expanded = expand_desc_tasks(desc)
             for t in expanded:
                 server.jobs.attach_task(job, t.get("id", 0))
+                if submit_time:
+                    # keep the ORIGINAL submit clock, not the restore's
+                    job.tasks[t.get("id", 0)].submitted_at = submit_time
             job.submits.append(submit_record(desc, len(expanded)))
             job_descs.setdefault(job_id, []).extend(expanded)
         elif kind == "job-opened":
@@ -98,6 +112,9 @@ def restore_from_journal(server) -> None:
                 TERMINAL[kind],
                 record.get("error", ""),
             )
+            task_finished_at[(job_id, record["task"])] = float(
+                record.get("time", 0.0)
+            )
         elif kind == "task-started":
             key = (job_id, record["task"])
             task_instances[key] = max(
@@ -105,6 +122,12 @@ def restore_from_journal(server) -> None:
             )
             task_variants[key] = record.get("variant", 0)
             task_maybe_running[key] = True
+            task_started_at[key] = (
+                float(record.get("queued_at", 0.0)),
+                float(record.get("assigned_at", 0.0)),
+                float(record.get("started_at", 0.0))
+                or float(record.get("time", 0.0)),
+            )
         elif kind == "task-restarted":
             key = (job_id, record["task"])
             task_crashes[key] = record.get(
@@ -117,7 +140,8 @@ def restore_from_journal(server) -> None:
         elif kind == "server-uid":
             server.journal_uids.add(record.get("server_uid") or "")
 
-    # apply terminal statuses to job counters
+    # apply terminal statuses to job counters (with the ORIGINAL clock so
+    # `hq job timeline` of a restored job reports true phase durations)
     for (job_id, task_id), (status, error) in task_status.items():
         job = server.jobs.jobs.get(job_id)
         if job is None or task_id not in job.tasks:
@@ -125,6 +149,10 @@ def restore_from_journal(server) -> None:
         info = job.tasks[task_id]
         info.status = status
         info.error = error
+        info.finished_at = task_finished_at.get((job_id, task_id), 0.0)
+        stamps = task_started_at.get((job_id, task_id))
+        if stamps is not None:
+            info.started_at = stamps[2]
         job.counters[status] += 1
 
     # re-submit unfinished tasks into the core
@@ -190,6 +218,12 @@ def restore_from_journal(server) -> None:
                 # worker reclaims it or the window expires. Gangs are never
                 # held — a partial gang reattach is worthless, so they are
                 # fenced + requeued like before.
+                stamps = task_started_at.get(key)
+                if stamps is not None:
+                    # pre-seed the lifecycle chain from the journal: on
+                    # reattach the task keeps its ORIGINAL start (one
+                    # unbroken timeline, no duplicate spawn phase)
+                    task.t_ready, task.t_assigned, task.t_started = stamps
                 server.core.tasks[task.task_id] = task
                 server.reattach_pending[task.task_id] = reattach_deadline
                 held += 1

@@ -153,6 +153,15 @@ class SolverWatchdog:
         # only reached for attributes not set on the watchdog itself
         return getattr(object.__getattribute__(self, "_last_ran"), name)
 
+    def reset_stats(self) -> None:
+        """Zero the telemetry counters (reset_metrics debug RPC) without
+        touching the armed/bench state machine."""
+        self.failures = 0
+        self.timeouts = 0
+        self.degraded_ticks = 0
+        self.rearms = 0
+        self.skipped_ticks = 0
+
     def stats(self) -> dict:
         return {
             "armed": self.armed,

@@ -31,6 +31,7 @@ from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
 from hyperqueue_tpu.utils import chaos
+from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.trace import TRACER
 from hyperqueue_tpu.transport.auth import (
     ROLE_CLIENT,
@@ -48,6 +49,14 @@ SCHEDULE_MIN_DELAY = 0.01  # seconds; reference msd: 500ms prod / 20ms in benche
 # forced worker overview cadence while a dashboard/stream listens
 # (reference DEFAULT_WORKER_OVERVIEW_INTERVAL, server/worker.rs:63)
 OVERVIEW_OVERRIDE_INTERVAL = 2.0
+
+# module-level instrument: _process_worker_message is the server's hottest
+# message path, so the get-or-create lookup must not run per message
+_WORKER_MESSAGES_TOTAL = REGISTRY.counter(
+    "hq_worker_messages_total",
+    "uplink messages processed on the worker plane",
+    labels=("op",),
+)
 
 
 class CommSender:
@@ -137,8 +146,15 @@ class EventBridge:
         self.server = server
 
     def on_task_started(self, task_id, instance_id, worker_ids, variant=0):
+        task = self.server.core.tasks.get(task_id)
+        # the core task's lifecycle stamps ride along: started_at survives a
+        # reattach (the task never stopped running through the outage), and
+        # queued/assigned let a journal consumer rebuild the full
+        # submit->queued->assigned->spawned chain offline
+        started_at = task.t_started if task else 0.0
         self.server.jobs.on_task_started(
-            task_id_job(task_id), task_id, worker_ids
+            task_id_job(task_id), task_id, worker_ids,
+            started_at=started_at or None,
         )
         # instance + chosen variant ride along (reference task-started
         # events carry instance/worker/variant, tests/test_events.py
@@ -147,7 +163,10 @@ class EventBridge:
             "task-started",
             {"job": task_id_job(task_id), "task": task_id_task(task_id),
              "workers": worker_ids, "instance": instance_id,
-             "variant": variant},
+             "variant": variant,
+             "queued_at": task.t_ready if task else 0.0,
+             "assigned_at": task.t_assigned if task else 0.0,
+             "started_at": started_at},
         )
 
     def on_task_restarted(self, task_id):
@@ -245,6 +264,8 @@ class Server:
         reattach_timeout: float = 15.0,
         solver_watchdog_timeout: float = 5.0,
         solver_rearm_ticks: int = 20,
+        metrics_port: int | None = None,
+        metrics_host: str = "0.0.0.0",
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -324,6 +345,20 @@ class Server:
         self._tasks: list[asyncio.Task] = []
         self._servers: list[asyncio.base_events.Server] = []
         self.started_at = time.time()
+        # Prometheus exposition endpoint (utils/metrics.py): None = off
+        # (the default — recording still happens, it is just not served),
+        # 0 = ephemeral port, resolved into self.metrics_port at start()
+        # and surfaced through `hq server info`. The endpoint is
+        # UNAUTHENTICATED (Prometheus convention) — metrics_host lets a
+        # deployment bind 127.0.0.1 behind a scraping sidecar.
+        self.requested_metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self.metrics_port: int | None = None
+        self._metrics_server = None
+        self._metrics_hook = None
+        # hq_worker_* metric names currently fanned out from piggybacked
+        # worker samples (cleared + rebuilt on every scrape)
+        self._piggyback_names: set[str] = set()
 
     # ------------------------------------------------------------------
     async def start(self) -> serverdir.AccessRecord:
@@ -381,6 +416,22 @@ class Server:
         self._servers = [client_srv, worker_srv]
         self.client_port = client_srv.sockets[0].getsockname()[1]
         self.worker_port = worker_srv.sockets[0].getsockname()[1]
+
+        self._metrics_hook = self._collect_metrics
+        REGISTRY.add_collect_hook(self._metrics_hook)
+        if self.requested_metrics_port is not None:
+            from hyperqueue_tpu.utils.metrics import start_metrics_server
+
+            self._metrics_server, self.metrics_port = (
+                await start_metrics_server(
+                    REGISTRY, self.requested_metrics_port,
+                    host=self.metrics_host,
+                )
+            )
+            logger.info(
+                "metrics endpoint on http://%s:%d/metrics",
+                self.metrics_host, self.metrics_port,
+            )
 
         instance_dir = serverdir.create_instance_dir(self.server_dir)
         self._instance_dir = instance_dir
@@ -447,6 +498,10 @@ class Server:
             t.cancel()
         for srv in self._servers:
             srv.close()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+        if self._metrics_hook is not None:
+            REGISTRY.remove_collect_hook(self._metrics_hook)
         for conn in self._worker_conns.values():
             conn.close()
         if self.journal is not None:
@@ -468,6 +523,120 @@ class Server:
                 link.unlink()
         except OSError:
             pass  # cleanup is best-effort; a dead link is still harmless
+
+    # --- metrics --------------------------------------------------------
+    def _collect_metrics(self) -> None:
+        """Refresh cluster-state gauges at scrape time (utils/metrics.py
+        collect hook): nothing here runs on a hot path, and everything is
+        O(workers + queues), never O(tasks) — walking a million-task map
+        per scrape would make the scrape itself a perturbation."""
+        core = self.core
+        REGISTRY.gauge(
+            "hq_workers_connected", "workers currently registered"
+        ).set(len(core.workers))
+        REGISTRY.gauge(
+            "hq_tasks_known", "tasks in the server core (all states)"
+        ).set(len(core.tasks))
+        REGISTRY.gauge(
+            "hq_tasks_ready_queued", "single-node tasks in the ready queues"
+        ).set(core.queues.total_ready())
+        REGISTRY.gauge(
+            "hq_tasks_mn_queued", "multi-node gang tasks awaiting workers"
+        ).set(len(core.mn_queue))
+        REGISTRY.gauge(
+            "hq_jobs_known", "jobs known to the server"
+        ).set(len(self.jobs.jobs))
+        REGISTRY.gauge(
+            "hq_reattach_pending_tasks",
+            "restored maybe-running tasks held for worker reattach",
+        ).set(len(self.reattach_pending))
+        # event stream backpressure: listeners and the deepest unsent queue
+        REGISTRY.gauge(
+            "hq_event_listeners", "attached event-stream clients"
+        ).set(len(self._event_listeners))
+        REGISTRY.gauge(
+            "hq_event_stream_depth",
+            "deepest per-listener backlog of undelivered events",
+        ).set(
+            max((q.qsize() for q in self._event_listeners), default=0)
+        )
+        REGISTRY.counter(
+            "hq_events_emitted_total", "server events emitted (journal seq)"
+        ).set_total(self._event_seq)
+        # solver watchdog: adopt its externally-tracked monotonic counters
+        wd = self.model.stats()
+        REGISTRY.gauge(
+            "hq_solver_armed",
+            "1 while the primary solver is armed, 0 while degraded to the "
+            "host-greedy fallback",
+        ).set(1.0 if wd.get("armed") else 0.0)
+        for key in ("failures", "timeouts", "degraded_ticks", "rearms",
+                    "skipped_ticks"):
+            REGISTRY.counter(
+                f"hq_solver_{key}_total",
+                f"solver watchdog {key.replace('_', ' ')} "
+                "(scheduler/watchdog.py)",
+            ).set_total(wd.get(key, 0))
+        cache = core.tick_cache.counters()
+        for key in ("full_rebuilds", "incremental_syncs"):
+            REGISTRY.counter(
+                f"hq_tick_cache_{key}_total",
+                f"tick snapshot cache {key.replace('_', ' ')}",
+            ).set_total(cache.get(key, 0))
+        # per-worker gauges: the server's own accounting, plus whatever
+        # gauges/counters the worker piggybacked on its last overview
+        # message (cluster-wide re-export under a `worker` label)
+        assigned = REGISTRY.gauge(
+            "hq_worker_assigned_tasks",
+            "tasks with accounted resources on each worker",
+            labels=("worker",), max_series=4096,
+        )
+        prefilled = REGISTRY.gauge(
+            "hq_worker_prefilled_tasks",
+            "tasks queued on each worker beyond current capacity",
+            labels=("worker",), max_series=4096,
+        )
+        assigned.clear()  # departed workers' series must not linger
+        prefilled.clear()
+        # piggybacked metric series are rebuilt from scratch each scrape so
+        # a departed worker's samples vanish with it
+        for name in self._piggyback_names:
+            metric = REGISTRY.get(name)
+            if metric is not None:
+                metric.clear()
+        self._piggyback_names = set()
+        piggybacked = self._piggyback_names
+        for w in core.workers.values():
+            assigned.labels(w.worker_id).set(len(w.assigned_tasks))
+            prefilled.labels(w.worker_id).set(len(w.prefilled_tasks))
+            for sample in w.last_metrics:
+                name = sample.get("name", "")
+                if not name.startswith("hq_worker_"):
+                    continue  # only the worker-runtime namespace fans out
+                labels = sample.get("labels") or {}
+                label_names = (*sorted(labels), "worker")
+                make = (
+                    REGISTRY.counter
+                    if sample.get("type") == "counter"
+                    else REGISTRY.gauge
+                )
+                try:
+                    metric = make(
+                        name, sample.get("help", ""),
+                        labels=label_names, max_series=4096,
+                    )
+                except ValueError:
+                    continue  # type conflict with an existing metric
+                if metric.label_names != label_names:
+                    continue  # conflicting shape from an older worker
+                piggybacked.add(name)
+                series = metric.labels(
+                    *(labels[k] for k in sorted(labels)), w.worker_id
+                )
+                if sample.get("type") == "counter":
+                    series.set_total(sample.get("value", 0.0))
+                else:
+                    series.set(sample.get("value", 0.0))
 
     # --- events out ----------------------------------------------------
     def emit_event(self, kind: str, payload: dict) -> None:
@@ -858,6 +1027,7 @@ class Server:
 
     def _process_worker_message(self, worker: Worker, msg: dict) -> None:
             op = msg.get("op")
+            _WORKER_MESSAGES_TOTAL.labels(str(op)).inc()
             if op == "task_running":
                 reactor.on_task_running(
                     self.core, self.events, msg["id"], msg["instance"]
@@ -901,9 +1071,14 @@ class Server:
                     "hw": msg.get("hw", {}),
                     "n_running": msg.get("n_running", 0),
                 }
+                # piggybacked gauge/counter samples feed the cluster-wide
+                # Prometheus view (collect hook) and the dashboard stream
+                worker.last_metrics = msg.get("metrics") or []
                 self.emit_event(
                     "worker-overview",
-                    {"id": worker.worker_id, "hw": msg.get("hw", {})},
+                    {"id": worker.worker_id, "hw": msg.get("hw", {}),
+                     "n_running": msg.get("n_running", 0),
+                     "metrics": worker.last_metrics},
                 )
             else:
                 logger.warning("unknown worker message %r", op)
@@ -962,6 +1137,7 @@ class Server:
             "n_workers": len(self.core.workers),
             "n_jobs": len(self.jobs.jobs),
             "scheduler": self.scheduler_kind,
+            "metrics_port": self.metrics_port,
         }
 
     async def _client_server_stats(self, msg: dict) -> dict:
@@ -984,6 +1160,111 @@ class Server:
             "reattach_pending": len(self.reattach_pending),
             "trace": TRACER.snapshot(recent=0),
         }
+
+    async def _client_reset_metrics(self, msg: dict) -> dict:
+        """Zero the metrics plane (registry values, tracer spans, tick-phase
+        aggregates) so benchmarks can measure a steady-state window:
+        reset, run, scrape. Registrations survive — only values clear.
+        Externally-tracked telemetry the collect hook re-adopts (watchdog
+        counters, tick-cache counters) is zeroed at its source too;
+        hq_events_emitted_total is exempt — it mirrors the journal seq,
+        which is functional state."""
+        from hyperqueue_tpu.scheduler.tick_cache import TickPhaseStats
+
+        REGISTRY.reset()
+        TRACER.reset()
+        self.core.tick_stats = TickPhaseStats()
+        self.model.reset_stats()
+        self.core.tick_cache.full_rebuilds = 0
+        self.core.tick_cache.incremental_syncs = 0
+        return {"op": "ok"}
+
+    async def _client_job_timeline(self, msg: dict) -> dict:
+        """Per-task lifecycle timeline of one job, aggregated server-side:
+        submit -> queued -> assigned -> spawned -> finished timestamps
+        folded into per-phase percentiles plus a slowest-task drill-down
+        (`hq job timeline`). Phase chains are clamped monotonic, so the
+        four phase durations of a finished task sum EXACTLY to its
+        finished-submitted wall time."""
+        job = self.jobs.jobs.get(msg["job_id"])
+        if job is None:
+            return {"op": "error", "message": f"job {msg['job_id']} not found"}
+        rows = []
+        for info in job.tasks.values():
+            task = self.core.tasks.get(
+                make_task_id(job.job_id, info.job_task_id)
+            )
+            pts = [
+                info.submitted_at,
+                task.t_ready if task else 0.0,
+                task.t_assigned if task else 0.0,
+                info.started_at,
+                info.finished_at,
+            ]
+            # forward-clamp the chain: a missing middle stamp (e.g. a
+            # restore dropped t_ready for a reattached task) collapses its
+            # phase to zero instead of corrupting the neighbours
+            for i in range(1, len(pts)):
+                if pts[i] <= 0 or pts[i] < pts[i - 1]:
+                    pts[i] = pts[i - 1]
+            rows.append({
+                "id": info.job_task_id,
+                "status": info.status,
+                "submitted": pts[0],
+                "queued": pts[1],
+                "assigned": pts[2],
+                "started": pts[3],
+                "finished": pts[4] if info.finished_at else 0.0,
+                "phases": {
+                    "pending": pts[1] - pts[0],
+                    "queued": pts[2] - pts[1],
+                    "dispatch": pts[3] - pts[2],
+                    "run": pts[4] - pts[3],
+                } if info.finished_at else None,
+            })
+        finished = [r for r in rows if r["phases"] is not None]
+
+        def pct(sorted_vals: list, q: float) -> float:
+            if not sorted_vals:
+                return 0.0
+            idx = min(
+                len(sorted_vals) - 1,
+                int(q * (len(sorted_vals) - 1) + 0.5),
+            )
+            return sorted_vals[idx]
+
+        phases_out = {}
+        for name in ("pending", "queued", "dispatch", "run"):
+            values = sorted(r["phases"][name] for r in finished)
+            phases_out[name] = {
+                "count": len(values),
+                "total": round(sum(values), 6),
+                "mean": round(sum(values) / len(values), 6) if values else 0.0,
+                "p50": round(pct(values, 0.50), 6),
+                "p95": round(pct(values, 0.95), 6),
+                "max": round(values[-1], 6) if values else 0.0,
+            }
+        makespan = 0.0
+        if finished:
+            makespan = max(r["finished"] for r in finished) - min(
+                r["submitted"] for r in finished
+            )
+        slowest = sorted(
+            finished, key=lambda r: r["finished"] - r["submitted"],
+            reverse=True,
+        )[:5]
+        out = {
+            "op": "job_timeline",
+            "job": job.job_id,
+            "n_tasks": len(rows),
+            "n_finished": len(finished),
+            "makespan": round(makespan, 6),
+            "phases": phases_out,
+            "slowest": slowest,
+        }
+        if msg.get("detail"):
+            out["tasks"] = rows
+        return out
 
     async def _client_stop_server(self, msg: dict) -> dict:
         asyncio.get_running_loop().call_soon(self.stop)

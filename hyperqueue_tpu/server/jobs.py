@@ -33,6 +33,10 @@ class JobTaskInfo:
     status: str = "waiting"
     error: str = ""
     worker_ids: list[int] = field(default_factory=list)
+    # lifecycle timeline endpoints (submitted_at defaults to creation time;
+    # restore overwrites it with the journal's job-submitted time so a
+    # restored timeline keeps the original clock)
+    submitted_at: float = field(default_factory=time.time)
     started_at: float = 0.0
     finished_at: float = 0.0
 
@@ -177,7 +181,9 @@ class JobManager:
             return None
         return job, info
 
-    def on_task_started(self, job_id: int, task_id: int, worker_ids: list[int]):
+    def on_task_started(self, job_id: int, task_id: int,
+                        worker_ids: list[int],
+                        started_at: float | None = None):
         found = self._task(job_id, task_id)
         if not found:
             return
@@ -186,7 +192,11 @@ class JobManager:
             job.counters["running"] += 1
         info.status = "running"
         info.worker_ids = worker_ids
-        info.started_at = time.time()
+        # started_at comes from the core task's t_started when available: a
+        # reattach after a server restart re-announces a task that never
+        # stopped running, and the timeline must keep the ORIGINAL start
+        # instead of restarting the clock (no duplicate spawn phase)
+        info.started_at = started_at or time.time()
 
     def on_task_restarted(self, job_id: int, task_id: int):
         found = self._task(job_id, task_id)

@@ -18,9 +18,36 @@ from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
+from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.trace import TRACER
 
 logger = logging.getLogger(__name__)
+
+# tick telemetry in the process-wide metrics plane (utils/metrics.py):
+# per-phase latency histograms plus assignment counters. Observed once per
+# tick (not per task) so the cost is a handful of dict ops per schedule().
+_TICK_PHASE_SECONDS = REGISTRY.histogram(
+    "hq_tick_phase_seconds",
+    "scheduler tick latency per phase (snapshot/batches/gangs/assemble/"
+    "solve/mapping/prefill/total)",
+    labels=("phase",),
+)
+_TICKS_TOTAL = REGISTRY.counter(
+    "hq_scheduler_ticks_total", "scheduling ticks run"
+)
+_ASSIGNED_TOTAL = REGISTRY.counter(
+    "hq_scheduler_assigned_tasks_total",
+    "tasks assigned to workers by the dense solve + gang phases",
+)
+_PREFILLED_TOTAL = REGISTRY.counter(
+    "hq_scheduler_prefilled_tasks_total",
+    "tasks proactively prefilled onto busy workers",
+)
+_RETRACTED_TOTAL = REGISTRY.counter(
+    "hq_scheduler_retracts_total",
+    "prefilled tasks asked back from workers",
+    labels=("reason",),
+)
 
 # max tasks queued on a worker beyond its current capacity. The reference
 # uses 40 (scheduler/state.rs:4-21) with its own tick cadence; ours is sized
@@ -80,6 +107,7 @@ def on_new_tasks(core: Core, comm: Comm, tasks: list[Task]) -> None:
 
 def _make_ready(core: Core, task: Task) -> None:
     task.state = TaskState.READY
+    task.t_ready = _time.time()
     rqv = core.rq_map.get_variants(task.rq_id)
     if rqv.is_multi_node:
         core.mn_queue.append(task.task_id)
@@ -227,6 +255,11 @@ def on_task_reattached(
     match)."""
     task.state = TaskState.RUNNING
     task.assigned_worker = worker.worker_id
+    if not task.t_started:
+        # restore pre-seeds t_started from the journal's task-started time;
+        # a reattach must NOT restart the clock — the task kept running
+        # through the outage and its timeline is one unbroken span
+        task.t_started = _time.time()
     worker.assign(
         task.task_id,
         core.variant_amounts(task.rq_id, task.assigned_variant, worker),
@@ -269,6 +302,7 @@ def on_task_running(
             task.prefilled = False
             task.retract_pending = False
         task.state = TaskState.RUNNING
+        task.t_started = _time.time()
         workers = list(task.mn_workers) or [task.assigned_worker]
         events.on_task_started(
             task_id, instance_id, workers, task.assigned_variant
@@ -479,11 +513,15 @@ def schedule(
     deterministic scheduler tests).
     """
     assigned = 0
+    prefilled = 0
     per_worker_msgs: dict[int, list[dict]] = {}
     # per-phase latency breakdown of THIS tick (ms), recorded into
     # core.tick_stats at the end and surfaced via `hq server stats`
     phases: dict = {}
     _t_tick = _time.perf_counter()
+    # one wall-clock stamp per tick: every task assigned this tick shares it
+    # (the timeline's resolution is the tick itself)
+    now = _time.time()
 
     # --- multi-node gangs: all-or-nothing N eligible workers from one
     # group.  Per-member eligibility matches the reference's
@@ -583,6 +621,9 @@ def schedule(
                             victim.retract_pending = True
                             refs.append((tid, victim.instance_id))
                         if refs:
+                            _RETRACTED_TOTAL.labels("gang-drain").inc(
+                                len(refs)
+                            )
                             comm.send_retract(w.worker_id, refs)
                 continue
             _clear_mn_reservations(core, task_id)
@@ -591,6 +632,7 @@ def schedule(
                 w.mn_task = task_id
             task.mn_workers = tuple(w.worker_id for w in chosen)
             task.state = TaskState.ASSIGNED
+            task.t_assigned = now
             root = chosen[0]
             msg = _compute_message(core, task, variant=0)
             msg["node_ids"] = list(task.mn_workers)
@@ -649,6 +691,7 @@ def schedule(
             task = core.tasks[task_id]
             worker = core.workers[worker_id]
             task.state = TaskState.ASSIGNED
+            task.t_assigned = now
             task.assigned_worker = worker_id
             task.assigned_variant = variant
             worker.assign(
@@ -751,9 +794,11 @@ def schedule(
                     for task_id in taken:
                         task = core.tasks[task_id]
                         task.state = TaskState.ASSIGNED
+                        task.t_assigned = now
                         task.assigned_worker = worker.worker_id
                         task.assigned_variant = variant
                         task.prefilled = True
+                        prefilled += 1
                         worker.prefilled_tasks.add(task_id)
                         budgets[worker.worker_id] -= 1
                         per_worker_msgs.setdefault(
@@ -838,6 +883,7 @@ def schedule(
                         allowance -= 1
                         retract_budget[worker_id] -= 1
             for wid, refs in retract_by_worker.items():
+                _RETRACTED_TOTAL.labels("displacement").inc(len(refs))
                 comm.send_retract(wid, refs)
 
     # --- retract: steal prefilled backlog back from loaded workers
@@ -897,6 +943,7 @@ def schedule(
                     task.retract_pending = True
                     victims.append((tid, task.instance_id))
                 if victims:
+                    _RETRACTED_TOTAL.labels("rebalance").inc(len(victims))
                     comm.send_retract(donor.worker_id, victims)
         phases["prefill"] = (_time.perf_counter() - _t_phase) * 1e3
         TRACER.record("scheduler/prefill", _time.perf_counter() - _t_phase)
@@ -905,6 +952,13 @@ def schedule(
         comm.send_compute(worker_id, msgs)
     phases["total"] = (_time.perf_counter() - _t_tick) * 1e3
     core.tick_stats.record(phases)
+    _TICKS_TOTAL.inc()
+    if assigned:
+        _ASSIGNED_TOTAL.inc(assigned)
+    if prefilled:
+        _PREFILLED_TOTAL.inc(prefilled)
+    for name, ms in phases.items():
+        _TICK_PHASE_SECONDS.labels(name).observe(ms / 1e3)
     return assigned
 
 

@@ -58,12 +58,25 @@ class Task:
     # multi-node gangs: workers allocated to this task (root first)
     mn_workers: tuple[int, ...] = ()
 
+    # lifecycle timeline (wall-clock, 0 = not reached for the CURRENT
+    # incarnation): became ready / assigned to a worker / worker reported
+    # running. Feed `hq job timeline` + the task-started event payload;
+    # cleared by increment_instance so every restart starts a fresh chain.
+    t_ready: float = 0.0
+    t_assigned: float = 0.0
+    t_started: float = 0.0
+
     @property
     def is_done(self) -> bool:
         return self.state in TERMINAL_STATES
 
     def increment_instance(self) -> int:
         self.instance_id += 1
+        # a new incarnation gets a fresh lifecycle chain; the timeline of
+        # the dead one already lives in the journal/job records
+        self.t_ready = 0.0
+        self.t_assigned = 0.0
+        self.t_started = 0.0
         return self.instance_id
 
     @property

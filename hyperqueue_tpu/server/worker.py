@@ -104,6 +104,10 @@ class Worker:
     mn_reserved: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     last_overview: dict = field(default_factory=dict)
+    # gauge/counter samples piggybacked on the worker's last overview
+    # message; fanned out (with a `worker` label) by the server's metrics
+    # collect hook for the cluster-wide Prometheus view
+    last_metrics: list = field(default_factory=list)
     # the worker is going away deliberately (`hq worker stop`, idle/time
     # limit): its tasks requeue WITHOUT a crash-counter increment
     # (reference gateway.rs CrashLimit doc: stops don't count)

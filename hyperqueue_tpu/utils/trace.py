@@ -19,7 +19,18 @@ from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from hyperqueue_tpu.utils.metrics import REGISTRY
+
 logger = logging.getLogger("hq.trace")
+
+# every span doubles as a histogram series in the metrics plane: the rolling
+# SpanStats keep the debug-dump shape, the histogram adds the percentile
+# view Prometheus consumers need (utils/metrics.py)
+_SPAN_SECONDS = REGISTRY.histogram(
+    "hq_span_seconds",
+    "duration of traced runtime spans (utils/trace.py TRACER)",
+    labels=("span",),
+)
 
 
 @dataclass(slots=True)
@@ -49,6 +60,7 @@ class Tracer:
         if entry is None:
             entry = self.stats[name] = SpanStats()
         entry.record(dt)
+        _SPAN_SECONDS.labels(name).observe(dt)
         self.recent.append((name, dt))
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug("span %s: %.3f ms", name, dt * 1000)
@@ -84,6 +96,7 @@ class Tracer:
     def reset(self) -> None:
         self.stats.clear()
         self.recent.clear()
+        _SPAN_SECONDS.reset()
 
 
 # process-wide tracer (one server or worker per process)

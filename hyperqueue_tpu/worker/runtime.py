@@ -44,11 +44,67 @@ from hyperqueue_tpu.transport.auth import (
     do_authentication,
 )
 from hyperqueue_tpu.utils import chaos
+from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.retry import jittered_backoff
 from hyperqueue_tpu.worker.allocator import ResourceAllocator
 from hyperqueue_tpu.worker.launcher import LaunchedTask, launch_task
 
 logger = logging.getLogger("hq.worker")
+
+# worker-side metrics plane (utils/metrics.py). Everything here lives in
+# the hq_worker_* namespace: gauge/counter samples piggyback on overview
+# messages and the server re-exports them cluster-wide under a `worker`
+# label, so the namespace is the fan-out filter.
+_SPAWN_SECONDS = REGISTRY.histogram(
+    "hq_worker_task_spawn_seconds",
+    "compute-message accept to process spawn (launch_task) latency",
+)
+_TASKS_DONE = REGISTRY.counter(
+    "hq_worker_tasks_done_total",
+    "tasks completed on this worker by outcome",
+    labels=("outcome",),
+)
+_RECONNECT_ATTEMPTS = REGISTRY.counter(
+    "hq_worker_reconnect_attempts_total",
+    "registration attempts while reconnecting to a lost server",
+)
+_RECONNECTS = REGISTRY.counter(
+    "hq_worker_reconnects_total",
+    "successful re-registrations after a lost server connection",
+)
+_REPLAYED = REGISTRY.counter(
+    "hq_worker_replayed_messages_total",
+    "uplink messages parked by a dead connection and re-sent after "
+    "reconnect",
+)
+_RUNNING = REGISTRY.gauge(
+    "hq_worker_running_tasks", "tasks currently executing"
+)
+_PARKED = REGISTRY.gauge(
+    "hq_worker_blocked_tasks",
+    "tasks parked waiting for local resources",
+)
+_SENDQ = REGISTRY.gauge(
+    "hq_worker_sendq_depth", "uplink messages awaiting the send drainer"
+)
+_CPU = REGISTRY.gauge(
+    "hq_worker_cpu_percent", "node CPU utilization (HwSampler)"
+)
+_MEM_TOTAL = REGISTRY.gauge(
+    "hq_worker_mem_total_bytes", "node memory total (HwSampler)"
+)
+_MEM_AVAILABLE = REGISTRY.gauge(
+    "hq_worker_mem_available_bytes", "node memory available (HwSampler)"
+)
+_LOAD = REGISTRY.gauge("hq_worker_loadavg_1m", "node 1-minute load average")
+_GPU = REGISTRY.gauge(
+    "hq_worker_gpu_percent", "per-GPU utilization (HwSampler)",
+    labels=("gpu",),
+)
+_GPU_MEM = REGISTRY.gauge(
+    "hq_worker_gpu_mem_percent", "per-GPU memory utilization (HwSampler)",
+    labels=("gpu",),
+)
 
 
 class RunningTask:
@@ -82,6 +138,8 @@ class WorkerRuntime:
         configuration: WorkerConfiguration,
         zero_worker: bool = False,
         server_dir: Path | None = None,
+        metrics_port: int | None = None,
+        metrics_host: str = "0.0.0.0",
     ):
         self.host = host
         self.port = port
@@ -134,6 +192,21 @@ class WorkerRuntime:
         self._overview_override: float | None = None
         self._overview_wake = asyncio.Event()
         self.localcomm = None
+        # Prometheus endpoint: None = off (recording still happens; gauges
+        # also piggyback on overview messages), 0 = ephemeral. Bind
+        # 127.0.0.1 via --metrics-host to keep the (unauthenticated)
+        # endpoint off shared networks.
+        self.requested_metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self.metrics_port: int | None = None
+        self._metrics_server = None
+
+    def _collect_metrics(self) -> None:
+        """Scrape-time gauges from live runtime state (collect hook — no
+        hot-path bookkeeping needed for queue depths)."""
+        _RUNNING.set(len(self.running))
+        _PARKED.set(self._n_blocked)
+        _SENDQ.set(self._sendq.qsize())
 
     async def _send(self, msg: dict) -> None:
         """Enqueue an uplink message; a drainer batches queued messages into
@@ -200,6 +273,21 @@ class WorkerRuntime:
         self.localcomm = LocalCommListener(self, Path(tempfile.gettempdir()))
         await self.localcomm.start()
 
+        REGISTRY.add_collect_hook(self._collect_metrics)
+        if self.requested_metrics_port is not None:
+            from hyperqueue_tpu.utils.metrics import start_metrics_server
+
+            self._metrics_server, self.metrics_port = (
+                await start_metrics_server(
+                    REGISTRY, self.requested_metrics_port,
+                    host=self.metrics_host,
+                )
+            )
+            logger.info(
+                "metrics endpoint on http://%s:%d/metrics",
+                self.metrics_host, self.metrics_port,
+            )
+
         try:
             while True:
                 outcome = await self._run_session()
@@ -228,6 +316,9 @@ class WorkerRuntime:
                     rt.launched.kill()
             if self.localcomm is not None:
                 self.localcomm.close()
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+            REGISTRY.remove_collect_hook(self._collect_metrics)
             if self._conn:
                 self._conn.close()
 
@@ -350,11 +441,13 @@ class WorkerRuntime:
         attempt = 0
         while True:
             attempt += 1
+            _RECONNECT_ATTEMPTS.inc()
             try:
                 await asyncio.wait_for(
                     self._connect(reattach=True),
                     timeout=self.RECONNECT_ATTEMPT_TIMEOUT,
                 )
+                _RECONNECTS.inc()
                 return True
             except (
                 ConnectionError,
@@ -393,8 +486,11 @@ class WorkerRuntime:
         queued while disconnected. Heartbeats/overviews are dropped — they
         describe a dead connection's moment in time."""
         items: list[dict] = list(self._done_log.values())
-        self._done_log.clear()
         items.extend(self._replay)
+        # messages past this index were merely queued while disconnected —
+        # they are first sends, not replays, and don't count as such
+        n_replay_candidates = len(items)
+        self._done_log.clear()
         self._replay = []
         while True:
             try:
@@ -403,13 +499,21 @@ class WorkerRuntime:
                 break
         fresh: asyncio.Queue = asyncio.Queue()
         seen: set[int] = set()
-        for msg in items:
+        replayed = 0
+        for i, msg in enumerate(items):
             if msg.get("op") in ("heartbeat", "overview"):
                 continue
             if id(msg) in seen:
                 continue  # same dict parked via both _done_log and _replay
             seen.add(id(msg))
+            if i < n_replay_candidates:
+                replayed += 1
             fresh.put_nowait(msg)
+        # counted HERE — messages actually re-sent after this reconnect —
+        # not at park time: a park-then-drop (heartbeat) or a re-park on a
+        # flapping connection must not inflate the replay count
+        if replayed:
+            _REPLAYED.inc(replayed)
         self._sendq = fresh
 
     async def _run_session(self) -> str:
@@ -571,6 +675,7 @@ class WorkerRuntime:
             self._sendq.put_nowait(
                 {"op": "task_finished", "id": task_id, "instance": instance}
             )
+            _TASKS_DONE.labels("finished").inc()
             self.last_task_time = time.monotonic()
             if allocation is not None:
                 self.allocator.release(allocation)
@@ -617,6 +722,7 @@ class WorkerRuntime:
             if self.localcomm is not None:
                 extra_env["HQ_LOCAL_SOCKET"] = self.localcomm.socket_path
                 extra_env["HQ_TOKEN"] = self.localcomm.register_task(task_id)
+            _t_spawn = time.perf_counter()
             launched = await launch_task(
                 task_msg,
                 allocation,
@@ -626,6 +732,7 @@ class WorkerRuntime:
                 streamer=streamer,
                 extra_env=extra_env,
             )
+            _SPAWN_SECONDS.observe(time.perf_counter() - _t_spawn)
             rt = self.running.get(task_id)
             if rt is not None:
                 rt.launched = launched
@@ -658,6 +765,7 @@ class WorkerRuntime:
             if timed_out:
                 if streamer is not None:
                     streamer.close_task(task_id, instance)
+                _TASKS_DONE.labels("timeout").inc()
                 await self._send(
                     {
                         "op": "task_failed",
@@ -669,6 +777,7 @@ class WorkerRuntime:
                 return
             if streamer is not None:
                 streamer.close_task(task_id, instance)
+            _TASKS_DONE.labels("finished" if code == 0 else "failed").inc()
             if code == 0:
                 await self._send(
                     {"op": "task_finished", "id": task_id, "instance": instance}
@@ -851,13 +960,35 @@ class WorkerRuntime:
             # seconds on a wedged driver); keep it off the event loop so
             # heartbeats and task messaging never stall
             hw = await asyncio.to_thread(sampler.sample)
+            self._fold_hw_gauges(hw)
             await self._send(
                 {
                     "op": "overview",
                     "hw": hw,
                     "n_running": len(self.running),
+                    # gauge/counter samples ride along so the server can
+                    # re-export a cluster-wide view with a `worker` label
+                    # (and the dashboard reads gauges, not raw hw dicts)
+                    "metrics": REGISTRY.export_samples(prefix="hq_worker_"),
                 }
             )
+
+    def _fold_hw_gauges(self, hw: dict) -> None:
+        """HwSampler output -> hq_worker_* gauges (labels per GPU)."""
+        _CPU.set(hw.get("cpu_usage_percent", 0.0))
+        _MEM_TOTAL.set(hw.get("mem_total_bytes", 0))
+        _MEM_AVAILABLE.set(hw.get("mem_available_bytes", 0))
+        _LOAD.set(hw.get("loadavg_1m", 0.0))
+        if "gpus" in hw:
+            # clear even on an empty sample (transient nvidia-smi/rocm-smi
+            # failure returns []): stale per-GPU series must not keep
+            # exporting dead utilization as live
+            _GPU.clear()
+            _GPU_MEM.clear()
+            for gpu in hw["gpus"]:
+                gid = str(gpu.get("id", ""))
+                _GPU.labels(gid).set(gpu.get("usage_percent", 0.0))
+                _GPU_MEM.labels(gid).set(gpu.get("mem_usage_percent", 0.0))
 
     async def _heartbeat_loop(self) -> None:
         interval = max(self.configuration.heartbeat_secs, 0.5)
@@ -906,9 +1037,12 @@ async def run_worker(
     configuration: WorkerConfiguration,
     zero_worker: bool = False,
     server_dir: Path | None = None,
+    metrics_port: int | None = None,
+    metrics_host: str = "0.0.0.0",
 ) -> None:
     runtime = WorkerRuntime(
         host, port, secret_key, configuration, zero_worker=zero_worker,
-        server_dir=server_dir,
+        server_dir=server_dir, metrics_port=metrics_port,
+        metrics_host=metrics_host,
     )
     await runtime.run()

@@ -38,3 +38,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests kept out of tier-1"
     )
+    config.addinivalue_line(
+        "markers",
+        "metrics: metrics-plane tests (registry, exposition, scrape, "
+        "timeline)",
+    )

@@ -1,0 +1,275 @@
+"""Metrics-plane tests: registry semantics, exposition golden, HTTP scrape
+smoke against a real server (`--metrics-port 0`)."""
+
+import json
+
+import pytest
+
+from hyperqueue_tpu.utils.metrics import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    histogram_summary,
+    parse_exposition,
+    scrape,
+)
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.metrics
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_and_gauge_semantics():
+    r = MetricsRegistry()
+    c = r.counter("hq_c_total", "c", labels=("op",))
+    c.labels(op="a").inc()
+    c.labels(op="a").inc(2)
+    c.labels("b").inc()
+    assert c.labels("a").value == 3
+    assert c.labels("b").value == 1
+    g = r.gauge("hq_g", "g")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.labels().value == 3
+    # get-or-create returns the same instrument; type conflicts are loud
+    assert r.counter("hq_c_total") is c
+    with pytest.raises(ValueError):
+        r.gauge("hq_c_total")
+
+
+def test_histogram_bucket_edges_are_inclusive():
+    r = MetricsRegistry()
+    h = r.histogram("hq_h_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    # exactly-on-edge values land IN that bucket (le is <=)
+    for v in (0.01, 0.1, 1.0, 5.0, 0.005):
+        h.observe(v)
+    text = r.render()
+    parsed = parse_exposition(text)
+    samples = parsed["hq_h_seconds"]["samples"]
+
+    def bucket(le):
+        return samples[
+            ("hq_h_seconds_bucket", frozenset({("le", le)}))
+        ]
+
+    assert bucket("0.01") == 2        # 0.005 and 0.01
+    assert bucket("0.1") == 3
+    assert bucket("1") == 4
+    assert bucket("+Inf") == 5
+    assert samples[("hq_h_seconds_count", frozenset())] == 5
+    assert abs(samples[("hq_h_seconds_sum", frozenset())] - 6.115) < 1e-9
+
+
+def test_label_cardinality_cap():
+    r = MetricsRegistry()
+    g = r.gauge("hq_capped", "g", labels=("k",), max_series=4)
+    for i in range(10):
+        g.labels(i).set(i)
+    assert len(g.series) == 4
+    assert r.dropped_series == 6
+    # dropped series silently no-op instead of raising on the hot path
+    # (every capped .labels() call counts as one more drop)
+    g.labels(99).inc()
+    text = r.render()
+    assert 'hq_capped{k="99"}' not in text
+    assert "hq_metrics_dropped_series_total 7" in text
+
+
+def test_exposition_golden():
+    r = MetricsRegistry()
+    c = r.counter("hq_ops_total", "operations handled", labels=("op",))
+    c.labels("submit").inc(3)
+    g = r.gauge("hq_depth", "queue depth")
+    g.set(2.5)
+    h = r.histogram("hq_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    assert r.render() == (
+        "# HELP hq_depth queue depth\n"
+        "# TYPE hq_depth gauge\n"
+        "hq_depth 2.5\n"
+        "# HELP hq_lat_seconds latency\n"
+        "# TYPE hq_lat_seconds histogram\n"
+        'hq_lat_seconds_bucket{le="0.1"} 1\n'
+        'hq_lat_seconds_bucket{le="1"} 2\n'
+        'hq_lat_seconds_bucket{le="+Inf"} 2\n'
+        "hq_lat_seconds_sum 0.55\n"
+        "hq_lat_seconds_count 2\n"
+        "# HELP hq_ops_total operations handled\n"
+        "# TYPE hq_ops_total counter\n"
+        'hq_ops_total{op="submit"} 3\n'
+    )
+
+
+def test_label_value_escaping_roundtrips():
+    r = MetricsRegistry()
+    g = r.gauge("hq_esc", "g", labels=("path",))
+    # the second value is the chained-replace killer: a LITERAL backslash
+    # followed by 'n' must not round-trip into a newline
+    for nasty in ('a"b\\c\nd', "C:\\new\\path"):
+        g.labels(nasty).set(1)
+    parsed = parse_exposition(r.render())
+    values = {dict(labels)["path"] for _, labels in
+              parsed["hq_esc"]["samples"]}
+    assert values == {'a"b\\c\nd', "C:\\new\\path"}
+
+
+def test_reset_keeps_registrations_and_zeroes_values():
+    r = MetricsRegistry()
+    c = r.counter("hq_r_total", "c")
+    h = r.histogram("hq_r_seconds", "h")
+    c.inc(5)
+    h.observe(0.2)
+    r.reset()
+    assert c.labels().value == 0
+    assert h.labels().count == 0 and h.labels().sum == 0.0
+    # the instrument handle stays live after reset
+    c.inc()
+    assert c.labels().value == 1
+
+
+def test_collect_hooks_run_at_render_and_bad_hooks_are_contained():
+    r = MetricsRegistry()
+    g = r.gauge("hq_live", "g")
+    state = {"v": 7}
+    r.add_collect_hook(lambda: g.set(state["v"]))
+
+    def bad():
+        raise RuntimeError("boom")
+
+    r.add_collect_hook(bad)
+    assert "hq_live 7" in r.render()
+    state["v"] = 9
+    assert "hq_live 9" in r.render()
+
+
+def test_export_samples_filters_scalars():
+    r = MetricsRegistry()
+    r.gauge("hq_worker_cpu_percent", "cpu").set(12.5)
+    r.counter("hq_worker_done_total", "done").inc(3)
+    r.histogram("hq_worker_lat_seconds", "lat").observe(0.1)
+    r.gauge("hq_other", "other").set(1)
+    samples = r.export_samples(prefix="hq_worker_")
+    names = {s["name"] for s in samples}
+    assert names == {"hq_worker_cpu_percent", "hq_worker_done_total"}
+    by_name = {s["name"]: s for s in samples}
+    assert by_name["hq_worker_cpu_percent"]["value"] == 12.5
+    assert by_name["hq_worker_done_total"]["type"] == "counter"
+
+
+def test_histogram_summary_percentiles():
+    r = MetricsRegistry()
+    h = r.histogram("hq_p_seconds", "p", buckets=(0.01, 0.1, 1.0))
+    for _ in range(90):
+        h.observe(0.05)
+    for _ in range(10):
+        h.observe(0.5)
+    summary = histogram_summary(parse_exposition(r.render()), "hq_p_seconds")
+    row = summary["_"]
+    assert row["count"] == 100
+    assert row["p50_le"] == 0.1
+    assert row["p95_le"] == 1.0
+
+
+def test_default_buckets_are_sorted():
+    assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+
+
+def test_span_tracer_feeds_registry_histogram():
+    from hyperqueue_tpu.utils.metrics import REGISTRY
+    from hyperqueue_tpu.utils.trace import Tracer
+
+    tracer = Tracer()
+    tracer.record("unit/span", 0.002)
+    h = REGISTRY.get("hq_span_seconds")
+    assert h is not None
+    assert h.labels("unit/span").count >= 1
+    # debug-dump snapshot shape is unchanged by the fold-in
+    snap = tracer.snapshot()
+    assert set(snap["unit/span"]) == {
+        "count", "total_ms", "mean_ms", "max_ms", "last_ms"
+    }
+
+
+# ---------------------------------------------------------------- e2e smoke
+def test_metrics_endpoint_smoke(tmp_path):
+    """Tier-1-safe gate: a server with `--metrics-port 0` (ephemeral)
+    serves one scrapeable exposition that parses and carries the scheduler
+    metrics; `hq server reset-metrics` zeroes the window."""
+    with HqEnv(tmp_path) as env:
+        env.start_server("--metrics-port", "0")
+        info = json.loads(env.command(
+            ["server", "info", "--output-mode", "json"]
+        ))
+        port = info["metrics_port"]
+        assert port and port > 0
+        text = scrape("127.0.0.1", port)
+        parsed = parse_exposition(text)
+        assert parsed, "empty exposition"
+        assert "hq_workers_connected" in parsed
+        assert "hq_solver_failures_total" in parsed
+        assert parsed["hq_solver_failures_total"]["type"] == "counter"
+
+        env.start_worker("--zero-worker", "--overview-interval", "0.2",
+                         cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--array", "0-49", "--wait", "--", "true"])
+        text = scrape("127.0.0.1", port)
+        parsed = parse_exposition(text)
+        # tick-phase histograms populated by the run
+        phases = histogram_summary(parsed, "hq_tick_phase_seconds")
+        assert any("phase=total" in key for key in phases)
+        assert sum(
+            parsed["hq_scheduler_ticks_total"]["samples"].values()
+        ) > 0
+        # per-worker gauges from the server's own accounting
+        worker_samples = parsed["hq_worker_assigned_tasks"]["samples"]
+        assert any(
+            dict(labels).get("worker") for _, labels in worker_samples
+        )
+
+        def utilization_scraped():
+            p = parse_exposition(scrape("127.0.0.1", port))
+            return "hq_worker_cpu_percent" in p
+
+        # piggybacked utilization gauges appear once an overview lands
+        wait_until(utilization_scraped, timeout=15,
+                   message="piggybacked worker gauges")
+
+        env.command(["server", "reset-metrics"])
+        parsed = parse_exposition(scrape("127.0.0.1", port))
+        assert sum(
+            parsed["hq_scheduler_assigned_tasks_total"]["samples"].values()
+        ) == 0
+
+
+def test_worker_metrics_endpoint(tmp_path):
+    """Workers serve their own endpoint too: spawn-latency histogram,
+    outcome counters and HwSampler gauges (the bound ephemeral port is
+    reported in the worker log)."""
+    import re
+
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker("--metrics-port", "0", cpus=4)
+        env.wait_workers(1)
+
+        def port():
+            m = re.search(
+                r"metrics endpoint on http://[^:]+:(\d+)/metrics",
+                env.read_log("worker0"),
+            )
+            return int(m.group(1)) if m else None
+
+        bound = wait_until(port, message="worker metrics port")
+        env.command(["submit", "--array", "0-9", "--wait", "--", "true"])
+        parsed = parse_exposition(scrape("127.0.0.1", bound))
+        assert parsed["hq_worker_task_spawn_seconds"]["type"] == "histogram"
+        done = parsed["hq_worker_tasks_done_total"]["samples"]
+        finished = sum(
+            v for (name, labels), v in done.items()
+            if name == "hq_worker_tasks_done_total"
+            and dict(labels).get("outcome") == "finished"
+        )
+        assert finished == 10
+        assert "hq_worker_running_tasks" in parsed

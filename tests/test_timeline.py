@@ -1,0 +1,127 @@
+"""Task lifecycle timeline tests: `hq job timeline` phase aggregation and
+the journal-restore/reattach single-timeline guarantee."""
+
+import json
+import time
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.metrics
+
+
+def _timeline(env, selector="last", tasks=True):
+    out = json.loads(env.command(
+        ["job", "timeline", selector, "--output-mode", "json"]
+        + (["--tasks"] if tasks else [])
+    ))
+    return out[0]
+
+
+def test_timeline_phase_sums_match_wall_clock(tmp_path):
+    """Per finished task, pending+queued+dispatch+run must equal its
+    finished-submitted wall time exactly (the chain is clamped monotonic),
+    and the reported makespan must agree with the measured one."""
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+        t0 = time.time()
+        env.command([
+            "submit", "--array", "0-7", "--wait", "--",
+            "python3", "-c", "import time; time.sleep(0.3)",
+        ], timeout=120)
+        measured = time.time() - t0
+        tl = _timeline(env)
+        assert tl["n_tasks"] == 8
+        assert tl["n_finished"] == 8
+        for row in tl["tasks"]:
+            total = row["finished"] - row["submitted"]
+            phase_sum = sum(row["phases"].values())
+            assert abs(phase_sum - total) < 1e-6, row
+            # timestamps form a monotonic chain
+            assert (
+                row["submitted"] <= row["queued"] <= row["assigned"]
+                <= row["started"] <= row["finished"]
+            ), row
+        # the job's makespan is bounded by the measured wall-clock around
+        # submit..wait (CLI process startup only ADDS to the measurement)
+        assert 0 < tl["makespan"] <= measured + 0.05
+        # every task slept 0.3s: the run phase must dominate and be honest
+        assert tl["phases"]["run"]["p50"] >= 0.25
+        assert tl["phases"]["run"]["max"] <= measured
+        # aggregate totals are consistent with the per-task identity
+        totals = sum(p["total"] for p in tl["phases"].values())
+        per_task = sum(
+            r["finished"] - r["submitted"] for r in tl["tasks"]
+        )
+        assert abs(totals - per_task) < 1e-4
+        # slowest drill-down is sorted by total, descending
+        slowest = [t["finished"] - t["submitted"] for t in tl["slowest"]]
+        assert slowest == sorted(slowest, reverse=True)
+
+
+def test_timeline_cli_table_and_errors(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--array", "0-3", "--wait", "--", "true"],
+                    timeout=120)
+        out = env.command(["job", "timeline", "last"])
+        for phase in ("pending", "queued", "dispatch", "run"):
+            assert phase in out
+        assert "makespan" in out
+        assert "slowest tasks" in out
+        # unknown job is a clean one-line failure
+        env.command(["job", "timeline", "999"], expect_fail=True)
+
+
+@pytest.mark.chaos
+def test_reattached_task_keeps_one_timeline(tmp_path):
+    """Kill -9 the journaled server mid-run; the reconnect-mode worker
+    reattaches its still-running tasks to the restarted server. The
+    timeline must keep ONE unbroken span per task: the original start
+    survives the restart (no duplicate spawn phase, no clock restart at
+    reattach) and the run phase covers the outage."""
+    with HqEnv(tmp_path) as env:
+        journal = tmp_path / "journal.bin"
+        flag = env.work_dir / "flag"
+        server_args = ("--journal", str(journal), "--reattach-timeout", "60")
+        env.start_server(*server_args)
+        env.start_worker("--on-server-lost", "reconnect", cpus=4)
+        env.wait_workers(1)
+        env.command([
+            "submit", "--array", "0-3", "--", "bash", "-c",
+            f"while [ ! -f {flag} ]; do sleep 0.2; done",
+        ])
+
+        def running():
+            out = json.loads(env.command(
+                ["job", "list", "--all", "--output-mode", "json"]
+            ))
+            return out and out[0]["counters"]["running"] == 4
+
+        wait_until(running, timeout=30, message="tasks running")
+        kill_time = time.time()
+        env.kill_process("server")
+        env.start_server(*server_args)
+        env.command(["server", "wait", "--timeout", "20"])
+        wait_until(running, timeout=30, message="tasks reattached")
+        flag.touch()
+        env.command(["job", "wait", "all"], timeout=60)
+
+        tl = _timeline(env, selector="1")
+        assert tl["n_finished"] == 4
+        for row in tl["tasks"]:
+            # the ORIGINAL start survived the restart: one spawn, one span
+            assert 0 < row["started"] < kill_time, row
+            # the run phase covers the outage (finish is after the restart)
+            assert row["finished"] > kill_time, row
+            assert (
+                row["phases"]["run"] >= row["finished"] - kill_time
+            ), row
+            # phase identity holds across the restore too
+            total = row["finished"] - row["submitted"]
+            assert abs(sum(row["phases"].values()) - total) < 1e-6, row
