@@ -748,6 +748,106 @@ def run_chaos_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_explain_smoke() -> None:
+    """Explainability gate: run a deliberately unsatisfiable and a
+    satisfiable workload against a real server, assert the reason codes
+    the flight recorder attributes to each, and record the solver
+    status/objective trajectory in the BENCH json (ISSUE 4)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    from utils_e2e import HqEnv, wait_until
+
+    failures = []
+    t0 = time.perf_counter()
+    trajectory = []
+    with tempfile.TemporaryDirectory() as td:
+        with HqEnv(Path(td)) as env:
+            env.start_server()
+            env.start_worker("--zero-worker", cpus=4)
+            env.wait_workers(1)
+
+            # job 1: unsatisfiable (no worker has 64 cpus) — must surface
+            # no-matching-worker, and never complete
+            env.command(["submit", "--cpus", "64", "--", "true"])
+
+            def unsat_classified():
+                out = json.loads(env.command(
+                    ["task", "explain", "1.0", "--output-mode", "json"]
+                ))
+                return out.get("reason") == "no-matching-worker"
+
+            try:
+                wait_until(unsat_classified, timeout=20,
+                           message="unsatisfiable task classified")
+            except TimeoutError:
+                failures.append(
+                    "unsatisfiable task was not classified "
+                    "no-matching-worker"
+                )
+
+            # job 2: satisfiable 200-task array — completes, solver ok
+            env.command([
+                "submit", "--array", "0-199", "--wait", "--", "true",
+            ], timeout=120)
+
+            dump = json.loads(env.command(
+                ["server", "flight-recorder", "dump", "--json"]
+            ))
+            for rec in dump.get("ticks", []):
+                trajectory.append({
+                    "tick": rec["tick"],
+                    "status": rec["solver"].get("status"),
+                    "objective": rec["solver"].get("objective"),
+                    "assigned": rec["counts"].get("assigned", 0),
+                    "prefilled": rec["counts"].get("prefilled", 0),
+                    "unplaced": rec["counts"].get("unplaced", 0),
+                })
+            reasons = {
+                e["reason"]
+                for rec in dump.get("ticks", [])
+                for e in rec.get("unplaced", [])
+            }
+            if "no-matching-worker" not in reasons:
+                failures.append(
+                    "flight recorder never recorded no-matching-worker"
+                )
+            statuses = {t["status"] for t in trajectory}
+            if "ok" not in statuses:
+                failures.append(
+                    f"no successful solve in the trajectory ({statuses})"
+                )
+            placed = sum(
+                t["assigned"] + t["prefilled"] for t in trajectory
+            )
+            if placed < 200:
+                failures.append(
+                    f"assigned+prefilled sum to {placed} < the 200 "
+                    "satisfiable tasks"
+                )
+            jobs = json.loads(env.command(
+                ["job", "list", "--all", "--output-mode", "json"]
+            ))
+            sat = next(j for j in jobs if j["id"] == 2)
+            if sat["status"] != "finished":
+                failures.append(
+                    f"satisfiable job status {sat['status']!r}"
+                )
+    print(json.dumps({
+        "metric": "explain_smoke",
+        "ok": not failures,
+        "failures": failures,
+        "value": round(time.perf_counter() - t0, 2),
+        "unit": "s",
+        "n_tick_records": len(trajectory),
+        "solver_trajectory": trajectory[-40:],
+    }))
+    sys.exit(1 if failures else 0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
@@ -776,6 +876,10 @@ def main() -> None:
                         help="end-to-end metrics gate: scrape the server's "
                              "Prometheus endpoint before/after a 1k-task "
                              "run and emit tick-phase histogram summaries")
+    parser.add_argument("--explain-smoke", action="store_true",
+                        help="explainability gate: unsatisfiable + "
+                             "satisfiable workloads, assert reason codes, "
+                             "record the solver status/objective trajectory")
     parser.add_argument("--classes", type=int, default=128,
                         help="distinct request classes for --phases")
     parser.add_argument("--workers", type=int, default=None,
@@ -790,6 +894,10 @@ def main() -> None:
 
     if args.chaos_smoke:
         run_chaos_smoke()
+        return
+
+    if args.explain_smoke:
+        run_explain_smoke()
         return
 
     if args.metrics:

@@ -94,20 +94,21 @@ def _resolve_job_selector(session: ClientSession, text: str) -> list[int]:
 
 
 # ---------------------------------------------------------------- server cmds
-def _setup_logging() -> None:
-    """Server and worker processes log to stderr at $HQ_LOG level."""
-    import logging
+def _setup_logging(args=None) -> None:
+    """Server and worker processes log to stderr at $HQ_LOG level.
 
-    logging.basicConfig(
-        level=os.environ.get("HQ_LOG", "INFO").upper(),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    --log-format json emits one JSON object per line with the correlation
+    keys (tick/job/task/worker) the flight recorder and metrics use
+    (utils/logfmt.py); plain stays the human-readable default."""
+    from hyperqueue_tpu.utils.logfmt import setup_logging
+
+    setup_logging(getattr(args, "log_format", None))
 
 
 def cmd_server_start(args) -> None:
     import asyncio
 
-    _setup_logging()
+    _setup_logging(args)
 
     # Enforce the scheduler's JAX platform: site preloads may hard-set the
     # platform (e.g. a TPU plugin overriding jax_platforms after reading
@@ -153,6 +154,7 @@ def cmd_server_start(args) -> None:
             solver_rearm_ticks=args.solver_rearm_ticks,
             metrics_port=args.metrics_port,
             metrics_host=args.metrics_host,
+            flight_recorder_ticks=args.flight_recorder_ticks,
         )
         access = await server.start()
         print(
@@ -239,6 +241,94 @@ def cmd_server_stats(args) -> None:
         print(f"paranoid-tick: every {stats['paranoid_tick']} ticks")
 
 
+def cmd_server_flight_recorder(args) -> None:
+    """Dump the server's flight recorder: last N per-tick DecisionRecords
+    plus recent control-plane events (`hq server flight-recorder dump`)."""
+    with _session(args) as session:
+        dump = session.request({"op": "flight_recorder_dump"})
+    dump.pop("op", None)
+    if args.json or args.output_mode == "json":
+        print(json.dumps(dump, default=str))
+        return
+    out = make_output(args.output_mode)
+    ticks = dump.get("ticks") or []
+    out.message(
+        f"flight recorder: {len(ticks)} tick record(s) "
+        f"(capacity {dump.get('capacity_ticks')}, "
+        f"{dump.get('dropped_idle_ticks', 0)} idle ticks dropped)"
+    )
+    if ticks:
+        out.table(
+            ["tick", "solver", "assigned", "prefilled", "unplaced",
+             "reasons"],
+            [
+                [
+                    r["tick"],
+                    (r.get("solver") or {}).get("status", "?"),
+                    r["counts"].get("assigned", 0)
+                    + r["counts"].get("gang_assigned", 0),
+                    r["counts"].get("prefilled", 0),
+                    r["counts"].get("unplaced", 0),
+                    " ".join(sorted({
+                        e["reason"] for e in r.get("unplaced") or ()
+                    })) or "-",
+                ]
+                for r in ticks[-20:]
+            ],
+        )
+    events = dump.get("events") or []
+    if events:
+        out.message("recent control-plane events:")
+        for e in events[-15:]:
+            t = time.strftime("%H:%M:%S", time.localtime(e.get("time", 0)))
+            rest = {k: v for k, v in e.items() if k not in ("time", "event")}
+            out.message(f"  {t} {e.get('event')} {rest}")
+
+
+def cmd_server_trace_export(args) -> None:
+    """Write the run's Chrome trace-event JSON (Perfetto-loadable): one
+    scheduler row from the flight recorder, one row per worker with its
+    task spans."""
+    with _session(args) as session:
+        result = session.request({"op": "trace_export"})
+    events = result.get("traceEvents") or []
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    n_tasks = sum(1 for e in events if e.get("cat") == "task")
+    n_ticks = sum(1 for e in events if e.get("cat") == "tick")
+    make_output(args.output_mode).message(
+        f"trace written to {args.output} ({n_ticks} tick slice(s), "
+        f"{n_tasks} task span(s)); open it at https://ui.perfetto.dev"
+    )
+
+
+def cmd_job_pause(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        result = session.request({"op": "job_pause", "job_ids": ids})
+    paused = result["paused"]
+    make_output(args.output_mode).message(
+        f"paused {len(paused)} job(s): " + ", ".join(
+            f"{p['job']} ({p['held']} held, "
+            f"{p.get('retracted', 0)} recalled from workers)"
+            for p in paused
+        ) if paused else "no jobs paused"
+    )
+
+
+def cmd_job_resume(args) -> None:
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        result = session.request({"op": "job_resume", "job_ids": ids})
+    resumed = result["resumed"]
+    make_output(args.output_mode).message(
+        f"resumed {len(resumed)} job(s): " + ", ".join(
+            f"{r['job']} ({r['released']} task(s) released)" for r in resumed
+        ) if resumed else "no paused jobs matched"
+    )
+
+
 def cmd_server_generate_access(args) -> None:
     client_host = args.client_host or args.host
     worker_host = args.worker_host or args.host
@@ -277,7 +367,7 @@ def cmd_worker_start(args) -> None:
 
     # without this the runtime's own reporting (reconnects, reattaches,
     # the bound --metrics-port endpoint) goes nowhere
-    _setup_logging()
+    _setup_logging(args)
 
     from hyperqueue_tpu.server.worker import WorkerConfiguration
     from hyperqueue_tpu.worker.hwdetect import detect_resources
@@ -985,6 +1075,17 @@ def cmd_job_info(args) -> None:
         record["counters"] = " ".join(
             f"{k}={v}" for k, v in record.pop("counters").items()
         )
+        # "37 tasks waiting: 30 insufficient-capacity, 7 gang-incomplete"
+        reasons = record.pop("pending_reasons", None)
+        if reasons:
+            from hyperqueue_tpu.scheduler.decision import (
+                format_reason_counts,
+            )
+
+            total = sum(reasons.values())
+            record["pending"] = (
+                f"{total} task(s) waiting: {format_reason_counts(reasons)}"
+            )
         out.record(record)
 
 
@@ -1737,6 +1838,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-host", default="0.0.0.0", metavar="HOST",
                    help="bind address for the (unauthenticated) metrics "
                         "endpoint; use 127.0.0.1 behind a scraping sidecar")
+    p.add_argument("--flight-recorder-ticks", type=int, default=512,
+                   metavar="N",
+                   help="keep the last N per-tick scheduling DecisionRecords"
+                        " in memory for `hq server flight-recorder dump` / "
+                        "`hq task explain` / `hq server trace export` "
+                        "(0 = off)")
+    p.add_argument("--log-format", choices=["plain", "json"],
+                   default=os.environ.get("HQ_LOG_FORMAT", "plain"),
+                   help="json: one JSON object per log line with "
+                        "tick/job/task/worker correlation fields")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)
@@ -1753,6 +1864,24 @@ def build_parser() -> argparse.ArgumentParser:
     p = ssub.add_parser("debug-dump", help="full server state as JSON")
     _add_common(p)
     p.set_defaults(fn=cmd_server_debug_dump)
+    p = ssub.add_parser(
+        "flight-recorder",
+        help="scheduling flight recorder: per-tick DecisionRecords + "
+             "recent control-plane events",
+    )
+    _add_common(p)
+    p.add_argument("fr_cmd", choices=["dump"])
+    p.add_argument("--json", action="store_true",
+                   help="print the raw dump as JSON")
+    p.set_defaults(fn=cmd_server_flight_recorder)
+    p = ssub.add_parser(
+        "trace",
+        help="export the run as Chrome trace-event JSON (Perfetto)",
+    )
+    _add_common(p)
+    p.add_argument("trace_cmd", choices=["export"])
+    p.add_argument("output", help="output path (e.g. trace.json)")
+    p.set_defaults(fn=cmd_server_trace_export)
     p = ssub.add_parser(
         "reset-metrics",
         help="zero the metrics plane (registry + tracer + tick aggregates) "
@@ -1822,6 +1951,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-host", default="0.0.0.0", metavar="HOST",
                    help="bind address for the (unauthenticated) metrics "
                         "endpoint; use 127.0.0.1 behind a scraping sidecar")
+    p.add_argument("--log-format", choices=["plain", "json"],
+                   default=os.environ.get("HQ_LOG_FORMAT", "plain"),
+                   help="json: one JSON object per log line with "
+                        "task/worker correlation fields")
     p.set_defaults(fn=cmd_worker_start)
     p = wsub.add_parser("hw-detect", help="print detected node resources")
     _add_common(p)
@@ -1929,6 +2062,8 @@ def build_parser() -> argparse.ArgumentParser:
         ("cancel", cmd_job_cancel, ()),
         ("forget", cmd_job_forget, ()),
         ("close", cmd_job_close, ()),
+        ("pause", cmd_job_pause, ()),
+        ("resume", cmd_job_resume, ()),
     ]:
         p = jsub.add_parser(name)
         _add_common(p)
@@ -2085,8 +2220,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_task_info)
     p = tsub.add_parser("explain", help="why is this task (not) running")
     _add_common(p)
-    p.add_argument("job_id", type=int)
-    p.add_argument("task_id", type=int)
+    p.add_argument("target",
+                   help="<job> or <job>.<task> (task defaults to the "
+                        "job's first pending task)")
+    p.add_argument("task_id", type=int, nargs="?", default=None,
+                   help="task id (legacy two-argument form)")
     p.set_defaults(fn=cmd_task_explain)
     p = tsub.add_parser("notify",
                         help="send a notification from inside a task")
@@ -2129,29 +2267,64 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _parse_explain_target(args) -> tuple[int, int | None]:
+    """`hq task explain <job>[.<task>]` (or legacy `<job> <task>`)."""
+    target = str(args.target)
+    if args.task_id is not None:
+        return int(target), args.task_id
+    if "." in target:
+        job_s, _, task_s = target.partition(".")
+        try:
+            return int(job_s), int(task_s)
+        except ValueError:
+            fail(f"invalid task selector {target!r} "
+                 "(expected <job> or <job>.<task>)")
+    try:
+        return int(target), None
+    except ValueError:
+        fail(f"invalid job id {target!r}")
+
+
 def cmd_task_explain(args) -> None:
+    job_id, task_id = _parse_explain_target(args)
     with _session(args) as session:
         result = session.request(
-            {"op": "task_explain", "job_id": args.job_id,
-             "task_id": args.task_id}
+            {"op": "task_explain", "job_id": job_id, "task_id": task_id}
         )
     result.pop("op", None)
     out = make_output(args.output_mode)
     if args.output_mode == "json":
         out.value(result)
         return
-    out.message(f"task {args.job_id}@{args.task_id}: {result['state']}")
+    task_label = f"{result.get('job', job_id)}.{result.get('task', task_id)}"
+    out.message(f"task {task_label}: {result['state']}")
+    # the verdict line: reason code + human detail + deferral age
+    reason = result.get("reason")
+    if reason:
+        line = f"verdict: {reason}"
+        deferred = result.get("deferred_ticks") or 0
+        if deferred:
+            line += f" (deferred for {deferred} consecutive tick(s))"
+        out.message(line)
+        if result.get("reason_detail"):
+            out.message(f"  {result['reason_detail']}")
     if result["n_waiting_deps"]:
         out.message(f"waiting for {result['n_waiting_deps']} dependencies")
-    for w in result["workers"]:
+    workers = result["workers"]
+    runnable = [w for w in workers if w["runnable"]]
+    out.message(
+        f"workers considered: {len(workers)}, "
+        f"could run it now: {len(runnable)}"
+    )
+    for w in workers:
         if w["runnable"]:
             out.message(f"worker {w['id']} ({w['hostname']}): can run")
         else:
             for v in w["variants"]:
-                for reason in v["blocked"]:
+                for blocked in v["blocked"]:
                     out.message(
                         f"worker {w['id']} ({w['hostname']}) "
-                        f"variant {v['variant']}: {reason}"
+                        f"variant {v['variant']}: {blocked}"
                     )
 
 

@@ -92,7 +92,22 @@ class JobState:
     completed_at: float = 0.0
     final_status: str = ""
     is_open: bool = False
+    paused: bool = False
+    # reason-code -> pending-task count from the server's latest
+    # DecisionRecord (job_info `pending_reasons`).  Snapshot semantics: the
+    # event stream does not carry reason updates, so this reflects the
+    # last seed/refresh (seed_from_server) and is cleared when the job
+    # completes; replay-mode dashboards never have it.
+    pending_reasons: dict = field(default_factory=dict)
     tasks: dict = field(default_factory=dict)  # task_id -> TaskView
+
+    def pending_summary(self) -> str:
+        """"30 insufficient-capacity, 7 gang-incomplete" or ""."""
+        if not self.pending_reasons:
+            return ""
+        from hyperqueue_tpu.scheduler.decision import format_reason_counts
+
+        return format_reason_counts(self.pending_reasons)
 
     def counters(self) -> dict:
         out = {"waiting": 0, "running": 0, "finished": 0, "failed": 0,
@@ -235,11 +250,21 @@ class DashboardData:
             job = self.jobs.get(record.get("job", 0))
             if job is not None:
                 job.is_open = False
+        elif kind == "job-paused":
+            job = self.jobs.get(record.get("job", 0))
+            if job is not None:
+                job.paused = True
+        elif kind == "job-resumed":
+            job = self.jobs.get(record.get("job", 0))
+            if job is not None:
+                job.paused = False
+                job.pending_reasons.pop("queue-paused", None)
         elif kind == "job-completed":
             job = self.jobs.get(record.get("job", 0))
             if job is not None:
                 job.completed_at = t
                 job.final_status = record.get("status", "finished")
+                job.pending_reasons = {}  # nothing pending anymore
         elif kind == "task-started":
             job = self.jobs.setdefault(
                 record.get("job", 0), JobState(job_id=record.get("job", 0))
@@ -403,6 +428,8 @@ def seed_from_server(data: DashboardData, session) -> None:
                 n_tasks=detail.get("n_tasks", 0),
                 submitted_at=detail.get("submitted_at", 0.0),
                 is_open=detail.get("is_open", False),
+                paused=detail.get("paused", False),
+                pending_reasons=dict(detail.get("pending_reasons") or {}),
             )
             status = detail.get("status", "")
             if status in ("finished", "failed", "canceled"):

@@ -63,3 +63,79 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
                 for r in range(n_r):
                     free[w][r] -= take * need[r]
     return counts
+
+
+def explain_unplaced(
+    free, nt_free, lifetime, needs, sizes, min_time, counts, total=None
+):
+    """Reference classifier for WHY each batch's remainder stayed unplaced.
+
+    The executable spec for scheduler/decision.classify_class, in the same
+    deliberately dumb loop style as solve_oracle: given the tick's dense
+    inputs and the solver's counts, return one reason string per batch
+    (None for fully placed batches). `total` is the worker TOTAL capacity
+    matrix (defaults to the tick-start `free`, which equals totals on an
+    empty cluster snapshot). Mutates nothing.
+    """
+    from hyperqueue_tpu.scheduler.decision import (
+        REASON_INSUFFICIENT_CAPACITY,
+        REASON_NO_MATCHING_WORKER,
+        REASON_SOLVER_DEFERRED,
+        REASON_WORKER_LIFETIME,
+    )
+
+    n_w = len(free)
+    n_r = len(free[0]) if n_w else 0
+    n_b = len(needs)
+    n_v = len(needs[0]) if n_b else 0
+    if total is None:
+        total = free
+    # replay the assignments onto a scratch copy: the post-solve free state
+    # decides insufficient-capacity vs solver-deferred
+    post_free = [list(row) for row in free]
+    post_nt = list(nt_free)
+    for b in range(n_b):
+        for v in range(n_v):
+            for w in range(n_w):
+                take = counts[b][v][w]
+                if take > 0:
+                    post_nt[w] -= take
+                    for r in range(n_r):
+                        post_free[w][r] -= take * needs[b][v][r]
+
+    reasons = []
+    for b in range(n_b):
+        placed = sum(
+            counts[b][v][w] for v in range(n_v) for w in range(n_w)
+        )
+        if placed >= sizes[b]:
+            reasons.append(None)
+            continue
+        present = [
+            v for v in range(n_v) if any(x > 0 for x in needs[b][v])
+        ]
+        amount_capable = False
+        lifetime_capable = False
+        fits_now = False
+        for w in range(n_w):
+            for v in present:
+                if all(
+                    total[w][r] >= needs[b][v][r] for r in range(n_r)
+                ):
+                    amount_capable = True
+                    if min_time[b][v] <= lifetime[w]:
+                        lifetime_capable = True
+                        if post_nt[w] >= 1 and all(
+                            post_free[w][r] >= needs[b][v][r]
+                            for r in range(n_r)
+                        ):
+                            fits_now = True
+        if not amount_capable:
+            reasons.append(REASON_NO_MATCHING_WORKER)
+        elif not lifetime_capable:
+            reasons.append(REASON_WORKER_LIFETIME)
+        elif fits_now:
+            reasons.append(REASON_SOLVER_DEFERRED)
+        else:
+            reasons.append(REASON_INSUFFICIENT_CAPACITY)
+    return reasons

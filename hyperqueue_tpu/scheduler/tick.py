@@ -155,6 +155,7 @@ def run_tick(
     dense=None,
     phases: dict | None = None,
     key_cache=None,
+    decision: dict | None = None,
 ) -> list[Assignment]:
     """Solve one tick and pop assigned tasks from the queues.
 
@@ -170,7 +171,10 @@ def run_tick(
     persistent incremental snapshot: the cache only serves ticks with no
     min-utilization workers, so the mu carve-out below is skipped
     structurally.  `phases` (optional dict) collects a per-phase latency
-    breakdown in ms; `key_cache` memoizes sort keys across ticks.
+    breakdown in ms; `key_cache` memoizes sort keys across ticks;
+    `decision` (optional dict) receives the solver's verdict for this
+    tick's DecisionRecord (scheduler/decision.py): status, backend,
+    solve_ms, objective.
     """
     if batches is None:
         batches = create_batches(queues)
@@ -182,6 +186,7 @@ def run_tick(
         return _run_main_solve(
             queues, None, rq_map, resource_map, model, batches,
             dense=dense, phases=phases, key_cache=key_cache,
+            decision=decision,
         )
     if not batches or not workers:
         return []
@@ -207,14 +212,14 @@ def run_tick(
                 (max(w.cpu_floor, 0) for w in workers), dtype=np.int64,
                 count=len(workers),
             ),
-            phases=phases, key_cache=key_cache,
+            phases=phases, key_cache=key_cache, decision=decision,
         )
     workers = [w for w in workers if w.cpu_floor <= 0]
     if not workers:
         return _solve_mu_workers(queues, mu_workers, rq_map, resource_map)
     assignments = _run_main_solve(
         queues, workers, rq_map, resource_map, model, batches,
-        phases=phases, key_cache=key_cache,
+        phases=phases, key_cache=key_cache, decision=decision,
     )
     if mu_workers:
         assignments.extend(
@@ -544,7 +549,8 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 
 
 def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
-                    cpu_floor=None, dense=None, phases=None, key_cache=None):
+                    cpu_floor=None, dense=None, phases=None, key_cache=None,
+                    decision=None):
     _t0 = _time.perf_counter()
     kwargs = assemble_solve_inputs(
         workers, batches, rq_map, resource_map, cpu_floor=cpu_floor,
@@ -553,6 +559,24 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
     _t1 = _time.perf_counter()
     counts = model.solve(**kwargs)
     _t2 = _time.perf_counter()
+    if decision is not None:
+        # the solver's verdict for this tick's DecisionRecord
+        # (scheduler/decision.py): a watchdog-wrapped model reports whether
+        # THIS solve ran degraded/skipped; plain models are always "ok".
+        # The objective mirrors the LP's maximized quantity in aggregate:
+        # how many tasks the dense solve placed.
+        if getattr(model, "last_solve_skipped", False):
+            status = "skipped"
+        elif getattr(model, "last_solve_degraded", False):
+            status = "fallback"
+        else:
+            status = "ok"
+        decision["solver"] = {
+            "status": status,
+            "backend": getattr(model, "last_backend", None),
+            "solve_ms": round((_t2 - _t1) * 1e3, 4),
+            "objective": int(np.asarray(counts).sum()),
+        }
     if phases is not None:
         phases["assemble"] = phases.get("assemble", 0.0) + (_t1 - _t0) * 1e3
         solve_ms = (_t2 - _t1) * 1e3

@@ -128,6 +128,11 @@ class SolverWatchdog:
         self.rearms = 0
         self.skipped_ticks = 0
         self.last_error = ""
+        # per-solve verdict of the MOST RECENT solve() call, read by the
+        # tick's DecisionRecord (scheduler/decision.py): degraded = the
+        # fallback ran this tick, skipped = even the fallback failed
+        self.last_solve_degraded = False
+        self.last_solve_skipped = False
 
     # --- model protocol -------------------------------------------------
     def _abandoned_busy(self) -> bool:
@@ -178,6 +183,8 @@ class SolverWatchdog:
 
     # --- solve ----------------------------------------------------------
     def solve(self, **kwargs) -> np.ndarray:
+        self.last_solve_degraded = False
+        self.last_solve_skipped = False
         # not armed (benched, or a stranded solve still runs) falls through
         # to _run_fallback below
         if self.armed:
@@ -231,6 +238,7 @@ class SolverWatchdog:
             raise
 
     def _run_fallback(self, kwargs) -> np.ndarray:
+        self.last_solve_degraded = True
         fb_kwargs = dict(kwargs)
         # the greedy fallback cannot express the MILP's joint
         # min-utilization floor. On a degraded tick, floored workers WAIT
@@ -251,6 +259,7 @@ class SolverWatchdog:
             result = self.fallback.solve(**fb_kwargs)
         except Exception:  # noqa: BLE001 - never kill the scheduling loop
             self.skipped_ticks += 1
+            self.last_solve_skipped = True
             logger.critical(
                 "fallback solve failed too; assigning nothing this tick",
                 exc_info=True,

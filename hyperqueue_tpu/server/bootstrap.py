@@ -266,6 +266,7 @@ class Server:
         solver_rearm_ticks: int = 20,
         metrics_port: int | None = None,
         metrics_host: str = "0.0.0.0",
+        flight_recorder_ticks: int = 512,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -310,6 +311,13 @@ class Server:
         # bit-identical to a from-scratch one (scheduler/tick_cache.py
         # paranoid_check; `--paranoid-tick N`)
         self.core.paranoid_tick = paranoid_tick
+        # flight recorder: ring of the last N per-tick DecisionRecords +
+        # control-plane events (`--flight-recorder-ticks`, 0 = off),
+        # dumped by `hq server flight-recorder dump` and joined by
+        # `hq task explain` / `hq server trace export`
+        from hyperqueue_tpu.utils.flight import FlightRecorder
+
+        self.core.flight = FlightRecorder(flight_recorder_ticks)
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
@@ -638,8 +646,25 @@ class Server:
                 else:
                     series.set(sample.get("value", 0.0))
 
+    # control-plane event kinds mirrored into the flight recorder so a
+    # dump shows what the cluster DID around each tick; per-task kinds are
+    # deliberately excluded (a million-task job must not flush the ring)
+    _FLIGHT_EVENT_KINDS = (
+        "worker-", "job-submitted", "job-completed", "job-opened",
+        "job-closed", "job-paused", "job-resumed", "alloc-", "server-uid",
+    )
+
     # --- events out ----------------------------------------------------
     def emit_event(self, kind: str, payload: dict) -> None:
+        if (
+            self.core.flight.enabled
+            and kind.startswith(self._FLIGHT_EVENT_KINDS)
+            and not kind.startswith("worker-overview")
+        ):
+            self.core.flight.record_event(
+                kind,
+                {k: v for k, v in payload.items() if k != "desc"},
+            )
         if self.journal is None and not self._event_listeners:
             return  # nobody consumes events; skip record construction
         record = {"time": time.time(), "seq": self._event_seq,
@@ -749,6 +774,7 @@ class Server:
                     "tick assigned %d tasks in %.2f ms",
                     n,
                     (time.perf_counter() - t0) * 1e3,
+                    extra={"tick": self.core.tick_counter},
                 )
 
     async def _journal_flush_loop(self) -> None:
@@ -787,6 +813,8 @@ class Server:
                     "task %d: no worker reclaimed it within the %.0fs "
                     "reattach window; requeueing",
                     task_id, self.reattach_timeout,
+                    extra={"job": task_id_job(task_id),
+                           "task": task_id_task(task_id)},
                 )
                 reactor.requeue_reattach_expired(self.core, self.comm, task)
 
@@ -816,6 +844,7 @@ class Server:
                         "worker %d heartbeat timeout (%.0fs)",
                         worker.worker_id,
                         now - worker.last_heartbeat,
+                        extra={"worker": worker.worker_id},
                     )
                     conn = self._worker_conns.pop(worker.worker_id, None)
                     if conn is not None:
@@ -993,6 +1022,7 @@ class Server:
                 "task(s), discarded %d stale",
                 worker.worker_id, reattach.get("worker_id"),
                 len(reattached), len(discard),
+                extra={"worker": worker.worker_id},
             )
         return reattached, discard
 
@@ -1364,18 +1394,52 @@ class Server:
             )
         return new_tasks
 
+    def _job_pending_reasons(self, job_id: int) -> dict[str, int]:
+        """Reason-code -> pending-task count for one job, joined from the
+        latest DecisionRecord plus the pause ledger (`hq job info`
+        "37 tasks waiting: 30 insufficient-capacity, 7 gang-incomplete")."""
+        from hyperqueue_tpu.scheduler import decision as decision_mod
+
+        reasons: dict[str, int] = {}
+        held = self.core.paused_held.get(job_id)
+        if held:
+            reasons[decision_mod.REASON_QUEUE_PAUSED] = len(held)
+        if job_id in self.core.paused_jobs:
+            # the pause supersedes whatever the last pre-pause tick said
+            return reasons
+        latest = self.core.flight.latest()
+        if latest:
+            for entry in latest.get("unplaced") or ():
+                if (
+                    entry.get("job") == job_id
+                    and entry.get("reason")
+                    != decision_mod.REASON_QUEUE_PAUSED
+                ):
+                    reasons[entry["reason"]] = (
+                        reasons.get(entry["reason"], 0) + entry["count"]
+                    )
+        return reasons
+
     async def _client_job_list(self, msg: dict) -> dict:
-        return {
-            "op": "job_list",
-            "jobs": [j.to_info() for j in self.jobs.jobs.values()],
-        }
+        jobs = []
+        for j in self.jobs.jobs.values():
+            info = j.to_info()
+            info["paused"] = j.job_id in self.core.paused_jobs
+            jobs.append(info)
+        return {"op": "job_list", "jobs": jobs}
 
     async def _client_job_info(self, msg: dict) -> dict:
         out = []
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)
             if job is not None:
-                out.append(job.to_detail())
+                detail = job.to_detail()
+                detail["paused"] = job_id in self.core.paused_jobs
+                if job.n_waiting() - job.counters["running"] > 0:
+                    detail["pending_reasons"] = self._job_pending_reasons(
+                        job_id
+                    )
+                out.append(detail)
         return {"op": "job_info", "jobs": out}
 
     async def _client_job_wait(self, msg: dict) -> dict:
@@ -1420,6 +1484,8 @@ class Server:
             del self.jobs.jobs[job_id]
             for job_task_id in job.tasks:
                 self.core.tasks.pop(make_task_id(job_id, job_task_id), None)
+            self.core.paused_jobs.discard(job_id)
+            self.core.paused_held.pop(job_id, None)
             forgotten += 1
         return {"op": "job_forget", "forgotten": forgotten}
 
@@ -1517,18 +1583,45 @@ class Server:
 
     async def _client_task_explain(self, msg: dict) -> dict:
         """Why is this task (not) running? Reference server/explain.rs:11-98 —
-        per worker x per variant, which constraints block."""
-        job_id, job_task_id = msg["job_id"], msg["task_id"]
+        per worker x per variant, which constraints block — joined with the
+        latest DecisionRecord (scheduler/decision.py) for the verdict:
+        reason code, human detail, and how many consecutive ticks the
+        task's class has been deferred (utils/flight.py)."""
+        from hyperqueue_tpu.scheduler import decision as decision_mod
+
+        job_id = msg["job_id"]
+        job = self.jobs.jobs.get(job_id)
+        job_task_id = msg.get("task_id")
+        if job_task_id is None:
+            # `hq task explain <job>` without a task: pick the job's first
+            # still-pending task (else its first task at all)
+            if job is None:
+                return {"op": "error", "message": f"job {job_id} not found"}
+            pending = sorted(
+                t.job_task_id for t in job.tasks.values()
+                if t.status in ("waiting", "running")
+            )
+            if pending:
+                job_task_id = pending[0]
+            elif job.tasks:
+                job_task_id = min(job.tasks)
+            else:
+                return {"op": "error",
+                        "message": f"job {job_id} has no tasks"}
         task = self.core.tasks.get(make_task_id(job_id, job_task_id))
         if task is None:
-            job = self.jobs.jobs.get(job_id)
             if job is not None and job_task_id in job.tasks:
                 info = job.tasks[job_task_id]
                 return {
                     "op": "task_explain",
+                    "job": job_id,
+                    "task": job_task_id,
                     "state": info.status,
                     "workers": [],
                     "n_waiting_deps": 0,
+                    "reason": None,
+                    "reason_detail": f"task is {info.status}",
+                    "deferred_ticks": 0,
                 }
             return {"op": "error", "message": "task not found"}
         rqv = self.core.rq_map.get_variants(task.rq_id)
@@ -1583,12 +1676,213 @@ class Server:
                     "runnable": any(not v["blocked"] for v in variants),
                 }
             )
+
+        # --- verdict: reason code + deferral from the flight recorder ---
+        reason = None
+        detail = ""
+        deferred = 0
+        decision_tick = None
+        paused = job_id in self.core.paused_jobs
+        if task.state is TaskState.WAITING:
+            reason = decision_mod.REASON_WAITING_DEPS
+            detail = (
+                f"waiting for {task.unfinished_deps} unfinished "
+                f"dependenc{'y' if task.unfinished_deps == 1 else 'ies'}"
+            )
+        elif task.state is TaskState.READY:
+            held = self.core.paused_held.get(job_id)
+            if paused and held and task.task_id in held:
+                reason = decision_mod.REASON_QUEUE_PAUSED
+                detail = (
+                    f"job {job_id} is paused; "
+                    f"`hq job resume {job_id}` to release it"
+                )
+            else:
+                rec = self.core.flight.reason_for(task.rq_id, job_id)
+                if rec is not None:
+                    reason = rec["reason"]
+                    detail = rec.get("detail") or ""
+                    deferred = rec["deferred_ticks"]
+                    decision_tick = rec["tick"]
+                elif rqv.is_multi_node:
+                    reason = decision_mod.REASON_GANG_INCOMPLETE
+                else:
+                    # no DecisionRecord covers it (no tick yet, or the
+                    # recorder is off): classify live against the pool
+                    reason = decision_mod.classify_class(
+                        self.core, task.rq_id, rqv
+                    )
+            if not detail:
+                n_capable = sum(
+                    1 for w in self.core.workers.values()
+                    if w.resources.is_capable_of_rqv(rqv)
+                )
+                detail = {
+                    decision_mod.REASON_NO_MATCHING_WORKER: (
+                        f"none of the {len(self.core.workers)} connected "
+                        "worker(s) provides the requested resources"
+                    ),
+                    decision_mod.REASON_INSUFFICIENT_CAPACITY: (
+                        f"{n_capable} capable worker(s), all currently "
+                        "occupied"
+                    ),
+                    decision_mod.REASON_WORKER_LIFETIME: (
+                        f"{n_capable} capable worker(s), but none has "
+                        "enough remaining lifetime for the requested "
+                        "--time-request"
+                    ),
+                    decision_mod.REASON_SOLVER_DEFERRED: (
+                        "capacity was free but the solver deferred the "
+                        "class this tick (priority interleaving or "
+                        "reservation drain)"
+                    ),
+                    decision_mod.REASON_WATCHDOG_FALLBACK: (
+                        "the tick ran on the watchdog's host-greedy "
+                        "fallback after the primary solver failed "
+                        "(see `hq server stats`)"
+                    ),
+                    decision_mod.REASON_GANG_INCOMPLETE: (
+                        "waiting for enough idle same-group workers to "
+                        "host the gang"
+                    ),
+                }.get(reason, "")
         return {
             "op": "task_explain",
+            "job": job_id,
+            "task": job_task_id,
             "state": task.state.value,
             "n_waiting_deps": task.unfinished_deps,
+            "reason": reason,
+            "reason_detail": detail,
+            "deferred_ticks": deferred,
+            "decision_tick": decision_tick,
+            "paused": paused,
             "workers": workers,
         }
+
+    async def _client_flight_recorder_dump(self, msg: dict) -> dict:
+        """The flight recorder's rings: last N DecisionRecords + recent
+        control-plane events (`hq server flight-recorder dump`)."""
+        return {"op": "flight_recorder", **self.core.flight.dump()}
+
+    async def _client_job_pause(self, msg: dict) -> dict:
+        """Hold the selected jobs' READY tasks out of the scheduler queues
+        (running/assigned tasks are not preempted)."""
+        paused = []
+        for job_id in msg["job_ids"]:
+            job = self.jobs.jobs.get(job_id)
+            if job is None or job.is_terminated():
+                continue
+            held, retracted = reactor.pause_jobs(
+                self.core, self.comm, [job_id]
+            )
+            paused.append(
+                {"job": job_id, "held": held, "retracted": retracted}
+            )
+            self.emit_event(
+                "job-paused",
+                {"job": job_id, "held": held, "retracted": retracted},
+            )
+        if paused:
+            # wake the scheduler so the next DecisionRecord reflects the
+            # pause (and freed prefill budgets can shift to other jobs)
+            self.comm.ask_for_scheduling()
+        return {"op": "job_pause", "paused": paused}
+
+    async def _client_job_resume(self, msg: dict) -> dict:
+        released = []
+        for job_id in msg["job_ids"]:
+            if job_id not in self.core.paused_jobs:
+                continue
+            n = reactor.resume_jobs(self.core, self.comm, [job_id])
+            released.append({"job": job_id, "released": n})
+            self.emit_event("job-resumed", {"job": job_id, "released": n})
+        return {"op": "job_resume", "resumed": released}
+
+    async def _client_trace_export(self, msg: dict) -> dict:
+        """Chrome trace-event JSON of the run so far: one scheduler row
+        built from the flight recorder's tick ring, one row per worker
+        carrying its task spans (lifecycle stamps), loadable in Perfetto
+        (`hq server trace export out.json`)."""
+        events: list[dict] = []
+        now = time.time()
+        events.append({
+            "ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+            "args": {"name": f"hq-server {self.host}"},
+        })
+        events.append({
+            "ph": "M", "pid": 0, "tid": 0, "name": "thread_name",
+            "args": {"name": "scheduler"},
+        })
+        seen_workers: set[int] = set()
+
+        def name_worker(wid: int, hostname: str = "") -> None:
+            if wid in seen_workers or not wid:
+                return
+            seen_workers.add(wid)
+            label = f"worker {wid}"
+            if hostname:
+                label += f" ({hostname})"
+            events.append({
+                "ph": "M", "pid": 0, "tid": wid, "name": "thread_name",
+                "args": {"name": label},
+            })
+
+        for w in self.core.workers.values():
+            name_worker(w.worker_id, w.configuration.hostname)
+        for wid, past in self.past_workers.items():
+            name_worker(wid, past.get("hostname", ""))
+
+        # scheduler row: one slice per recorded tick + a ready-queue counter
+        for rec in self.core.flight.ticks():
+            ts = rec["time"] * 1e6
+            events.append({
+                "ph": "X", "pid": 0, "tid": 0, "ts": ts,
+                "dur": max(rec.get("duration_ms", 0.0) * 1e3, 1.0),
+                "cat": "tick", "name": f"tick {rec['tick']}",
+                "args": {
+                    "solver": rec.get("solver"),
+                    "counts": rec.get("counts"),
+                    "phases": rec.get("phases"),
+                    "unplaced": rec.get("unplaced"),
+                },
+            })
+            events.append({
+                "ph": "C", "pid": 0, "tid": 0, "ts": ts,
+                "name": "ready_tasks",
+                "args": {
+                    "ready": rec.get("counts", {}).get("ready_left", 0)
+                },
+            })
+
+        # worker rows: one slice per task execution span
+        for job in self.jobs.jobs.values():
+            for info in job.tasks.values():
+                if not info.started_at:
+                    continue
+                wid = info.worker_ids[0] if info.worker_ids else 0
+                name_worker(wid)
+                end = info.finished_at or now
+                core_task = self.core.tasks.get(
+                    make_task_id(job.job_id, info.job_task_id)
+                )
+                events.append({
+                    "ph": "X", "pid": 0, "tid": wid,
+                    "ts": info.started_at * 1e6,
+                    "dur": max((end - info.started_at) * 1e6, 1.0),
+                    "cat": "task",
+                    "name": f"{job.job_id}.{info.job_task_id}",
+                    "args": {
+                        "status": info.status,
+                        "submitted_at": info.submitted_at,
+                        "queued_at": core_task.t_ready if core_task else 0.0,
+                        "assigned_at": (
+                            core_task.t_assigned if core_task else 0.0
+                        ),
+                        "workers": info.worker_ids,
+                    },
+                })
+        return {"op": "trace_export", "traceEvents": events}
 
     def _record_past_worker(self, worker_id: int, reason: str) -> None:
         w = self.core.workers.get(worker_id)

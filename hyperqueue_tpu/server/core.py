@@ -19,6 +19,7 @@ from hyperqueue_tpu.scheduler.tick import WorkerRow
 from hyperqueue_tpu.scheduler.tick_cache import TickPhaseStats, TickStateCache
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
+from hyperqueue_tpu.utils.flight import FlightRecorder
 
 
 @dataclass
@@ -53,6 +54,17 @@ class Core:
     # skip the O(W) membership walk on the common unchanged tick.
     # Row CONTENT changes (free/nt_free) ride on Worker.epoch instead.
     membership_epoch: int = 0
+    # flight recorder: ring of per-tick DecisionRecords + control-plane
+    # events (utils/flight.py); reactor.schedule records into it and the
+    # explain/flight-recorder/trace RPCs read it
+    flight: FlightRecorder = field(default_factory=FlightRecorder)
+    # rq_id -> (membership_epoch, amount_capable, lifetime_ok) memo for
+    # decision.classify_class (pure in the worker set per class)
+    capable_memo: dict = field(default_factory=dict)
+    # jobs paused via `hq job pause`: their READY tasks are held out of the
+    # scheduler queues (paused_held[job_id] = task ids) until resume
+    paused_jobs: set[int] = field(default_factory=set)
+    paused_held: dict[int, set[int]] = field(default_factory=dict)
 
     def bump_membership(self) -> None:
         self.membership_epoch += 1

@@ -13,6 +13,8 @@ import logging
 import time as _time
 from typing import Protocol
 
+from hyperqueue_tpu.ids import task_id_job, task_id_task
+from hyperqueue_tpu.scheduler import decision as decision_mod
 from hyperqueue_tpu.scheduler.queues import Priority as Priority_t
 from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
 from hyperqueue_tpu.server.core import Core
@@ -29,7 +31,7 @@ logger = logging.getLogger(__name__)
 _TICK_PHASE_SECONDS = REGISTRY.histogram(
     "hq_tick_phase_seconds",
     "scheduler tick latency per phase (snapshot/batches/gangs/assemble/"
-    "solve/mapping/prefill/total)",
+    "solve/mapping/prefill/decide/total)",
     labels=("phase",),
 )
 _TICKS_TOTAL = REGISTRY.counter(
@@ -108,12 +110,98 @@ def on_new_tasks(core: Core, comm: Comm, tasks: list[Task]) -> None:
 def _make_ready(core: Core, task: Task) -> None:
     task.state = TaskState.READY
     task.t_ready = _time.time()
+    if core.paused_jobs:
+        job_id = task_id_job(task.task_id)
+        if job_id in core.paused_jobs:
+            # the job is paused: the task is READY but held out of the
+            # queues until `hq job resume` re-enqueues it
+            core.paused_held.setdefault(job_id, set()).add(task.task_id)
+            return
     rqv = core.rq_map.get_variants(task.rq_id)
     if rqv.is_multi_node:
         core.mn_queue.append(task.task_id)
         core.mn_queue.sort(key=lambda t: core.tasks[t].priority, reverse=True)
     else:
         core.queues.add(task.rq_id, task.priority, task.task_id)
+
+
+def pause_jobs(core: Core, comm: Comm, job_ids: list[int]) -> tuple[int, int]:
+    """Hold the READY tasks of these jobs out of the scheduler queues.
+
+    Tasks already RUNNING (or assigned with resources accounted) are not
+    recalled — pause gates placement, it does not preempt.  PREFILLED
+    backlog (queued on a worker, not started) IS asked back via the
+    retract path: a successful retract requeues through _make_ready,
+    which holds the task because the job is paused.  WAITING tasks whose
+    dependencies finish while paused are held the same way.  Returns
+    (newly held, retracts sent)."""
+    wanted = set(job_ids)
+    core.paused_jobs |= wanted
+    held = 0
+    for _rq_id, queue in core.queues.items():
+        for task_id in queue.all_tasks():
+            if task_id_job(task_id) in wanted:
+                queue.remove(task_id)
+                core.paused_held.setdefault(
+                    task_id_job(task_id), set()
+                ).add(task_id)
+                held += 1
+    for task_id in list(core.mn_queue):
+        if task_id_job(task_id) in wanted:
+            core.mn_queue.remove(task_id)
+            _clear_mn_reservations(core, task_id)
+            core.paused_held.setdefault(
+                task_id_job(task_id), set()
+            ).add(task_id)
+            held += 1
+    retracts: dict[int, list[tuple[int, int]]] = {}
+    for worker in core.workers.values():
+        for task_id in worker.prefilled_tasks:
+            if task_id_job(task_id) not in wanted:
+                continue
+            task = core.tasks[task_id]
+            if task.retract_pending:
+                continue  # an earlier retract already covers it
+            task.retract_pending = True
+            retracts.setdefault(worker.worker_id, []).append(
+                (task_id, task.instance_id)
+            )
+    n_retracted = 0
+    for worker_id, refs in retracts.items():
+        _RETRACTED_TOTAL.labels("pause").inc(len(refs))
+        n_retracted += len(refs)
+        comm.send_retract(worker_id, refs)
+    return held, n_retracted
+
+
+def resume_jobs(core: Core, comm: Comm, job_ids: list[int]) -> int:
+    """Re-enqueue the held READY tasks of paused jobs."""
+    released = 0
+    mn_added = False
+    for job_id in job_ids:
+        core.paused_jobs.discard(job_id)
+        held = core.paused_held.pop(job_id, None)
+        if not held:
+            continue
+        for task_id in sorted(held):
+            task = core.tasks.get(task_id)
+            if (
+                task is None
+                or task.is_done
+                or task.state is not TaskState.READY
+            ):
+                continue
+            if core.rq_map.get_variants(task.rq_id).is_multi_node:
+                core.mn_queue.append(task_id)
+                mn_added = True
+            else:
+                core.queues.add(task.rq_id, task.priority, task_id)
+            released += 1
+    if mn_added:
+        core.mn_queue.sort(key=lambda t: core.tasks[t].priority, reverse=True)
+    if released:
+        comm.ask_for_scheduling()
+    return released
 
 
 def on_new_worker(core: Core, comm: Comm, events: EventSink, worker: Worker) -> None:
@@ -380,13 +468,17 @@ def on_cancel_tasks(
         stack.extend(sorted(task.consumers))
         task.consumers.clear()
         if task.state is TaskState.READY:
-            rqv = core.rq_map.get_variants(task.rq_id)
-            if rqv.is_multi_node:
-                if tid in core.mn_queue:
-                    core.mn_queue.remove(tid)
-                _clear_mn_reservations(core, tid)
+            held = core.paused_held.get(task_id_job(tid))
+            if held is not None and tid in held:
+                held.discard(tid)  # paused: held out of the queues
             else:
-                core.queues.remove(task.rq_id, tid)
+                rqv = core.rq_map.get_variants(task.rq_id)
+                if rqv.is_multi_node:
+                    if tid in core.mn_queue:
+                        core.mn_queue.remove(tid)
+                    _clear_mn_reservations(core, tid)
+                else:
+                    core.queues.remove(task.rq_id, tid)
         elif task.state in (TaskState.ASSIGNED, TaskState.RUNNING):
             notify = list(task.mn_workers) or [task.assigned_worker]
             _release_task_resources(core, task)
@@ -514,6 +606,7 @@ def schedule(
     """
     assigned = 0
     prefilled = 0
+    gang_assigned = 0
     per_worker_msgs: dict[int, list[dict]] = {}
     # per-phase latency breakdown of THIS tick (ms), recorded into
     # core.tick_stats at the end and surfaced via `hq server stats`
@@ -522,6 +615,13 @@ def schedule(
     # one wall-clock stamp per tick: every task assigned this tick shares it
     # (the timeline's resolution is the tick itself)
     now = _time.time()
+    # DecisionRecord collection (scheduler/decision.py + utils/flight.py):
+    # gang_unplaced gathers per-gang reasons during the gang phase,
+    # decision_info receives the solver verdict from run_tick, and the
+    # leftover classification runs once at the end of the tick
+    record_decision = core.flight.enabled
+    gang_unplaced: list[dict] = []
+    decision_info: dict = {}
 
     # --- multi-node gangs: all-or-nothing N eligible workers from one
     # group.  Per-member eligibility matches the reference's
@@ -562,6 +662,7 @@ def schedule(
                     )
                     chosen = idle[:n_nodes]
                     break
+            deferred_for_sn = False
             if (
                 chosen is not None
                 and top_sn is not None
@@ -573,8 +674,42 @@ def schedule(
                 # blocks the gang the same way, solver.rs:479-518); the
                 # gang retries on what the sn solve leaves idle
                 chosen = None
+                deferred_for_sn = True
             if chosen is None:
                 remaining_mn.append(task_id)
+                if record_decision:
+                    if deferred_for_sn:
+                        # the gang WAS placeable: the solver deferred it
+                        # behind higher-priority single-node work, which is
+                        # not a group shortfall
+                        reason = decision_mod.REASON_SOLVER_DEFERRED
+                        detail = (
+                            f"{n_nodes} idle same-group workers are "
+                            "available, but strictly-higher-priority "
+                            "single-node work goes first this tick"
+                        )
+                    else:
+                        best = max(groups.values(), key=len, default=None)
+                        n_idle = (
+                            sum(1 for w in best if w.is_idle())
+                            if best else 0
+                        )
+                        reason = decision_mod.REASON_GANG_INCOMPLETE
+                        detail = (
+                            f"needs {n_nodes} idle same-group workers; "
+                            f"largest eligible group has "
+                            f"{len(best) if best else 0} "
+                            f"({n_idle} idle)"
+                        )
+                    gang_unplaced.append({
+                        "rq_id": task.rq_id,
+                        "job": task_id_job(task_id),
+                        "task": task_id_task(task_id),
+                        "priority": task.priority[0],
+                        "count": 1,
+                        "reason": reason,
+                        "detail": detail,
+                    })
                 # user-priority comparison only: the scheduler component of
                 # the tuple is -job_id, and an older sn job must not
                 # strictly outrank a same-user-priority gang forever
@@ -642,6 +777,7 @@ def schedule(
             ]
             per_worker_msgs.setdefault(root.worker_id, []).append(msg)
             assigned += 1
+            gang_assigned += 1
         core.mn_queue = remaining_mn
         phases["gangs"] = (_time.perf_counter() - _t_phase) * 1e3
         TRACER.record("scheduler/gangs", _time.perf_counter() - _t_phase)
@@ -685,6 +821,7 @@ def schedule(
             core.queues, rows, core.rq_map, core.resource_map, model,
             batches=batches, dense=snapshot, phases=phases,
             key_cache=core.tick_cache,
+            decision=decision_info if record_decision else None,
         )
         taken_by_batch: dict[tuple[int, Priority_t], int] = {}
         for task_id, worker_id, rq_id, variant in assignments:
@@ -950,6 +1087,63 @@ def schedule(
 
     for worker_id, msgs in per_worker_msgs.items():
         comm.send_compute(worker_id, msgs)
+
+    # --- decision record: attribute everything this tick left unplaced
+    # to a reason code (scheduler/decision.py) and push the record into
+    # the flight recorder ring. Cost is O(leftover classes), never
+    # O(tasks) — `phases["decide"]` makes any regression visible in the
+    # same place the <=5% budget is enforced. ---
+    record = None
+    if record_decision:
+        _t_phase = _time.perf_counter()
+        try:
+            # tick-local: only a solve that actually ran THIS tick can mark
+            # it degraded (a stale flag from a previous tick must not leak)
+            solver = decision_info.get("solver") or {"status": "idle"}
+            degraded = solver["status"] in ("fallback", "skipped")
+            unplaced = list(gang_unplaced)
+            ready_left = core.queues.total_ready()
+            if ready_left:
+                if leftover_batches is None:
+                    leftover_batches = create_batches(core.queues)
+                unplaced.extend(decision_mod.build_unplaced_entries(
+                    core, leftover_batches, {}, degraded=degraded,
+                ))
+            n_paused = 0
+            for job_id, held in core.paused_held.items():
+                if held:
+                    n_paused += len(held)
+                    unplaced.append({
+                        "rq_id": None, "job": job_id, "priority": None,
+                        "count": len(held),
+                        "reason": decision_mod.REASON_QUEUE_PAUSED,
+                    })
+            record = {
+                "tick": core.tick_counter,
+                "time": now,
+                "solver": solver,
+                "counts": {
+                    "workers": len(core.workers),
+                    "assigned": assigned - gang_assigned,
+                    "gang_assigned": gang_assigned,
+                    "prefilled": prefilled,
+                    "unplaced": sum(
+                        e["count"] for e in unplaced
+                        if e["reason"] != decision_mod.REASON_QUEUE_PAUSED
+                    ),
+                    "paused": n_paused,
+                    "ready_left": ready_left,
+                    "mn_waiting": len(core.mn_queue),
+                },
+                "unplaced": unplaced,
+            }
+        except Exception:  # noqa: BLE001 - explainability must never
+            # take the scheduling loop down with it
+            logger.exception("decision-record assembly failed; tick %d "
+                             "goes unrecorded", core.tick_counter)
+            record = None
+        phases["decide"] = (_time.perf_counter() - _t_phase) * 1e3
+
     phases["total"] = (_time.perf_counter() - _t_tick) * 1e3
     core.tick_stats.record(phases)
     _TICKS_TOTAL.inc()
@@ -959,6 +1153,10 @@ def schedule(
         _PREFILLED_TOTAL.inc(prefilled)
     for name, ms in phases.items():
         _TICK_PHASE_SECONDS.labels(name).observe(ms / 1e3)
+    if record is not None:
+        record["duration_ms"] = round(phases["total"], 4)
+        record["phases"] = {k: round(v, 4) for k, v in phases.items()}
+        core.flight.record_tick(record)
     return assigned
 
 

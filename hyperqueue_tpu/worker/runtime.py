@@ -264,7 +264,8 @@ class WorkerRuntime:
     # --- connection lifecycle -------------------------------------------
     async def run(self) -> None:
         await self._connect(reattach=False)
-        logger.info("registered as worker %d", self.worker_id)
+        logger.info("registered as worker %d", self.worker_id,
+                    extra={"worker": self.worker_id})
 
         import tempfile
 
@@ -650,7 +651,8 @@ class WorkerRuntime:
             return False
         allocation = self.allocator.try_allocate(entries)
         if allocation is None and entries:
-            logger.debug("task %d blocked on resources", task_msg["id"])
+            logger.debug("task %d blocked on resources", task_msg["id"],
+                         extra={"task": task_msg["id"]})
             self._park(sig, task_msg)
             return False
         self._start_with_allocation(task_msg, allocation)
@@ -797,7 +799,8 @@ class WorkerRuntime:
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 - report, don't kill the worker
-            logger.exception("task %d launch failed", task_id)
+            logger.exception("task %d launch failed", task_id,
+                             extra={"task": task_id})
             if task_id not in self._discarded:
                 try:
                     await self._send(

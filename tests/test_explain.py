@@ -1,0 +1,619 @@
+"""Scheduling explainability: reason codes, flight recorder, explain RPC,
+Perfetto trace export (ISSUE 4).
+
+Unit level drives reactor.schedule through TestEnv and asserts the
+DecisionRecord reason matrix per constraint type; e2e level drives real
+processes through `hq task explain` / `hq server flight-recorder dump` /
+`hq server trace export`; the docs checker pins every emitted reason code
+to the docs/observability.md catalog.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hyperqueue_tpu.scheduler import decision
+from hyperqueue_tpu.utils.flight import FlightRecorder
+
+from utils_env import TestEnv
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.metrics
+
+
+# --------------------------------------------------------------------------
+# reason-code matrix (one scenario per constraint type)
+# --------------------------------------------------------------------------
+def _reasons(env) -> dict[str, int]:
+    rec = env.core.flight.latest()
+    assert rec is not None, "tick recorded no decision"
+    out: dict[str, int] = {}
+    for entry in rec["unplaced"]:
+        out[entry["reason"]] = out.get(entry["reason"], 0) + entry["count"]
+    return out
+
+
+def test_reason_no_matching_worker_without_any_worker():
+    env = TestEnv()
+    env.submit(priority=(0, -1))
+    env.schedule()
+    assert _reasons(env) == {decision.REASON_NO_MATCHING_WORKER: 1}
+
+
+def test_reason_no_matching_worker_wrong_resources():
+    env = TestEnv()
+    env.worker(cpus=2)
+    env.submit(rqv=env.rqv(cpus=64), priority=(0, -1))
+    env.schedule()
+    assert _reasons(env) == {decision.REASON_NO_MATCHING_WORKER: 1}
+
+
+def test_reason_insufficient_capacity():
+    env = TestEnv()
+    env.worker(cpus=2)
+    env.submit(n=3, rqv=env.rqv(cpus=2), priority=(0, -1))
+    assert env.schedule() == 1
+    assert _reasons(env) == {decision.REASON_INSUFFICIENT_CAPACITY: 2}
+    # the record's counts agree with the outcome
+    rec = env.core.flight.latest()
+    assert rec["counts"]["assigned"] == 1
+    assert rec["counts"]["unplaced"] == 2
+    assert rec["solver"]["status"] == "ok"
+    assert rec["solver"]["objective"] == 1
+
+
+def test_reason_gang_incomplete_names_group_shortfall():
+    env = TestEnv()
+    env.worker(cpus=2)
+    env.submit(rqv=env.rqv(n_nodes=3), priority=(0, -1))
+    env.schedule()
+    rec = env.core.flight.latest()
+    (entry,) = rec["unplaced"]
+    assert entry["reason"] == decision.REASON_GANG_INCOMPLETE
+    assert "needs 3 idle same-group workers" in entry["detail"]
+    assert "1 (1 idle)" in entry["detail"]
+
+
+def test_reason_queue_paused_and_resume_roundtrip():
+    from hyperqueue_tpu.server import reactor
+
+    env = TestEnv()
+    env.worker(cpus=4)
+    ids = env.submit(n=3, job=7, priority=(0, -7))
+    assert reactor.pause_jobs(env.core, env.comm, [7]) == (3, 0)
+    env.core.sanity_check()
+    assert env.schedule() == 0
+    assert _reasons(env) == {decision.REASON_QUEUE_PAUSED: 3}
+    # resume re-enqueues exactly the held tasks
+    assert reactor.resume_jobs(env.core, env.comm, [7]) == 3
+    assert env.schedule() == 3
+    assert not env.core.paused_held.get(7)
+    # tasks becoming ready WHILE paused are held too
+    (a,) = env.submit(job=9, priority=(0, -9))
+    (b,) = env.submit(job=9, deps=[a], priority=(0, -9))
+    reactor.pause_jobs(env.core, env.comm, [9])
+    env.schedule()
+    assert env.core.paused_held[9] == {a}
+    reactor.resume_jobs(env.core, env.comm, [9])
+    env.schedule()
+    env.start_all_assigned()
+    env.finish(a)
+    reactor.pause_jobs(env.core, env.comm, [9])
+    # b became READY after the pause: _make_ready must hold it
+    assert b in env.core.paused_held[9]
+
+
+def test_pause_recalls_prefilled_backlog():
+    """A paused job's PREFILLED tasks (queued on a worker, not started)
+    are retracted; the successful retract requeues through _make_ready,
+    which holds them because the job is paused."""
+    from hyperqueue_tpu.server import reactor
+    from hyperqueue_tpu.server.task import TaskState
+
+    env = TestEnv()
+    env.worker(cpus=2)
+    (blocker,) = env.submit(rqv=env.rqv(cpus=2), job=1, priority=(0, -1))
+    (backlog,) = env.submit(rqv=env.rqv(cpus=2), job=2, priority=(0, -2))
+    env.schedule(prefill=True)
+    task = env.core.tasks[backlog]
+    assert task.prefilled
+    held, retracted = reactor.pause_jobs(env.core, env.comm, [2])
+    assert (held, retracted) == (0, 1)
+    assert env.comm.retracts[-1][1] == [(backlog, task.instance_id)]
+    # worker answers: not started, handed back -> held by the pause
+    reactor.on_retract_response(
+        env.core, env.comm, backlog, ok=True,
+        instance_id=task.instance_id,
+    )
+    assert task.state is TaskState.READY
+    assert backlog in env.core.paused_held[2]
+    env.core.sanity_check()
+    # resume releases it back into the queues
+    reactor.resume_jobs(env.core, env.comm, [2])
+    assert env.core.queues.total_ready() == 1
+
+
+def test_paused_task_cancel_does_not_corrupt_queues():
+    from hyperqueue_tpu.server import reactor
+
+    env = TestEnv()
+    env.worker(cpus=4)
+    ids = env.submit(n=2, job=5, priority=(0, -5))
+    reactor.pause_jobs(env.core, env.comm, [5])
+    assert env.cancel([ids[0]]) == [ids[0]]
+    env.core.sanity_check()
+    reactor.resume_jobs(env.core, env.comm, [5])
+    assert env.schedule() == 1  # only the surviving task
+
+
+def test_reason_worker_lifetime():
+    env = TestEnv()
+    env.worker(cpus=4, time_limit=10.0)
+    env.submit(rqv=env.rqv(min_time=3600.0), priority=(0, -1))
+    env.schedule()
+    assert _reasons(env) == {decision.REASON_WORKER_LIFETIME: 1}
+
+
+def test_worker_lifetime_memo_tracks_decay(monkeypatch):
+    """A lifetime_ok verdict backed only by finite-lifetime workers must
+    not be served stale once those lifetimes decay below the request's
+    min_time (the membership epoch never changed)."""
+    env = TestEnv()
+    w = env.worker(cpus=4, time_limit=100.0)
+    (t,) = env.submit(
+        rqv=env.rqv(cpus=64, min_time=50.0), priority=(0, -1)
+    )
+    env.schedule()  # cpus=64 impossible -> but warms the memo per class
+    (t2,) = env.submit(rqv=env.rqv(min_time=50.0), priority=(0, -1))
+    rq_id = env.core.tasks[t2].rq_id
+    assert decision.classify_class(env.core, rq_id) in (
+        decision.REASON_SOLVER_DEFERRED,  # placeable right now
+    )
+    # fast-forward: the worker now has only 10s left; same epoch
+    monkeypatch.setattr(type(w), "lifetime_secs", lambda self: 10)
+    assert (
+        decision.classify_class(env.core, rq_id)
+        == decision.REASON_WORKER_LIFETIME
+    )
+
+
+def test_gang_deferred_for_higher_priority_sn_is_solver_deferred():
+    """A placeable gang pushed behind strictly-higher-priority single-node
+    work must report solver-deferred, not a (false) group shortfall."""
+    env = TestEnv()
+    env.worker(cpus=2)
+    env.worker(cpus=2)
+    env.submit(rqv=env.rqv(n_nodes=2), job=1, priority=(0, -1))
+    env.submit(n=8, rqv=env.rqv(cpus=2), job=2, priority=(5, -2))
+    env.schedule()
+    rec = env.core.flight.latest()
+    gang = [e for e in rec["unplaced"] if e.get("task") is not None]
+    assert len(gang) == 1
+    assert gang[0]["reason"] == decision.REASON_SOLVER_DEFERRED
+    assert "higher-priority single-node" in gang[0]["detail"]
+
+
+def test_reason_solver_deferred_when_solver_declines():
+    class _ZeroModel:
+        def solve(self, free, nt_free, lifetime, needs, sizes, min_time,
+                  priorities, **kw):
+            return np.zeros(
+                (needs.shape[0], needs.shape[1], free.shape[0]),
+                dtype=np.int32,
+            )
+
+    env = TestEnv(model=_ZeroModel())
+    env.worker(cpus=4)
+    env.submit(priority=(0, -1))
+    assert env.schedule() == 0
+    assert _reasons(env) == {decision.REASON_SOLVER_DEFERRED: 1}
+
+
+@pytest.mark.chaos
+def test_watchdog_fallback_reason_when_solver_killed(monkeypatch):
+    """Solver killed mid-solve (chaos hang past the watchdog deadline) and
+    the fallback broken too: the tick assigns nothing, the DecisionRecord
+    reports solver status `skipped`, and the unplaced (but placeable) work
+    carries the `watchdog-fallback` reason code."""
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+    from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
+    from hyperqueue_tpu.utils import chaos
+
+    plan = {"rules": [
+        {"site": "solve", "action": "hang", "at": 1, "hang_s": 5},
+    ]}
+    monkeypatch.setenv("HQ_FAULT_PLAN", json.dumps(plan))
+    chaos._load()
+
+    class _BrokenFallback:
+        def solve(self, **kw):
+            raise RuntimeError("fallback broken too")
+
+    try:
+        wd = SolverWatchdog(
+            GreedyCutScanModel(backend="numpy"),
+            timeout_s=0.2, rearm_ticks=100, fallback=_BrokenFallback(),
+        )
+        env = TestEnv(model=wd)
+        env.worker(cpus=4)
+        env.submit(n=2, priority=(0, -1))
+        assert env.schedule() == 0
+        rec = env.core.flight.latest()
+        assert rec["solver"]["status"] == "skipped"
+        assert _reasons(env) == {decision.REASON_WATCHDOG_FALLBACK: 2}
+    finally:
+        chaos.ACTIVE = False
+        chaos._PLAN = None
+
+
+def test_decision_job_attribution_splits_by_job():
+    env = TestEnv()
+    env.worker(cpus=2)
+    env.submit(n=2, rqv=env.rqv(cpus=2), job=1, priority=(0, -1))
+    env.submit(n=3, rqv=env.rqv(cpus=2), job=2, priority=(0, -2))
+    env.schedule()
+    rec = env.core.flight.latest()
+    by_job = {}
+    for e in rec["unplaced"]:
+        by_job[e["job"]] = by_job.get(e["job"], 0) + e["count"]
+    # one task ran; jobs share one rq class but batches split per job
+    assert sum(by_job.values()) == 4
+    assert set(by_job) == {1, 2}
+
+
+def test_deferred_ticks_accumulate_and_reason_for_joins():
+    env = TestEnv()
+    env.worker(cpus=2)
+    a, b = env.submit(n=2, rqv=env.rqv(cpus=2), job=3, priority=(0, -3))
+    for _ in range(5):
+        env.schedule()
+    rq_id = env.core.tasks[b].rq_id
+    rec = env.core.flight.reason_for(rq_id, 3)
+    assert rec["reason"] == decision.REASON_INSUFFICIENT_CAPACITY
+    assert rec["deferred_ticks"] == 5
+    # a different job has no entry
+    assert env.core.flight.reason_for(rq_id, 99) is None
+
+
+# --------------------------------------------------------------------------
+# flight recorder ring semantics
+# --------------------------------------------------------------------------
+def test_flight_ring_evicts_oldest():
+    fr = FlightRecorder(capacity_ticks=4)
+    for i in range(10):
+        fr.record_tick({
+            "tick": i, "time": float(i),
+            "counts": {"assigned": 1}, "unplaced": [],
+        })
+    assert [r["tick"] for r in fr.ticks()] == [6, 7, 8, 9]
+
+
+def test_flight_drops_idle_ticks_and_disables_at_zero():
+    fr = FlightRecorder(capacity_ticks=4)
+    fr.record_tick({"tick": 1, "time": 1.0, "counts": {}, "unplaced": []})
+    assert fr.ticks() == []
+    assert fr.dropped_idle_ticks == 1
+    off = FlightRecorder(capacity_ticks=0)
+    off.record_tick({
+        "tick": 1, "time": 1.0, "counts": {"assigned": 5}, "unplaced": [],
+    })
+    off.record_event("worker-connected", {"id": 1})
+    assert not off.enabled
+    assert off.ticks() == [] and off.events() == []
+
+
+def test_flight_event_ring_bounded():
+    fr = FlightRecorder(capacity_ticks=4, capacity_events=3)
+    for i in range(9):
+        fr.record_event("worker-connected", {"id": i})
+    events = fr.events()
+    assert len(events) == 3
+    assert [e["id"] for e in events] == [6, 7, 8]
+
+
+# --------------------------------------------------------------------------
+# oracle reference classifier (executable spec)
+# --------------------------------------------------------------------------
+def test_oracle_explain_matrix():
+    from hyperqueue_tpu.scheduler.oracle import explain_unplaced, solve_oracle
+    from hyperqueue_tpu.utils.constants import INF_TIME
+
+    INF = int(INF_TIME)
+    free = [[4]]
+    nt_free = [4]
+    lifetime = [50]
+    # b0: amount impossible; b1: fits twice of three; b2: lifetime-blocked
+    needs = [[[8]], [[2]], [[1]]]
+    sizes = [1, 3, 1]
+    min_time = [[0], [0], [100]]
+    counts = solve_oracle(
+        free, nt_free, lifetime, needs, sizes, min_time, [1.0]
+    )
+    reasons = explain_unplaced(
+        free, nt_free, lifetime, needs, sizes, min_time, counts
+    )
+    assert reasons == [
+        decision.REASON_NO_MATCHING_WORKER,
+        decision.REASON_INSUFFICIENT_CAPACITY,
+        decision.REASON_WORKER_LIFETIME,
+    ]
+    # solver-deferred: hand the classifier a solve that left capacity idle
+    reasons = explain_unplaced(
+        [[4]], [4], [INF], [[[1]]], [2], [[0]], [[[1]]]
+    )
+    assert reasons == [decision.REASON_SOLVER_DEFERRED]
+    # a fully placed batch gets no reason
+    reasons = explain_unplaced(
+        [[4]], [4], [INF], [[[2]]], [2], [[0]], [[[2]]]
+    )
+    assert reasons == [None]
+
+
+def test_oracle_and_production_classifier_agree():
+    """The dumb-loop oracle classifier and the production classify_class
+    must agree on the constraint matrix (same scenarios both ways)."""
+    from hyperqueue_tpu.scheduler.oracle import explain_unplaced
+
+    scenarios = [
+        # (worker cpus, time_limit, task cpus, min_time, expected)
+        (2, 0.0, 64, 0.0, decision.REASON_NO_MATCHING_WORKER),
+        (2, 10.0, 1, 3600.0, decision.REASON_WORKER_LIFETIME),
+    ]
+    for w_cpus, t_limit, cpus, min_time, expected in scenarios:
+        env = TestEnv()
+        env.worker(cpus=w_cpus, time_limit=t_limit)
+        (t,) = env.submit(
+            rqv=env.rqv(cpus=cpus, min_time=min_time), priority=(0, -1)
+        )
+        env.schedule()
+        assert _reasons(env) == {expected: 1}
+        # the dense mirror of the same scenario
+        U = 10_000
+        life = int(t_limit) if t_limit else 10**9
+        oracle_reason = explain_unplaced(
+            [[w_cpus * U]], [w_cpus], [life],
+            [[[int(cpus * U)]]], [1], [[int(min_time)]],
+            [[[0]]],
+        )
+        assert oracle_reason == [expected]
+
+
+# --------------------------------------------------------------------------
+# docs catalog checker: no reason code ships undocumented
+# --------------------------------------------------------------------------
+def test_every_reason_code_is_documented():
+    docs = (REPO_ROOT / "docs" / "observability.md").read_text()
+    for code in sorted(decision.ALL_REASONS):
+        assert f"`{code}`" in docs, (
+            f"reason code {code!r} is not listed in the "
+            "docs/observability.md catalog"
+        )
+
+
+def test_every_emitted_reason_constant_resolves_to_the_registry():
+    """Any REASON_* name referenced anywhere in scheduler/ or the server
+    layers must exist in the decision.py registry (and therefore, by the
+    test above, in the docs catalog)."""
+    sources = list((REPO_ROOT / "hyperqueue_tpu" / "scheduler").glob("*.py"))
+    sources += [
+        REPO_ROOT / "hyperqueue_tpu" / "server" / "reactor.py",
+        REPO_ROOT / "hyperqueue_tpu" / "server" / "bootstrap.py",
+    ]
+    referenced = set()
+    for path in sources:
+        referenced |= set(re.findall(r"REASON_[A-Z_]+", path.read_text()))
+    assert referenced, "no reason-code references found (paths moved?)"
+    for name in sorted(referenced):
+        assert hasattr(decision, name), (
+            f"{name} referenced in scheduler/server code but missing from "
+            "the scheduler/decision.py registry"
+        )
+    # and the registry itself is complete: every constant is in ALL_REASONS
+    for name in dir(decision):
+        if name.startswith("REASON_"):
+            assert getattr(decision, name) in decision.ALL_REASONS
+
+
+# --------------------------------------------------------------------------
+# e2e: explain RPC, flight-recorder dump, pause, trace export
+# --------------------------------------------------------------------------
+from utils_e2e import HqEnv, wait_until  # noqa: E402
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _explain(env, target: str) -> dict:
+    return json.loads(env.command(
+        ["task", "explain", target, "--output-mode", "json"]
+    ))
+
+
+def test_explain_rpc_end_to_end(env):
+    """One cluster, every constraint scenario: no-matching-worker,
+    insufficient-capacity (past the prefill budget), gang-incomplete and
+    queue-paused each produce a non-empty, correct verdict through the
+    real `hq task explain` CLI."""
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    flag = env.work_dir / "flag"
+
+    # job 1: blocker occupying the whole worker
+    env.command([
+        "submit", "--cpus", "2", "--", "bash", "-c",
+        f"while [ ! -f {flag} ]; do sleep 0.2; done",
+    ])
+
+    def blocker_running():
+        jobs = json.loads(env.command(
+            ["job", "list", "--all", "--output-mode", "json"]
+        ))
+        return jobs and jobs[0]["counters"]["running"] == 1
+
+    wait_until(blocker_running, message="blocker running")
+
+    # job 2: impossible request -> no-matching-worker
+    env.command(["submit", "--cpus", "64", "--", "true"])
+    # job 3: gang needing 2 workers in a 1-worker cluster
+    env.command(["submit", "--nodes", "2", "--", "true"])
+    # job 4: deep backlog past the 512-task prefill budget; the tail
+    # stays READY with insufficient-capacity
+    env.command(["submit", "--cpus", "2", "--array", "0-519", "--", "true"])
+    # job 5: paused before anything can place it
+    env.command(["submit", "--cpus", "1", "--", "true"])
+    env.command(["job", "pause", "5"])
+
+    def tail_pending():
+        out = _explain(env, "4.519")
+        return out.get("reason") == decision.REASON_INSUFFICIENT_CAPACITY
+
+    wait_until(tail_pending, message="backlog tail classified")
+
+    out = _explain(env, "2.0")
+    assert out["reason"] == decision.REASON_NO_MATCHING_WORKER
+    assert out["reason_detail"]
+    assert out["workers"] and not out["workers"][0]["runnable"]
+
+    out = _explain(env, "3.0")
+    assert out["reason"] == decision.REASON_GANG_INCOMPLETE
+    assert "idle same-group workers" in out["reason_detail"]
+
+    out = _explain(env, "4.519")
+    assert out["reason"] == decision.REASON_INSUFFICIENT_CAPACITY
+    assert out["deferred_ticks"] >= 1
+
+    out = _explain(env, "5.0")
+    assert out["reason"] == decision.REASON_QUEUE_PAUSED
+    assert out["paused"] is True
+    assert "hq job resume" in out["reason_detail"]
+
+    # `hq job info` surfaces the per-job pending-reason counts
+    info = json.loads(env.command(
+        ["job", "info", "4", "--output-mode", "json"]
+    ))[0]
+    assert info["pending_reasons"].get(
+        decision.REASON_INSUFFICIENT_CAPACITY, 0
+    ) >= 1
+    info5 = json.loads(env.command(
+        ["job", "info", "5", "--output-mode", "json"]
+    ))[0]
+    assert info5["paused"] is True
+    assert info5["pending_reasons"] == {decision.REASON_QUEUE_PAUSED: 1}
+
+    # flight recorder dump carries the same reasons + control-plane events
+    dump = json.loads(env.command(
+        ["server", "flight-recorder", "dump", "--json"]
+    ))
+    assert dump["capacity_ticks"] == 512
+    reasons = {
+        e["reason"]
+        for rec in dump["ticks"]
+        for e in rec["unplaced"]
+    }
+    assert decision.REASON_NO_MATCHING_WORKER in reasons
+    assert decision.REASON_GANG_INCOMPLETE in reasons
+    assert decision.REASON_QUEUE_PAUSED in reasons
+    kinds = {e["event"] for e in dump["events"]}
+    assert "worker-connected" in kinds
+    assert "job-submitted" in kinds
+    assert "job-paused" in kinds
+
+    # release everything: resume, unblock, drop the impossible jobs
+    env.command(["job", "resume", "5"])
+    env.command(["job", "cancel", "2"])
+    env.command(["job", "cancel", "3"])
+    flag.touch()
+    env.command(["job", "wait", "1,4,5"], timeout=120)
+
+    # after completion the explain verdict reflects the terminal state
+    out = _explain(env, "5.0")
+    assert out["state"] == "finished"
+    assert out["reason"] is None
+
+    # trace export: valid Chrome trace-event JSON with a scheduler row
+    # and per-worker task spans (golden structural contract Perfetto needs)
+    trace_path = env.work_dir / "trace.json"
+    env.command(["server", "trace", "export", str(trace_path)])
+    trace = json.loads(trace_path.read_text())
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert ev["ph"] in ("M", "X", "C")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert isinstance(ev["name"], str) and ev["name"]
+        if ev["ph"] in ("X", "C"):
+            assert isinstance(ev["ts"], (int, float))
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 1
+    thread_names = {
+        ev["args"]["name"]
+        for ev in events
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    }
+    assert "scheduler" in thread_names
+    assert any(name.startswith("worker ") for name in thread_names)
+    ticks = [e for e in events if e.get("cat") == "tick"]
+    spans = [e for e in events if e.get("cat") == "task"]
+    assert ticks, "no scheduler tick slices in the trace"
+    # 522 finished tasks -> at least that many spans on the worker row
+    assert len(spans) >= 522
+    assert all(e["tid"] != 0 for e in spans)
+    # spans land inside the run's wall-clock window (microseconds)
+    t0 = min(e["ts"] for e in spans)
+    t1 = max(e["ts"] + e["dur"] for e in spans)
+    assert t1 >= t0 > 1e15  # sane epoch-microsecond timestamps
+
+
+def test_log_format_json_lines_carry_correlation_fields(env):
+    env.start_server("--log-format", "json")
+    env.start_worker("--log-format", "json", cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--", "true"])
+    env.command(["job", "wait", "1"], timeout=60)
+
+    def parsed(name):
+        out = []
+        for line in env.read_log(name).splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return out
+
+    def worker_registered():
+        return any(
+            rec.get("worker") is not None and "registered" in rec.get("msg", "")
+            for rec in parsed("worker0")
+        )
+
+    wait_until(worker_registered, message="worker json log line")
+    server_lines = parsed("server")
+    assert server_lines, "server emitted no JSON log lines"
+    for rec in server_lines:
+        assert {"ts", "level", "logger", "msg"} <= set(rec)
+
+
+def test_flight_recorder_disabled_and_custom_capacity(env):
+    env.start_server("--flight-recorder-ticks", "7")
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--", "true"])
+    env.command(["job", "wait", "1"], timeout=60)
+    dump = json.loads(env.command(
+        ["server", "flight-recorder", "dump", "--json"]
+    ))
+    assert dump["capacity_ticks"] == 7
+    assert len(dump["ticks"]) <= 7
