@@ -848,6 +848,42 @@ def run_explain_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_throughput_smoke() -> None:
+    """Scaled-down dask-comparison (200 x 8 ms sleeps, 4 lanes) against the
+    in-process pool comparator AND this host's bare-spawn bound, so the
+    `hq_vs_pool` ratio is tracked in every round's BENCH json. The ok gate
+    uses the spawn-bound ratio: `hq_vs_pool` conflates dispatch overhead
+    with the host's process-creation cost (an in-process pool never
+    spawns), which varies ~100x between bare metal and container
+    sandboxes — the floor-normalized ratio is the comparable number."""
+    import os
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["HQ_BENCH_NO_DB"] = "1"  # scaled config: BENCH json only
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from experiment_dask_comparison import measure_config, measure_spawn_floor
+
+    n_tasks, seconds, cores = 200, 0.008, 4
+    t0 = time.perf_counter()
+    row = measure_config(n_tasks, seconds, cores, measure_spawn_floor())
+    ratio_bound = row["hq_vs_spawn_bound"]
+    failures = []
+    if ratio_bound > 3.0:
+        failures.append(
+            f"hq_vs_spawn_bound {ratio_bound} > 3.0: dispatch overhead "
+            "regressed far above this host's process-creation floor"
+        )
+    print(json.dumps({
+        "metric": "throughput_smoke",
+        "ok": not failures,
+        "failures": failures,
+        **{k: v for k, v in row.items() if k != "experiment"},
+        "total_s": round(time.perf_counter() - t0, 2),
+    }))
+    sys.exit(1 if failures else 0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
@@ -880,6 +916,11 @@ def main() -> None:
                         help="explainability gate: unsatisfiable + "
                              "satisfiable workloads, assert reason codes, "
                              "record the solver status/objective trajectory")
+    parser.add_argument("--throughput-smoke", action="store_true",
+                        help="scaled-down dask-comparison (200 x 8 ms): "
+                             "emit hq_vs_pool + the spawn-floor-normalized "
+                             "ratio so real-task dispatch overhead is "
+                             "tracked every round")
     parser.add_argument("--classes", type=int, default=128,
                         help="distinct request classes for --phases")
     parser.add_argument("--workers", type=int, default=None,
@@ -898,6 +939,10 @@ def main() -> None:
 
     if args.explain_smoke:
         run_explain_smoke()
+        return
+
+    if args.throughput_smoke:
+        run_throughput_smoke()
         return
 
     if args.metrics:

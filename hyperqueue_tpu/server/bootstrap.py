@@ -12,6 +12,7 @@ snapshot needs no locks.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import os
 import socket
@@ -57,6 +58,23 @@ _WORKER_MESSAGES_TOTAL = REGISTRY.counter(
     "uplink messages processed on the worker plane",
     labels=("op",),
 )
+
+# reusable/stateless, so one instance serves every frame
+_NOOP_BATCH = contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def _journal_batch(journal, fsync: bool, flush: bool):
+    """One group-committed journal batch (see _journal_group_commit)."""
+    journal.begin_batch()
+    try:
+        yield
+    finally:
+        if journal.commit_batch():
+            if fsync:
+                journal.flush(sync=True)
+            elif flush:
+                journal.flush()
 
 
 class CommSender:
@@ -655,6 +673,20 @@ class Server:
     )
 
     # --- events out ----------------------------------------------------
+    def _journal_group_commit(self):
+        """Context manager: buffer journal writes inside the block and
+        commit them as one append (+ one fsync under `--journal-fsync
+        always`) at exit. The block MUST NOT await — group commit is
+        correct only while no external effect can run before the commit."""
+        journal = self.journal
+        if journal is None or journal.in_batch:
+            return _NOOP_BATCH
+        return _journal_batch(
+            journal,
+            fsync=self.journal_fsync == "always",
+            flush=not self.journal_flush_period,
+        )
+
     def emit_event(self, kind: str, payload: dict) -> None:
         if (
             self.core.flight.enabled
@@ -676,15 +708,22 @@ class Server:
             # process restores everything (fsync-against-OS-crash happens on
             # close and `hq journal flush`). With --journal-flush-period the
             # periodic loop flushes instead (reference 30 s default).
-            # --journal-fsync always additionally fsyncs per event.
-            if self.journal_fsync == "always":
-                self.journal.flush(sync=True)
-            elif not self.journal_flush_period:
-                self.journal.flush()
+            # --journal-fsync always additionally fsyncs per event. Inside
+            # a group-commit block the batch commit does all of this once
+            # at block exit instead.
+            if not self.journal.in_batch:
+                if self.journal_fsync == "always":
+                    self.journal.flush(sync=True)
+                elif not self.journal_flush_period:
+                    self.journal.flush()
         if chaos.ACTIVE:
             # kill-at-event-K injection sits AFTER the journal write+flush:
             # a chaos test killing the server here proves exactly what the
-            # configured flush/fsync policy persisted
+            # configured flush/fsync policy persisted. A pending group
+            # commit gets a durability barrier first so the guarantee
+            # holds at the injection point too.
+            if self.journal is not None and self.journal.in_batch:
+                self.journal.flush(sync=self.journal_fsync == "always")
             chaos.fire("server.event", event=kind)
         for q in self._event_listeners:
             q.put_nowait(record)
@@ -993,12 +1032,14 @@ class Server:
                 and task.state is TaskState.READY
                 and not self.core.rq_map.get_variants(task.rq_id).is_multi_node
             ):
-                # the task started pre-crash but its task_running died with
-                # the old connection, so restore re-queued it at the SAME
-                # instance instead of holding it. The worker proves that
-                # incarnation still runs: claim it straight out of the
-                # ready queue — re-issuing it would execute it twice under
-                # one instance id, invisible to the fence. The journal
+                # a ready task whose claimed instance matches EXACTLY what
+                # the server would re-issue. Since restore fences re-issues
+                # to the boot's generation base (core.instance_fence_floor)
+                # a prior boot's incarnation can no longer collide here;
+                # this branch stays as a safety net — if a matching claim
+                # ever does arrive, adopting it out of the ready queue is
+                # strictly safer than racing a second execution under the
+                # same instance id, invisible to the fence. The journal
                 # never saw this start, so the worker's reported variant is
                 # the only truth about which resources it occupies.
                 variant = int(entry.get("variant", 0))
@@ -1027,25 +1068,48 @@ class Server:
         return reattached, discard
 
     async def _worker_sender(self, conn: Connection, queue: asyncio.Queue):
+        """Drain the per-worker queue into batch frames: a tick's burst
+        (compute batches, retract fan-out, cancels) leaves as one
+        encryption + one syscall instead of one per message — the downlink
+        half of the pipelined assignment delivery. Chaos actions apply per
+        LOGICAL message so fault plans behave identically under batching."""
         while True:
             msg = await queue.get()
+            batch = [msg]
+            while len(batch) < 256:
+                try:
+                    batch.append(queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
             if chaos.ACTIVE:
-                action = await chaos.on_message(
-                    "server.send", op=msg.get("op")
-                )
-                if action == "drop":
+                injected = []
+                for m in batch:
+                    action = await chaos.on_message(
+                        "server.send", op=m.get("op")
+                    )
+                    if action == "drop":
+                        continue
+                    injected.append(m)
+                    if action == "dup":
+                        injected.append(m)
+                batch = injected
+                if not batch:
                     continue
-                if action == "dup":
-                    await conn.send(msg)
-            await conn.send(msg)
+            if len(batch) == 1:
+                await conn.send(batch[0])
+            else:
+                await conn.send({"op": "batch", "msgs": batch})
 
     async def _worker_recv_loop(self, conn: Connection, worker: Worker) -> None:
         while True:
             msg = await conn.recv()
             worker.last_heartbeat = time.monotonic()
             subs = msg["msgs"] if msg.get("op") == "batch" else [msg]
-            for sub in subs:
-                if chaos.ACTIVE:
+            if chaos.ACTIVE:
+                # conservative path: chaos actions await between messages,
+                # so the group-commit block (which must stay synchronous)
+                # is skipped and every event keeps its per-event flush
+                for sub in subs:
                     action = await chaos.on_message(
                         "server.recv", op=sub.get("op")
                     )
@@ -1053,7 +1117,17 @@ class Server:
                         continue
                     if action == "dup":
                         self._process_worker_message(worker, sub)
-                self._process_worker_message(worker, sub)
+                    self._process_worker_message(worker, sub)
+                continue
+            # batched completion plane: the whole frame is processed
+            # synchronously (no awaits), then the journal group-commits —
+            # ONE write (+ fsync under --journal-fsync always) covers every
+            # event the batch produced, and nothing externally visible
+            # (sender queues, client replies, event listeners) runs before
+            # the commit, preserving durability-before-visibility
+            with self._journal_group_commit():
+                for sub in subs:
+                    self._process_worker_message(worker, sub)
 
     def _process_worker_message(self, worker: Worker, msg: dict) -> None:
             op = msg.get("op")

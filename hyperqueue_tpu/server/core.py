@@ -33,6 +33,12 @@ class Core:
     # multi-node gang tasks waiting for enough workers, in priority order
     mn_queue: list[int] = field(default_factory=list)
     scheduling_needed: bool = False
+    # restart fencing base for THIS boot: n_prior_boots * the generation
+    # stride (task.py INSTANCE_GENERATION_STRIDE), set by journal restore.
+    # Every restored task re-issued (not reattached) is fenced to at least
+    # this, so no instance a crashed boot issued in its lost journal tail
+    # can collide with a re-issue (0 on a fresh server: nothing to fence)
+    instance_fence_floor: int = 0
     # (rq_id, variant) -> (wire entries, n_nodes); rq interning is
     # append-only so entries never change within a Core
     entries_cache: dict = field(default_factory=dict)

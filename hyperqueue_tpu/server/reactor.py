@@ -360,10 +360,13 @@ def on_task_reattached(
 
 def requeue_reattach_expired(core: Core, comm: Comm, task: Task) -> None:
     """No worker reclaimed this restored maybe-running task within the
-    reattach window: fence out the (presumed dead) pre-crash incarnation by
-    bumping the instance id, then queue it like any other ready task. No
+    reattach window: fence out the (presumed dead) pre-crash incarnation,
+    then queue it like any other ready task. The fence jumps to this
+    boot's generation base — the crashed boot may have requeued/restarted
+    the task past the journaled instance inside its lost tail, so a plain
+    +1 could collide with an incarnation that still runs somewhere. No
     crash-counter charge — a server restart is not the task's fault."""
-    task.increment_instance()
+    task.fence_instance(core.instance_fence_floor)
     task.state = TaskState.WAITING
     _make_ready(core, task)
     comm.ask_for_scheduling()

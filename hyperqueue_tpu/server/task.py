@@ -27,6 +27,18 @@ TERMINAL_STATES = (TaskState.FINISHED, TaskState.FAILED, TaskState.CANCELED)
 
 DEFAULT_CRASH_LIMIT = 5  # reference gateway.rs: MaxCrashes(5)
 
+# Restart fencing: boot g (g = prior server-uid records in the journal)
+# re-issues every restored non-terminal task at instance >= g * STRIDE.
+# A crashed boot can have issued SEVERAL instances of one task whose
+# lifecycle events all died in its unflushed journal tail (start,
+# worker-lost requeue, restart — each bumps by 1), so fencing by "+1 past
+# what the journal saw" can collide with a lost incarnation that still
+# runs on a reconnecting worker. The stride clears everything a prior
+# boot could have issued as long as no single boot bumps one task more
+# than STRIDE times — requeues are bounded by the crash limit, orders of
+# magnitude below 2^20.
+INSTANCE_GENERATION_STRIDE = 1 << 20
+
 
 @dataclass(slots=True)
 class Task:
@@ -74,6 +86,19 @@ class Task:
         self.instance_id += 1
         # a new incarnation gets a fresh lifecycle chain; the timeline of
         # the dead one already lives in the journal/job records
+        self.t_ready = 0.0
+        self.t_assigned = 0.0
+        self.t_started = 0.0
+        return self.instance_id
+
+    def fence_instance(self, floor: int) -> int:
+        """Advance the instance past every incarnation a crashed boot
+        could have issued: always by at least 1, and at least to `floor`
+        (the restoring boot's generation base, Core.instance_fence_floor).
+        Used wherever a restored task is re-issued instead of reattached —
+        a bump-by-one there could collide with an incarnation whose
+        lifecycle events died in the crashed boot's unflushed tail."""
+        self.instance_id = max(self.instance_id + 1, floor)
         self.t_ready = 0.0
         self.t_assigned = 0.0
         self.t_started = 0.0

@@ -40,6 +40,12 @@ class WorkerConfiguration:
     manager: str = "none"
     manager_job_id: str = ""
     alloc_id: str = ""
+    # warm runner pool width: -1 = auto-size to CPU capacity, 0 = disable
+    # (every task spawns through the in-loop asyncio path)
+    runner_pool: int = -1
+    # bounded coalescing delay of the uplink send drainer: completions
+    # within the window share one frame (0 = send-as-ready)
+    uplink_flush_secs: float = 0.002
 
     def to_wire(self) -> dict:
         return {
@@ -57,6 +63,8 @@ class WorkerConfiguration:
             "manager": self.manager,
             "manager_job_id": self.manager_job_id,
             "alloc_id": self.alloc_id,
+            "runner_pool": self.runner_pool,
+            "uplink_flush_secs": self.uplink_flush_secs,
         }
 
     @classmethod
@@ -76,6 +84,8 @@ class WorkerConfiguration:
             manager=data.get("manager", "none"),
             manager_job_id=data.get("manager_job_id", ""),
             alloc_id=data.get("alloc_id", ""),
+            runner_pool=data.get("runner_pool", -1),
+            uplink_flush_secs=data.get("uplink_flush_secs", 0.002),
         )
 
 

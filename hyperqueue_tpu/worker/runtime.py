@@ -35,6 +35,7 @@ import time
 from collections import OrderedDict
 from pathlib import Path
 
+from hyperqueue_tpu.ids import task_id_job
 from hyperqueue_tpu.server.worker import WorkerConfiguration
 from hyperqueue_tpu.transport.auth import (
     ROLE_SERVER,
@@ -47,7 +48,13 @@ from hyperqueue_tpu.utils import chaos
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.retry import jittered_backoff
 from hyperqueue_tpu.worker.allocator import ResourceAllocator
-from hyperqueue_tpu.worker.launcher import LaunchedTask, launch_task
+from hyperqueue_tpu.worker.launcher import (
+    LaunchedTask,
+    LaunchPlan,
+    launch_task,
+    poolable,
+)
+from hyperqueue_tpu.worker.runner_pool import RunnerCrashed, RunnerPool
 
 logger = logging.getLogger("hq.worker")
 
@@ -57,7 +64,8 @@ logger = logging.getLogger("hq.worker")
 # label, so the namespace is the fan-out filter.
 _SPAWN_SECONDS = REGISTRY.histogram(
     "hq_worker_task_spawn_seconds",
-    "compute-message accept to process spawn (launch_task) latency",
+    "compute-message accept to launch latency (runner-pool dispatch on "
+    "the hot path, full process spawn on the in-loop path)",
 )
 _TASKS_DONE = REGISTRY.counter(
     "hq_worker_tasks_done_total",
@@ -86,6 +94,16 @@ _PARKED = REGISTRY.gauge(
 )
 _SENDQ = REGISTRY.gauge(
     "hq_worker_sendq_depth", "uplink messages awaiting the send drainer"
+)
+_PLAN_LOOKUPS = REGISTRY.counter(
+    "hq_worker_launch_plan_total",
+    "launch-plan cache lookups on the runner-pool dispatch path",
+    labels=("result",),
+)
+_UPLINK_BATCH = REGISTRY.histogram(
+    "hq_worker_uplink_batch_size",
+    "messages coalesced per uplink frame by the send drainer",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
 )
 _CPU = REGISTRY.gauge(
     "hq_worker_cpu_percent", "node CPU utilization (HwSampler)"
@@ -187,6 +205,13 @@ class WorkerRuntime:
         # the killed copy would pass the fence and fail the live one
         self._discarded: set[int] = set()
         self._stop = asyncio.Event()
+        # warm runner pool (worker/runner_pool.py): None while disabled
+        # (--runner-pool 0, zero-worker mode, or the restart budget blew);
+        # plan cache: (job_id, id(body)) -> LaunchPlan, LRU-bounded. Plans
+        # hold their body so the id() key stays stable while cached.
+        self.runner_pool: RunnerPool | None = None
+        self._pool_warmup: asyncio.Task | None = None
+        self._plan_cache: OrderedDict[tuple, LaunchPlan] = OrderedDict()
         self._rng = random.Random()
         # server-forced overview cadence (None = use configuration)
         self._overview_override: float | None = None
@@ -216,25 +241,43 @@ class WorkerRuntime:
         self._sendq.put_nowait(msg)
 
     async def _send_drainer(self) -> None:
+        flush_delay = max(self.configuration.uplink_flush_secs, 0.0)
         while True:
             msg = await self._sendq.get()
             batch = [msg]
+            if flush_delay > 0:
+                # bounded coalescing delay: completions landing within the
+                # window ride the same frame (one encryption + one syscall
+                # + one server recv wakeup for the burst) — the uplink half
+                # of the batched completion plane
+                try:
+                    await asyncio.sleep(flush_delay)
+                except asyncio.CancelledError:
+                    self._replay.extend(batch)  # never lose the popped msg
+                    raise
             while len(batch) < 512:
                 try:
                     batch.append(self._sendq.get_nowait())
                 except asyncio.QueueEmpty:
                     break
+            _UPLINK_BATCH.observe(len(batch))
             if chaos.ACTIVE:
                 injected = []
-                for m in batch:
-                    action = await chaos.on_message(
-                        "worker.send", op=m.get("op")
-                    )
-                    if action == "drop":
-                        continue
-                    injected.append(m)
-                    if action == "dup":
+                try:
+                    for m in batch:
+                        action = await chaos.on_message(
+                            "worker.send", op=m.get("op")
+                        )
+                        if action == "drop":
+                            continue
                         injected.append(m)
+                        if action == "dup":
+                            injected.append(m)
+                except asyncio.CancelledError:
+                    # teardown caught the drainer mid-injection: nothing was
+                    # sent yet, park the whole popped batch
+                    self._replay.extend(batch)
+                    raise
                 batch = injected
                 if not batch:
                     continue
@@ -263,7 +306,7 @@ class WorkerRuntime:
 
     # --- connection lifecycle -------------------------------------------
     async def run(self) -> None:
-        await self._connect(reattach=False)
+        await self._initial_connect()
         logger.info("registered as worker %d", self.worker_id,
                     extra={"worker": self.worker_id})
 
@@ -273,6 +316,35 @@ class WorkerRuntime:
 
         self.localcomm = LocalCommListener(self, Path(tempfile.gettempdir()))
         await self.localcomm.start()
+
+        if not self.zero_worker and self.configuration.runner_pool != 0:
+            size = self.configuration.runner_pool
+            if size < 0:
+                # auto: bounded by the configured CPUs AND the physical
+                # cores minus one (a 4-lane worker on a 2-core box gains
+                # nothing from 4 runners — extra processes just add
+                # context-switch pressure, and one core must stay with the
+                # worker's event loop; each runner supervises any number
+                # of concurrent payloads, the width only bounds how many
+                # spawn syscalls overlap)
+                import os as _os
+
+                size = max(
+                    1, min(self._n_cpus(), (_os.cpu_count() or 2) - 1, 8)
+                )
+            self.runner_pool = RunnerPool(size)
+            # warm in the background: tasks arriving in the first ~0.5 s
+            # take the in-loop spawn path instead of waiting on N python
+            # interpreter startups
+            self._pool_warmup = asyncio.create_task(self.runner_pool.start())
+            self._pool_warmup.add_done_callback(
+                lambda t: logger.info(
+                    "runner pool started (%d warm runners)", size
+                ) if not t.cancelled() and t.exception() is None
+                else logger.error("runner pool failed to start: %s",
+                                  t.exception() if not t.cancelled()
+                                  else "cancelled")
+            )
 
         REGISTRY.add_collect_hook(self._collect_metrics)
         if self.requested_metrics_port is not None:
@@ -315,6 +387,12 @@ class WorkerRuntime:
             for rt in self.running.values():
                 if rt.launched is not None:
                     rt.launched.kill()
+            if self._pool_warmup is not None and not self._pool_warmup.done():
+                self._pool_warmup.cancel()
+            if self.runner_pool is not None:
+                # drain AFTER the kills above: the kill frames must reach
+                # the runners before their stdin EOF triggers exit
+                await self.runner_pool.close()
             if self.localcomm is not None:
                 self.localcomm.close()
             if self._metrics_server is not None:
@@ -322,6 +400,63 @@ class WorkerRuntime:
             REGISTRY.remove_collect_hook(self._collect_metrics)
             if self._conn:
                 self._conn.close()
+
+    async def _initial_connect(self) -> None:
+        """First registration. Under `--on-server-lost reconnect` an
+        unreachable server is retried with the same jittered backoff and
+        `--reconnect-timeout` window as a lost session: a worker whose
+        policy is to ride out server restarts must also ride out being
+        STARTED during one (autoalloc and chaos soaks race worker startup
+        against server crashes all the time). Any other policy keeps the
+        fail-fast contract: a bad address dies immediately and visibly."""
+        if self.configuration.on_server_lost != "reconnect":
+            await self._connect(reattach=False)
+            return
+        window = self.configuration.reconnect_timeout_secs
+        deadline = time.monotonic() + window if window > 0 else None
+        delay = self.RECONNECT_BACKOFF_BASE
+        while True:
+            try:
+                if self.server_dir is not None:
+                    # re-resolve every attempt: a server that comes (back)
+                    # up lives in a fresh instance dir with fresh ports
+                    from hyperqueue_tpu.utils import serverdir
+
+                    access = serverdir.load_access(self.server_dir)
+                    self.host = access.host_for_workers()
+                    self.port = access.worker_port
+                    self.secret_key = access.worker_key_bytes()
+                await asyncio.wait_for(
+                    self._connect(reattach=False),
+                    timeout=self.RECONNECT_ATTEMPT_TIMEOUT,
+                )
+                return
+            except (
+                ConnectionError,
+                OSError,
+                RuntimeError,
+                ValueError,  # torn/corrupt access record mid-publish
+                AuthError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,
+            ) as e:
+                now = time.monotonic()
+                limit = self.configuration.time_limit_secs
+                if limit > 0 and now - self.started_at >= limit:
+                    raise  # same contract as _reconnect_with_backoff
+                if deadline is not None and now >= deadline:
+                    raise
+                sleep_for, delay = jittered_backoff(
+                    delay, self.RECONNECT_BACKOFF_CAP, self._rng,
+                    remaining=(
+                        deadline - now if deadline is not None else None
+                    ),
+                )
+                logger.info(
+                    "server unreachable at first registration (%s); "
+                    "retrying in %.2fs", e, sleep_for,
+                )
+                await asyncio.sleep(sleep_for)
 
     async def _connect(self, reattach: bool) -> None:
         """One connect + register handshake; sets self._conn on success.
@@ -402,6 +537,8 @@ class WorkerRuntime:
         self.host, self.port, self.secret_key = host, port, key
         self._conn = conn
         if reattach:
+            # plans embed the (now stale) worker id and server uid
+            self._clear_launch_plans()
             discard = registered.get("discard") or []
             for task_id in discard:
                 # the server refused this incarnation (requeued under a
@@ -556,17 +693,23 @@ class WorkerRuntime:
     async def _message_loop(self) -> None:
         while True:
             msg = await self._conn.recv()
-            action = None
-            if chaos.ACTIVE:
-                action = await chaos.on_message(
-                    "worker.recv", op=msg.get("op")
-                )
-                if action == "drop":
-                    continue
-            if await self._handle_server_message(msg):
-                return
-            if action == "dup" and await self._handle_server_message(msg):
-                return
+            # the server coalesces bursts (assignment batches, retract
+            # fan-out) into one batch frame; chaos actions keep applying
+            # per LOGICAL message so fault plans targeting e.g. `compute`
+            # behave identically under batching
+            subs = msg["msgs"] if msg.get("op") == "batch" else (msg,)
+            for sub in subs:
+                action = None
+                if chaos.ACTIVE:
+                    action = await chaos.on_message(
+                        "worker.recv", op=sub.get("op")
+                    )
+                    if action == "drop":
+                        continue
+                if await self._handle_server_message(sub):
+                    return
+                if action == "dup" and await self._handle_server_message(sub):
+                    return
 
     async def _handle_server_message(self, msg: dict) -> bool:
         """Process one server message; True = stop requested."""
@@ -725,14 +868,8 @@ class WorkerRuntime:
                 extra_env["HQ_LOCAL_SOCKET"] = self.localcomm.socket_path
                 extra_env["HQ_TOKEN"] = self.localcomm.register_task(task_id)
             _t_spawn = time.perf_counter()
-            launched = await launch_task(
-                task_msg,
-                allocation,
-                server_uid=self.server_uid,
-                worker_id=self.worker_id,
-                zero_worker=self.zero_worker,
-                streamer=streamer,
-                extra_env=extra_env,
+            launched = await self._launch(
+                task_msg, allocation, streamer, extra_env
             )
             _SPAWN_SECONDS.observe(time.perf_counter() - _t_spawn)
             rt = self.running.get(task_id)
@@ -746,6 +883,10 @@ class WorkerRuntime:
             time_limit = (task_msg.get("body") or {}).get("time_limit")
             timed_out = False
             if time_limit:
+                # arm the limit at the true spawn (the runner acks it for
+                # pool launches), not at dispatch: time queued behind other
+                # spawns in a backlogged runner is not the task's runtime
+                await launched.started()
                 try:
                     code, detail = await asyncio.wait_for(
                         launched.wait(), timeout=float(time_limit)
@@ -824,6 +965,106 @@ class WorkerRuntime:
             if rt is not None and rt.allocation is not None:
                 self.allocator.release(rt.allocation)
             self._retry_blocked()
+
+    # --- dispatch: runner pool fast path vs in-loop asyncio spawn --------
+    MAX_LAUNCH_PLANS = 512
+
+    def _n_cpus(self) -> int:
+        from hyperqueue_tpu.resources.amount import FRACTIONS_PER_UNIT
+        from hyperqueue_tpu.resources.descriptor import DescriptorKind
+
+        for item in self.configuration.descriptor.items:
+            if item.name != "cpus":
+                continue
+            if item.kind is DescriptorKind.SUM:
+                return max(1, item.sum_size // FRACTIONS_PER_UNIT)
+            return max(1, sum(len(g) for g in item.index_groups()))
+        return 1
+
+    async def _launch(self, task_msg, allocation, streamer, extra_env):
+        """Route a launch: warm runner pool for plain process tasks, the
+        in-loop asyncio path for stream/stdin/gang tasks, zero-worker mode
+        and a broken pool."""
+        pool = self.runner_pool
+        if (
+            pool is not None
+            and pool.available
+            and not self.zero_worker
+            and streamer is None
+            and poolable(task_msg)
+        ):
+            plan = self._launch_plan(task_msg)
+            spec = plan.instantiate(task_msg, allocation, extra_env)
+            try:
+                # no spawn ack on the hot path: the dispatch frame IS the
+                # start (the runner spawns in-order), so the exit frame
+                # stays the only per-task runner->worker wakeup; a spawn
+                # failure surfaces through wait() as SpawnFailed. Tasks
+                # with a time limit opt into the ack so the limit timer
+                # arms at the real spawn, not at dispatch.
+                ack = bool(
+                    (task_msg.get("body") or {}).get("time_limit")
+                )
+                return await pool.launch(plan, spec, ack=ack)
+            except RunnerCrashed:
+                # pool raced into unavailability between the check and the
+                # dispatch: this task still launches, just in-loop
+                logger.warning(
+                    "runner pool unavailable; task %d falls back to "
+                    "in-loop spawn", task_msg["id"],
+                )
+        return await launch_task(
+            task_msg,
+            allocation,
+            server_uid=self.server_uid,
+            worker_id=self.worker_id,
+            zero_worker=self.zero_worker,
+            streamer=streamer,
+            extra_env=extra_env,
+        )
+
+    def _launch_plan(self, task_msg) -> LaunchPlan:
+        """Get-or-build the launch plan for this task's (program, env
+        template, stdio shape). Keyed by the job and the IDENTITY of the
+        shared body dict: an array's tasks share one body object on the
+        wire, while a task submitted with different env/cwd/stdio carries a
+        different body and therefore never reuses a stale plan."""
+        key = (task_id_job(task_msg["id"]), id(task_msg.get("body")))
+        plan = self._plan_cache.get(key)
+        if plan is not None:
+            _PLAN_LOOKUPS.labels("hit").inc()
+            self._plan_cache.move_to_end(key)
+            return plan
+        _PLAN_LOOKUPS.labels("miss").inc()
+        static_env = {}
+        if self.localcomm is not None:
+            static_env["HQ_LOCAL_SOCKET"] = self.localcomm.socket_path
+        plan = LaunchPlan(
+            task_msg, self.server_uid, self.worker_id, static_env=static_env
+        )
+        self._plan_cache[key] = plan
+        while len(self._plan_cache) > self.MAX_LAUNCH_PLANS:
+            _, evicted = self._plan_cache.popitem(last=False)
+            self._drop_plan(evicted)
+        return plan
+
+    def _drop_plan(self, plan: LaunchPlan) -> None:
+        if self.runner_pool is None:
+            return
+        for runner in self.runner_pool.runners:
+            if plan.plan_id in runner.known_plans:
+                runner.known_plans.discard(plan.plan_id)
+                try:
+                    runner.send({"op": "drop_plan", "plan": plan.plan_id})
+                except (ConnectionError, OSError):
+                    pass
+
+    def _clear_launch_plans(self) -> None:
+        """Reconnect invalidates every plan: plans embed HQ_WORKER_ID and
+        the server uid, both of which change with the new registration."""
+        for plan in self._plan_cache.values():
+            self._drop_plan(plan)
+        self._plan_cache.clear()
 
     # keep this many stream writers' fds open at most; in-use writers are
     # never closed, so the bound can be exceeded while > MAX distinct

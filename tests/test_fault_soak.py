@@ -1,0 +1,154 @@
+"""Randomized fault soak (VERDICT r5 #8): many tasks, workers killed at
+random intervals, the server kill -9'd and restored from its journal
+mid-flight — every task must complete EXACTLY once through the batched
+completion plane:
+
+- no loss: the job finishes with every task accounted `finished`;
+- no stale-instance double-completion: the journal carries exactly one
+  task-finished event per task, and no (task, instance) incarnation ever
+  starts twice (kills legitimately re-run a task, but always under a new
+  fenced instance id).
+
+The chaos-marked soak runs a scaled workload inside tier-1; the full
+10k-task soak is the same body marked slow.
+"""
+
+import json
+import os
+import random
+import signal
+import time
+from collections import Counter
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _job(env):
+    out = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    return out[0] if out else None
+
+
+def _finished(env) -> int:
+    job = _job(env)
+    return job["counters"]["finished"] if job else 0
+
+
+def _soak(env, tmp_path, n_tasks: int) -> None:
+    rng = random.Random(1234)
+    journal = tmp_path / "journal.bin"
+    marker = env.work_dir / "starts.txt"
+    server_args = ("--journal", str(journal), "--reattach-timeout", "5")
+    env.start_server(*server_args)
+    worker_args = ("--on-server-lost", "reconnect")
+    env.start_worker(*worker_args, cpus=4)
+    env.start_worker(*worker_args, cpus=4)
+    env.wait_workers(2)
+    # each task sleeps briefly so the kill rounds land on a live pipeline
+    # (instances genuinely interrupted mid-run and re-fenced), not on an
+    # already-drained queue
+    env.command([
+        "submit", "--array", f"0-{n_tasks - 1}", "--crash-limit", "50",
+        "--", "bash", "-c",
+        f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; sleep 0.1',
+    ])
+
+    def wait_progress(target, stall_timeout=180):
+        """Wait until `target` tasks finished; time out only if the count
+        stops MOVING for stall_timeout (absolute duration scales with the
+        host — a loaded 2-core sandbox crawls but must not flake)."""
+        last, last_change = -1, time.monotonic()
+        while True:
+            now_done = _finished(env)
+            if now_done >= target:
+                return
+            if now_done != last:
+                last, last_change = now_done, time.monotonic()
+            elif time.monotonic() - last_change > stall_timeout:
+                raise TimeoutError(
+                    f"no progress past {now_done}/{target} for "
+                    f"{stall_timeout}s (job: {_job(env)})"
+                )
+            time.sleep(0.25)
+
+    # four random worker kills around a mid-flight server kill -9 + journal
+    # restore; each kill waits for fresh progress first so the faults land
+    # on a live pipeline, not on an already-failed run
+    quarter = max(n_tasks // 8, 1)
+    kills = 0
+    for round_no in range(4):
+        wait_progress(quarter * (round_no + 1))
+        time.sleep(rng.uniform(0.1, 1.0))
+        victims = [
+            (name, proc) for name, proc in env.processes
+            if name.startswith("worker") and proc.poll() is None
+        ]
+        if victims:
+            name, proc = victims[rng.randrange(len(victims))]
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            kills += 1
+            env.start_worker(*worker_args, cpus=4)
+        if round_no == 1:
+            # mid-flight server crash: SIGKILL (no clean close; the
+            # group-commit flush policy is what makes restore complete)
+            env.kill_process("server")
+            env.start_server(*server_args)
+            env.command(["server", "wait", "--timeout", "30"])
+    assert kills >= 3, "the soak never killed enough workers"
+
+    wait_progress(n_tasks)
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=60,
+               message=lambda: f"soak job finished (job: {_job(env)})")
+    job = _job(env)
+    assert job["counters"]["finished"] == n_tasks, job["counters"]
+
+    # --- exactly-once through the completion plane --------------------
+    env.command(["journal", "flush"])
+    events = [
+        json.loads(line)
+        for line in env.command(
+            ["journal", "export", str(journal)], timeout=120
+        ).splitlines()
+    ]
+    finished_per_task = Counter(
+        e["task"] for e in events if e["event"] == "task-finished"
+    )
+    assert set(finished_per_task) == set(range(n_tasks)), (
+        f"missing finishes for "
+        f"{sorted(set(range(n_tasks)) - set(finished_per_task))[:10]}"
+    )
+    dupes = {t: c for t, c in finished_per_task.items() if c != 1}
+    assert not dupes, f"tasks finished more than once: {dupes}"
+
+    # --- no (task, instance) incarnation ever started twice -----------
+    starts = Counter(marker.read_text().splitlines())
+    double_started = {k: c for k, c in starts.items() if c != 1}
+    assert not double_started, (
+        f"duplicate incarnation executions: {double_started}"
+    )
+    started_ids = {int(k.split(":")[1]) for k in starts}
+    assert started_ids == set(range(n_tasks))
+
+
+@pytest.mark.chaos
+def test_fault_soak_scaled(env, tmp_path):
+    """Tier-1-sized soak: 400 tasks, 4 worker kills, 1 server restart."""
+    _soak(env, tmp_path, n_tasks=400)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fault_soak_full(env, tmp_path):
+    """The full VERDICT-r5 #8 soak: 10k tasks (run explicitly; slow)."""
+    _soak(env, tmp_path, n_tasks=int(os.environ.get("HQ_SOAK_TASKS", 10_000)))

@@ -93,6 +93,8 @@ def test_restore_roundtrips_instance_and_crash_counters(tmp_path):
     journal = tmp_path / "j.bin"
     _write_journal(journal, _RESTORE_RECORDS)
 
+    from hyperqueue_tpu.server.task import INSTANCE_GENERATION_STRIDE
+
     server = _restore_server(tmp_path, journal, reattach_timeout=30.0)
     started = server.core.tasks[make_task_id(1, 0)]
     fresh = server.core.tasks[make_task_id(1, 1)]
@@ -100,12 +102,19 @@ def test_restore_roundtrips_instance_and_crash_counters(tmp_path):
     assert started.crash_counter == 2
     assert started.task_id in server.reattach_pending
     assert server.core.queues.total_ready() == 1  # only the never-started
-    assert fresh.instance_id == 0
+    # fenced to the boot's generation base: the crashed boot may have
+    # issued any number of instances of this task inside its lost journal
+    # tail (start, requeue, restart — each a bump), and one may still run
+    # on a reconnecting worker, so the re-issue must clear them ALL — a
+    # plain +1 past the journaled state is not enough
+    assert fresh.instance_id == INSTANCE_GENERATION_STRIDE
+    assert server.core.instance_fence_floor == INSTANCE_GENERATION_STRIDE
 
     # reattach disabled: the started task is fenced and queued immediately
     server = _restore_server(tmp_path, journal, reattach_timeout=0.0)
     started = server.core.tasks[make_task_id(1, 0)]
-    assert started.instance_id == 2  # pre-crash incarnation 1 fenced out
+    # pre-crash incarnation 1 (and the whole lost tail) fenced out
+    assert started.instance_id == INSTANCE_GENERATION_STRIDE
     assert started.crash_counter == 2
     assert not server.reattach_pending
     assert server.core.queues.total_ready() == 2
@@ -153,8 +162,12 @@ def test_restore_counters_survive_mid_record_truncation(tmp_path):
                 assert task.task_id in server.reattach_pending
             elif n_complete == 3:
                 # last complete event: task-restarted(1) -> NOT running
-                # anywhere; fenced past the journal-max instance + queued
-                assert task.instance_id == 2
+                # anywhere; fenced to the boot's generation base + queued
+                from hyperqueue_tpu.server.task import (
+                    INSTANCE_GENERATION_STRIDE,
+                )
+
+                assert task.instance_id == INSTANCE_GENERATION_STRIDE
                 assert task.task_id not in server.reattach_pending
             else:
                 # full journal: re-started at instance 1, held

@@ -7,13 +7,118 @@ server dir, captures logs, asserts liveness, and polls with wait_until.
 
 from __future__ import annotations
 
+import io
 import os
 import subprocess
 import sys
+import threading
 import time
+import traceback
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# Client commands run IN-PROCESS by default (cli.main called in a worker
+# thread with captured stdio): a subprocess `python -m hyperqueue_tpu`
+# costs ~0.75 s of interpreter+import startup on a busy 2-core box, and
+# the suite issues thousands of client calls — polling loops included —
+# so in-process execution cuts tier-1 wall time by several minutes AND
+# makes wait_until polling actually poll at its nominal interval. The
+# server/worker processes tests drive stay real subprocesses; the full
+# wire protocol is still exercised. Set HQ_TEST_CLI_SUBPROCESS=1 to
+# restore fork-per-command (debugging aid).
+_CLI_IN_PROCESS = not os.environ.get("HQ_TEST_CLI_SUBPROCESS")
+
+
+class _CliResult:
+    """subprocess.run-shaped result for the in-process CLI path."""
+
+    __slots__ = ("returncode", "stdout", "stderr")
+
+    def __init__(self, returncode: int, stdout: str, stderr: str):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _capture_stream():
+    """Text stream with a working `.buffer` (cli uses sys.stdout.buffer
+    for raw output channels like `job cat`)."""
+    raw = io.BytesIO()
+    wrapper = io.TextIOWrapper(
+        raw, encoding="utf-8", errors="replace", write_through=True
+    )
+    return raw, wrapper
+
+
+def _run_cli_inprocess(
+    args: list[str], server_dir: Path, cwd, timeout: float
+) -> _CliResult:
+    out_raw, out = _capture_stream()
+    err_raw, err = _capture_stream()
+    result: dict = {}
+    # set when the caller gives up on a hung command: the zombie thread
+    # must NOT restore process-global cwd/env/stdio minutes later while an
+    # unrelated test (or its own replacement command) is mid-flight. The
+    # lock makes check+restore atomic on both sides — without it a thread
+    # finishing exactly at the join deadline could pass the is_set() check,
+    # lose the CPU, and run its restore() after the caller moved on
+    abandoned = threading.Event()
+    restore_lock = threading.Lock()
+
+    old_cwd = os.getcwd()
+    old_sd = os.environ.get("HQ_SERVER_DIR")
+    old_out, old_err = sys.stdout, sys.stderr
+
+    def restore() -> None:
+        sys.stdout, sys.stderr = old_out, old_err
+        os.chdir(old_cwd)
+        if old_sd is None:
+            os.environ.pop("HQ_SERVER_DIR", None)
+        else:
+            os.environ["HQ_SERVER_DIR"] = old_sd
+
+    def body() -> None:
+        from hyperqueue_tpu.client.cli import main as cli_main
+
+        os.environ["HQ_SERVER_DIR"] = str(server_dir)
+        os.chdir(str(cwd))
+        sys.stdout, sys.stderr = out, err
+        try:
+            try:
+                cli_main([str(a) for a in args])
+                result["rc"] = 0
+            except SystemExit as e:
+                if isinstance(e.code, int) or e.code is None:
+                    result["rc"] = e.code or 0
+                else:  # parser.error-style string payloads
+                    err.write(f"{e.code}\n")
+                    result["rc"] = 2
+            except BaseException:  # noqa: BLE001 - mimic a crash rc
+                traceback.print_exc(file=err)
+                result["rc"] = 1
+        finally:
+            with restore_lock:
+                if not abandoned.is_set():
+                    restore()
+
+    # daemon thread so a hung command can't wedge interpreter shutdown;
+    # the TimeoutExpired mirrors the subprocess path's contract
+    t = threading.Thread(target=body, daemon=True, name="hq-cli")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        with restore_lock:
+            abandoned.set()
+            restore()  # the zombie skips its own (late, corrupting) restore
+        raise subprocess.TimeoutExpired(cmd=args, timeout=timeout)
+    out.flush()
+    err.flush()
+    return _CliResult(
+        result.get("rc", 1),
+        out_raw.getvalue().decode("utf-8", "replace"),
+        err_raw.getvalue().decode("utf-8", "replace"),
+    )
 
 # Subprocesses must never grab the real TPU during tests. Built per call so
 # tests that mutate os.environ (PATH mocks, HQ_ALLOC_ID) are picked up.
@@ -38,6 +143,8 @@ def wait_until(predicate, timeout=15.0, interval=0.05, message="condition"):
         if result:
             return result
         time.sleep(interval)
+    if callable(message):  # computed at failure time (live state snapshot)
+        message = message()
     raise TimeoutError(f"timed out waiting for {message}")
 
 
@@ -86,7 +193,11 @@ class HqEnv:
                 (self.server_dir / d / "access.json").exists() for d in fresh
             )
 
-        wait_until(new_instance_ready, message="server access file")
+        # a restart over a large journal replays + resubmits every
+        # unfinished task before the access file appears; on a loaded
+        # 2-core sandbox that alone can exceed the default 15 s
+        wait_until(new_instance_ready, timeout=60.0,
+                   message="server access file")
         assert process.poll() is None, self.read_log(
             "server" if n == 0 else f"server{n}"
         )
@@ -106,14 +217,19 @@ class HqEnv:
         self, args: list[str], cwd=None, expect_fail=False, timeout=60.0,
         with_stderr=False,
     ) -> str:
-        result = subprocess.run(
-            [sys.executable, "-m", "hyperqueue_tpu", *args],
-            env={**_env_base(), "HQ_SERVER_DIR": str(self.server_dir)},
-            cwd=cwd or self.work_dir,
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
+        if _CLI_IN_PROCESS:
+            result = _run_cli_inprocess(
+                args, self.server_dir, cwd or self.work_dir, timeout
+            )
+        else:
+            result = subprocess.run(
+                [sys.executable, "-m", "hyperqueue_tpu", *args],
+                env={**_env_base(), "HQ_SERVER_DIR": str(self.server_dir)},
+                cwd=cwd or self.work_dir,
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
         if expect_fail:
             assert result.returncode != 0, (
                 f"expected failure, got: {result.stdout}"
