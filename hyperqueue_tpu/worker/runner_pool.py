@@ -108,11 +108,18 @@ class PooledProcess:
         try:
             code, detail = await asyncio.shield(self.exited)
         except SpawnFailed:
-            raise  # the caller reports a launch failure, not a task exit
+            # the caller reports a launch failure, not a task exit; the
+            # task dir was created at instantiate time and must not leak
+            cleanup_task_files(-1, self.rm_if_finished, self.cleanup_dirs)
+            raise
         except RunnerCrashed as e:
             # fail, never hang: the payload may or may not still run, but
             # its supervisor is gone — report and let the crash-counter
-            # policy decide the task's fate
+            # policy decide the task's fate. Scratch dirs go too (same
+            # whatever-the-outcome contract as LaunchedTask.wait); an
+            # unkillable orphan payload loses its TMPDIR, which is fine —
+            # its incarnation is already failed and fenced out.
+            cleanup_task_files(-1, self.rm_if_finished, self.cleanup_dirs)
             return -1, str(e)
         cleanup_task_files(code, self.rm_if_finished, self.cleanup_dirs)
         return code, detail
@@ -129,6 +136,12 @@ class _Runner:
         self.known_plans: set[int] = set()
         self.inflight: dict[int, PooledProcess] = {}
         self._reader: asyncio.Task | None = None
+        # True from the moment EOF is observed on stdout until the respawn
+        # completes. proc.returncode alone is NOT a liveness signal here —
+        # the child watcher may not have reaped yet while _on_runner_exit
+        # awaits the restart, and dispatching into that window would
+        # register a task the replacement process never learns about.
+        self.dead = False
 
     async def start(self) -> None:
         argv, env = _runner_argv_env()
@@ -140,6 +153,7 @@ class _Runner:
             env=env,
         )
         self.known_plans = set()
+        self.dead = False
         self._reader = asyncio.create_task(self._read_loop())
 
     def send(self, msg: dict) -> None:
@@ -164,6 +178,10 @@ class _Runner:
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
+            # flagged BEFORE failing the in-flight set and with no await in
+            # between: a concurrent launch() can never register a task on
+            # this runner after its tasks were failed
+            self.dead = True
             self._fail_inflight()
             await self.pool._on_runner_exit(self)
 
@@ -308,7 +326,10 @@ class RunnerPool:
         if not self.available:
             raise RunnerCrashed("runner pool is unavailable")
         runner = min(
-            (r for r in self.runners if r.proc.returncode is None),
+            (
+                r for r in self.runners
+                if not r.dead and r.proc.returncode is None
+            ),
             key=lambda r: len(r.inflight),
             default=None,
         )

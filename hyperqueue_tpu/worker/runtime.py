@@ -241,25 +241,40 @@ class WorkerRuntime:
         self._sendq.put_nowait(msg)
 
     async def _send_drainer(self) -> None:
-        flush_delay = max(self.configuration.uplink_flush_secs, 0.0)
+        # zero-worker mode is a control-plane benchmark instrument: tasks
+        # complete in microseconds and the coalescing nap (a latency-for-
+        # syscalls trade sized against millisecond process spawns) would
+        # dominate the very overhead being measured. Bursts still batch
+        # naturally below.
+        flush_delay = (
+            0.0 if self.zero_worker
+            else max(self.configuration.uplink_flush_secs, 0.0)
+        )
         while True:
             msg = await self._sendq.get()
             batch = [msg]
-            if flush_delay > 0:
-                # bounded coalescing delay: completions landing within the
-                # window ride the same frame (one encryption + one syscall
-                # + one server recv wakeup for the burst) — the uplink half
-                # of the batched completion plane
-                try:
-                    await asyncio.sleep(flush_delay)
-                except asyncio.CancelledError:
-                    self._replay.extend(batch)  # never lose the popped msg
-                    raise
             while len(batch) < 512:
                 try:
                     batch.append(self._sendq.get_nowait())
                 except asyncio.QueueEmpty:
                     break
+            if flush_delay > 0 and len(batch) == 1:
+                # a lone message with no companions queued: wait the
+                # bounded coalescing window so completions landing within
+                # it ride the same frame (one encryption + one syscall +
+                # one server recv wakeup for the burst) — the uplink half
+                # of the batched completion plane. A burst already in the
+                # queue skips the nap: the batch has formed by itself.
+                try:
+                    await asyncio.sleep(flush_delay)
+                except asyncio.CancelledError:
+                    self._replay.extend(batch)  # never lose the popped msg
+                    raise
+                while len(batch) < 512:
+                    try:
+                        batch.append(self._sendq.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
             _UPLINK_BATCH.observe(len(batch))
             if chaos.ACTIVE:
                 injected = []
