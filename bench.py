@@ -884,6 +884,158 @@ def run_throughput_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_restore_smoke(args) -> None:
+    """Bounded-restore gate (ISSUE 6): restore must be O(live state), not
+    O(history).
+
+    Synthesizes a journal of >= 1M completed tasks spread over many jobs
+    plus one small live job, measures a FULL replay (the O(history)
+    baseline), forgets the completed jobs, compacts (snapshot + GC —
+    exactly the server's code path), and asserts the snapshot restore
+    lands under 2 s with the journal GC'd to a bounded size. The row is
+    recorded in benchmarks/results/db.jsonl so rounds are comparable."""
+    import os
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+
+    from hyperqueue_tpu.events import snapshot as snapshot_mod
+    from hyperqueue_tpu.events.journal import Journal
+    from hyperqueue_tpu.events.restore import restore_from_journal
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    n_tasks = args.tasks if args.tasks else 1_000_000
+    n_jobs = max(n_tasks // 10_000, 1)
+    per_job = n_tasks // n_jobs
+    n_live = 5
+    failures = []
+    tmp = Path(tempfile.mkdtemp(prefix="hq-restore-smoke-"))
+    try:
+        journal = tmp / "journal.bin"
+        t0 = time.perf_counter()
+        j = Journal(journal)
+        j.open_for_append()
+        seq = 0
+
+        def write(rec):
+            nonlocal seq
+            rec["seq"] = seq
+            rec["time"] = 1_000.0 + seq * 1e-3
+            seq += 1
+            j.write(rec)
+
+        write({"event": "server-uid", "server_uid": "bench-uid"})
+        body = {"cmd": ["true"]}
+        for job_id in range(1, n_jobs + 1):
+            ids = list(range(per_job))
+            write({"event": "job-submitted", "job": job_id,
+                   "desc": {"name": f"bulk{job_id}",
+                            "array": {"ids": ids, "body": body}}})
+            for i in ids:
+                write({"event": "task-started", "job": job_id, "task": i,
+                       "instance": 0, "variant": 0, "workers": [1]})
+                write({"event": "task-finished", "job": job_id, "task": i})
+            write({"event": "job-completed", "job": job_id,
+                   "status": "finished"})
+        live_job = n_jobs + 1
+        write({"event": "job-submitted", "job": live_job,
+               "desc": {"name": "live",
+                        "array": {"ids": list(range(n_live)),
+                                  "body": body}}})
+        j.close()
+        journal_bytes = journal.stat().st_size
+        synth_s = time.perf_counter() - t0
+
+        # --- O(history) baseline: full replay of every event -----------
+        t0 = time.perf_counter()
+        server = Server(server_dir=tmp / "full", journal_path=journal)
+        restore_from_journal(server)
+        full_replay_s = time.perf_counter() - t0
+        restored_tasks = sum(
+            job.n_tasks() for job in server.jobs.jobs.values()
+        )
+        if restored_tasks != per_job * n_jobs + n_live:
+            failures.append(
+                f"full replay restored {restored_tasks} tasks, expected "
+                f"{per_job * n_jobs + n_live}"
+            )
+
+        # --- forget the completed bulk, compact (server code path) ------
+        for job_id in list(server.jobs.jobs):
+            job = server.jobs.jobs[job_id]
+            if job.is_terminated():
+                del server.jobs.jobs[job_id]
+        server.n_boots += 1  # as the running server would have counted
+        server.journal_uids.add("bench-uid")
+        state = snapshot_mod.capture_state(server)
+        snapshot_mod.write_snapshot(journal, state)
+        keep = set(server.jobs.jobs)
+        stop_at = journal.stat().st_size
+        gc_tmp = Path(str(journal) + ".gc")
+        kept, dropped = Journal.gc_rewrite(
+            journal, gc_tmp, keep, state["seq"], stop_at
+        )
+        Journal.gc_finalize(journal, gc_tmp, stop_at)
+        journal_bytes_after = journal.stat().st_size
+        snapshot_bytes = snapshot_mod.snapshot_path(journal).stat().st_size
+
+        # --- O(live state) restore: snapshot + empty tail ---------------
+        t0 = time.perf_counter()
+        server2 = Server(server_dir=tmp / "snap", journal_path=journal)
+        restore_from_journal(server2)
+        restore_s = time.perf_counter() - t0
+        if server2.last_restore["snapshot"] is None:
+            failures.append("bounded restore did not use the snapshot")
+        if len(server2.jobs.jobs) != 1 or (
+            server2.jobs.jobs[live_job].n_tasks() != n_live
+        ):
+            failures.append(
+                f"bounded restore state wrong: {server2.last_restore}"
+            )
+        if restore_s >= 2.0:
+            failures.append(
+                f"restore took {restore_s:.2f}s >= 2s after {n_tasks} "
+                "completed+forgotten tasks — not O(live state)"
+            )
+        bound = 1 << 20
+        if journal_bytes_after + snapshot_bytes >= bound:
+            failures.append(
+                f"journal+snapshot {journal_bytes_after + snapshot_bytes} "
+                f"bytes >= {bound} after compaction — size not bounded"
+            )
+        if full_replay_s <= restore_s * 5:
+            failures.append(
+                f"full replay ({full_replay_s:.2f}s) is not demonstrably "
+                f"O(history) vs the bounded restore ({restore_s:.3f}s)"
+            )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    emit({
+        "experiment": "restore_smoke",
+        "metric": "restore_smoke",
+        "ok": not failures,
+        "failures": failures,
+        "value": round(restore_s, 4),
+        "unit": "s",
+        "n_tasks": n_tasks,
+        "n_jobs": n_jobs,
+        "full_replay_s": round(full_replay_s, 3),
+        "restore_s": round(restore_s, 4),
+        "speedup": round(full_replay_s / max(restore_s, 1e-9), 1),
+        "journal_bytes_before": journal_bytes,
+        "journal_bytes_after": journal_bytes_after,
+        "snapshot_bytes": snapshot_bytes,
+        "gc_kept_records": kept,
+        "gc_dropped_records": dropped,
+        "synth_s": round(synth_s, 2),
+    })
+    sys.exit(1 if failures else 0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
@@ -921,6 +1073,11 @@ def main() -> None:
                              "emit hq_vs_pool + the spawn-floor-normalized "
                              "ratio so real-task dispatch overhead is "
                              "tracked every round")
+    parser.add_argument("--restore-smoke", action="store_true",
+                        help="bounded-restore gate: restore under 2 s from "
+                             "a snapshot after --tasks (default 1M) "
+                             "completed+forgotten tasks, with the full-"
+                             "replay O(history) baseline in the same row")
     parser.add_argument("--classes", type=int, default=128,
                         help="distinct request classes for --phases")
     parser.add_argument("--workers", type=int, default=None,
@@ -943,6 +1100,10 @@ def main() -> None:
 
     if args.throughput_smoke:
         run_throughput_smoke()
+        return
+
+    if args.restore_smoke:
+        run_restore_smoke(args)
         return
 
     if args.metrics:

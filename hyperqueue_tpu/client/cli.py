@@ -148,6 +148,9 @@ def cmd_server_start(args) -> None:
             access_file=Path(args.access_file) if args.access_file else None,
             paranoid_tick=args.paranoid_tick,
             journal_fsync=args.journal_fsync,
+            journal_compact_interval=args.journal_compact_interval,
+            journal_compact_threshold=args.journal_compact_threshold,
+            journal_salvage=args.journal_salvage,
             heartbeat_timeout_factor=args.heartbeat_timeout_factor,
             reattach_timeout=args.reattach_timeout,
             solver_watchdog_timeout=args.solver_watchdog_timeout,
@@ -237,6 +240,32 @@ def cmd_server_stats(args) -> None:
         print(
             f"tasks awaiting worker reattach: {stats['reattach_pending']}"
         )
+    jn = stats.get("journal")
+    if jn:
+        age = jn.get("snapshot_age_seconds")
+        print(
+            f"journal: {jn['journal_bytes']} bytes, "
+            f"{jn['segments']} segment(s), snapshot "
+            + (f"{jn['snapshot_bytes']} bytes (age {age:.0f}s)"
+               if jn.get("snapshot_bytes") else "none")
+        )
+        lc = jn.get("last_compaction")
+        if lc:
+            print(
+                f"  last compaction ({lc['reason']}): "
+                f"kept {lc['kept_records']}, dropped "
+                f"{lc['dropped_records']}, "
+                f"{lc['journal_bytes_before']} -> "
+                f"{lc['journal_bytes_after']} bytes "
+                f"in {lc['duration_ms']} ms"
+            )
+        lr = jn.get("last_restore")
+        if lr:
+            print(
+                f"  last restore: {lr['duration_s']}s via "
+                + ("snapshot" if lr.get("snapshot") else "full replay")
+                + f", {lr['tail_events']} tail events"
+            )
     if stats.get("paranoid_tick"):
         print(f"paranoid-tick: every {stats['paranoid_tick']} ticks")
 
@@ -1575,7 +1604,9 @@ def cmd_alloc_dry_run(args) -> None:
 def cmd_journal_export(args) -> None:
     from hyperqueue_tpu.events.journal import Journal
 
-    for record in Journal.read_all(Path(args.journal_file)):
+    for record in Journal.read_all(
+        Path(args.journal_file), salvage=getattr(args, "salvage", False)
+    ):
         print(json.dumps(record, default=str))
 
 
@@ -1592,6 +1623,65 @@ def cmd_journal_prune(args) -> None:
         f"journal pruned: kept {result['kept_records']} records "
         f"for live jobs {result['live_jobs']}"
     )
+
+
+def cmd_journal_compact(args) -> None:
+    """Snapshot live server state + GC the superseded journal prefix."""
+    with _session(args) as session:
+        result = session.request({"op": "journal_compact"})
+    out = make_output(args.output_mode)
+    if args.output_mode != "cli":
+        result.pop("op", None)
+        out.record(result)
+        return
+    if result.get("skipped"):
+        out.message(f"compaction skipped: {result['skipped']}")
+        return
+    out.message(
+        f"journal compacted: {result['kept_records']} records kept, "
+        f"{result['dropped_records']} dropped, "
+        f"{result['journal_bytes_before']} -> "
+        f"{result['journal_bytes_after']} bytes "
+        f"(snapshot {result['snapshot_bytes']} bytes, "
+        f"{result['duration_ms']} ms)"
+    )
+
+
+def cmd_journal_info(args) -> None:
+    """Journal + snapshot sizes, lineage, and compaction/restore stats."""
+    with _session(args) as session:
+        info = session.request({"op": "journal_info"})
+    if args.output_mode != "cli":
+        info.pop("op", None)
+        make_output(args.output_mode).record(info)
+        return
+    snap = info.get("snapshot") or {}
+    print(f"journal: {info['path']} ({info['journal_bytes']} bytes, "
+          f"{info['segments']} segment(s), fsync {info['fsync_policy']})")
+    print(f"event seq: {info['event_seq']}  boots: {info['n_boots']}")
+    if snap.get("bytes"):
+        print(f"snapshot: {snap['path']} ({snap['bytes']} bytes, "
+              f"age {snap['age_seconds']:.0f}s"
+              + (f", prev {snap['prev_bytes']} bytes" if snap.get("prev_bytes")
+                 else "") + ")")
+    else:
+        print("snapshot: none")
+    lc = info.get("last_compaction")
+    if lc:
+        print(f"last compaction ({lc['reason']}): kept {lc['kept_records']}, "
+              f"dropped {lc['dropped_records']}, "
+              f"{lc['journal_bytes_before']} -> "
+              f"{lc['journal_bytes_after']} bytes in {lc['duration_ms']} ms")
+    lr = info.get("last_restore")
+    if lr:
+        print(f"last restore: {lr['duration_s']}s "
+              f"({'snapshot ' + lr['snapshot'] if lr['snapshot'] else 'full replay'}, "
+              f"{lr['tail_events']} tail events, "
+              f"{lr['resubmitted']} resubmitted, "
+              f"{lr['held_for_reattach']} held)")
+    if info.get("compact_interval") or info.get("compact_threshold"):
+        print(f"auto-compaction: every {info['compact_interval']}s"
+              f" / over {info['compact_threshold']} bytes")
 
 
 def cmd_journal_report(args) -> None:
@@ -1825,6 +1915,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--journal-flush-period", type=_parse_duration, default=0.0,
                    help="flush the journal on this period instead of after "
                         "every event (0 = per-event, the default)")
+    p.add_argument("--journal-compact-interval", type=_parse_duration,
+                   default=0.0,
+                   help="snapshot live state and GC the superseded journal "
+                        "prefix on this period (0 = no periodic compaction; "
+                        "`hq journal compact` still works)")
+    p.add_argument("--journal-compact-threshold", type=int, default=0,
+                   metavar="BYTES",
+                   help="also compact whenever the journal file exceeds "
+                        "this many bytes (0 = no size trigger)")
+    p.add_argument("--journal-salvage", action="store_true",
+                   help="skip mid-file CRC-corrupt journal records (counted "
+                        "in hq_journal_salvaged_records_total) instead of "
+                        "refusing to start; torn tails are always handled")
     p.add_argument("--idle-timeout", type=_parse_duration, default=0.0,
                    help="default idle timeout adopted by workers that set "
                         "none of their own")
@@ -2185,10 +2288,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = josub.add_parser("export", help="dump a journal file as NDJSON")
     _add_common(p)
     p.add_argument("journal_file")
+    p.add_argument("--salvage", action="store_true",
+                   help="skip mid-file CRC-corrupt records instead of "
+                        "failing loudly")
     p.set_defaults(fn=cmd_journal_export)
     p = josub.add_parser("replay", help="replay a journal file as NDJSON")
     _add_common(p)
     p.add_argument("journal_file")
+    p.add_argument("--salvage", action="store_true",
+                   help="skip mid-file CRC-corrupt records instead of "
+                        "failing loudly")
     p.set_defaults(fn=cmd_journal_replay)
     p = josub.add_parser("report", help="static HTML analytics report")
     _add_common(p)
@@ -2205,6 +2314,17 @@ def build_parser() -> argparse.ArgumentParser:
     p = josub.add_parser("prune")
     _add_common(p)
     p.set_defaults(fn=cmd_journal_prune)
+    p = josub.add_parser(
+        "compact",
+        help="snapshot live state + GC the superseded journal prefix",
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_journal_compact)
+    p = josub.add_parser(
+        "info", help="journal/snapshot sizes and compaction stats"
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_journal_info)
     p = josub.add_parser("stream", help="stream live server events as NDJSON")
     _add_common(p)
     p.add_argument("--history", action="store_true",

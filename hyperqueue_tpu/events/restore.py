@@ -1,166 +1,306 @@
-"""Crash-resume: replay the journal into a fresh server state.
+"""Crash-resume: snapshot load + journal tail replay into a fresh server.
 
 Reference: crates/hyperqueue/src/server/restore.rs — StateRestorer replays
 events, reconstructs jobs/open-state, re-submits unfinished tasks into the
 core with preserved instance/crash counters (gateway.rs:201-205) so stale
 messages from pre-crash workers are discarded; finished tasks are skipped and
 their dependents see them as satisfied.
+
+Two-phase bounded restore (events/snapshot.py): phase 1 loads the newest
+valid snapshot — seeding the SAME accumulators a journal replay fills, so
+everything downstream is one code path — and phase 2 replays only journal
+records at/after the snapshot's event-seq watermark. A torn/corrupt
+snapshot falls back to the previous snapshot, then to a full replay.
+Restore cost is O(live state + tail), not O(history).
 """
 
 from __future__ import annotations
 
 import logging
+import time
 
+from hyperqueue_tpu.events import snapshot as snapshot_mod
 from hyperqueue_tpu.events.journal import Journal
 from hyperqueue_tpu.ids import make_task_id
 from hyperqueue_tpu.server import reactor
+from hyperqueue_tpu.server.jobs import JobManager
 from hyperqueue_tpu.server.protocol import (
     expand_desc_tasks,
     rqv_from_wire,
     submit_record,
 )
 from hyperqueue_tpu.server.task import Task
+from hyperqueue_tpu.utils.metrics import REGISTRY
 
 logger = logging.getLogger("hq.restore")
 
 TERMINAL = {"task-finished": "finished", "task-failed": "failed",
             "task-canceled": "canceled"}
 
+# restores are rare; the histogram's job is distinguishing "instant" from
+# "the journal needs compaction" — hence buckets out to a minute
+_RESTORE_SECONDS = REGISTRY.histogram(
+    "hq_restore_duration_seconds",
+    "journal/snapshot restore duration at server start",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0),
+)
+
+
+class _RestoreAcc:
+    """The replay accumulators: filled by a snapshot seed and/or journal
+    records, applied to the server once at the end."""
+
+    def __init__(self):
+        self.task_status: dict[tuple[int, int], tuple[str, str]] = {}
+        # terminal event wall-clock per task (timeline: finished_at survives)
+        self.task_finished_at: dict[tuple[int, int], float] = {}
+        # lifecycle stamps of the LAST start per task: (queued, assigned,
+        # started) — `hq job timeline` keeps one unbroken span across a
+        # server restart + reattach instead of restarting the clock
+        self.task_started_at: dict[tuple[int, int],
+                                   tuple[float, float, float]] = {}
+        # highest instance id seen per task (journal: last task-started OR
+        # task-restarted; snapshot: the live instance at capture)
+        self.task_instances: dict[tuple[int, int], int] = {}
+        # True while the LAST lifecycle event was a start (the task may
+        # still be running on a reconnecting worker)
+        self.task_maybe_running: dict[tuple[int, int], bool] = {}
+        self.task_variants: dict[tuple[int, int], int] = {}
+        self.task_crashes: dict[tuple[int, int], int] = {}
+        self.job_descs: dict[int, list[dict]] = {}
+        # restore generation: every boot that owned this journal wrote one
+        # server-uid record; a snapshot folds the pre-watermark count into
+        # n_boots and tail records add to it. Fencing jumps re-issued tasks
+        # to n_boots * stride, past everything a prior boot could have
+        # issued in its lost journal tail.
+        self.n_boots = 0
+
+
+def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
+    """Phase 1: install a snapshot as if the pre-watermark journal had just
+    been replayed. Touches only server.jobs/_event_seq/journal_uids and the
+    accumulators, so a failure can be rolled back before falling back to
+    the previous snapshot or a full replay."""
+    bodies = state["bodies"]
+    requests = state["requests"]
+    for jd in state["jobs"]:
+        job_id = jd["id"]
+        job = server.jobs.create_job(
+            name=jd["name"],
+            submit_dir=jd["submit_dir"],
+            max_fails=jd["max_fails"],
+            is_open=jd["open"],
+            job_id=job_id,
+        )
+        job.submitted_at = jd["submitted_at"]
+        job.cancel_reason = jd["cancel_reason"]
+        job.submits = list(jd["submits"])
+        for tid, status, error, finished_at, started_at, submitted_at in (
+            jd["done"]
+        ):
+            server.jobs.attach_task(job, tid)
+            info = job.tasks[tid]
+            info.submitted_at = submitted_at
+            key = (job_id, tid)
+            acc.task_status[key] = (status, error)
+            acc.task_finished_at[key] = finished_at
+            if started_at:
+                acc.task_started_at[key] = (0.0, 0.0, started_at)
+        descs = acc.job_descs.setdefault(job_id, [])
+        for t in jd["pending"]:
+            tid = t["id"]
+            server.jobs.attach_task(job, tid)
+            job.tasks[tid].submitted_at = t["submitted_at"]
+            desc = {
+                "id": tid,
+                # index into the shared tables: tasks of one array get the
+                # SAME body object back, preserving the identity sharing
+                # the compute-message dedup relies on
+                "body": bodies[t["b"]],
+                "request": requests[t["rq"]],
+                "priority": t["priority"],
+                "crash_limit": t["crash_limit"],
+                "deps": t["deps"],
+            }
+            if "entry" in t:
+                desc["entry"] = t["entry"]
+            descs.append(desc)
+            key = (job_id, tid)
+            if t["crashes"]:
+                acc.task_crashes[key] = t["crashes"]
+            if t["running"]:
+                acc.task_instances[key] = t["instance"]
+                acc.task_variants[key] = t["variant"]
+                acc.task_maybe_running[key] = True
+                acc.task_started_at[key] = tuple(t["stamps"])
+            elif t["instance"]:
+                # not running, but the instance counter moved (crashes,
+                # assignment at capture): restore must fence past it
+                acc.task_instances[key] = t["instance"]
+                acc.task_variants[key] = t["variant"]
+                acc.task_maybe_running[key] = False
+    acc.n_boots = state["n_boots"]
+    server.journal_uids.update(state.get("server_uids") or ())
+    if state["seq"] > server._event_seq:
+        server._event_seq = state["seq"]
+    # forgotten jobs are absent from the snapshot but their ids must not be
+    # reused — a reconnecting worker could still hold a forgotten job's
+    # task under the same (job, task) id
+    server.jobs.job_id_counter.ensure_above(state.get("next_job_id", 1) - 1)
+
+
+def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
+    """One journal record into the accumulators (phase 2 / full replay)."""
+    kind = record.get("event")
+    job_id = record.get("job")
+    if kind == "job-submitted":
+        desc = record.get("desc") or {}
+        job = server.jobs.jobs.get(job_id)
+        if job is None:
+            job = server.jobs.create_job(
+                name=desc.get("name", "job"),
+                submit_dir=desc.get("submit_dir", "/"),
+                max_fails=desc.get("max_fails"),
+                is_open=desc.get("open", False),
+                job_id=job_id,
+            )
+        submit_time = float(record.get("time", 0.0))
+        if submit_time and (
+            not job.tasks or submit_time < job.submitted_at
+        ):
+            job.submitted_at = submit_time
+        expanded = expand_desc_tasks(desc)
+        for t in expanded:
+            server.jobs.attach_task(job, t.get("id", 0))
+            if submit_time:
+                # keep the ORIGINAL submit clock, not the restore's
+                job.tasks[t.get("id", 0)].submitted_at = submit_time
+        job.submits.append(submit_record(desc, len(expanded)))
+        acc.job_descs.setdefault(job_id, []).extend(expanded)
+    elif kind == "job-opened":
+        if job_id not in server.jobs.jobs:
+            server.jobs.create_job(
+                name=record.get("name", "job"),
+                submit_dir=record.get("submit_dir", "/"),
+                is_open=True,
+                job_id=job_id,
+            )
+    elif kind == "job-closed":
+        job = server.jobs.jobs.get(job_id)
+        if job is not None:
+            job.is_open = False
+    elif kind == "job-completed":
+        job = server.jobs.jobs.get(job_id)
+        if job is not None and record.get("cancel_reason"):
+            job.cancel_reason = record["cancel_reason"]
+    elif kind in TERMINAL:
+        acc.task_status[(job_id, record["task"])] = (
+            TERMINAL[kind],
+            record.get("error", ""),
+        )
+        acc.task_finished_at[(job_id, record["task"])] = float(
+            record.get("time", 0.0)
+        )
+    elif kind == "task-started":
+        key = (job_id, record["task"])
+        acc.task_instances[key] = max(
+            record.get("instance", 0), acc.task_instances.get(key, 0)
+        )
+        acc.task_variants[key] = record.get("variant", 0)
+        acc.task_maybe_running[key] = True
+        acc.task_started_at[key] = (
+            float(record.get("queued_at", 0.0)),
+            float(record.get("assigned_at", 0.0)),
+            float(record.get("started_at", 0.0))
+            or float(record.get("time", 0.0)),
+        )
+    elif kind == "task-restarted":
+        key = (job_id, record["task"])
+        acc.task_crashes[key] = record.get(
+            "crash_count", acc.task_crashes.get(key, 0)
+        )
+        acc.task_instances[key] = max(
+            record.get("instance", 0), acc.task_instances.get(key, 0)
+        )
+        acc.task_maybe_running[key] = False
+    elif kind == "server-uid":
+        server.journal_uids.add(record.get("server_uid") or "")
+        acc.n_boots += 1
+
 
 def restore_from_journal(server) -> None:
-    """Replay server.journal_path into server.jobs/server.core.
+    """Restore server.jobs/server.core from the snapshot + journal pair.
 
     Tasks that were RUNNING at the crash (a task-started with no terminal
-    event) are held in server.reattach_pending instead of being requeued:
-    their pre-crash worker keeps running them through the outage
+    event — or, via a snapshot, RUNNING at capture with no later terminal)
+    are held in server.reattach_pending instead of being requeued: their
+    pre-crash worker keeps running them through the outage
     (`--on-server-lost reconnect`) and reclaims them at re-registration
     with the preserved instance id. Only when no worker reclaims a task
     within `--reattach-timeout` is it fenced (instance bump) and requeued
     (see Server._reattach_reaper). With the window disabled the fence +
     requeue happens here, the pre-reattach behavior.
     """
-    task_status: dict[tuple[int, int], tuple[str, str]] = {}
-    # terminal event wall-clock per task (timeline: finished_at survives)
-    task_finished_at: dict[tuple[int, int], float] = {}
-    # lifecycle stamps of the LAST start per task: (queued, assigned,
-    # started) — `hq job timeline` keeps one unbroken span across a server
-    # restart + reattach instead of restarting the clock
-    task_started_at: dict[tuple[int, int], tuple[float, float, float]] = {}
-    # highest instance id the journal saw per task (last task-started OR
-    # task-restarted — a restart bumps the instance without a new start);
-    # the live pre-crash worker holds at most this instance
-    task_instances: dict[tuple[int, int], int] = {}
-    # True while the LAST lifecycle event was a start (the task may still
-    # be running on a reconnecting worker); a later restart clears it
-    task_maybe_running: dict[tuple[int, int], bool] = {}
-    task_variants: dict[tuple[int, int], int] = {}
-    task_crashes: dict[tuple[int, int], int] = {}
-    job_descs: dict[int, list[dict]] = {}
-    n_events = 0
-    # restore generation: every prior boot that owned this journal wrote
-    # one server-uid record (before any task event of its lifetime). Each
-    # boot can have issued instances whose lifecycle events (start,
-    # requeue, restart — every one a bump) died in its unflushed tail, so
-    # neither "the journal never saw a start" nor "the last journaled
-    # instance was i" bounds what actually ran. Fencing below jumps to
-    # this boot's generation base (n_boots * stride), past everything a
-    # prior boot could have issued.
-    n_boots = 0
+    t_restore0 = time.perf_counter()
+    salvage = getattr(server, "journal_salvage", False)
+    acc = _RestoreAcc()
 
-    for record in Journal.read_all(server.journal_path):
-        n_events += 1
-        # continue the event sequence where the journal left off so
-        # stream-with-history seq dedup stays monotonic across restarts
-        seq = record.get("seq")
-        if isinstance(seq, int) and seq >= server._event_seq:
-            server._event_seq = seq + 1
-        kind = record.get("event")
-        job_id = record.get("job")
-        if kind == "job-submitted":
-            desc = record.get("desc") or {}
-            job = server.jobs.jobs.get(job_id)
-            if job is None:
-                job = server.jobs.create_job(
-                    name=desc.get("name", "job"),
-                    submit_dir=desc.get("submit_dir", "/"),
-                    max_fails=desc.get("max_fails"),
-                    is_open=desc.get("open", False),
-                    job_id=job_id,
-                )
-            submit_time = float(record.get("time", 0.0))
-            if submit_time and (
-                not job.tasks or submit_time < job.submitted_at
+    # --- phase 1: newest valid snapshot, with fallback -----------------
+    watermark = None
+    snap_used = None
+    for state, snap_path in snapshot_mod.iter_snapshot_candidates(
+        server.journal_path
+    ):
+        try:
+            _seed_from_snapshot(server, acc, state)
+            watermark = state["seq"]
+            snap_used = snap_path
+            break
+        except Exception:
+            logger.exception(
+                "snapshot %s failed to load; falling back", snap_path
+            )
+            # the seed only touched jobs/seq/uids + accumulators: reset
+            # them and try the next candidate (then full replay)
+            server.jobs = JobManager()
+            server.journal_uids = set()
+            server._event_seq = 0
+            acc = _RestoreAcc()
+
+    # --- phase 2: journal tail replay ----------------------------------
+    n_events = 0
+    n_skipped = 0
+    if server.journal_path.exists():
+        for record in Journal.read_all(server.journal_path, salvage=salvage):
+            # continue the event sequence where the journal left off so
+            # stream-with-history seq dedup stays monotonic across restarts
+            seq = record.get("seq")
+            if isinstance(seq, int) and seq >= server._event_seq:
+                server._event_seq = seq + 1
+            if (
+                watermark is not None
+                and isinstance(seq, int)
+                and seq < watermark
             ):
-                job.submitted_at = submit_time
-            expanded = expand_desc_tasks(desc)
-            for t in expanded:
-                server.jobs.attach_task(job, t.get("id", 0))
-                if submit_time:
-                    # keep the ORIGINAL submit clock, not the restore's
-                    job.tasks[t.get("id", 0)].submitted_at = submit_time
-            job.submits.append(submit_record(desc, len(expanded)))
-            job_descs.setdefault(job_id, []).extend(expanded)
-        elif kind == "job-opened":
-            if job_id not in server.jobs.jobs:
-                server.jobs.create_job(
-                    name=record.get("name", "job"),
-                    submit_dir=record.get("submit_dir", "/"),
-                    is_open=True,
-                    job_id=job_id,
-                )
-        elif kind == "job-closed":
-            job = server.jobs.jobs.get(job_id)
-            if job is not None:
-                job.is_open = False
-        elif kind == "job-completed":
-            job = server.jobs.jobs.get(job_id)
-            if job is not None and record.get("cancel_reason"):
-                job.cancel_reason = record["cancel_reason"]
-        elif kind in TERMINAL:
-            task_status[(job_id, record["task"])] = (
-                TERMINAL[kind],
-                record.get("error", ""),
-            )
-            task_finished_at[(job_id, record["task"])] = float(
-                record.get("time", 0.0)
-            )
-        elif kind == "task-started":
-            key = (job_id, record["task"])
-            task_instances[key] = max(
-                record.get("instance", 0), task_instances.get(key, 0)
-            )
-            task_variants[key] = record.get("variant", 0)
-            task_maybe_running[key] = True
-            task_started_at[key] = (
-                float(record.get("queued_at", 0.0)),
-                float(record.get("assigned_at", 0.0)),
-                float(record.get("started_at", 0.0))
-                or float(record.get("time", 0.0)),
-            )
-        elif kind == "task-restarted":
-            key = (job_id, record["task"])
-            task_crashes[key] = record.get(
-                "crash_count", task_crashes.get(key, 0)
-            )
-            task_instances[key] = max(
-                record.get("instance", 0), task_instances.get(key, 0)
-            )
-            task_maybe_running[key] = False
-        elif kind == "server-uid":
-            server.journal_uids.add(record.get("server_uid") or "")
-            n_boots += 1
+                # pre-watermark records survive GC only so that
+                # `journal stream --history` keeps live jobs' timelines;
+                # their effects are already inside the snapshot
+                n_skipped += 1
+                continue
+            n_events += 1
+            _replay_record(server, acc, record)
 
     # apply terminal statuses to job counters (with the ORIGINAL clock so
     # `hq job timeline` of a restored job reports true phase durations)
-    for (job_id, task_id), (status, error) in task_status.items():
+    for (job_id, task_id), (status, error) in acc.task_status.items():
         job = server.jobs.jobs.get(job_id)
         if job is None or task_id not in job.tasks:
             continue
         info = job.tasks[task_id]
         info.status = status
         info.error = error
-        info.finished_at = task_finished_at.get((job_id, task_id), 0.0)
-        stamps = task_started_at.get((job_id, task_id))
+        info.finished_at = acc.task_finished_at.get((job_id, task_id), 0.0)
+        stamps = acc.task_started_at.get((job_id, task_id))
         if stamps is not None:
             info.started_at = stamps[2]
         job.counters[status] += 1
@@ -168,15 +308,14 @@ def restore_from_journal(server) -> None:
     # re-submit unfinished tasks into the core
     from hyperqueue_tpu.server.task import INSTANCE_GENERATION_STRIDE
 
-    fence_floor = max(n_boots, 1) * INSTANCE_GENERATION_STRIDE
+    fence_floor = max(acc.n_boots, 1) * INSTANCE_GENERATION_STRIDE
     server.core.instance_fence_floor = fence_floor
+    server.n_boots = acc.n_boots
     resubmitted = 0
     held = 0
     reattach_window = getattr(server, "reattach_timeout", 0.0)
-    import time as _time
-
-    reattach_deadline = _time.monotonic() + reattach_window
-    for job_id, descs in job_descs.items():
+    reattach_deadline = time.monotonic() + reattach_window
+    for job_id, descs in acc.job_descs.items():
         job = server.jobs.jobs.get(job_id)
         if job is None:
             continue
@@ -184,18 +323,19 @@ def restore_from_journal(server) -> None:
         for t in descs:
             job_task_id = t.get("id", 0)
             key = (job_id, job_task_id)
-            if key in task_status:
+            if key in acc.task_status:
                 continue  # already terminal
             rqv = rqv_from_wire(t.get("request") or {}, server.core.resource_map)
             rq_id = server.core.intern_rqv(rqv)
             deps = tuple(
                 make_task_id(job_id, d)
                 for d in t.get("deps", ())
-                if task_status.get((job_id, d), ("",))[0] != "finished"
+                if acc.task_status.get((job_id, d), ("",))[0] != "finished"
             )
             # failed/canceled dependency => this task can never run; mark it
             dead_dep = any(
-                task_status.get((job_id, d), ("",))[0] in ("failed", "canceled")
+                acc.task_status.get((job_id, d), ("",))[0]
+                in ("failed", "canceled")
                 for d in t.get("deps", ())
             )
             if dead_dep:
@@ -211,8 +351,8 @@ def restore_from_journal(server) -> None:
                 deps=deps,
                 crash_limit=int(t.get("crash_limit", 5)),
             )
-            task.crash_counter = task_crashes.get(key, 0)
-            started_instance = task_instances.get(key)
+            task.crash_counter = acc.task_crashes.get(key, 0)
+            started_instance = acc.task_instances.get(key)
             if started_instance is None:
                 # never started AS FAR AS THE JOURNAL KNOWS. The start —
                 # or a whole start/requeue/restart chain — may sit in the
@@ -231,10 +371,10 @@ def restore_from_journal(server) -> None:
             # older instance ids and are dropped (reference gateway.rs:204
             # adjust_instance_id_and_crash_counters)
             task.instance_id = started_instance
-            task.assigned_variant = task_variants.get(key, 0)
+            task.assigned_variant = acc.task_variants.get(key, 0)
             if (
                 reattach_window > 0
-                and task_maybe_running.get(key)
+                and acc.task_maybe_running.get(key)
                 and not rqv.is_multi_node
             ):
                 # maybe still running on a reconnecting worker: hold it out
@@ -242,7 +382,7 @@ def restore_from_journal(server) -> None:
                 # worker reclaims it or the window expires. Gangs are never
                 # held — a partial gang reattach is worthless, so they are
                 # fenced + requeued like before.
-                stamps = task_started_at.get(key)
+                stamps = acc.task_started_at.get(key)
                 if stamps is not None:
                     # pre-seed the lifecycle chain from the journal: on
                     # reattach the task keeps its ORIGINAL start (one
@@ -259,10 +399,23 @@ def restore_from_journal(server) -> None:
         if new_tasks:
             reactor.on_new_tasks(server.core, server.comm, new_tasks)
             resubmitted += len(new_tasks)
+    duration = time.perf_counter() - t_restore0
+    _RESTORE_SECONDS.observe(duration)
+    server.last_restore = {
+        "duration_s": round(duration, 4),
+        "snapshot": str(snap_used) if snap_used else None,
+        "tail_events": n_events,
+        "skipped_pre_watermark": n_skipped,
+        "jobs": len(server.jobs.jobs),
+        "resubmitted": resubmitted,
+        "held_for_reattach": held,
+    }
     logger.info(
-        "restored %d jobs (%d events, %d tasks resubmitted, %d held for "
-        "reattach) from %s",
+        "restored %d jobs in %.3fs (%s, %d tail events, %d tasks "
+        "resubmitted, %d held for reattach) from %s",
         len(server.jobs.jobs),
+        duration,
+        f"snapshot {snap_used.name}" if snap_used else "full replay",
         n_events,
         resubmitted,
         held,

@@ -278,6 +278,9 @@ class Server:
         access_file: Path | None = None,
         paranoid_tick: int = 0,
         journal_fsync: str = "never",
+        journal_compact_interval: float = 0.0,
+        journal_compact_threshold: int = 0,
+        journal_salvage: bool = False,
         heartbeat_timeout_factor: float = 4.0,
         reattach_timeout: float = 15.0,
         solver_watchdog_timeout: float = 5.0,
@@ -308,6 +311,23 @@ class Server:
         if journal_fsync not in ("never", "periodic", "always"):
             raise ValueError(f"unknown journal fsync policy {journal_fsync!r}")
         self.journal_fsync = journal_fsync
+        # journal compaction (events/snapshot.py): snapshot live state +
+        # GC the superseded journal prefix, every --journal-compact-interval
+        # seconds and/or whenever the journal exceeds
+        # --journal-compact-threshold bytes (0 = that trigger off)
+        self.journal_compact_interval = journal_compact_interval
+        self.journal_compact_threshold = journal_compact_threshold
+        # --journal-salvage: skip CRC-corrupt mid-file journal records
+        # (counted in hq_journal_salvaged_records_total) instead of
+        # refusing to start
+        self.journal_salvage = journal_salvage
+        # boots that have written this journal lineage (server-uid records
+        # up to now, self included once start() emits ours): the
+        # instance-generation fence base a snapshot must carry
+        self.n_boots = 0
+        self.last_restore: dict | None = None
+        self.last_compaction: dict | None = None
+        self._compacting = False
         self.heartbeat_timeout_factor = heartbeat_timeout_factor
         # restored maybe-running tasks wait this long for their pre-crash
         # worker to reconnect and reclaim them before being fenced and
@@ -399,11 +419,18 @@ class Server:
         gc.set_threshold(100_000, 50, 25)
 
         if self.journal_path is not None:
+            from hyperqueue_tpu.events import snapshot as snapshot_mod
             from hyperqueue_tpu.events.journal import Journal
             from hyperqueue_tpu.events.restore import restore_from_journal
 
-            self.journal = Journal(self.journal_path)
-            if self.journal_path.exists():
+            self.journal = Journal(
+                self.journal_path, salvage=self.journal_salvage
+            )
+            # a snapshot alone is restorable (the journal may be freshly
+            # rotated away or lost with the tail already folded in)
+            if self.journal_path.exists() or snapshot_mod.have_snapshot(
+                self.journal_path
+            ):
                 restore_from_journal(self)
             self.journal.open_for_append()
 
@@ -476,6 +503,7 @@ class Server:
             # record this instance's uid in the journal so a future restore
             # can verify that reattaching workers come from this lineage
             self.journal_uids.add(self.access.server_uid)
+            self.n_boots += 1
             self.emit_event("server-uid", {"server_uid": self.access.server_uid})
 
         from hyperqueue_tpu.autoalloc.service import AutoAllocService
@@ -488,6 +516,11 @@ class Server:
             self.journal_flush_period > 0 or self.journal_fsync == "periodic"
         ):
             self._tasks.append(self._spawn_loop(self._journal_flush_loop))
+        if self.journal is not None and (
+            self.journal_compact_interval > 0
+            or self.journal_compact_threshold > 0
+        ):
+            self._tasks.append(self._spawn_loop(self._journal_compact_loop))
         if self.reattach_pending:
             # journal restore held maybe-running tasks for their pre-crash
             # workers; requeue whatever is unclaimed when the window closes
@@ -603,6 +636,28 @@ class Server:
                 f"solver watchdog {key.replace('_', ' ')} "
                 "(scheduler/watchdog.py)",
             ).set_total(wd.get(key, 0))
+        if self.journal_path is not None:
+            # durability-plane gauges: both are one stat() each — the
+            # scrape must never walk the journal
+            try:
+                journal_bytes = float(self.journal_path.stat().st_size)
+            except OSError:
+                journal_bytes = 0.0
+            REGISTRY.gauge(
+                "hq_journal_size_bytes",
+                "event journal file size (compaction bounds this)",
+            ).set(journal_bytes)
+            from hyperqueue_tpu.events import snapshot as snapshot_mod
+
+            snap_stats = snapshot_mod.snapshot_stats(self.journal_path)
+            REGISTRY.gauge(
+                "hq_snapshot_age_seconds",
+                "age of the newest journal snapshot (-1 = no snapshot yet)",
+            ).set(
+                snap_stats["age_seconds"]
+                if snap_stats["age_seconds"] is not None
+                else -1.0
+            )
         cache = core.tick_cache.counters()
         for key in ("full_rebuilds", "incremental_syncs"):
             REGISTRY.counter(
@@ -825,6 +880,178 @@ class Server:
         while True:
             await asyncio.sleep(period)
             self.journal.flush(sync=self.journal_fsync != "never")
+
+    async def _journal_compact_loop(self) -> None:
+        """Compact on --journal-compact-interval and/or whenever the
+        journal grows past --journal-compact-threshold bytes. The size
+        check is a cheap stat on a 5 s poll; compaction itself runs
+        through compact_journal (snapshot + GC, heavy work off-loop)."""
+        poll = 5.0
+        if self.journal_compact_interval > 0:
+            poll = min(poll, self.journal_compact_interval)
+        last = time.monotonic()
+        while True:
+            await asyncio.sleep(poll)
+            due = (
+                self.journal_compact_interval > 0
+                and time.monotonic() - last >= self.journal_compact_interval
+            )
+            if not due and self.journal_compact_threshold > 0:
+                # a journal whose LIVE-work floor exceeds the threshold
+                # must not be recompacted every poll: require the file to
+                # have doubled past the last compaction's result before the
+                # size trigger fires again (geometric backoff)
+                floor = (
+                    self.last_compaction["journal_bytes_after"]
+                    if self.last_compaction
+                    else 0
+                )
+                try:
+                    size = self.journal_path.stat().st_size
+                except OSError:
+                    size = 0
+                due = (
+                    size >= self.journal_compact_threshold
+                    and size >= 2 * floor
+                )
+            if not due:
+                continue
+            try:
+                await self.compact_journal(reason="auto")
+            except Exception:
+                logger.exception("journal compaction failed")
+            last = time.monotonic()
+
+    async def compact_journal(self, reason: str = "manual") -> dict:
+        """One snapshot + journal-GC cycle.
+
+        Phases (each kill -9-survivable, chaos site `server.compact`):
+
+        1. **barrier** (sync on the reactor loop): commit + fsync any open
+           group-commit batch so every acknowledged event is durable, then
+           capture the live state and the event-seq watermark. Nothing can
+           interleave — capture is one synchronous block.
+        2. **snapshot** (executor thread): serialize + write temp → fsync →
+           rotate `.snap` to `.snap.prev` → atomic rename → dir fsync.
+           Only after this is the snapshot allowed to supersede anything.
+        3. **GC** (executor thread): rewrite the pre-barrier journal region
+           into a temp file, keeping live jobs' events (for `--history`),
+           server-uid lineage records, and nothing else — completed and
+           forgotten jobs' events are dropped. The journal keeps appending
+           concurrently; only bytes below the barrier offset are touched.
+        4. **swap** (sync on the loop): close the appender, carry over the
+           frames appended during the rewrite, atomically publish the GC'd
+           journal, fsync the directory, reopen for append.
+        """
+        from hyperqueue_tpu.events import snapshot as snapshot_mod
+        from hyperqueue_tpu.events.journal import Journal
+
+        if self.journal is None:
+            raise RuntimeError("server runs without a journal")
+        if self._compacting:
+            return {"skipped": "compaction already in progress"}
+        self._compacting = True
+        try:
+            t0 = time.perf_counter()
+            loop = asyncio.get_running_loop()
+            # phase 1: barrier + capture (no awaits until stop_at is read)
+            if self.journal.in_batch:
+                self.journal.commit_batch()
+            self.journal.flush(sync=True)
+            state = snapshot_mod.capture_state(self)
+            watermark = state["seq"]
+            stop_at = self.journal_path.stat().st_size
+            keep_jobs = {
+                job_id
+                for job_id, job in self.jobs.jobs.items()
+                if not job.is_terminated()
+            }
+            bytes_before = stop_at
+
+            # the current .snap becomes .snap.prev — the fallback source if
+            # the NEW snapshot later proves corrupt. The GC floor must stay
+            # at the fallback's watermark, or events of jobs that completed
+            # between the two snapshots would be dropped and a fallback
+            # restore would re-execute acknowledged-finished work. Retains
+            # at most one compaction window of extra journal.
+            def _retained_seq():
+                try:
+                    return snapshot_mod.read_snapshot(
+                        snapshot_mod.snapshot_path(self.journal_path)
+                    )["seq"]
+                except Exception:
+                    return None  # no/corrupt old snapshot: nothing retained
+
+            old_seq = await loop.run_in_executor(None, _retained_seq)
+            gc_floor = (
+                watermark if old_seq is None else min(watermark, old_seq)
+            )
+            # phase 2: durable snapshot publish (off-loop)
+            snap = await loop.run_in_executor(
+                None, snapshot_mod.write_snapshot, self.journal_path, state
+            )
+            # phase 3: GC rewrite of the superseded prefix (off-loop)
+            tmp = Path(str(self.journal_path) + ".gc")
+            try:
+                kept, dropped = await loop.run_in_executor(
+                    None,
+                    Journal.gc_rewrite,
+                    self.journal_path,
+                    tmp,
+                    keep_jobs,
+                    gc_floor,
+                    stop_at,
+                    self.journal_salvage,
+                )
+                if chaos.ACTIVE:
+                    chaos.fire("server.compact", event="pre-swap")
+                # phase 4: synchronous swap — no awaits, so no event can be
+                # appended between close and reopen
+                self.journal.close()
+                try:
+                    Journal.gc_finalize(self.journal_path, tmp, stop_at)
+                finally:
+                    # whatever happened (ENOSPC mid-carry-over, either file
+                    # published), the appender MUST come back or every
+                    # subsequent emit_event would crash the handlers
+                    self.journal.open_for_append()
+            except BaseException:
+                tmp.unlink(missing_ok=True)
+                raise
+            if chaos.ACTIVE:
+                chaos.fire("server.compact", event="post-swap")
+            stats = {
+                "reason": reason,
+                "time": time.time(),
+                "duration_ms": round((time.perf_counter() - t0) * 1e3, 2),
+                "watermark": watermark,
+                "gc_floor": gc_floor,
+                "kept_records": kept,
+                "dropped_records": dropped,
+                "journal_bytes_before": bytes_before,
+                "journal_bytes_after": self.journal_path.stat().st_size,
+                "snapshot_bytes": snap.stat().st_size,
+                "live_jobs": len(keep_jobs),
+            }
+            self.last_compaction = stats
+            REGISTRY.counter(
+                "hq_journal_compactions_total",
+                "journal snapshot+GC compaction cycles completed",
+            ).inc()
+            REGISTRY.counter(
+                "hq_journal_gc_dropped_records_total",
+                "journal records dropped by compaction GC",
+            ).inc(dropped)
+            logger.info(
+                "journal compacted (%s): %d records kept, %d dropped, "
+                "%d -> %d bytes (+%d snapshot) in %.1f ms",
+                reason, kept, dropped, bytes_before,
+                stats["journal_bytes_after"], stats["snapshot_bytes"],
+                stats["duration_ms"],
+            )
+            return stats
+        finally:
+            self._compacting = False
 
     async def _reattach_reaper(self) -> None:
         """Requeue restored maybe-running tasks whose pre-crash worker did
@@ -1262,7 +1489,34 @@ class Server:
             ),
             "watchdog": self.model.stats(),
             "reattach_pending": len(self.reattach_pending),
+            "journal": await self._journal_stats_brief(),
             "trace": TRACER.snapshot(recent=0),
+        }
+
+    async def _journal_stats_brief(self) -> dict | None:
+        """Compact journal/snapshot block for `hq server stats` (stat-only;
+        `hq journal info` is the full view)."""
+        if self.journal_path is None:
+            return None
+        from hyperqueue_tpu.events import snapshot as snapshot_mod
+
+        try:
+            journal_bytes = self.journal_path.stat().st_size
+        except OSError:
+            journal_bytes = 0
+        snap = snapshot_mod.snapshot_stats(self.journal_path)
+        return {
+            "journal_bytes": journal_bytes,
+            "segments": int(journal_bytes > 0) + int(snap["bytes"] > 0)
+            + int(snap["prev_bytes"] > 0),
+            "snapshot_bytes": snap["bytes"],
+            "snapshot_age_seconds": (
+                round(snap["age_seconds"], 1)
+                if snap["age_seconds"] is not None
+                else None
+            ),
+            "last_compaction": self.last_compaction,
+            "last_restore": self.last_restore,
         }
 
     async def _client_reset_metrics(self, msg: dict) -> dict:
@@ -2165,6 +2419,10 @@ class Server:
         """Drop completed jobs from the journal (reference journal/prune.rs)."""
         if self.journal is None:
             return {"op": "error", "message": "server runs without a journal"}
+        if self._compacting:
+            return {"op": "error",
+                    "message": "journal compaction in progress; retry"}
+        from hyperqueue_tpu.events import snapshot as snapshot_mod
         from hyperqueue_tpu.events.journal import Journal
 
         live = {
@@ -2172,11 +2430,63 @@ class Server:
             for job_id, job in self.jobs.jobs.items()
             if not job.is_terminated()
         }
+        if snapshot_mod.have_snapshot(self.journal_path):
+            # a snapshot supersedes the journal prefix: a bare prune would
+            # drop post-watermark terminal events of completed jobs while
+            # leaving the stale snapshot in place — the next restore would
+            # resurrect and re-execute them. Compaction IS the
+            # snapshot-aware prune, so delegate.
+            stats = await self.compact_journal(reason="prune")
+            if stats.get("skipped"):
+                return {"op": "error", "message": stats["skipped"]}
+            return {"op": "ok", "kept_records": stats["kept_records"],
+                    "live_jobs": sorted(live)}
         self.journal.close()
-        kept = Journal.prune(self.journal_path, live)
+        kept = Journal.prune(self.journal_path, live,
+                             salvage=self.journal_salvage)
         self.journal.open_for_append()
         # live jobs' submit events survived the prune; re-log nothing
         return {"op": "ok", "kept_records": kept, "live_jobs": sorted(live)}
+
+    async def _client_journal_compact(self, msg: dict) -> dict:
+        """Snapshot + GC now (`hq journal compact`)."""
+        if self.journal is None:
+            return {"op": "error", "message": "server runs without a journal"}
+        stats = await self.compact_journal(reason="cli")
+        return {"op": "journal_compact", **stats}
+
+    async def _client_journal_info(self, msg: dict) -> dict:
+        """Journal/snapshot sizes, lineage, restore + compaction stats
+        (`hq journal info`)."""
+        if self.journal_path is None:
+            return {"op": "error", "message": "server runs without a journal"}
+        from hyperqueue_tpu.events import snapshot as snapshot_mod
+
+        self.journal.flush()
+        journal_bytes = (
+            self.journal_path.stat().st_size
+            if self.journal_path.exists()
+            else 0
+        )
+        snap = snapshot_mod.snapshot_stats(self.journal_path)
+        segments = int(journal_bytes > 0) + int(snap["bytes"] > 0) + int(
+            snap["prev_bytes"] > 0
+        )
+        return {
+            "op": "journal_info",
+            "path": str(self.journal_path),
+            "journal_bytes": journal_bytes,
+            "segments": segments,
+            "event_seq": self._event_seq,
+            "n_boots": self.n_boots,
+            "snapshot": snap,
+            "fsync_policy": self.journal_fsync,
+            "compact_interval": self.journal_compact_interval,
+            "compact_threshold": self.journal_compact_threshold,
+            "salvage": self.journal_salvage,
+            "last_restore": self.last_restore,
+            "last_compaction": self.last_compaction,
+        }
 
 
 async def run_server(**kwargs) -> None:

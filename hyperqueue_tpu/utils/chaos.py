@@ -30,7 +30,11 @@ Sites threaded through the control plane:
 - ``solve`` — the per-tick scheduler solve (actions raise/hang, guarded by
   the solver watchdog, scheduler/watchdog.py);
 - ``server.event`` — Server.emit_event, AFTER the journal write+flush (so
-  ``kill`` at event K proves exactly what the flush policy persisted).
+  ``kill`` at event K proves exactly what the flush policy persisted);
+- ``server.compact`` — the journal compaction phases (match on ``event``:
+  ``mid-snapshot-write`` / ``pre-rename`` / ``post-rename`` / ``mid-gc`` /
+  ``pre-swap`` / ``post-swap``), so kill -9 can land inside every window
+  of the snapshot+GC crash matrix (docs/fault_tolerance.md).
 
 Faults are injected at the MESSAGE level, not the raw frame level: the
 encrypted transport seals frames with counter nonces (transport/auth.py),

@@ -47,18 +47,25 @@ def _soak(env, tmp_path, n_tasks: int) -> None:
     rng = random.Random(1234)
     journal = tmp_path / "journal.bin"
     marker = env.work_dir / "starts.txt"
-    server_args = ("--journal", str(journal), "--reattach-timeout", "5")
+    # compaction runs throughout the soak (including across the mid-flight
+    # server kill -9): snapshots + journal GC must preserve the
+    # exactly-once proof, not just a quiet journal
+    server_args = ("--journal", str(journal), "--reattach-timeout", "5",
+                   "--journal-compact-interval", "2")
     env.start_server(*server_args)
     worker_args = ("--on-server-lost", "reconnect")
     env.start_worker(*worker_args, cpus=4)
     env.start_worker(*worker_args, cpus=4)
     env.wait_workers(2)
+    # the soak job stays OPEN so compaction's GC never drops its events —
+    # the exactly-once assertions below replay them from the journal.
     # each task sleeps briefly so the kill rounds land on a live pipeline
     # (instances genuinely interrupted mid-run and re-fenced), not on an
     # already-drained queue
+    env.command(["job", "open"])
     env.command([
-        "submit", "--array", f"0-{n_tasks - 1}", "--crash-limit", "50",
-        "--", "bash", "-c",
+        "submit", "--job", "1", "--array", f"0-{n_tasks - 1}",
+        "--crash-limit", "50", "--", "bash", "-c",
         f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; sleep 0.1',
     ])
 
@@ -107,7 +114,9 @@ def _soak(env, tmp_path, n_tasks: int) -> None:
     assert kills >= 3, "the soak never killed enough workers"
 
     wait_progress(n_tasks)
-    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+    # an open job reports "opened" once nothing runs/waits and nothing
+    # failed — i.e. every task finished
+    wait_until(lambda: (_job(env) or {}).get("status") == "opened",
                timeout=60,
                message=lambda: f"soak job finished (job: {_job(env)})")
     job = _job(env)

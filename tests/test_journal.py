@@ -138,7 +138,7 @@ def test_restore_counters_survive_mid_record_truncation(tmp_path):
     pos = len(MAGIC)
     while pos < len(blob):
         (length,) = struct.unpack_from("<I", blob, pos)
-        pos += 4 + length
+        pos += 8 + length  # v2 framing: [u32 len][u32 crc][payload]
         bounds.append(pos)
 
     torn = tmp_path / "torn.bin"

@@ -1,0 +1,712 @@
+"""Snapshot + compaction tests (ISSUE 6).
+
+Unit tier: per-record CRC framing (torn tail vs mid-file corruption vs
+salvage), v1 transparent read, the snapshot round-trip property (restore
+from snapshot bit-equal to a full journal replay on randomized job/task
+histories), torn-snapshot fallback chain. E2e tier: live compaction bounds
+the journal and survives restart; `journal stream --history` across a
+compaction boundary honors the seq watermark; kill -9 injected at every
+compaction phase restores with zero acknowledged-event loss and
+exactly-once execution.
+"""
+
+import json
+import os
+import random
+import shutil
+import struct
+
+import pytest
+
+from hyperqueue_tpu.events import snapshot as snapshot_mod
+from hyperqueue_tpu.events.journal import (
+    MAGIC,
+    MAGIC_V1,
+    Journal,
+    JournalCorruption,
+)
+from hyperqueue_tpu.events.restore import restore_from_journal
+from hyperqueue_tpu.server.protocol import rqv_to_wire
+from hyperqueue_tpu.server.task import TaskState
+
+from utils_e2e import HqEnv, wait_until
+
+
+# --------------------------------------------------------------------------
+# journal framing: CRCs, salvage, v1 compatibility
+# --------------------------------------------------------------------------
+def _frame_bounds(blob):
+    """[start0, end0(=start1), ...] record boundaries of a v2 journal."""
+    bounds = [len(MAGIC)]
+    pos = len(MAGIC)
+    while pos < len(blob):
+        (length,) = struct.unpack_from("<I", blob, pos)
+        pos += 8 + length
+        bounds.append(pos)
+    return bounds
+
+
+def _three_record_journal(path):
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"event": "a", "job": 1, "seq": 0})
+    j.write({"event": "b", "job": 2, "seq": 1})
+    j.write({"event": "c", "job": 3, "seq": 2})
+    j.close()
+    return _frame_bounds(path.read_bytes())
+
+
+def test_crc_mid_file_corruption_raises_then_salvages(tmp_path):
+    path = tmp_path / "j.bin"
+    bounds = _three_record_journal(path)
+    blob = bytearray(path.read_bytes())
+    # flip one payload byte inside record 2 (not the last record)
+    blob[bounds[1] + 8 + 2] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    with pytest.raises(JournalCorruption):
+        list(Journal.read_all(path))
+    # salvage skips exactly the corrupt record and keeps going
+    records = list(Journal.read_all(path, salvage=True))
+    assert [r["event"] for r in records] == ["a", "c"]
+    # open_for_append refuses too (the server must not silently truncate
+    # two good records behind a corrupt one) unless salvaging
+    with pytest.raises(JournalCorruption):
+        Journal(path).open_for_append()
+    j = Journal(path, salvage=True)
+    j.open_for_append()
+    j.write({"event": "d", "job": 4, "seq": 3})
+    j.close()
+    assert [r["event"] for r in Journal.read_all(path, salvage=True)] == [
+        "a", "c", "d",
+    ]
+
+
+def test_crc_corrupt_final_record_is_a_torn_tail(tmp_path):
+    """A bad-CRC record at EOF is a partial-sector crash artifact: read
+    stops silently, append truncates it — never a loud error."""
+    path = tmp_path / "j.bin"
+    bounds = _three_record_journal(path)
+    blob = bytearray(path.read_bytes())
+    blob[bounds[2] + 8 + 1] ^= 0xFF  # corrupt the LAST record's payload
+    path.write_bytes(bytes(blob))
+    assert [r["event"] for r in Journal.read_all(path)] == ["a", "b"]
+    j = Journal(path)
+    j.open_for_append()
+    assert path.stat().st_size == bounds[2]
+    j.write({"event": "c2", "job": 3, "seq": 2})
+    j.close()
+    assert [r["event"] for r in Journal.read_all(path)] == ["a", "b", "c2"]
+
+
+def test_v1_journal_read_and_append_transparent(tmp_path):
+    """Old hqtpujl1 files (no CRCs) stay readable and appendable in place;
+    a prune rewrite upgrades them to v2."""
+    import msgpack
+
+    path = tmp_path / "old.bin"
+    records = [{"event": "job-submitted", "job": 1, "seq": 0},
+               {"event": "task-finished", "job": 1, "task": 0, "seq": 1}]
+    with open(path, "wb") as f:
+        f.write(MAGIC_V1)
+        for r in records:
+            data = msgpack.packb(r, use_bin_type=True)
+            f.write(struct.pack("<I", len(data)) + data)
+    assert list(Journal.read_all(path)) == records
+    j = Journal(path)
+    j.open_for_append()
+    j.write({"event": "job-closed", "job": 1, "seq": 2})
+    j.close()
+    assert path.read_bytes()[:8] == MAGIC_V1  # same-file framing kept
+    assert len(list(Journal.read_all(path))) == 3
+    Journal.prune(path, keep_jobs={1})
+    assert path.read_bytes()[:8] == MAGIC  # rewrite upgraded
+    assert len(list(Journal.read_all(path))) == 3
+
+
+# --------------------------------------------------------------------------
+# snapshot round-trip property: restore-from-snapshot == full replay
+# --------------------------------------------------------------------------
+def _random_history(rng: random.Random):
+    """A random but causally-consistent journal: jobs (arrays and graphs,
+    some open), task lifecycles (start / restart chains / terminal or
+    still-running), interleaved with extra boot records."""
+    records = []
+    seq = [0]
+
+    def emit(rec):
+        rec["seq"] = seq[0]
+        rec["time"] = 1_000.0 + seq[0]  # deterministic original clocks
+        seq[0] += 1
+        records.append(rec)
+
+    emit({"event": "server-uid", "server_uid": "uid-boot-1"})
+    n_jobs = rng.randint(1, 4)
+    for job_id in range(1, n_jobs + 1):
+        kind = rng.choice(["array", "graph", "open"])
+        if kind == "array":
+            ids = list(range(rng.randint(1, 6)))
+            desc = {"name": f"arr{job_id}",
+                    "array": {"ids": ids, "body": {"cmd": ["true"]},
+                              "priority": rng.randint(0, 2)}}
+            if rng.random() < 0.5:
+                desc["array"]["entries"] = [f"e{i}" for i in ids]
+            emit({"event": "job-submitted", "job": job_id, "desc": desc})
+        elif kind == "graph":
+            ids = list(range(rng.randint(2, 5)))
+            tasks = []
+            for i in ids:
+                t = {"id": i, "body": {"n": i}}
+                if i and rng.random() < 0.6:
+                    t["deps"] = [rng.randrange(i)]
+                tasks.append(t)
+            emit({"event": "job-submitted", "job": job_id,
+                  "desc": {"name": f"g{job_id}", "tasks": tasks}})
+        else:
+            emit({"event": "job-opened", "job": job_id, "name": f"o{job_id}"})
+            ids = list(range(rng.randint(1, 3)))
+            emit({"event": "job-submitted", "job": job_id,
+                  "desc": {"name": f"o{job_id}", "open": True,
+                           "array": {"ids": ids, "body": {"o": job_id}}}})
+            if rng.random() < 0.5:
+                emit({"event": "job-closed", "job": job_id})
+        for i in ids:
+            roll = rng.random()
+            if roll < 0.25:
+                continue  # never started
+            instance = 0
+            emit({"event": "task-started", "job": job_id, "task": i,
+                  "instance": instance, "variant": 0, "workers": [1],
+                  "queued_at": 1.0 + i, "assigned_at": 2.0 + i,
+                  "started_at": 3.0 + i})
+            for _ in range(rng.randint(0, 2)):
+                if rng.random() < 0.4:
+                    instance += 1
+                    emit({"event": "task-restarted", "job": job_id,
+                          "task": i, "crash_count": instance,
+                          "instance": instance})
+                    if rng.random() < 0.7:
+                        emit({"event": "task-started", "job": job_id,
+                              "task": i, "instance": instance, "variant": 0,
+                              "workers": [2], "queued_at": 4.0,
+                              "assigned_at": 5.0, "started_at": 6.0})
+            roll = rng.random()
+            if roll < 0.5:
+                emit({"event": "task-finished", "job": job_id, "task": i})
+            elif roll < 0.6:
+                emit({"event": "task-failed", "job": job_id, "task": i,
+                      "error": "boom"})
+            elif roll < 0.7:
+                emit({"event": "task-canceled", "job": job_id, "task": i})
+            # else: still (maybe) running at the crash
+        if rng.random() < 0.3:
+            emit({"event": "server-uid",
+                  "server_uid": f"uid-extra-{job_id}"})
+    return records
+
+
+def _write_records(path, records):
+    j = Journal(path)
+    j.open_for_append()
+    for r in records:
+        j.write(r)
+    j.close()
+
+
+def _make_server(tmp_path, name, journal):
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    server = Server(
+        server_dir=tmp_path / name, journal_path=journal,
+        reattach_timeout=60.0,
+    )
+    restore_from_journal(server)
+    return server
+
+
+def _fingerprint(server) -> dict:
+    """Canonical restorable-state dump. The ONLY tolerated difference
+    between a snapshot restore and a full replay is the wall-clock
+    `t_ready` a re-queued task picks up at restore time, so it is zeroed
+    for tasks that are not held for reattach."""
+    core = server.core
+    jobs = {}
+    for job_id, job in server.jobs.jobs.items():
+        jobs[job_id] = {
+            "name": job.name,
+            "open": job.is_open,
+            "cancel_reason": job.cancel_reason,
+            "submitted_at": round(job.submitted_at, 6),
+            "counters": dict(job.counters),
+            "submits": job.submits,
+            "tasks": {
+                t.job_task_id: (
+                    t.status, t.error, round(t.submitted_at, 6),
+                    t.started_at, t.finished_at,
+                )
+                for t in job.tasks.values()
+            },
+        }
+    tasks = {}
+    body_groups: dict[int, list[int]] = {}
+    for task_id, task in core.tasks.items():
+        held = task_id in server.reattach_pending
+        tasks[task_id] = {
+            "instance": task.instance_id,
+            "crashes": task.crash_counter,
+            "state": task.state.value,
+            "priority": task.priority,
+            "entry": task.entry,
+            "body": task.body,
+            "deps": tuple(sorted(task.deps)),
+            "crash_limit": task.crash_limit,
+            "stamps": (task.t_ready, task.t_assigned, task.t_started)
+            if held else (0.0, task.t_assigned, task.t_started),
+            "rqv": rqv_to_wire(
+                core.rq_map.get_variants(task.rq_id), core.resource_map
+            ),
+            "held": held,
+        }
+        body_groups.setdefault(id(task.body), []).append(task_id)
+    return {
+        "jobs": jobs,
+        "tasks": tasks,
+        "ready": core.queues.total_ready(),
+        "fence_floor": core.instance_fence_floor,
+        "event_seq": server._event_seq,
+        "uids": sorted(server.journal_uids),
+        "n_boots": server.n_boots,
+        # identity sharing of array bodies must survive the snapshot (the
+        # compute-message dedup depends on it)
+        "body_sharing": sorted(
+            tuple(sorted(g)) for g in body_groups.values()
+        ),
+    }
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42, 1234, 9999])
+def test_snapshot_roundtrip_property(tmp_path, seed):
+    """capture(full_replay(J)) restored == full_replay(J + this boot's
+    server-uid record): bit-equal state on randomized histories."""
+    rng = random.Random(seed)
+    records = _random_history(rng)
+    j_orig = tmp_path / "orig.bin"
+    _write_records(j_orig, records)
+
+    # server A replays the journal and "boots" (emits its server-uid,
+    # which raises the next restore's generation fence base)
+    a = _make_server(tmp_path, "a", j_orig)
+    a.n_boots += 1
+    a.journal_uids.add("uid-boot-A")
+    a._event_seq += 1
+
+    # comparator C: a full replay of the journal A would have left behind
+    j_replay = tmp_path / "replay.bin"
+    shutil.copy(j_orig, j_replay)
+    jw = Journal(j_replay)
+    jw.open_for_append()
+    jw.write({"event": "server-uid", "server_uid": "uid-boot-A",
+              "seq": a._event_seq - 1, "time": 9_999.0})
+    jw.close()
+    c = _make_server(tmp_path, "c", j_replay)
+
+    # B: A's snapshot alone (journal fully compacted away)
+    j_snap = tmp_path / "snap.bin"
+    snapshot_mod.write_snapshot(j_snap, snapshot_mod.capture_state(a))
+    b = _make_server(tmp_path, "b", j_snap)
+    assert b.last_restore["snapshot"] is not None
+
+    assert _fingerprint(b) == _fingerprint(c)
+    # and the reattach holds match exactly
+    assert sorted(b.reattach_pending) == sorted(c.reattach_pending)
+
+
+def test_snapshot_plus_tail_replay(tmp_path):
+    """Events after the snapshot watermark replay on top of the seeded
+    state; pre-watermark records left for --history are skipped."""
+    records = [
+        {"event": "server-uid", "server_uid": "u1", "seq": 0, "time": 1.0},
+        {"event": "job-submitted", "job": 1, "seq": 1, "time": 2.0,
+         "desc": {"name": "a",
+                  "array": {"ids": [0, 1], "body": {"cmd": ["true"]}}}},
+        {"event": "task-started", "job": 1, "task": 0, "instance": 0,
+         "variant": 0, "workers": [1], "seq": 2, "time": 3.0},
+    ]
+    j1 = tmp_path / "j1.bin"
+    _write_records(j1, records)
+    a = _make_server(tmp_path, "a", j1)
+    a.n_boots += 1
+    a.journal_uids.add("uA")
+    a._event_seq += 1
+
+    # snapshot at watermark, then a tail: task 0 finishes, job 2 arrives
+    j2 = tmp_path / "j2.bin"
+    state = snapshot_mod.capture_state(a)
+    snapshot_mod.write_snapshot(j2, state)
+    tail = [
+        # pre-watermark record kept by GC for history: must be SKIPPED
+        dict(records[1]),
+        {"event": "server-uid", "server_uid": "uA",
+         "seq": state["seq"] - 1, "time": 3.5},
+        {"event": "task-finished", "job": 1, "task": 0,
+         "seq": state["seq"], "time": 4.0},
+        {"event": "job-submitted", "job": 2, "seq": state["seq"] + 1,
+         "time": 5.0,
+         "desc": {"name": "late", "array": {"ids": [0], "body": {}}}},
+    ]
+    _write_records(j2, tail)
+    b = _make_server(tmp_path, "b", j2)
+    assert b.last_restore["skipped_pre_watermark"] == 2
+    assert b.last_restore["tail_events"] == 2
+    job1 = b.jobs.jobs[1]
+    assert job1.tasks[0].status == "finished"
+    assert job1.counters["finished"] == 1
+    assert job1.n_tasks() == 2  # NOT doubled by the skipped resubmit
+    assert 2 in b.jobs.jobs and b.jobs.jobs[2].n_tasks() == 1
+    # both uid records (u1, uA) sit below the watermark: folded into the
+    # snapshot's n_boots, not double-counted from the kept history record
+    assert b.n_boots == 2
+
+
+def test_torn_snapshot_falls_back_to_prev_then_full_replay(tmp_path):
+    records = [
+        {"event": "server-uid", "server_uid": "u1", "seq": 0, "time": 1.0},
+        {"event": "job-submitted", "job": 1, "seq": 1, "time": 2.0,
+         "desc": {"name": "a", "array": {"ids": [0], "body": {}}}},
+    ]
+    journal = tmp_path / "j.bin"
+    _write_records(journal, records)
+    a = _make_server(tmp_path, "a", journal)
+    a.n_boots += 1
+    a.journal_uids.add("uA")
+    a._event_seq += 1
+
+    # two generations of snapshots: the second rotates the first to .prev
+    snapshot_mod.write_snapshot(journal, snapshot_mod.capture_state(a))
+    a._event_seq += 1  # pretend an event happened; newer snapshot differs
+    snapshot_mod.write_snapshot(journal, snapshot_mod.capture_state(a))
+    snap = snapshot_mod.snapshot_path(journal)
+    prev = snapshot_mod.prev_snapshot_path(journal)
+    assert snap.exists() and prev.exists()
+
+    # torn newest snapshot -> prev is used
+    good = snap.read_bytes()
+    snap.write_bytes(good[: len(good) // 2])
+    b = _make_server(tmp_path, "b", journal)
+    assert b.last_restore["snapshot"] == str(prev)
+    assert 1 in b.jobs.jobs
+
+    # corrupt CRC (bit flip) in newest -> prev is used
+    flipped = bytearray(good)
+    flipped[len(MAGIC) + 10] ^= 0xFF
+    snap.write_bytes(bytes(flipped))
+    b2 = _make_server(tmp_path, "b2", journal)
+    assert b2.last_restore["snapshot"] == str(prev)
+
+    # both corrupt -> full replay of the journal
+    prev.write_bytes(good[: len(good) // 3])
+    b3 = _make_server(tmp_path, "b3", journal)
+    assert b3.last_restore["snapshot"] is None
+    assert 1 in b3.jobs.jobs
+    assert b3.core.queues.total_ready() == 1
+
+
+def test_prev_snapshot_fallback_survives_gc_exactly_once(tmp_path):
+    """A job completes BETWEEN two compactions, then the newest snapshot
+    bit-rots: the fallback restore from .snap.prev must see the job's
+    terminal events (the GC floor stays at the fallback's watermark) and
+    must NOT resubmit its acknowledged-finished tasks."""
+    import asyncio
+
+    from hyperqueue_tpu.ids import make_task_id
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    journal = tmp_path / "j.bin"
+    _write_records(journal, [
+        {"event": "server-uid", "server_uid": "u1", "seq": 0, "time": 1.0},
+        {"event": "job-submitted", "job": 1, "seq": 1, "time": 2.0,
+         "desc": {"name": "closes-between",
+                  "array": {"ids": [0], "body": {}}}},
+        {"event": "job-submitted", "job": 2, "seq": 2, "time": 3.0,
+         "desc": {"name": "stays-live",
+                  "array": {"ids": [0], "body": {}}}},
+    ])
+    server = Server(server_dir=tmp_path / "a", journal_path=journal)
+    restore_from_journal(server)
+    server.n_boots += 1
+    server.journal_uids.add("uA")
+    server._event_seq += 1
+    server.journal = Journal(journal)
+    server.journal.open_for_append()
+
+    # compaction #1 -> the snapshot that will become .snap.prev
+    asyncio.run(server.compact_journal(reason="test"))
+    # job 1 finishes AFTER the first watermark (acknowledged completion)
+    server.events.on_task_finished(make_task_id(1, 0))
+    # compaction #2 rotates #1 to .snap.prev; its GC must keep job 1's
+    # terminal events even though job 1 is now completed
+    stats = asyncio.run(server.compact_journal(reason="test"))
+    assert stats["gc_floor"] < stats["watermark"]
+    server.journal.close()
+
+    # newest snapshot bit-rots -> restore falls back to .snap.prev
+    snap = snapshot_mod.snapshot_path(journal)
+    blob = bytearray(snap.read_bytes())
+    blob[len(MAGIC) + 12] ^= 0xFF
+    snap.write_bytes(bytes(blob))
+    b = Server(server_dir=tmp_path / "b", journal_path=journal)
+    restore_from_journal(b)
+    assert b.last_restore["snapshot"] == str(
+        snapshot_mod.prev_snapshot_path(journal)
+    )
+    job1 = b.jobs.jobs[1]
+    assert job1.tasks[0].status == "finished"
+    assert job1.counters["finished"] == 1
+    # exactly-once: the finished task was NOT resubmitted into the core
+    assert make_task_id(1, 0) not in b.core.tasks
+    assert b.core.queues.total_ready() == 1  # only job 2's live task
+
+
+def test_prune_with_snapshot_delegates_to_compaction(env, tmp_path):
+    """`hq journal prune` after a compaction must not drop post-watermark
+    terminal events while leaving the stale snapshot in place — it
+    compacts (snapshot refresh + GC) instead."""
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--name", "first", "--", "true"])
+    env.command(["journal", "compact"])
+    env.command(["submit", "--wait", "--name", "second", "--", "true"])
+    env.command(["journal", "prune"])  # delegates to compaction
+    info = json.loads(
+        env.command(["journal", "info", "--output-mode", "json"])
+    )
+    assert info["last_compaction"]["reason"] == "prune"
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    jobs = {j["name"]: j for j in _jobs(env)}
+    assert jobs["second"]["status"] == "finished"
+    assert jobs["second"]["counters"]["finished"] == 1
+
+
+def test_capture_marks_assigned_not_running(tmp_path):
+    """Journal-replay parity for ASSIGNED tasks: no journaled start means
+    a restore must fence + re-issue, so capture must not claim they run."""
+    records = [
+        {"event": "server-uid", "server_uid": "u1", "seq": 0, "time": 1.0},
+        {"event": "job-submitted", "job": 1, "seq": 1, "time": 2.0,
+         "desc": {"name": "a", "array": {"ids": [0], "body": {}}}},
+    ]
+    journal = tmp_path / "j.bin"
+    _write_records(journal, records)
+    a = _make_server(tmp_path, "a", journal)
+    task = next(iter(a.core.tasks.values()))
+    task.state = TaskState.ASSIGNED
+    task.assigned_worker = 7
+    state = snapshot_mod.capture_state(a)
+    (entry,) = state["jobs"][0]["pending"]
+    assert entry["running"] is False
+
+
+# --------------------------------------------------------------------------
+# e2e: live compaction + restart, history across the boundary
+# --------------------------------------------------------------------------
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _jobs(env):
+    return json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+
+
+def test_compaction_bounds_journal_and_survives_restart(env, tmp_path):
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    # a chunk of completed-and-forgotten history + one finished job
+    env.command(["submit", "--wait", "--array", "0-49", "--name", "old",
+                 "--", "true"], timeout=120)
+    env.command(["submit", "--wait", "--name", "done", "--", "true"])
+    env.command(["job", "forget", "1"])
+    env.command(["journal", "flush"])
+    size_before = journal.stat().st_size
+    out = json.loads(
+        env.command(["journal", "compact", "--output-mode", "json"])
+    )
+    assert out["dropped_records"] > 100  # 50 tasks x (start+finish) + misc
+    assert journal.stat().st_size < size_before
+    assert snapshot_mod.snapshot_path(journal).exists()
+    info = json.loads(env.command(["journal", "info", "--output-mode",
+                                   "json"]))
+    assert info["journal_bytes"] == journal.stat().st_size
+    assert info["last_compaction"]["kept_records"] == out["kept_records"]
+    stats = json.loads(env.command(["server", "stats", "--output-mode",
+                                    "json"]))
+    assert stats["journal"]["snapshot_bytes"] > 0
+
+    # a second compaction rotates the fallback snapshot into place
+    env.command(["journal", "compact"])
+    assert snapshot_mod.prev_snapshot_path(journal).exists()
+
+    # restart: the snapshot restores the forgotten-job-free state
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    jobs = {j["name"]: j for j in _jobs(env)}
+    assert "old" not in jobs  # forgotten stays forgotten
+    assert jobs["done"]["status"] == "finished"
+    assert jobs["done"]["counters"]["finished"] == 1
+    # and new work still runs (fresh journal segment is appendable)
+    env.start_worker(cpus=2)
+    env.command(["submit", "--wait", "--name", "after", "--", "true"],
+                timeout=60)
+
+
+def test_stream_history_across_compaction_boundary(env, tmp_path):
+    """--history after a compaction: live jobs keep their full event
+    timeline, each event exactly once (seq watermark honored), completed
+    jobs' events are gone with the GC."""
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    worker = env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--wait", "--name", "done", "--", "true"])
+    env.command(["worker", "stop", "1"])
+    wait_until(lambda: worker.poll() is not None, message="worker stopped")
+    env.command(["submit", "--name", "live", "--", "true"])  # stays pending
+    env.command(["journal", "compact"])
+
+    out = env.command(["journal", "stream", "--history"])
+    events = [json.loads(line) for line in out.splitlines()]
+    seqs = [e["seq"] for e in events]
+    assert len(seqs) == len(set(seqs)), "duplicate seq delivered"
+    assert seqs == sorted(seqs), "history out of order"
+    submits = [e for e in events if e["event"] == "job-submitted"]
+    assert [s["job"] for s in submits] == [2]  # 'done' job GC'd, 'live' kept
+
+    # work arriving after the compaction extends the same stream exactly
+    # once per event
+    env.start_worker(cpus=2)
+    env.command(["job", "wait", "2"], timeout=60)
+    env.command(["journal", "flush"])
+    out = env.command(["journal", "stream", "--history"])
+    events = [json.loads(line) for line in out.splitlines()]
+    seqs = [e["seq"] for e in events]
+    assert len(seqs) == len(set(seqs))
+    finished = [e for e in events
+                if e["event"] == "task-finished" and e["job"] == 2]
+    assert len(finished) == 1
+
+
+# --------------------------------------------------------------------------
+# chaos: kill -9 at every compaction phase -> zero acknowledged-event loss
+# --------------------------------------------------------------------------
+COMPACT_PHASES = [
+    "mid-snapshot-write",
+    "pre-rename",
+    "post-rename",
+    "mid-gc",
+    "pre-swap",
+    "post-swap",
+]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("phase", COMPACT_PHASES)
+def test_kill9_at_compaction_phase_loses_nothing(
+    env, tmp_path, phase, monkeypatch
+):
+    """`hq journal compact` with a kill -9 injected at `phase`: after
+    restart, the acknowledged finished job is intact, the running task
+    reattaches (or re-runs under a fenced instance) and the job completes
+    with exactly-once execution."""
+    # the compact request's connection dies with the server; don't spend
+    # the full 15 s default retry window per phase
+    monkeypatch.setenv("HQ_CLIENT_RETRY_SECS", "2")
+    journal = tmp_path / "journal.bin"
+    marker = env.work_dir / "starts.txt"
+    flag = env.work_dir / "flag"
+    plan = {"rules": [{"site": "server.compact", "event": phase,
+                       "action": "kill", "at": 1}]}
+    server = env.start_server(
+        "--journal", str(journal), "--reattach-timeout", "60",
+        env_extra={"HQ_FAULT_PLAN": json.dumps(plan)},
+    )
+    env.start_worker("--on-server-lost", "reconnect", cpus=2)
+    env.wait_workers(1)
+    # acknowledged completed work (counters visible to the client) ...
+    env.command(["submit", "--wait", "--name", "done", "--", "true"])
+    # ... plus a running task blocked on the flag file
+    env.command([
+        "submit", "--name", "blocked", "--", "bash", "-c",
+        f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; '
+        f"while [ ! -f {flag} ]; do sleep 0.2; done",
+    ])
+    wait_until(
+        lambda: any(j["name"] == "blocked"
+                    and j["counters"]["running"] == 1 for j in _jobs(env)),
+        timeout=30, message="blocked task running",
+    )
+    # the injected kill -9 lands inside the compaction; the request fails
+    env.command(["journal", "compact"], expect_fail=True, timeout=30)
+    wait_until(lambda: server.poll() is not None, timeout=30,
+               message=f"server killed itself at {phase}")
+
+    env.start_server(
+        "--journal", str(journal), "--reattach-timeout", "60",
+    )
+    env.command(["server", "wait", "--timeout", "20"])
+    jobs = {j["name"]: j for j in _jobs(env)}
+    # zero acknowledged-event loss: the finished job survived the crash
+    assert jobs["done"]["status"] == "finished", jobs
+    assert jobs["done"]["counters"]["finished"] == 1
+    assert "blocked" in jobs, jobs
+    flag.touch()
+    env.command(["job", "wait", "all"], timeout=90)
+    jobs = {j["name"]: j for j in _jobs(env)}
+    assert jobs["blocked"]["status"] == "finished", jobs
+    # exactly-once: every incarnation line is unique (a reattach keeps
+    # instance 0 with no second line; a re-issue runs once under a fenced
+    # instance)
+    lines = marker.read_text().splitlines()
+    assert len(lines) == len(set(lines)), lines
+    assert len({line.split(":")[1] for line in lines}) == 1
+
+
+@pytest.mark.chaos
+def test_compaction_while_jobs_run_keeps_exactly_once(env, tmp_path):
+    """Aggressive auto-compaction under live traffic + a mid-flight server
+    kill: the batched completion plane, reattach and compaction compose —
+    every task runs exactly once."""
+    journal = tmp_path / "journal.bin"
+    marker = env.work_dir / "starts.txt"
+    server_args = ("--journal", str(journal),
+                   "--journal-compact-interval", "1",
+                   "--reattach-timeout", "10")
+    env.start_server(*server_args)
+    env.start_worker("--on-server-lost", "reconnect", cpus=4)
+    env.wait_workers(1)
+    env.command([
+        "submit", "--array", "0-59", "--crash-limit", "50", "--", "bash",
+        "-c", f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}; '
+              "sleep 0.05",
+    ])
+
+    def finished():
+        jobs = _jobs(env)
+        return jobs and jobs[0]["counters"]["finished"]
+
+    wait_until(lambda: (finished() or 0) >= 15, timeout=60,
+               message="a quarter finished")
+    env.kill_process("server")
+    env.start_server(*server_args)
+    env.command(["server", "wait", "--timeout", "30"])
+    wait_until(lambda: (finished() or 0) >= 60, timeout=120,
+               message=lambda: f"all finished (jobs: {_jobs(env)})")
+    starts = marker.read_text().splitlines()
+    assert len(starts) == len(set(starts)), "duplicate incarnation ran"
+    assert {int(l.split(":")[1]) for l in starts} == set(range(60))
