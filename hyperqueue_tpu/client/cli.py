@@ -158,6 +158,7 @@ def cmd_server_start(args) -> None:
             metrics_port=args.metrics_port,
             metrics_host=args.metrics_host,
             flight_recorder_ticks=args.flight_recorder_ticks,
+            tick_pipeline=args.tick_pipeline,
         )
         access = await server.start()
         print(
@@ -1936,7 +1937,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--paranoid-tick", type=int, default=0, metavar="N",
                    help="debug: every N ticks, run the incremental and the "
                         "from-scratch tick assembly and assert they are "
-                        "bit-identical (0 = off)")
+                        "bit-identical (0 = off); on the device-resident "
+                        "solve path the same cadence re-solves from a "
+                        "fresh full upload and asserts identical counts, "
+                        "and forces --tick-pipeline ticks synchronous")
+    p.add_argument("--tick-pipeline", action="store_true",
+                   help="two-stage async scheduling ticks: dispatch solve "
+                        "N without blocking and map it at tick N+1, "
+                        "overlapping device execution with inter-tick "
+                        "host work (scheduler/pipeline.py); assignments "
+                        "lag one tick")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve Prometheus metrics on this port (0 = "
                         "ephemeral, see `hq server info`; off by default)")
@@ -2438,6 +2448,13 @@ def cmd_task_explain(args) -> None:
         out.message(line)
         if result.get("reason_detail"):
             out.message(f"  {result['reason_detail']}")
+    if result.get("solver_backend"):
+        line = f"solver backend: {result['solver_backend']}"
+        if result.get("solver_backend_reason"):
+            line += f" ({result['solver_backend_reason']})"
+        if result.get("solver_pipelined"):
+            line += " [pipelined]"
+        out.message(line)
     if result["n_waiting_deps"]:
         out.message(f"waiting for {result['n_waiting_deps']} dependencies")
     workers = result["workers"]

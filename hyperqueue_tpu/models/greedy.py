@@ -10,9 +10,22 @@ XLA (see SURVEY.md §7 "Fixed shapes on TPU").
 Padding is semantically inert: padded workers have zero free resources and
 zero task slots; padded batches have size 0; padded variants are all-zero
 need rows which `_variant_capacity` masks off.
+
+Device path (new in the device-resident tick): the padded state stays
+RESIDENT on the accelerator (parallel/resident.py) — per-tick uploads are
+only the dirty-row delta, the solve donates its buffers so free_after/nt_after
+of solve N feed solve N+1 on-device, and the padded counts are sliced to the
+live (B, V, W) extents ON the device before readback.  Backend choice is a
+per-solve cost model over measured host and device times with a periodically
+re-probed sync latency — a transiently slow relay no longer disables the
+device path for the life of the process.
 """
 
 from __future__ import annotations
+
+import functools
+import threading
+import time
 
 import numpy as np
 
@@ -32,15 +45,21 @@ def _bucket(n: int, floor: int) -> int:
     return size
 
 
-# One-shot device sync-latency probe, shared by all models in the process.
+# Device sync-latency probe, shared by all models in the process.
 # None = not yet resolved; float = measured round-trip ms (inf = probe
 # failed). Probed in a BACKGROUND daemon thread: in-process (an exclusively
 # attached TPU cannot be re-initialized from a subprocess), and without
 # ever blocking the caller (this environment's relay is known to WEDGE —
 # a hung probe simply never resolves and the host solve stays selected).
+# Unlike the original one-shot probe, a resolved measurement AGES OUT
+# (REPROBE_INTERVAL_S): callers that pass max_age_s re-launch the probe in
+# the background when the value is stale, so a relay that was slow at
+# startup gets re-evaluated instead of benching the device forever.
 _DEVICE_SYNC_MS: float | None = None
-_PROBE_STARTED = False
-_PROBE_DONE = None  # threading.Event once started
+_PROBE_RUNNING = False
+_PROBE_DONE = None  # threading.Event of the probe currently in flight
+_PROBE_TS = 0.0     # monotonic stamp of the last RESOLVED probe
+_PROBE_LOCK = threading.Lock()
 
 # A tick must complete in single-digit milliseconds; a device whose
 # dispatch+readback round trip alone exceeds this is not worth using for
@@ -49,57 +68,179 @@ _PROBE_DONE = None  # threading.Event once started
 # a host that cannot see the result sooner than the relay allows).
 DISPATCH_LATENCY_BUDGET_MS = 5.0
 
+# re-probe the sync latency when the last measurement is older than this
+# and the host path is currently winning (the device path self-measures)
+REPROBE_INTERVAL_S = 30.0
 
-def device_sync_ms(wait_s: float = 0.0) -> float | None:
+# while the cost model picks the host, retry the device path after this
+# many solves even if the last device measurement lost — measurements go
+# stale as shapes and relay health drift
+DEVICE_RETRY_SOLVES = 512
+
+# cost-model EWMA smoothing for per-shape host/device solve times
+_EWMA_ALPHA = 0.25
+
+
+def _start_probe_locked() -> None:
+    global _PROBE_RUNNING, _PROBE_DONE
+    _PROBE_RUNNING = True
+    _PROBE_DONE = threading.Event()
+    done = _PROBE_DONE
+
+    def _probe():
+        global _DEVICE_SYNC_MS, _PROBE_RUNNING, _PROBE_TS
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            f = jax.jit(lambda v: (v * 2).sum())
+            x = jax.device_put(jnp.arange(256, dtype=jnp.int32))
+            np.asarray(f(x))  # compile + first transfer
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(f(x))
+                ts.append((time.perf_counter() - t0) * 1000)
+            measured = min(ts)
+        except Exception:
+            measured = float("inf")
+        with _PROBE_LOCK:
+            _DEVICE_SYNC_MS = measured
+            _PROBE_TS = time.monotonic()
+            _PROBE_RUNNING = False
+        done.set()
+
+    threading.Thread(
+        target=_probe, name="hq-device-probe", daemon=True
+    ).start()
+
+
+def device_sync_ms(wait_s: float = 0.0,
+                   max_age_s: float | None = None) -> float | None:
     """Current known device sync round trip in ms.
 
-    Starts the background probe on first call; returns None while it is
-    unresolved (callers treat that as "use the host solve for now").
+    Starts the background probe on first call; returns None while the
+    FIRST probe is unresolved (callers treat that as "use the host solve
+    for now").  `max_age_s` triggers a background RE-probe when the last
+    resolved measurement is older — the stale value keeps being returned
+    until the new one lands, so callers never block on freshness.
     `wait_s` > 0 blocks up to that long for a result — benchmarks use it
     for a stable backend choice; the server never passes it."""
-    global _PROBE_STARTED, _PROBE_DONE
-    if not _PROBE_STARTED:
-        import threading
-
-        _PROBE_STARTED = True
-        _PROBE_DONE = threading.Event()
-
-        def _probe():
-            global _DEVICE_SYNC_MS
-            import time
-
-            try:
-                import jax
-                import jax.numpy as jnp
-
-                f = jax.jit(lambda v: (v * 2).sum())
-                x = jax.device_put(jnp.arange(256, dtype=jnp.int32))
-                np.asarray(f(x))  # compile + first transfer
-                ts = []
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    np.asarray(f(x))
-                    ts.append((time.perf_counter() - t0) * 1000)
-                _DEVICE_SYNC_MS = min(ts)
-            except Exception:
-                _DEVICE_SYNC_MS = float("inf")
-            finally:
-                _PROBE_DONE.set()
-
-        threading.Thread(
-            target=_probe, name="hq-device-probe", daemon=True
-        ).start()
-    if wait_s > 0:
-        _PROBE_DONE.wait(wait_s)
+    with _PROBE_LOCK:
+        if _DEVICE_SYNC_MS is None and not _PROBE_RUNNING:
+            _start_probe_locked()
+        elif (
+            max_age_s is not None
+            and not _PROBE_RUNNING
+            and _DEVICE_SYNC_MS is not None
+            and time.monotonic() - _PROBE_TS > max_age_s
+        ):
+            _start_probe_locked()
+        done = _PROBE_DONE
+    if wait_s > 0 and done is not None:
+        done.wait(wait_s)
     return _DEVICE_SYNC_MS
 
 
+def _reset_probe_for_tests() -> None:
+    global _DEVICE_SYNC_MS, _PROBE_RUNNING, _PROBE_DONE, _PROBE_TS
+    with _PROBE_LOCK:
+        _DEVICE_SYNC_MS = None
+        _PROBE_RUNNING = False
+        _PROBE_DONE = None
+        _PROBE_TS = 0.0
+
+
+class ResidentParanoidError(AssertionError):
+    """The device-resident solve diverged from a fresh full-upload solve.
+
+    Deliberately loud: the solver watchdog re-raises it instead of
+    degrading (like tick_cache.paranoid_check, the paranoid contract is a
+    debug tool — masking the divergence behind the fallback would both
+    hide the bug and destroy the evidence via resident invalidation)."""
+
+
+@functools.lru_cache(maxsize=64)
+def _device_slicer(n_b: int, n_v: int, n_w: int):
+    """Jitted padded->live slicer: the device path trims the padded
+    (PB, PV, PW) counts to the live extents ON the device, so the host
+    readback never copies (or receives) the padded volume and the
+    resulting numpy array is C-contiguous (scheduler/tick.py relies on
+    that to use the native nonzero on both backends).  Compiled once per
+    distinct extent triple — live extents repeat in steady state."""
+    import jax
+
+    return jax.jit(lambda c: c[:n_b, :n_v, :n_w])
+
+
+class _ReadyCounts:
+    """Solve handle whose result is already materialized (host paths)."""
+
+    __slots__ = ("_counts",)
+
+    def __init__(self, counts: np.ndarray):
+        self._counts = counts
+
+    def result(self) -> np.ndarray:
+        return self._counts
+
+
+class _DeviceCounts:
+    """In-flight device solve: `result()` materializes the (device-sliced)
+    counts, re-synchronizes the residency mirror from the donated outputs,
+    and feeds the cost model.  The dispatch is asynchronous — between
+    construction and `result()` the device executes while the host does
+    other tick work (the pipelined tick exploits exactly this window)."""
+
+    __slots__ = ("_model", "_res", "_counts_dev", "_after", "_prep")
+
+    def __init__(self, model, res, counts_dev, after, prep):
+        self._model = model
+        self._res = res
+        self._counts_dev = counts_dev
+        self._after = after  # (free_after, nt_after) device arrays
+        self._prep = prep
+
+    def result(self) -> np.ndarray:
+        model = self._model
+        prep = self._prep
+        t0 = time.perf_counter()
+        out = np.asarray(self._counts_dev)
+        if self._after is not None:
+            free_after, nt_after = self._after
+            self._res.apply_outputs(
+                np.asarray(free_after), np.asarray(nt_after)
+            )
+        t1 = time.perf_counter()
+        sync_ms = (t1 - t0) * 1e3
+        # the cost the TICK pays: dispatch + readback wait.  Synchronous
+        # solves call result() immediately, so sync_ms contains the whole
+        # device execution; pipelined solves call it a tick later, when
+        # the execution already overlapped host work — charging the idle
+        # gap would wrongly bench the device in the cost model.
+        total_ms = prep["dispatch_ms"] + sync_ms
+        model._observe("device", prep["shape_key"], total_ms)
+        model.last_phases = {
+            "pad_ms": prep["pad_ms"],
+            "visit_ms": prep["visit_ms"],
+            "dispatch_ms": prep["dispatch_ms"],
+            "sync_ms": sync_ms,
+        }
+        model._maybe_paranoid_check(prep, out)
+        if not out.flags.c_contiguous:  # pragma: no cover - np.asarray copy
+            out = np.ascontiguousarray(out)
+        return out
+
+
 class GreedyCutScanModel:
-    """Stateless apart from jit's own compile cache.
+    """Stateless apart from jit's compile cache and the device residency.
 
     backend: "auto" uses the jitted kernel on an accelerator and the numpy
     implementation on CPU hosts (identical semantics; the XLA while-loop is
-    slower than numpy on CPU); "jax"/"numpy" force a path.
+    slower than numpy on CPU); "jax"/"numpy" force a path.  With an
+    accelerator visible, "auto" runs a per-solve cost model (measured host
+    vs device times per padded shape, periodically re-probed sync latency)
+    instead of a one-shot permanent decision.
     """
 
     def __init__(
@@ -116,8 +257,10 @@ class GreedyCutScanModel:
         self.variant_floor = variant_floor
         self.backend = backend
         # which path the last solve actually ran (host-native / host-numpy
-        # / device-jax); bench.py reports it
+        # / device-jax / device-sharded); bench.py and the DecisionRecords
+        # report it, with last_backend_reason naming WHY it was chosen
         self.last_backend: str | None = None
+        self.last_backend_reason: str = ""
         self._use_numpy: bool | None = (
             None if backend == "auto" else (backend == "numpy")
         )
@@ -133,60 +276,128 @@ class GreedyCutScanModel:
         # per-phase latency of the last solve() in ms (pad/visit/dispatch/
         # sync) — consumed by the tick's phase breakdown
         self.last_phases: dict = {}
+        # device residency (parallel/resident.py), built on first device
+        # solve; None until then
+        self._res = None
+        # per-shape EWMA of measured end-to-end solve ms, host vs device —
+        # the adaptive backend decision reads these
+        self._cost: dict[str, dict[tuple, float]] = {"host": {}, "device": {}}
+        self._solves_since_device = 0
+        # paranoid mode: every Nth RESIDENT device solve re-runs the same
+        # padded inputs through a fresh full-upload solve and asserts
+        # bitwise count equality (0 = off); wired to `--paranoid-tick`
+        self.paranoid_resident = 0
+        self._resident_solves = 0
+        self.paranoid_checks = 0
+
+    # -- backend selection -------------------------------------------------
+    def _sticky_host(self) -> bool | None:
+        """Process-sticky part of the backend decision: True = host
+        forever (forced numpy, CPU-pinned env, CPU jax backend, failed
+        init), False = device forced, None = accelerator visible — decide
+        per solve (_backend_decision)."""
+        if self._use_numpy is not None:
+            return self._use_numpy
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # the environment pins the cpu backend: decide without
+            # importing jax at all (a multi-second cost per server
+            # process that the host solve never pays back)
+            self._use_numpy = True
+            return True
+        import jax
+
+        try:
+            backend = jax.default_backend()
+        except RuntimeError:
+            # the configured accelerator backend failed to initialize
+            # (e.g. an unhealthy TPU relay at process start): the solve
+            # must keep working on the host — and the choice is sticky,
+            # because jax caches the failed init for the process anyway
+            self._use_numpy = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax backend unavailable; solving on the host (numpy)",
+                exc_info=True,
+            )
+            return True
+        if backend == "cpu":
+            # the XLA while-loop overhead loses to numpy on CPU hosts
+            self._use_numpy = True
+            return True
+        return None
 
     def _numpy_path(self) -> bool:
-        if self._use_numpy is None:
-            import os
+        """Compatibility probe: True when the solve is host-pinned for the
+        process.  With an accelerator visible the answer is per-solve
+        (_backend_decision); this returns False then."""
+        return self._sticky_host() is True
 
-            if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-                # the environment pins the cpu backend: decide without
-                # importing jax at all (a multi-second cost per server
-                # process that the host solve never pays back)
-                self._use_numpy = True
-                return True
-            import jax
+    def _backend_decision(self, shape_key: tuple) -> tuple[str, str]:
+        """("host"|"device", reason) for THIS solve.
 
-            try:
-                backend = jax.default_backend()
-            except RuntimeError:
-                # the configured accelerator backend failed to initialize
-                # (e.g. an unhealthy TPU relay at process start): the solve
-                # must keep working on the host — and the choice is sticky,
-                # because jax caches the failed init for the process anyway
-                self._use_numpy = True
-                import logging
+        The cost model compares per-shape EWMAs of measured end-to-end
+        solve times.  Until a host measurement exists the original budget
+        rule applies (device only when its sync round trip fits the tick
+        budget); a benched device is retried after DEVICE_RETRY_SOLVES and
+        the sync probe re-runs every REPROBE_INTERVAL_S, so neither a slow
+        first probe nor a transiently wedged relay is permanent."""
+        sticky = self._sticky_host()
+        if sticky is True:
+            return "host", (
+                "forced-numpy" if self.backend == "numpy" else "cpu-host"
+            )
+        if sticky is False:
+            return "device", "forced-jax"
+        sync_ms = device_sync_ms(max_age_s=REPROBE_INTERVAL_S)
+        if sync_ms is None:
+            return "host", "sync-probe-pending"
+        if sync_ms == float("inf"):
+            return "host", "sync-probe-failed"
+        host_est = self._cost["host"].get(shape_key)
+        dev_est = self._cost["device"].get(shape_key)
+        if dev_est is not None and host_est is not None:
+            if dev_est <= host_est:
+                return "device", "cost-model"
+            if (
+                self._solves_since_device >= DEVICE_RETRY_SOLVES
+                and sync_ms < host_est
+            ):
+                return "device", "periodic-retry"
+            return "host", (
+                f"cost-model (device {dev_est:.1f}ms > host {host_est:.1f}ms)"
+            )
+        if host_est is None and dev_est is not None:
+            return "device", "cost-model"
+        if host_est is not None:
+            # no device measurement for this shape yet: its end-to-end time
+            # is at least the sync round trip — try it when that alone
+            # could beat the measured host time
+            if sync_ms < host_est:
+                return "device", "first-measurement"
+            return "host", (
+                f"sync {sync_ms:.1f}ms exceeds host {host_est:.1f}ms"
+            )
+        # no measurements at all: the original conservative budget rule
+        if sync_ms <= DISPATCH_LATENCY_BUDGET_MS:
+            return "device", "sync-within-budget"
+        return "host", (
+            f"sync {sync_ms:.1f}ms exceeds the "
+            f"{DISPATCH_LATENCY_BUDGET_MS:.0f}ms budget"
+        )
 
-                logging.getLogger(__name__).warning(
-                    "jax backend unavailable; solving on the host (numpy)",
-                    exc_info=True,
-                )
-                return True
-            if backend == "cpu":
-                # the XLA while-loop overhead loses to numpy on CPU hosts
-                self._use_numpy = True
-            else:
-                # an accelerator is visible — but only worth using when the
-                # host can actually get the answer back within the tick
-                # budget (a tunneled chip with tens of ms of relay RTT runs
-                # the kernel in <1 ms and then sits on the result; the host
-                # solve at ~16 ms for 1M x 1k beats it end to end). The
-                # probe runs in the background: until it resolves, solve on
-                # the host WITHOUT caching the decision (never blocks the
-                # server's event loop; a wedged relay simply never resolves)
-                sync_ms = device_sync_ms()
-                if sync_ms is None:
-                    return True  # provisional — retry next solve
-                self._use_numpy = sync_ms > DISPATCH_LATENCY_BUDGET_MS
-                if self._use_numpy:
-                    import logging
+    def _observe(self, kind: str, shape_key: tuple, ms: float) -> None:
+        table = self._cost[kind]
+        prev = table.get(shape_key)
+        table[shape_key] = (
+            ms if prev is None else prev + _EWMA_ALPHA * (ms - prev)
+        )
+        if kind == "device":
+            self._solves_since_device = 0
 
-                    logging.getLogger(__name__).warning(
-                        "device sync round trip %.1f ms exceeds the %.0f ms "
-                        "tick budget: solving on the host (numpy) instead",
-                        sync_ms, DISPATCH_LATENCY_BUDGET_MS,
-                    )
-        return self._use_numpy
-
+    # -- solve -------------------------------------------------------------
     def solve(
         self,
         free: np.ndarray,       # (W, R) int32
@@ -205,10 +416,48 @@ class GreedyCutScanModel:
                                              # run_tick's batch ordering;
                                              # accepted for interface parity
     ) -> np.ndarray:
-        """Returns counts (B, V, W) int32 (unpadded)."""
-        import time as _time
+        """Returns counts (B, V, W) int32 (unpadded, C-contiguous)."""
+        return self.solve_async(
+            free, nt_free, lifetime, needs, sizes, min_time,
+            priorities=priorities, total=total, all_mask=all_mask,
+            weights=weights,
+        ).result()
 
-        _t0 = _time.perf_counter()
+    def solve_async(
+        self, free, nt_free, lifetime, needs, sizes, min_time,
+        priorities=None, total=None, all_mask=None, weights=None,
+    ):
+        """Dispatch one solve; returns a handle whose `.result()` yields the
+        unpadded counts.  Host backends compute eagerly (the handle is just
+        a box); the device backend returns with the program ENQUEUED, so
+        the caller can overlap host work with the device execution — the
+        pipelined tick (scheduler/pipeline.py) maps the previous solve
+        during exactly this window."""
+        prep = self._prepare(
+            free, nt_free, lifetime, needs, sizes, min_time, total, all_mask
+        )
+        backend, reason = self._backend_decision(prep["shape_key"])
+        self.last_backend_reason = reason
+        self._solves_since_device += 1
+        if backend == "host":
+            return self._host_solve(prep)
+        try:
+            return self._device_solve(prep)
+        except Exception as e:  # noqa: BLE001 - degrade, don't kill the tick
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "device solve dispatch failed (%s); falling back to the "
+                "host solve for this tick", e, exc_info=True,
+            )
+            self.invalidate_resident()
+            self.last_backend_reason = f"device-dispatch-failed: {e}"
+            return self._host_solve(prep)
+
+    # -- preparation (shared by every backend) ----------------------------
+    def _prepare(self, free, nt_free, lifetime, needs, sizes, min_time,
+                 total, all_mask) -> dict:
+        _t0 = time.perf_counter()
         n_w, n_r = free.shape
         n_b, n_v, _ = needs.shape
 
@@ -272,7 +521,7 @@ class GreedyCutScanModel:
                 amask_p[:n_b, n_v:lv] = 0
             total_p[:n_w, :n_r] = total if total is not None else free
             amask_p[:n_b, :n_v, :n_r] = all_mask
-        _t1 = _time.perf_counter()
+        _t1 = time.perf_counter()
 
         scarcity = np.asarray(
             scarcity_weights(free_p.astype(np.int64).sum(axis=0))
@@ -286,23 +535,159 @@ class GreedyCutScanModel:
         if pm > class_m.shape[0]:
             pad = np.zeros((pm - class_m.shape[0], pw), dtype=np.int32)
             class_m = np.concatenate([class_m, pad], axis=0)
-        _t2 = _time.perf_counter()
+        _t2 = time.perf_counter()
 
-        counts = self._solve_padded(
-            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids,
-            total_p=total_p, amask_p=amask_p,
-        )
-        _t3 = _time.perf_counter()
-        out = np.asarray(counts)[:n_b, :n_v, :n_w]
-        _t4 = _time.perf_counter()
-        self.last_phases = {
+        return {
+            "free_p": free_p, "nt_p": nt_p, "life_p": life_p,
+            "needs_p": needs_p, "sizes_p": sizes_p, "mt_p": mt_p,
+            "total_p": total_p, "amask_p": amask_p,
+            "class_m": class_m, "order_ids": order_ids,
+            "extents": (n_b, n_v, n_w),
+            "shape_key": (pw, pb, pr, pv, pm, has_all),
+            "has_all": has_all,
             "pad_ms": (_t1 - _t0) * 1e3,
             "visit_ms": (_t2 - _t1) * 1e3,
-            "dispatch_ms": (_t3 - _t2) * 1e3,
-            "sync_ms": (_t4 - _t3) * 1e3,
+            "dispatch_ms": 0.0,
         }
-        return out
 
+    # -- host path ---------------------------------------------------------
+    def _host_solve(self, prep) -> _ReadyCounts:
+        _t0 = time.perf_counter()
+        counts = self._host_counts(prep)
+        _t1 = time.perf_counter()
+        n_b, n_v, n_w = prep["extents"]
+        out = np.ascontiguousarray(
+            np.asarray(counts)[:n_b, :n_v, :n_w]
+        )
+        _t2 = time.perf_counter()
+        self.last_phases = {
+            "pad_ms": prep["pad_ms"],
+            "visit_ms": prep["visit_ms"],
+            "dispatch_ms": (_t1 - _t0) * 1e3,
+            "sync_ms": (_t2 - _t1) * 1e3,
+        }
+        self._observe("host", prep["shape_key"], (_t2 - _t0) * 1e3)
+        return _ReadyCounts(out)
+
+    def _host_counts(self, prep):
+        """The host solve on fully padded inputs: the native C++ scan
+        (identical semantics, with saturation early-exits) when the lib is
+        available, else numpy."""
+        from hyperqueue_tpu.utils.native import native_cut_scan
+
+        counts = native_cut_scan(
+            prep["free_p"], prep["nt_p"], prep["life_p"], prep["needs_p"],
+            prep["sizes_p"], prep["mt_p"], prep["class_m"],
+            prep["order_ids"], total=prep["total_p"],
+            all_mask=prep["amask_p"],
+        )
+        if counts is not None:
+            self.last_backend = "host-native"
+            return counts
+        self.last_backend = "host-numpy"
+        counts, _free_after, _nt_after = greedy_cut_scan_numpy(
+            prep["free_p"], prep["nt_p"], prep["life_p"], prep["needs_p"],
+            prep["sizes_p"], prep["mt_p"], prep["class_m"],
+            prep["order_ids"], total=prep["total_p"],
+            all_mask=prep["amask_p"],
+        )
+        return counts
+
+    # -- device path (resident state + donated buffers) --------------------
+    _device_backend_name = "device-jax"
+
+    def _residency(self):
+        if self._res is None:
+            from hyperqueue_tpu.parallel.resident import DeviceResidency
+
+            self._res = DeviceResidency()
+        return self._res
+
+    def invalidate_resident(self) -> None:
+        """Drop the device-resident state (next device solve re-uploads in
+        full).  The watchdog calls this whenever a solve is abandoned or
+        degraded mid-flight — the device buffers may then hold outputs the
+        host never accounted for."""
+        if self._res is not None:
+            self._res.invalidate()
+
+    def resident_stats(self) -> dict:
+        base = {"backend": self.last_backend,
+                "backend_reason": self.last_backend_reason}
+        if self._res is not None:
+            base.update(self._res.stats())
+        base["paranoid_checks"] = self.paranoid_checks
+        return base
+
+    def _device_solve(self, prep) -> _DeviceCounts:
+        _t0 = time.perf_counter()
+        res = self._residency()
+        free_d, nt_d, life_d, total_d = res.sync(
+            prep["free_p"], prep["nt_p"], prep["life_p"], prep["total_p"]
+        )
+        counts, free_after, nt_after = self._kernel_dispatch(
+            res, free_d, nt_d, life_d, total_d, prep
+        )
+        res.adopt_outputs(free_after, nt_after)
+        n_b, n_v, n_w = prep["extents"]
+        counts_dev = _device_slicer(n_b, n_v, n_w)(counts)
+        prep["dispatch_ms"] = (time.perf_counter() - _t0) * 1e3
+        self.last_backend = self._device_backend_name
+        self._resident_solves += 1
+        return _DeviceCounts(
+            self, res, counts_dev, (free_after, nt_after), prep
+        )
+
+    def _kernel_dispatch(self, res, free_d, nt_d, life_d, total_d, prep):
+        """Enqueue the jitted kernel on the resident buffers (donating
+        free/nt_free); replicated inputs ride the placement cache.
+        Overridden by the multichip model to shard the worker axis."""
+        return greedy_cut_scan(
+            free_d, nt_d, life_d,
+            res.place_cached("needs", prep["needs_p"]),
+            res.place_cached("sizes", prep["sizes_p"]),
+            res.place_cached("min_time", prep["mt_p"]),
+            res.place_cached("class_m", prep["class_m"]),
+            res.place_cached("order_ids", prep["order_ids"]),
+            total=total_d,
+            all_mask=res.place_cached("all_mask", prep["amask_p"]),
+        )
+
+    def _maybe_paranoid_check(self, prep, out: np.ndarray) -> None:
+        """Resident-vs-fresh bit-exactness guard: re-run the SAME padded
+        inputs through a fresh full-upload device solve and assert count
+        equality.  The padded buffers are untouched between dispatch and
+        result (the pipeline maps a pending solve before preparing the
+        next), so the comparison is exact by construction."""
+        if (
+            not self.paranoid_resident
+            or self._resident_solves % self.paranoid_resident != 0
+        ):
+            return
+        self.paranoid_checks += 1
+        fresh = self._fresh_device_counts(prep)
+        n_b, n_v, n_w = prep["extents"]
+        fresh = np.asarray(fresh)[:n_b, :n_v, :n_w]
+        if not np.array_equal(out, fresh):
+            raise ResidentParanoidError(
+                "paranoid-resident: device-resident counts diverge from a "
+                "fresh full-upload solve of the same padded inputs"
+            )
+
+    def _fresh_device_counts(self, prep):
+        """Full-upload reference solve (no residency, no placement cache);
+        the donated jit consumes the fresh uploads, never the resident
+        buffers."""
+        counts, _f, _n = greedy_cut_scan(
+            prep["free_p"].copy(), prep["nt_p"].copy(), prep["life_p"],
+            prep["needs_p"], prep["sizes_p"], prep["mt_p"],
+            prep["class_m"], prep["order_ids"],
+            total=None if prep["total_p"] is None else prep["total_p"].copy(),
+            all_mask=prep["amask_p"],
+        )
+        return counts
+
+    # -- padded-buffer management -----------------------------------------
     def _get_buffers(self, pw: int, pb: int, pr: int, pv: int,
                      has_all: bool) -> dict:
         """Persistent padded host buffers for one bucket shape.
@@ -344,34 +729,3 @@ class GreedyCutScanModel:
 
     def _worker_bucket(self, n_w: int) -> int:
         return _bucket(n_w, self.worker_floor)
-
-    def _solve_padded(
-        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-        order_ids, total_p=None, amask_p=None,
-    ):
-        """Run the kernel on fully padded inputs; overridden by the
-        multi-chip model (models/multichip.py) to shard the worker axis."""
-        if self._numpy_path():
-            # host solve: the native C++ scan (identical semantics, with
-            # saturation early-exits) when the lib is available, else numpy
-            from hyperqueue_tpu.utils.native import native_cut_scan
-
-            counts = native_cut_scan(
-                free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-                order_ids, total=total_p, all_mask=amask_p,
-            )
-            if counts is not None:
-                self.last_backend = "host-native"
-                return counts
-            self.last_backend = "host-numpy"
-            counts, _free_after, _nt_after = greedy_cut_scan_numpy(
-                free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-                order_ids, total=total_p, all_mask=amask_p,
-            )
-            return counts
-        self.last_backend = "device-jax"
-        counts, _free_after, _nt_after = greedy_cut_scan(
-            free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m, order_ids,
-            total=total_p, all_mask=amask_p,
-        )
-        return counts

@@ -15,13 +15,21 @@ devices the process sees (all of them by default, or `n_devices`). With a
 single device the model degrades to the plain single-chip kernel — a
 single-chip deployment selecting `--scheduler=multichip` is valid and loses
 nothing.
+
+Residency: the sharded solve inherits the device-resident tick state from
+the parent model — the (W, R) shards stay on their devices across ticks,
+per-tick uploads are the dirty-row delta (scattered under GSPMD, so each
+device receives only its own rows), and `sharded_cut_scan_donate` reuses
+the resident buffers for `free_after`/`nt_after`.  `--scheduler=multichip`
+is an explicit operator choice, so the adaptive host-vs-device cost model
+is bypassed: with a real mesh the sharded kernel runs unconditionally
+(the watchdog still guards failures), matching the documented contract
+that selecting multichip means "shard my solve".
 """
 
 from __future__ import annotations
 
 import logging
-
-import numpy as np
 
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel, _bucket
 
@@ -29,6 +37,8 @@ logger = logging.getLogger(__name__)
 
 
 class MultichipModel(GreedyCutScanModel):
+    _device_backend_name = "device-sharded"
+
     def __init__(self, n_devices: int | None = None, **kwargs):
         # backend only matters for the single-device fallback, where the
         # parent's "auto" (numpy on CPU hosts) is the right default; with a
@@ -82,24 +92,61 @@ class MultichipModel(GreedyCutScanModel):
             pw = ((pw + d - 1) // d) * d  # shard_map needs W % D == 0
         return pw
 
-    def _solve_padded(
-        self, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-        order_ids, total_p=None, amask_p=None,
-    ):
+    def _backend_decision(self, shape_key):
+        # an operator who selected --scheduler=multichip asked for the
+        # sharded device solve: run it whenever a mesh exists (the solver
+        # watchdog still catches failures); without one, behave exactly
+        # like the single-chip model (adaptive on accelerators, host on
+        # CPU-only deployments)
+        if self._get_mesh():
+            return "device", "multichip-mesh"
+        return super()._backend_decision(shape_key)
+
+    def _residency(self):
+        if self._res is None:
+            from hyperqueue_tpu.parallel.resident import DeviceResidency
+            from hyperqueue_tpu.parallel.solve import _mesh_shardings
+
+            mesh = self._get_mesh()
+            if mesh:
+                self._res = DeviceResidency(shardings=_mesh_shardings(mesh))
+            else:
+                self._res = super()._residency()
+        return self._res
+
+    def _kernel_dispatch(self, res, free_d, nt_d, life_d, total_d, prep):
         mesh = self._get_mesh()
         if not mesh:
-            return super()._solve_padded(
-                free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-                order_ids, total_p=total_p, amask_p=amask_p,
+            return super()._kernel_dispatch(
+                res, free_d, nt_d, life_d, total_d, prep
             )
+        from hyperqueue_tpu.parallel.solve import sharded_cut_scan_donate
+
+        return sharded_cut_scan_donate(
+            mesh, free_d, nt_d, life_d,
+            res.place_cached("needs", prep["needs_p"]),
+            res.place_cached("sizes", prep["sizes_p"]),
+            res.place_cached("min_time", prep["mt_p"]),
+            res.place_cached("class_m", prep["class_m"], kind=3),
+            res.place_cached("order_ids", prep["order_ids"]),
+            total=total_d,
+            all_mask=res.place_cached("all_mask", prep["amask_p"]),
+        )
+
+    def _fresh_device_counts(self, prep):
+        mesh = self._get_mesh()
+        if not mesh:
+            return super()._fresh_device_counts(prep)
         from hyperqueue_tpu.parallel.solve import (
             place_tick_inputs,
             sharded_cut_scan,
         )
 
         placed = place_tick_inputs(
-            mesh, free_p, nt_p, life_p, needs_p, sizes_p, mt_p, class_m,
-            order_ids, total=total_p, all_mask=amask_p,
+            mesh, prep["free_p"], prep["nt_p"], prep["life_p"],
+            prep["needs_p"], prep["sizes_p"], prep["mt_p"],
+            prep["class_m"], prep["order_ids"], total=prep["total_p"],
+            all_mask=prep["amask_p"],
         )
-        counts, _free_after, _nt_after = sharded_cut_scan(mesh, *placed)
-        return np.asarray(counts)
+        counts, _f, _n = sharded_cut_scan(mesh, *placed)
+        return counts

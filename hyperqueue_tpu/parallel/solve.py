@@ -23,6 +23,14 @@ traffic, no host round-trip, no resharding of the (W, R) state. Exactness is
 pinned by tests/test_parallel.py, which asserts bitwise count equality with
 the single-chip kernel on random and adversarial instances.
 
+Memory layout note: the per-batch visit-class one-hots (B, V, W, C) are
+expanded INSIDE the shard_map body from the worker-sharded class table
+(class_m is sharded (M, W/D) per device), so no replicated (B, V, W, C)
+tensor is ever materialized — each device builds only its own
+(B, V, W/D, C) slice. An earlier revision expanded the one-hots outside the
+shard_map, which materialized the full W axis on every device (268 MB at
+B=256, W=8192) and dominated the sharded solve's cost.
+
 Reference anchor: the solver IS the production scheduler there too
 (crates/tako/src/internal/scheduler/{main.rs:40-46,solver.rs:16-461}); this
 module is its multi-device form, selected with `--scheduler=multichip`
@@ -90,14 +98,22 @@ def _sharded_water_fill_classed(cap, remaining, class_onehot, axis):
 
 
 def _sharded_body(
-    free, nt_free, lifetime, needs, sizes, min_time, onehots,
+    free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
 ):
-    """shard_map body: free/nt_free/lifetime/onehots/total are local worker
-    shards; needs/sizes/min_time/all_mask are replicated. The scan itself is
-    ops.assign.scan_batches — the SAME code the single-chip kernel runs —
-    with only the water-fill swapped for the cluster-wide-prefix variant, so
-    single/multi-chip parity is structural."""
+    """shard_map body: free/nt_free/lifetime/class_m/total are local worker
+    shards; needs/sizes/min_time/order_ids/all_mask are replicated. The
+    scan itself is ops.assign.scan_batches — the SAME code the single-chip
+    kernel runs — with only the water-fill swapped for the
+    cluster-wide-prefix variant, so single/multi-chip parity is structural.
+
+    The one-hot expansion happens here, per device, over the LOCAL worker
+    slice: class_m arrives as this device's (M, Wl) shard, so the expanded
+    (B, V, Wl, C) tensor is 1/D of the full volume (the SAME
+    ops.assign.expand_onehots the single-chip kernel uses, barrier
+    included).
+    """
+    onehots = expand_onehots(class_m, order_ids)
 
     def water_fill(cap, remaining, class_onehot):
         return _sharded_water_fill_classed(cap, remaining, class_onehot, "w")
@@ -108,23 +124,10 @@ def _sharded_body(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("mesh",))
-def sharded_cut_scan(
+def _sharded_cut_scan_impl(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
 ):
-    """Worker-sharded variant of ops.assign.greedy_cut_scan — same inputs,
-    same outputs, identical semantics.
-
-    free/total (W, R), nt_free/lifetime (W,) sharded on axis "w"; needs/
-    sizes/min_time/class_m/order_ids/all_mask replicated. Returns counts
-    (B, V, W) sharded on W, plus free/nt_free after.
-    """
-    # Per-batch visit-class one-hots, expanded OUTSIDE the shard_map/scan
-    # (in-scan dynamic row gathers cost ~140us/step on TPU — same reasoning
-    # as greedy_cut_scan_impl); XLA shards the (B, V, W, C) result on W.
-    onehots = expand_onehots(class_m, order_ids)
-
     in_specs = [
         P("w", None),              # free
         P("w"),                    # nt_free
@@ -132,9 +135,11 @@ def sharded_cut_scan(
         P(),                       # needs
         P(),                       # sizes
         P(),                       # min_time
-        P(None, None, "w", None),  # onehots
+        P(None, "w"),              # class_m (per-mask class table, W-sharded)
+        P(),                       # order_ids
     ]
-    args = [free, nt_free, lifetime, needs, sizes, min_time, onehots]
+    args = [free, nt_free, lifetime, needs, sizes, min_time, class_m,
+            order_ids]
     # optional ALL-policy inputs: None args are dropped from the pytree so
     # the no-ALL compiled program is unchanged
     if total is not None:
@@ -144,8 +149,8 @@ def sharded_cut_scan(
         in_specs.append(P())
         args.append(all_mask)
 
-    def body(free, nt_free, lifetime, needs, sizes, min_time, onehots,
-             *extra):
+    def body(free, nt_free, lifetime, needs, sizes, min_time, class_m,
+             order_ids, *extra):
         i = 0
         t = m = None
         if total is not None:
@@ -154,8 +159,8 @@ def sharded_cut_scan(
         if all_mask is not None:
             m = extra[i]
         return _sharded_body(
-            free, nt_free, lifetime, needs, sizes, min_time, onehots,
-            total=t, all_mask=m,
+            free, nt_free, lifetime, needs, sizes, min_time, class_m,
+            order_ids, total=t, all_mask=m,
         )
 
     return _shard_map(
@@ -167,15 +172,58 @@ def sharded_cut_scan(
     )(*args)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def sharded_cut_scan(
+    mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
+    order_ids, total=None, all_mask=None,
+):
+    """Worker-sharded variant of ops.assign.greedy_cut_scan — same inputs,
+    same outputs, identical semantics.
+
+    free/total (W, R), nt_free/lifetime (W,), class_m (M, W) sharded on
+    axis "w"; needs/sizes/min_time/order_ids/all_mask replicated. Returns
+    counts (B, V, W) sharded on W, plus free/nt_free after.
+    """
+    return _sharded_cut_scan_impl(
+        mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
+        order_ids, total=total, all_mask=all_mask,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2)
+)
+def sharded_cut_scan_donate(
+    mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
+    order_ids, total=None, all_mask=None,
+):
+    """`sharded_cut_scan` with `free`/`nt_free` DONATED: the input buffers
+    are consumed and their storage reused for `free_after`/`nt_after`.
+
+    This is the device-resident tick's solve (parallel/resident.py): solve
+    N's outputs become solve N+1's inputs without ever crossing the host
+    boundary, so the per-tick host->device traffic is only the dirty-row
+    delta. Callers MUST not touch the passed free/nt_free arrays again.
+    """
+    return _sharded_cut_scan_impl(
+        mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
+        order_ids, total=total, all_mask=all_mask,
+    )
+
+
 @functools.lru_cache(maxsize=4)
 def _mesh_shardings(mesh: Mesh):
     """NamedSharding objects per mesh, built once: the production tick
     places tensors every solve, and re-constructing shardings per call is
-    avoidable host work on the hot path."""
+    avoidable host work on the hot path.
+
+    Returns (w2, w1, rep, cm): (W, R)-sharded, (W,)-sharded, replicated,
+    and the (M, W) class-table sharding."""
     return (
         NamedSharding(mesh, P("w", None)),
         NamedSharding(mesh, P("w")),
         NamedSharding(mesh, P()),
+        NamedSharding(mesh, P(None, "w")),
     )
 
 
@@ -183,7 +231,7 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
                       min_time, class_m, order_ids, total=None,
                       all_mask=None):
     """Device-put the tick tensors with the proper shardings."""
-    w2, w1, rep = _mesh_shardings(mesh)
+    w2, w1, rep, cm = _mesh_shardings(mesh)
     out = (
         jax.device_put(free, w2),
         jax.device_put(nt_free, w1),
@@ -191,7 +239,7 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
         jax.device_put(needs, rep),
         jax.device_put(sizes, rep),
         jax.device_put(min_time, rep),
-        jax.device_put(class_m, rep),
+        jax.device_put(class_m, cm),
         jax.device_put(order_ids, rep),
     )
     if total is not None or all_mask is not None:

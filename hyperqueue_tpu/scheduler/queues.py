@@ -99,6 +99,11 @@ class TaskQueues:
 
     def __init__(self):
         self._queues: dict[int, TaskQueue] = {}
+        # monotone mutation counter over add/remove (takes during mapping/
+        # prefill are reactor-internal and show up in total_ready instead):
+        # the pipelined tick uses (membership, version, total_ready) as a
+        # cheap "could a re-solve see different inputs?" signature
+        self.version = 0
 
     def queue(self, rq_id: int) -> TaskQueue:
         q = self._queues.get(rq_id)
@@ -110,11 +115,13 @@ class TaskQueues:
         return q
 
     def add(self, rq_id: int, priority: Priority, task_id: int) -> None:
+        self.version += 1
         self.queue(rq_id).add(priority, task_id)
 
     def remove(self, rq_id: int, task_id: int) -> None:
         q = self._queues.get(rq_id)
         if q is not None:
+            self.version += 1
             q.remove(task_id)
 
     def items(self):

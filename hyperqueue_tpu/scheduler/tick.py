@@ -156,6 +156,7 @@ def run_tick(
     phases: dict | None = None,
     key_cache=None,
     decision: dict | None = None,
+    pipeline=None,
 ) -> list[Assignment]:
     """Solve one tick and pop assigned tasks from the queues.
 
@@ -175,6 +176,13 @@ def run_tick(
     `decision` (optional dict) receives the solver's verdict for this
     tick's DecisionRecord (scheduler/decision.py): status, backend,
     solve_ms, objective.
+
+    `pipeline` (a scheduler/pipeline.TickPipeline, dense path only)
+    switches this tick to ASYNC dispatch: the solve is enqueued via
+    `model.solve_async` and registered as the pipeline's pending solve,
+    and THIS call returns no assignments — the caller maps the pending
+    solve at the top of its next tick (pipeline.take_result), overlapping
+    the device execution with the inter-tick host work.
     """
     if batches is None:
         batches = create_batches(queues)
@@ -186,7 +194,7 @@ def run_tick(
         return _run_main_solve(
             queues, None, rq_map, resource_map, model, batches,
             dense=dense, phases=phases, key_cache=key_cache,
-            decision=decision,
+            decision=decision, pipeline=pipeline,
         )
     if not batches or not workers:
         return []
@@ -550,13 +558,46 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 
 def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
                     cpu_floor=None, dense=None, phases=None, key_cache=None,
-                    decision=None):
+                    decision=None, pipeline=None):
     _t0 = _time.perf_counter()
     kwargs = assemble_solve_inputs(
         workers, batches, rq_map, resource_map, cpu_floor=cpu_floor,
         dense=dense, key_cache=key_cache,
     )
     _t1 = _time.perf_counter()
+    if pipeline is not None and hasattr(model, "solve_async"):
+        # pipelined dispatch: enqueue the solve and return WITHOUT mapping
+        # — the caller maps this solve at the top of its next tick
+        # (pipeline.take_result), after the device had the whole inter-tick
+        # window to execute.  Only reachable on the dense path (run_tick),
+        # where worker_ids come from the snapshot.
+        from hyperqueue_tpu.scheduler.pipeline import PendingSolve
+
+        handle = model.solve_async(**kwargs)
+        if phases is not None:
+            phases["assemble"] = (
+                phases.get("assemble", 0.0) + (_t1 - _t0) * 1e3
+            )
+            phases["solve_dispatch"] = (
+                phases.get("solve_dispatch", 0.0)
+                + (_time.perf_counter() - _t1) * 1e3
+            )
+        if decision is not None:
+            decision.setdefault("solver", {
+                "status": "pipelined",
+                "backend": getattr(model, "last_backend", None),
+                "backend_reason": getattr(model, "last_backend_reason", ""),
+                "pipelined": True,
+            })
+        pipeline.put(PendingSolve(
+            handle=handle,
+            batches=batches,
+            worker_ids=list(dense.worker_ids),
+            queues=queues,
+            backend=getattr(model, "last_backend", None),
+            backend_reason=getattr(model, "last_backend_reason", ""),
+        ))
+        return []
     counts = model.solve(**kwargs)
     _t2 = _time.perf_counter()
     if decision is not None:
@@ -574,6 +615,7 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
         decision["solver"] = {
             "status": status,
             "backend": getattr(model, "last_backend", None),
+            "backend_reason": getattr(model, "last_backend_reason", ""),
             "solve_ms": round((_t2 - _t1) * 1e3, 4),
             "objective": int(np.asarray(counts).sum()),
         }
@@ -594,19 +636,39 @@ def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
             solve_ms - dispatch - sync, 0.0
         )
 
-    assignments: list[Assignment] = []
-    counts = np.asarray(counts)
     worker_ids = (
         dense.worker_ids if dense is not None
         else [w.worker_id for w in workers]
     )
+    return _map_counts(queues, batches, worker_ids, counts, phases=phases)
+
+
+def _map_counts(queues, batches, worker_ids, counts,
+                phases=None) -> list[Assignment]:
+    """Pop the solver's counts out of the queues as Assignment tuples.
+
+    The one mapping path for the synchronous tick AND the pipelined tick
+    (scheduler/pipeline.TickPipeline.take_result): `batches`/`worker_ids`
+    are the solve-time snapshot, `queues` is live — a cell whose tasks
+    were canceled (or stolen by prefill) while a pipelined solve was in
+    flight simply pops fewer ids than the count, which is safe.
+
+    Both backends hand over C-contiguous int32 counts (the device path
+    slices the padded volume ON the device before readback —
+    models/greedy._device_slicer), so the native nonzero fast path applies
+    everywhere.
+    """
+    _t2 = _time.perf_counter()
+    assignments: list[Assignment] = []
+    counts = np.asarray(counts)
     try:
         # one global nonzero over (B, V, W): row-major order preserves the
         # per-batch FIFO take semantics of the nested loop it replaces
         from hyperqueue_tpu.utils.native import native_nonzero
 
-        # only for already-contiguous counts (the native solve's output): a
-        # strided view from the padded device path would force a full copy
+        # both backends return contiguous int32 (host: padded-contiguous
+        # native output; device: sliced on device before readback), so this
+        # fast path is the common case on every backend now
         nz = (
             native_nonzero(counts)
             if counts.dtype == np.int32 and counts.flags.c_contiguous

@@ -202,13 +202,34 @@ class SolverWatchdog:
                 self.timeouts += 1
                 self._degrade(e)
             except Exception as e:  # noqa: BLE001 - the guard IS the point
+                self._raise_if_paranoid(e)
                 self.failures += 1
                 self._degrade(e)
         return self._run_fallback(kwargs)
 
+    @staticmethod
+    def _raise_if_paranoid(error: BaseException) -> None:
+        """--paranoid-tick contract violations must be LOUD, like
+        tick_cache.paranoid_check: degrading would both hide the bug and
+        destroy the evidence (the degrade path invalidates the resident
+        state the divergence lives in)."""
+        from hyperqueue_tpu.models.greedy import ResidentParanoidError
+
+        if isinstance(error, ResidentParanoidError):
+            raise error
+
     def _degrade(self, error: BaseException) -> None:
         self.last_error = f"{type(error).__name__}: {error}"
         self._bench_remaining = self.rearm_ticks
+        # a failed/abandoned solve may have consumed (donated) or half-
+        # updated the primary's device-resident tick state: drop it so the
+        # next primary attempt starts from a clean full upload
+        invalidate = getattr(self.model, "invalidate_resident", None)
+        if invalidate is not None:
+            try:
+                invalidate()
+            except Exception:  # noqa: BLE001 - never raise out of degrade
+                pass
         logger.critical(
             "solver failed (%s); degrading to the host greedy fallback for "
             "%d ticks",
@@ -224,6 +245,10 @@ class SolverWatchdog:
                 chaos.fire("solve")
             return self.model.solve(**kwargs)
 
+        return self._run_deadlined(call)
+
+    def _run_deadlined(self, call):
+        """Run `call` on the watchdog thread under the solve deadline."""
         if self.timeout_s <= 0:
             return call()  # exception guard only
         if self._worker is None:
@@ -236,6 +261,45 @@ class SolverWatchdog:
                 self._abandoned.append(self._worker.last_done)
             self._worker = None
             raise
+
+    # --- async solve (the pipelined tick, scheduler/pipeline.py) ---------
+    def solve_async(self, **kwargs):
+        """Guarded async dispatch: returns a handle whose `.result()` is
+        ALSO guarded — an exception or deadline overrun while materializing
+        the pending counts degrades exactly like a synchronous failure
+        (bench the primary, drop its resident device state, and solve the
+        SAME snapshot on the host fallback), so a pipelined tick can never
+        lose a solve: the pipeline's pending handle always resolves to a
+        valid counts array."""
+        self.last_solve_degraded = False
+        self.last_solve_skipped = False
+        if self.armed and hasattr(self.model, "solve_async"):
+            if self._rearm_pending:
+                self._rearm_pending = False
+                self.rearms += 1
+                logger.warning(
+                    "re-arming the primary solver (stranded solve drained)"
+                )
+
+            def dispatch():
+                if chaos.ACTIVE:
+                    chaos.fire("solve")
+                return self.model.solve_async(**kwargs)
+
+            try:
+                inner = self._run_deadlined(dispatch)
+                self._last_ran = self.model
+                return _WatchdogHandle(self, inner, kwargs)
+            except SolveTimeout as e:
+                self.timeouts += 1
+                self._degrade(e)
+            except Exception as e:  # noqa: BLE001 - the guard IS the point
+                self._raise_if_paranoid(e)
+                self.failures += 1
+                self._degrade(e)
+        # not armed / no async support / dispatch failed: solve NOW on
+        # whatever solve() would have used and box the counts
+        return _ReadyHandle(self.solve(**kwargs))
 
     def _run_fallback(self, kwargs) -> np.ndarray:
         self.last_solve_degraded = True
@@ -286,3 +350,49 @@ class SolverWatchdog:
                     )
         self._last_ran = self.fallback
         return result
+
+
+class _ReadyHandle:
+    """Async-solve handle whose counts are already materialized."""
+
+    __slots__ = ("_counts",)
+
+    def __init__(self, counts):
+        self._counts = counts
+
+    def result(self):
+        return self._counts
+
+
+class _WatchdogHandle:
+    """Deadline + exception guard around a primary model's pending solve.
+
+    `result()` materializes the inner handle on the watchdog thread with
+    the solve deadline; a timeout or exception degrades the watchdog
+    (bench + resident-state invalidation, exactly like a synchronous
+    failure) and re-solves the SAME dispatched snapshot on the host
+    fallback — the captured kwargs are the assemble output of that tick,
+    which stays untouched until the pipeline maps this handle."""
+
+    __slots__ = ("_wd", "_inner", "_kwargs")
+
+    def __init__(self, wd: "SolverWatchdog", inner, kwargs):
+        self._wd = wd
+        self._inner = inner
+        self._kwargs = kwargs
+
+    def result(self):
+        wd = self._wd
+        inner = self._inner
+        try:
+            out = wd._run_deadlined(inner.result)
+            wd._last_ran = wd.model
+            return out
+        except SolveTimeout as e:
+            wd.timeouts += 1
+            wd._degrade(e)
+        except Exception as e:  # noqa: BLE001 - the guard IS the point
+            wd._raise_if_paranoid(e)
+            wd.failures += 1
+            wd._degrade(e)
+        return wd._run_fallback(self._kwargs)

@@ -288,6 +288,7 @@ class Server:
         metrics_port: int | None = None,
         metrics_host: str = "0.0.0.0",
         flight_recorder_ticks: int = 512,
+        tick_pipeline: bool = False,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -349,6 +350,14 @@ class Server:
         # bit-identical to a from-scratch one (scheduler/tick_cache.py
         # paranoid_check; `--paranoid-tick N`)
         self.core.paranoid_tick = paranoid_tick
+        # --tick-pipeline: two-stage async ticks (scheduler/pipeline.py) —
+        # solve N dispatches without blocking and is mapped at tick N+1,
+        # overlapping device execution with the inter-tick host work.
+        # Paranoid ticks and watchdog fallbacks force the synchronous path.
+        if tick_pipeline:
+            from hyperqueue_tpu.scheduler.pipeline import TickPipeline
+
+            self.core.tick_pipeline = TickPipeline()
         # flight recorder: ring of the last N per-tick DecisionRecords +
         # control-plane events (`--flight-recorder-ticks`, 0 = off),
         # dumped by `hq server flight-recorder dump` and joined by
@@ -365,6 +374,11 @@ class Server:
             base_model = MultichipModel()
         else:
             base_model = GreedyCutScanModel()
+        # --paranoid-tick also arms the device-resident solve's own
+        # bit-exactness guard: every N resident solves re-run from a fresh
+        # full upload and assert identical counts (models/greedy.py)
+        if paranoid_tick and hasattr(base_model, "paranoid_resident"):
+            base_model.paranoid_resident = paranoid_tick
         # every solve runs behind the watchdog: a solver exception or hang
         # degrades that tick to the host greedy fallback instead of killing
         # the scheduling loop (scheduler/watchdog.py)
@@ -664,6 +678,55 @@ class Server:
                 f"hq_tick_cache_{key}_total",
                 f"tick snapshot cache {key.replace('_', ' ')}",
             ).set_total(cache.get(key, 0))
+        # solve backend + device-resident state (parallel/resident.py):
+        # which backend the last solve ran, how many bytes the device path
+        # uploaded (full + delta), and how many rows were dirty last tick
+        resident = {}
+        get_resident = getattr(self.model, "resident_stats", None)
+        if get_resident is not None:
+            try:
+                resident = get_resident()
+            except Exception:  # noqa: BLE001 - metrics must never break
+                resident = {}
+        backend_gauge = REGISTRY.gauge(
+            "hq_solve_backend",
+            "1 for the backend the last solve ran on "
+            "(host-native/host-numpy/device-jax/device-sharded)",
+            labels=("backend",), max_series=8,
+        )
+        backend_gauge.clear()
+        if resident.get("backend"):
+            backend_gauge.labels(resident["backend"]).set(1.0)
+        if resident:
+            REGISTRY.counter(
+                "hq_device_upload_bytes_total",
+                "bytes uploaded to the solve device (full uploads + "
+                "dirty-row deltas + replicated-input placements)",
+            ).set_total(resident.get("upload_bytes_total", 0))
+            REGISTRY.gauge(
+                "hq_tick_dirty_rows",
+                "worker rows the device path uploaded last solve "
+                "(delta size; W on a full upload)",
+            ).set(resident.get("dirty_rows_last", 0))
+            for key in ("full_uploads", "delta_uploads", "invalidations",
+                        "rep_cache_hits"):
+                REGISTRY.counter(
+                    f"hq_resident_{key}_total",
+                    f"device-resident tick state {key.replace('_', ' ')}",
+                ).set_total(resident.get(key, 0))
+        pipeline = core.tick_pipeline
+        if pipeline is not None:
+            ps = pipeline.stats()
+            REGISTRY.gauge(
+                "hq_tick_pipeline_depth",
+                "solves currently in flight in the async tick pipeline "
+                "(0 or 1)",
+            ).set(ps["depth"])
+            for key in ("dispatched", "mapped", "drains"):
+                REGISTRY.counter(
+                    f"hq_tick_pipeline_{key}_total",
+                    f"async tick pipeline: solves {key}",
+                ).set_total(ps[key])
         # per-worker gauges: the server's own accounting, plus whatever
         # gauges/counters the worker piggybacked on its last overview
         # message (cluster-wide re-export under a `worker` label)
@@ -1484,8 +1547,19 @@ class Server:
             "paranoid_tick": self.core.paranoid_tick,
             "scheduler": self.scheduler_kind,
             "solve_backend": getattr(self.model, "last_backend", None),
+            "solve_backend_reason": getattr(
+                self.model, "last_backend_reason", None
+            ),
             "shape_allocations": getattr(
                 self.model, "shape_allocations", None
+            ),
+            "resident": (
+                self.model.resident_stats()
+                if hasattr(self.model, "resident_stats") else None
+            ),
+            "pipeline": (
+                self.core.tick_pipeline.stats()
+                if self.core.tick_pipeline is not None else None
             ),
             "watchdog": self.model.stats(),
             "reattach_pending": len(self.reattach_pending),
@@ -2074,6 +2148,11 @@ class Server:
                         "host the gang"
                     ),
                 }.get(reason, "")
+        # the latest tick's solver verdict: which backend solved (and WHY
+        # that backend was chosen — the adaptive cost model's reason), so
+        # "why did this tick solve on the host?" is answerable from here
+        latest = self.core.flight.latest()
+        solver = (latest or {}).get("solver") or {}
         return {
             "op": "task_explain",
             "job": job_id,
@@ -2085,6 +2164,9 @@ class Server:
             "deferred_ticks": deferred,
             "decision_tick": decision_tick,
             "paused": paused,
+            "solver_backend": solver.get("backend"),
+            "solver_backend_reason": solver.get("backend_reason"),
+            "solver_pipelined": bool(solver.get("pipelined")),
             "workers": workers,
         }
 

@@ -54,6 +54,9 @@ class Core:
     # debug: every N ticks, assert the incremental assembly is
     # bit-identical to a from-scratch one (0 = off; --paranoid-tick N)
     paranoid_tick: int = 0
+    # two-stage async tick pipeline (scheduler/pipeline.TickPipeline) when
+    # the server started with --tick-pipeline; None = synchronous ticks
+    tick_pipeline: object = None
     tick_counter: int = 0
     # bumped on every change of the schedulable-worker SET (connect,
     # disconnect, gang reservation/claim/release): lets the tick cache

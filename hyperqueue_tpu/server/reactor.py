@@ -799,6 +799,49 @@ def schedule(
     # with min-utilization workers; those fall back to the from-scratch
     # WorkerRow path, whose mu carve-out needs per-worker floors.
     core.tick_counter += 1
+    # --- pipelined tick (scheduler/pipeline.py): map the solve dispatched
+    # LAST tick first — its device execution overlapped all the host work
+    # since then, so the readback is usually free.  This must happen before
+    # tick_cache.sync: applying the mapped assignments dirties the worker
+    # rows, and the snapshot this tick dispatches from has to include them
+    # (the device already does, via the donated free_after).  `--paranoid-
+    # tick` ticks force the synchronous path: the pending solve is drained
+    # here and the fresh solve below runs sync + bit-checked. ---
+    pipeline = core.tick_pipeline
+    paranoid_now = (
+        core.paranoid_tick > 0
+        and core.tick_counter % core.paranoid_tick == 0
+    )
+    if pipeline is not None and pipeline.pending is not None:
+        decision_target = decision_info if record_decision else None
+        mapped = (
+            pipeline.drain(model=model, phases=phases,
+                           decision=decision_target)
+            if paranoid_now
+            else pipeline.take_result(model=model, phases=phases,
+                                      decision=decision_target)
+        )
+        for task_id, worker_id, rq_id, variant in mapped:
+            task = core.tasks.get(task_id)
+            if task is None:
+                continue  # vanished while the solve was in flight
+            worker = core.workers.get(worker_id)
+            if worker is None:
+                # its worker disconnected while the solve was in flight:
+                # back to the queue, a later tick re-places it
+                core.queues.add(rq_id, task.priority, task_id)
+                continue
+            task.state = TaskState.ASSIGNED
+            task.t_assigned = now
+            task.assigned_worker = worker_id
+            task.assigned_variant = variant
+            worker.assign(
+                task_id, core.variant_amounts(rq_id, variant, worker)
+            )
+            per_worker_msgs.setdefault(worker_id, []).append(
+                _compute_message(core, task, variant)
+            )
+            assigned += 1
     snapshot = core.tick_cache.sync(core)
     rows = core.worker_rows() if snapshot is None else None
     leftover_batches = None
@@ -810,22 +853,53 @@ def schedule(
         _t_batches = _time.perf_counter()
         batches = create_batches(core.queues)
         phases["batches"] = (_time.perf_counter() - _t_batches) * 1e3
-        if (
-            snapshot is not None
-            and core.paranoid_tick > 0
-            and core.tick_counter % core.paranoid_tick == 0
-        ):
+        if snapshot is not None and paranoid_now:
             from hyperqueue_tpu.scheduler.tick_cache import paranoid_check
 
             paranoid_check(
                 core, snapshot, batches, core.rq_map, core.resource_map
             )
-        assignments = run_tick(
-            core.queues, rows, core.rq_map, core.resource_map, model,
-            batches=batches, dense=snapshot, phases=phases,
-            key_cache=core.tick_cache,
-            decision=decision_info if record_decision else None,
+        pipeline_this_tick = (
+            pipeline
+            if pipeline is not None and not paranoid_now
+            and snapshot is not None
+            else None
         )
+        if (
+            pipeline_this_tick is not None
+            and pipeline_this_tick.idle_sig is not None
+            and pipeline_this_tick.idle_sig == (
+                core.membership_epoch, core.queues.version,
+                core.queues.total_ready(),
+            )
+            and core.tick_cache.rows_rewritten_last == 0
+        ):
+            # the last pipelined solve mapped NOTHING and no queue
+            # mutation, membership change or worker-row drift happened
+            # since it was dispatched: a re-solve would see bit-identical
+            # inputs and assign nothing again.  Skip the dispatch — with
+            # no pending solve the end-of-tick self-request stays off, so
+            # an unplaceable backlog costs one extra tick instead of
+            # spinning at the min-delay cadence until the next event.
+            assignments = []
+        else:
+            assignments = run_tick(
+                core.queues, rows, core.rq_map, core.resource_map, model,
+                batches=batches, dense=snapshot, phases=phases,
+                key_cache=core.tick_cache,
+                decision=decision_info if record_decision else None,
+                pipeline=pipeline_this_tick,
+            )
+            if (
+                pipeline_this_tick is not None
+                and pipeline_this_tick.pending is not None
+            ):
+                # stamp the solve-input state so an EMPTY mapping next tick
+                # can prove a re-solve redundant (PendingSolve.state_sig)
+                pipeline_this_tick.pending.state_sig = (
+                    core.membership_epoch, core.queues.version,
+                    core.queues.total_ready(),
+                )
         taken_by_batch: dict[tuple[int, Priority_t], int] = {}
         for task_id, worker_id, rq_id, variant in assignments:
             task = core.tasks[task_id]
@@ -1160,6 +1234,13 @@ def schedule(
         record["duration_ms"] = round(phases["total"], 4)
         record["phases"] = {k: round(v, 4) for k, v in phases.items()}
         core.flight.record_tick(record)
+    if pipeline is not None and pipeline.pending is not None:
+        # a solve is in flight: without another event (submit, completion,
+        # worker change) no further tick would run and the pending solve
+        # would never be mapped — ask for one more pass.  The server's
+        # schedule_min_delay throttle paces the follow-up, which doubles as
+        # the window the device has to finish before the readback.
+        comm.ask_for_scheduling()
     return assigned
 
 

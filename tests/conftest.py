@@ -43,3 +43,10 @@ def pytest_configure(config):
         "metrics: metrics-plane tests (registry, exposition, scrape, "
         "timeline)",
     )
+    config.addinivalue_line(
+        "markers",
+        "multichip: sharded multi-device solver tests; run on the virtual "
+        "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
+        "count=8, set above) so tier-1 exercises the 8-device path on "
+        "CPU-only hosts",
+    )

@@ -1,0 +1,154 @@
+"""Adaptive solve-backend selection (models/greedy.py).
+
+The one-shot 63 ms-probe permanent host fallback is replaced by a
+per-solve cost model: measured host/device EWMAs per padded shape, a sync
+probe that ages out and re-probes, and a periodic device retry.  These
+tests drive the decision table directly (no accelerator needed) and the
+probe's re-probe machinery on the CPU backend.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from hyperqueue_tpu.models import greedy as greedy_mod
+from hyperqueue_tpu.models.greedy import (
+    DEVICE_RETRY_SOLVES,
+    DISPATCH_LATENCY_BUDGET_MS,
+    GreedyCutScanModel,
+    device_sync_ms,
+)
+
+SHAPE = (1024, 256, 8, 2, 4, False)
+
+
+@pytest.fixture
+def accel_model(monkeypatch):
+    """A model that believes an accelerator is visible, with a
+    controllable sync probe."""
+    model = GreedyCutScanModel()
+    monkeypatch.setattr(model, "_sticky_host", lambda: None)
+    state = {"sync": None}
+    monkeypatch.setattr(
+        greedy_mod, "device_sync_ms",
+        lambda wait_s=0.0, max_age_s=None: state["sync"],
+    )
+    return model, state
+
+
+def test_forced_backends_are_sticky():
+    numpy_model = GreedyCutScanModel(backend="numpy")
+    assert numpy_model._backend_decision(SHAPE) == ("host", "forced-numpy")
+    jax_model = GreedyCutScanModel(backend="jax")
+    assert jax_model._backend_decision(SHAPE) == ("device", "forced-jax")
+
+
+def test_cpu_host_is_sticky_host(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    model = GreedyCutScanModel()
+    assert model._backend_decision(SHAPE) == ("host", "cpu-host")
+    assert model._numpy_path() is True
+
+
+def test_probe_pending_and_failed_select_host(accel_model):
+    model, state = accel_model
+    assert model._backend_decision(SHAPE) == ("host", "sync-probe-pending")
+    state["sync"] = float("inf")
+    assert model._backend_decision(SHAPE) == ("host", "sync-probe-failed")
+
+
+def test_no_measurements_budget_rule(accel_model):
+    model, state = accel_model
+    state["sync"] = DISPATCH_LATENCY_BUDGET_MS - 1
+    assert model._backend_decision(SHAPE)[0] == "device"
+    state["sync"] = 63.0
+    backend, reason = model._backend_decision(SHAPE)
+    assert backend == "host"
+    assert "budget" in reason
+
+
+def test_device_tried_once_sync_could_beat_measured_host(accel_model):
+    model, state = accel_model
+    state["sync"] = 63.0
+    model._observe("host", SHAPE, 20.0)
+    backend, reason = model._backend_decision(SHAPE)
+    assert backend == "host"  # 63ms sync can never beat a 20ms host
+    model._observe("host", SHAPE, 200.0)
+    # EWMA moved towards 200: sync alone is now below the host estimate,
+    # so the device gets its first measurement
+    assert model._backend_decision(SHAPE) == ("device", "first-measurement")
+
+
+def test_cost_model_picks_measured_winner(accel_model):
+    model, state = accel_model
+    state["sync"] = 3.0
+    model._observe("host", SHAPE, 10.0)
+    model._observe("device", SHAPE, 5.0)
+    assert model._backend_decision(SHAPE) == ("device", "cost-model")
+    model._observe("device", SHAPE, 100.0)  # device got slow
+    backend, reason = model._backend_decision(SHAPE)
+    assert backend == "host"
+    assert "cost-model" in reason
+
+
+def test_benched_device_retries_periodically(accel_model):
+    model, state = accel_model
+    state["sync"] = 3.0
+    model._observe("host", SHAPE, 10.0)
+    model._observe("device", SHAPE, 100.0)
+    assert model._backend_decision(SHAPE)[0] == "host"
+    model._solves_since_device = DEVICE_RETRY_SOLVES
+    assert model._backend_decision(SHAPE) == ("device", "periodic-retry")
+    # an observed device solve resets the retry clock
+    model._observe("device", SHAPE, 100.0)
+    assert model._solves_since_device == 0
+    assert model._backend_decision(SHAPE)[0] == "host"
+
+
+def test_ewma_smoothing():
+    model = GreedyCutScanModel()
+    model._observe("host", SHAPE, 10.0)
+    assert model._cost["host"][SHAPE] == 10.0
+    model._observe("host", SHAPE, 20.0)
+    assert 10.0 < model._cost["host"][SHAPE] < 20.0
+
+
+def test_sync_probe_reprobes_when_stale():
+    greedy_mod._reset_probe_for_tests()
+    try:
+        first = device_sync_ms(wait_s=30.0)
+        assert first is not None and first != float("inf")
+        # age the measurement out; asking with max_age_s must RE-launch
+        # the probe in the background while still returning the old value
+        with greedy_mod._PROBE_LOCK:
+            greedy_mod._PROBE_TS = time.monotonic() - 3600.0
+        stale = device_sync_ms(max_age_s=1.0)
+        assert stale == first
+        with greedy_mod._PROBE_LOCK:
+            relaunched = greedy_mod._PROBE_RUNNING or (
+                greedy_mod._PROBE_TS > time.monotonic() - 60.0
+            )
+        assert relaunched
+        # and it resolves again
+        fresh = device_sync_ms(wait_s=30.0)
+        assert fresh is not None and fresh != float("inf")
+    finally:
+        greedy_mod._reset_probe_for_tests()
+
+
+def test_backend_reason_reaches_solve(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    model = GreedyCutScanModel()
+    U = 10_000
+    counts = model.solve(
+        free=np.array([[4 * U]], dtype=np.int32),
+        nt_free=np.array([4], dtype=np.int32),
+        lifetime=np.array([2**30], dtype=np.int32),
+        needs=np.array([[[U]]], dtype=np.int32),
+        sizes=np.array([2], dtype=np.int32),
+        min_time=np.zeros((1, 1), dtype=np.int32),
+    )
+    assert counts.sum() == 2
+    assert model.last_backend in ("host-native", "host-numpy")
+    assert model.last_backend_reason == "cpu-host"

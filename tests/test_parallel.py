@@ -5,6 +5,17 @@ construction (parallel/solve.py module docstring) — so these tests assert
 BITWISE count equality, not just totals, across random and adversarial
 instances (priorities, variants, min_time, heterogeneous workers), plus the
 production model wrapper (models/multichip.py) against GreedyCutScanModel.
+
+The device-resident path (parallel/resident.py) adds a multi-tick contract:
+delta uploads + donated buffers must stay bitwise identical to a fresh
+full-upload solve EVERY tick, across completions, worker churn (mesh-padded
+W resizes) and ALL-policy solves — the randomized soaks below drive it with
+the paranoid cross-check armed (the same check `--paranoid-tick` runs in
+production).
+
+Everything here carries the `multichip` marker: the suite runs inside
+tier-1 on CPU-only hosts because conftest.py forces the virtual 8-device
+mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8).
 """
 
 import numpy as np
@@ -14,6 +25,8 @@ import pytest
 
 from hyperqueue_tpu.models.greedy import GreedyCutScanModel
 from hyperqueue_tpu.models.multichip import MultichipModel
+
+pytestmark = pytest.mark.multichip
 from hyperqueue_tpu.ops.assign import (
     greedy_cut_scan,
     host_visit_classes,
@@ -186,3 +199,192 @@ def test_multichip_model_single_device_fallback():
     )
     assert counts.sum() == 3
     assert model._mesh is False  # degraded to the single-chip kernel
+
+
+# ---------------------------------------------------------------------------
+# device-resident multi-tick soak: delta uploads + donated buffers must be
+# bitwise identical to a fresh full-upload solve EVERY tick
+# ---------------------------------------------------------------------------
+
+def _random_tick_batches(rng, n_r, with_all=False):
+    n_b = int(rng.integers(1, 9))
+    n_v = int(rng.integers(1, 3))
+    needs = (rng.integers(0, 3, size=(n_b, n_v, n_r)) * (U // 2)).astype(
+        np.int32
+    )
+    # every batch requests something in its first variant so no batch is
+    # accidentally absent
+    needs[:, 0, 0] = np.maximum(needs[:, 0, 0], U)
+    sizes = rng.integers(0, 25, size=n_b).astype(np.int32)
+    min_time = rng.choice([0, 0, 120, 3600], size=(n_b, n_v)).astype(np.int32)
+    kwargs = dict(needs=needs, sizes=sizes, min_time=min_time)
+    if with_all and rng.random() < 0.3:
+        # ALL-policy on resource 1 for one batch: the kernel drains the
+        # whole pool; the resident mirror must track the zeroing exactly
+        # (it does — the mirror is the donated free_after read back)
+        all_mask = np.zeros((n_b, n_v, n_r), dtype=np.int32)
+        all_mask[0, 0, :] = 0
+        all_mask[0, 0, 1] = 1
+        needs[0, 0, 1] = 0
+        kwargs["all_mask"] = all_mask
+    return kwargs
+
+
+def _random_workers(rng, n_w, n_r):
+    free = (rng.integers(0, 8, size=(n_w, n_r)) * U).astype(np.int32)
+    total = free.copy()
+    nt_free = rng.integers(0, 10, size=n_w).astype(np.int32)
+    lifetime = rng.choice(
+        [600, 3600, int(INF_TIME)], size=n_w
+    ).astype(np.int32)
+    return free, total, nt_free, lifetime
+
+
+@pytest.mark.parametrize(
+    "seed",
+    [0, pytest.param(3, marks=pytest.mark.slow)],
+)
+def test_resident_multi_tick_soak_bitwise(seed):
+    """Randomized multi-tick history through ONE resident model vs a fresh
+    full-upload model per tick: counts must match bitwise every tick, with
+    completions dirtying rows, worker join/leave resizing the mesh-padded
+    W, ALL-policy ticks, and the paranoid fresh-solve cross-check armed
+    (the `--paranoid-tick` wiring)."""
+    rng = np.random.default_rng(seed)
+    n_r = 4
+    n_w = int(rng.integers(9, 20))
+    free, total, nt_free, lifetime = _random_workers(rng, n_w, n_r)
+
+    resident = MultichipModel()
+    # fresh-solve cross-check every 2nd solve (every solve is a second
+    # full sharded solve — the half cadence keeps the soak inside the
+    # tier-1 budget while still covering every shape the soak produces)
+    resident.paranoid_resident = 2
+    for tick in range(12):
+        batch_kwargs = _random_tick_batches(rng, n_r, with_all=True)
+        kwargs = dict(
+            free=free.copy(), nt_free=nt_free.copy(),
+            lifetime=lifetime.copy(),
+            **batch_kwargs,
+        )
+        if "all_mask" in batch_kwargs:
+            kwargs["total"] = total.copy()
+        out_res = resident.solve(**{k: v.copy() for k, v in kwargs.items()})
+        fresh = MultichipModel()  # no residency: full upload by definition
+        out_fresh = fresh.solve(**kwargs)
+        np.testing.assert_array_equal(
+            out_res, out_fresh,
+            err_msg=f"resident diverged from fresh at tick {tick}",
+        )
+        assert out_res.flags.c_contiguous  # device-sliced before readback
+
+        # --- evolve the host state like the reactor would ---------------
+        needs = batch_kwargs["needs"]
+        used = np.einsum(
+            "bvw,bvr->wr", out_res.astype(np.int64), needs.astype(np.int64)
+        )
+        free = (free - used).astype(np.int32)
+        if "all_mask" in batch_kwargs:
+            drained = np.einsum(
+                "bvw,bvr->wr", out_res.astype(np.int64),
+                batch_kwargs["all_mask"].astype(np.int64),
+            ) > 0
+            free[drained] = 0
+        nt_free = (nt_free - out_res.sum(axis=(0, 1))).astype(np.int32)
+        # random completions release some of what is in use
+        release_rows = rng.integers(0, 2, size=free.shape[0]).astype(bool)
+        free[release_rows] = np.minimum(
+            free[release_rows] + U * rng.integers(
+                0, 3, size=(int(release_rows.sum()), n_r)
+            ).astype(np.int64),
+            total[release_rows],
+        ).astype(np.int32)
+        nt_free[release_rows] = np.minimum(nt_free[release_rows] + 1, 10)
+        # lifetimes decay for limited workers
+        finite = lifetime < int(INF_TIME)
+        lifetime[finite] = np.maximum(lifetime[finite] - 1, 0)
+
+        # --- occasional worker churn: join/leave resizes the padded W ---
+        if rng.random() < 0.25:
+            if rng.random() < 0.5 and free.shape[0] > 6:
+                gone = int(rng.integers(0, free.shape[0]))
+                free = np.delete(free, gone, axis=0)
+                total = np.delete(total, gone, axis=0)
+                nt_free = np.delete(nt_free, gone)
+                lifetime = np.delete(lifetime, gone)
+            else:
+                nf, nt2, nn, nl = _random_workers(rng, 1, n_r)
+                free = np.concatenate([free, nf])
+                total = np.concatenate([total, nt2])
+                nt_free = np.concatenate([nt_free, nn])
+                lifetime = np.concatenate([lifetime, nl])
+
+    stats = resident.resident_stats()
+    assert stats["delta_uploads"] > 0, (
+        "the soak never exercised the dirty-row delta path"
+    )
+    assert resident.paranoid_checks > 0
+
+
+def test_resident_steady_state_uploads_only_dirty_rows():
+    """A tick whose inputs equal the donated outputs of the previous solve
+    uploads NOTHING; touching one worker row uploads a one-row delta."""
+    rng = np.random.default_rng(7)
+    n_w, n_r = 16, 4
+    free, total, nt_free, lifetime = _random_workers(rng, n_w, n_r)
+    lifetime[:] = int(INF_TIME)
+    model = MultichipModel()
+    batch = _random_tick_batches(np.random.default_rng(1), n_r)
+    kwargs = dict(
+        free=free, nt_free=nt_free, lifetime=lifetime, **batch
+    )
+    out = model.solve(**{k: v.copy() for k, v in kwargs.items()})
+    res = model._res
+    assert res.stats()["full_uploads"] == 1
+
+    # reactor-applied state == donated free_after: nothing is dirty
+    needs = batch["needs"]
+
+    def apply(free_in, nt_in, counts):
+        used = np.einsum(
+            "bvw,bvr->wr", counts.astype(np.int64), needs.astype(np.int64)
+        )
+        return (
+            (free_in - used).astype(np.int32),
+            (nt_in - counts.sum(axis=(0, 1))).astype(np.int32),
+        )
+
+    free2, nt2 = apply(free, nt_free, out)
+    out2 = model.solve(free=free2, nt_free=nt2, lifetime=lifetime, **batch)
+    assert res.dirty_rows_last == 0
+
+    # one completion dirties exactly one row
+    free3, nt3 = apply(free2, nt2, out2)
+    free3[3] = total[3]
+    nt3[3] = nt3[3] + 1
+    model.solve(free=free3, nt_free=nt3, lifetime=lifetime, **batch)
+    assert res.dirty_rows_last == 1
+    assert res.stats()["full_uploads"] == 1  # never re-uploaded in full
+
+
+def test_resident_paranoid_check_fires_on_corruption():
+    """If the resident device state ever diverged from the host's view,
+    the paranoid fresh-solve cross-check must catch it."""
+    rng = np.random.default_rng(11)
+    n_r = 4
+    free, total, nt_free, lifetime = _random_workers(rng, 12, n_r)
+    model = MultichipModel()
+    batch = _random_tick_batches(np.random.default_rng(2), n_r)
+    model.solve(free=free, nt_free=nt_free, lifetime=lifetime, **batch)
+    # corrupt the mirror so it claims the device ALREADY holds the next
+    # tick's inputs: the delta diff then uploads nothing, the solve runs on
+    # stale device state, and only the paranoid cross-check can catch it
+    res = model._res
+    nt_next = np.full_like(nt_free, 10)
+    res._m_free[: total.shape[0]] = total
+    res._m_nt[: total.shape[0]] = nt_next
+    model.paranoid_resident = 1
+    with pytest.raises(AssertionError, match="paranoid-resident"):
+        model.solve(
+            free=total.copy(), nt_free=nt_next, lifetime=lifetime, **batch,
+        )

@@ -1,0 +1,275 @@
+"""Async tick pipeline tests (scheduler/pipeline.py + --tick-pipeline).
+
+The pipelined tick dispatches solve N without blocking and maps it at tick
+N+1.  The contracts pinned here:
+
+- a dispatched solve maps to EXACTLY the assignments the synchronous tick
+  would have produced from the same snapshot (the solve is pure; mapping
+  pops the same queues);
+- the pipeline drains losslessly: paranoid ticks force the synchronous
+  path, watchdog failures resolve the pending handle through the host
+  fallback, and a worker that disconnects mid-flight gets its tasks
+  requeued instead of crashing the reactor;
+- depth is bounded at 1 and the reactor maps before it dispatches.
+"""
+
+import numpy as np
+import pytest
+
+from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+from hyperqueue_tpu.scheduler.pipeline import TickPipeline
+from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
+from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
+from hyperqueue_tpu.server.task import TaskState
+
+from utils_env import TestEnv
+
+
+def _env_with_pipeline(n_workers=3, n_tasks=16, model=None):
+    env = TestEnv(model=model)
+    env.core.tick_pipeline = TickPipeline()
+    for _ in range(n_workers):
+        env.worker(cpus=4)
+    env.submit(n=n_tasks, rqv=env.rqv(cpus=1))
+    return env
+
+
+def test_run_tick_pipelined_dispatch_then_map_equals_sync():
+    env_a = TestEnv()
+    env_b = TestEnv()
+    for env in (env_a, env_b):
+        for _ in range(3):
+            env.worker(cpus=4)
+        env.submit(n=20, rqv=env.rqv(cpus=1))
+
+    model = GreedyCutScanModel(backend="numpy")
+
+    def dense_tick(env, pipeline):
+        snap = env.core.tick_cache.sync(env.core)
+        batches = create_batches(env.core.queues)
+        return run_tick(
+            env.core.queues, None, env.core.rq_map, env.core.resource_map,
+            model, batches=batches, dense=snap, pipeline=pipeline,
+        )
+
+    # sync reference
+    sync_assignments = dense_tick(env_a, None)
+    assert sync_assignments
+
+    # pipelined: dispatch returns nothing, take_result maps the identical
+    # assignment set (same snapshot, same pure solve, same queue pops)
+    pipeline = TickPipeline()
+    out = dense_tick(env_b, pipeline)
+    assert out == []
+    assert pipeline.depth == 1
+    mapped = pipeline.take_result(model=model)
+    assert pipeline.depth == 0
+    assert sorted(mapped) == sorted(sync_assignments)
+
+
+def test_reactor_pipeline_one_tick_lag_and_completion():
+    env = _env_with_pipeline(n_workers=2, n_tasks=8)
+    # tick 1: dispatch only — nothing assigned yet, depth 1
+    assert env.schedule() == 0
+    assert env.core.tick_pipeline.depth == 1
+    # tick 2: maps tick 1's solve (2 workers x 4 cpus = 8 tasks) and
+    # dispatches the next solve over what is left
+    assigned = env.schedule()
+    assert assigned == 8
+    states = [env.state(t) for t in env.core.tasks]
+    assert all(s is TaskState.ASSIGNED for s in states)
+    env.core.sanity_check()
+
+
+def test_reactor_pipeline_requeues_for_vanished_worker():
+    env = _env_with_pipeline(n_workers=2, n_tasks=8)
+    env.schedule()  # dispatch
+    # one worker disconnects while the solve is in flight
+    gone = next(iter(env.core.workers.values()))
+    env.lose_worker(gone.worker_id)
+    before = env.core.queues.total_ready()
+    env.schedule()  # maps: the dead worker's share is requeued, not crashed
+    env.core.sanity_check()
+    alive = next(iter(env.core.workers.values()))
+    assigned = [
+        t for t in env.core.tasks.values()
+        if t.state is TaskState.ASSIGNED
+    ]
+    assert assigned, "surviving worker received its share"
+    assert all(t.assigned_worker == alive.worker_id for t in assigned)
+    # the vanished worker's tasks went back to the queues (still READY and
+    # queued, possibly re-dispatched into the new pending solve)
+    ready = [
+        t for t in env.core.tasks.values() if t.state is TaskState.READY
+    ]
+    assert ready
+    assert before > 0
+
+
+def test_paranoid_tick_forces_synchronous_path():
+    env = _env_with_pipeline(n_workers=2, n_tasks=8)
+    env.core.paranoid_tick = 1  # EVERY tick paranoid -> always synchronous
+    assigned = env.schedule()
+    assert assigned == 8  # no one-tick lag: the sync path mapped inline
+    assert env.core.tick_pipeline.depth == 0
+    assert env.core.tick_pipeline.dispatched == 0
+
+
+def test_paranoid_tick_drains_pending_before_sync_solve():
+    env = _env_with_pipeline(n_workers=2, n_tasks=8)
+    assert env.schedule() == 0  # tick 1 dispatches (not paranoid yet)
+    env.core.paranoid_tick = 1
+    # tick 2 is paranoid: drains the pending solve (8 assignments), then
+    # solves synchronously (queues empty -> nothing more)
+    assert env.schedule() == 8
+    assert env.core.tick_pipeline.depth == 0
+    assert env.core.tick_pipeline.drains == 1
+
+
+class _ExplodingHandle:
+    def result(self):
+        raise RuntimeError("device readback exploded")
+
+
+def test_watchdog_resolves_failing_pending_handle_via_fallback():
+    """A pending solve whose readback fails must still resolve: the
+    watchdog degrades, invalidates the resident state, and re-solves the
+    dispatched snapshot on the host fallback — the pipeline maps valid
+    assignments and the scheduling loop never sees the error."""
+    primary = GreedyCutScanModel(backend="numpy")
+    invalidated = []
+    primary.invalidate_resident = lambda: invalidated.append(True)
+    real_async = primary.solve_async
+    primary.solve_async = lambda **kw: _ExplodingHandle()
+    watchdog = SolverWatchdog(primary, timeout_s=5.0, rearm_ticks=2)
+
+    env = _env_with_pipeline(n_workers=2, n_tasks=8, model=watchdog)
+    assert env.schedule() == 0          # dispatch (exploding handle pending)
+    assigned = env.schedule()           # readback fails -> fallback solves
+    assert assigned == 8
+    assert watchdog.failures == 1
+    assert not watchdog.armed           # benched
+    assert invalidated                  # resident state dropped
+    env.core.sanity_check()
+    primary.solve_async = real_async
+
+
+def test_watchdog_solve_async_unarmed_returns_ready_fallback():
+    primary = GreedyCutScanModel(backend="numpy")
+    watchdog = SolverWatchdog(primary, timeout_s=0.0, rearm_ticks=3)
+    watchdog._bench_remaining = 3  # benched: fallback path
+    env = _env_with_pipeline(n_workers=1, n_tasks=4, model=watchdog)
+    assert env.schedule() == 0
+    # the pending handle is a ready box around the fallback's counts
+    assert env.core.tick_pipeline.depth == 1
+    assert env.schedule() == 4
+    assert watchdog.degraded_ticks >= 1
+
+
+def test_pipeline_canceled_task_pops_short_harmlessly():
+    """A task canceled while its solve is in flight simply is not in the
+    queue at map time: the cell pops short and nothing references it."""
+    env = _env_with_pipeline(n_workers=1, n_tasks=4)
+    env.schedule()  # dispatch over 4 ready tasks
+    # cancel one queued task mid-flight (removed from its queue)
+    victim = next(iter(env.core.tasks.values()))
+    env.cancel([victim.task_id])
+    assigned = env.schedule()
+    assert assigned == 3
+    env.core.sanity_check()
+
+
+def test_unplaceable_backlog_does_not_spin_redispatch():
+    """An unplaceable backlog must not keep the pipeline re-dispatching
+    (and re-self-requesting ticks) forever: once a solve maps EMPTY and
+    nothing changed since its dispatch, the next tick skips the dispatch
+    entirely — and a state change (a completion freeing resources) turns
+    scheduling back on."""
+    env = TestEnv()
+    env.core.tick_pipeline = TickPipeline()
+    env.worker(cpus=2)
+    ids = env.submit(n=4, rqv=env.rqv(cpus=2))
+    env.schedule()                        # dispatch over the backlog
+    assert env.schedule() == 1            # maps: 1 fits (2 of 2 cpus)
+    env.schedule()                        # maps the follow-up: empty
+    dispatched_before = env.core.tick_pipeline.dispatched
+    for _ in range(5):                    # saturated + unchanged state:
+        assert env.schedule() == 0        # no re-dispatch, no progress
+    assert env.core.tick_pipeline.dispatched == dispatched_before
+    # a completion frees resources -> scheduling resumes
+    running = [t for t in ids if env.state(t) is TaskState.ASSIGNED]
+    env.start_all_assigned()
+    env.finish(running[0])
+    env.schedule()                        # re-dispatches over freed cpus
+    assert env.core.tick_pipeline.dispatched > dispatched_before
+    assert env.schedule() == 1            # and the next task lands
+    env.core.sanity_check()
+
+
+def test_paranoid_resident_error_passes_through_watchdog():
+    """A --paranoid-tick resident divergence must surface loudly, not be
+    silently converted into a watchdog degrade (which would also destroy
+    the evidence by invalidating the resident state)."""
+    from hyperqueue_tpu.models.greedy import ResidentParanoidError
+
+    primary = GreedyCutScanModel(backend="numpy")
+
+    def exploding_solve(**kw):
+        raise ResidentParanoidError("resident diverged")
+
+    primary.solve = exploding_solve
+    watchdog = SolverWatchdog(primary, timeout_s=0.0, rearm_ticks=2)
+    import numpy as np
+    import pytest
+
+    kwargs = dict(
+        free=np.array([[10_000]], dtype=np.int32),
+        nt_free=np.array([1], dtype=np.int32),
+        lifetime=np.array([2**30], dtype=np.int32),
+        needs=np.array([[[10_000]]], dtype=np.int32),
+        sizes=np.array([1], dtype=np.int32),
+        min_time=np.zeros((1, 1), dtype=np.int32),
+    )
+    with pytest.raises(ResidentParanoidError):
+        watchdog.solve(**kwargs)
+    assert watchdog.armed  # NOT benched: the failure was the debug tool
+
+
+def test_tick_pipeline_e2e_array_completes(tmp_path):
+    """End-to-end: a server started with --tick-pipeline runs a task
+    array to completion (one-tick assignment lag is invisible to jobs)
+    and reports the pipeline counters in `hq server stats`."""
+    from utils_e2e import HqEnv
+
+    with HqEnv(tmp_path) as env:
+        env.start_server("--tick-pipeline")
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+        env.command(
+            ["submit", "--array", "0-19", "--wait", "--", "true"],
+            timeout=90,
+        )
+        jobs = __import__("json").loads(env.command(
+            ["job", "list", "--all", "--output-mode", "json"]
+        ))
+        assert jobs[0]["status"] == "finished"
+        stats = __import__("json").loads(env.command(
+            ["server", "stats", "--output-mode", "json"]
+        ))
+        pipe = stats.get("pipeline")
+        assert pipe is not None
+        assert pipe["mapped"] + pipe["drains"] >= 1
+
+
+def test_pipeline_decision_record_carries_backend_and_pipelined_flag():
+    env = _env_with_pipeline(n_workers=1, n_tasks=4)
+    env.core.flight.__init__(16)  # enable the ring
+    env.schedule()
+    env.schedule()
+    recs = env.core.flight.ticks()
+    solver = [r.get("solver") for r in recs if r.get("solver")]
+    assert any(s.get("pipelined") for s in solver)
+    mapped = [s for s in solver if s.get("status") == "ok"]
+    assert mapped and mapped[-1]["backend"] == "host-native" or (
+        mapped and mapped[-1]["backend"] in ("host-numpy", "host-native")
+    )
