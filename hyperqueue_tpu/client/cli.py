@@ -159,6 +159,9 @@ def cmd_server_start(args) -> None:
             metrics_host=args.metrics_host,
             flight_recorder_ticks=args.flight_recorder_ticks,
             tick_pipeline=args.tick_pipeline,
+            stall_budget=args.stall_budget,
+            stall_dumps=args.stall_dumps,
+            task_trace_capacity=args.task_trace_capacity,
         )
         access = await server.start()
         print(
@@ -267,6 +270,31 @@ def cmd_server_stats(args) -> None:
                 + ("snapshot" if lr.get("snapshot") else "full replay")
                 + f", {lr['tail_events']} tail events"
             )
+    lag = stats.get("lag") or {}
+    if lag:
+        print(f"{'loop lag':<16}{'mean ms':>10}{'last ms':>10}{'max ms':>10}")
+        for plane, row in lag.items():
+            print(f"{plane:<16}{row['mean_ms']:>10.3f}"
+                  f"{row['last_ms']:>10.3f}{row['max_ms']:>10.3f}")
+    stalls = stats.get("stalls") or {}
+    if stalls.get("captured"):
+        last = stalls.get("last") or {}
+        print(
+            f"reactor stalls: {stalls['captured']} over the "
+            f"{stalls.get('budget_s')}s budget — last: "
+            f"{last.get('plane')} plane held {last.get('duration_s')}s "
+            f"at tick {last.get('tick')}"
+            + (f" (dump: {last['dump']})" if last.get("dump") else "")
+        )
+    traces = stats.get("task_traces") or {}
+    if traces.get("capacity"):
+        print(
+            f"task traces: {traces.get('tasks', 0)} of "
+            f"{traces['capacity']} slots, {traces.get('spans', 0)} spans, "
+            f"{traces.get('evictions', 0)} evicted"
+        )
+    if stats.get("subscribers"):
+        print(f"event subscribers: {stats['subscribers']}")
     if stats.get("paranoid_tick"):
         print(f"paranoid-tick: every {stats['paranoid_tick']} ticks")
 
@@ -1003,7 +1031,15 @@ def cmd_submit(args) -> None:
     if args.on_notify and (args.wait or args.progress):
         notify_runner = _NotifyRunner(args)
     with _session(args) as session:
-        response = session.request({"op": "submit", "job": job_desc})
+        # trace-context stamp: the client's send clock opens every task's
+        # distributed trace (`hq task trace` client/submit span)
+        from hyperqueue_tpu.transport.framing import attach_trace
+        from hyperqueue_tpu.utils.trace import new_trace_id
+
+        response = session.request(attach_trace(
+            {"op": "submit", "job": job_desc},
+            new_trace_id(), sent_at=time.time(),
+        ))
         job_id = response["job_id"]
         if notify_runner is not None:
             notify_runner.set_job_id(job_id)
@@ -1963,6 +1999,20 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.environ.get("HQ_LOG_FORMAT", "plain"),
                    help="json: one JSON object per log line with "
                         "tick/job/task/worker correlation fields")
+    p.add_argument("--stall-budget", type=_parse_duration, default=1.0,
+                   help="reactor stall watchdog: when one work class "
+                        "(rpc/journal/solve/fanout) or the loop itself "
+                        "holds the event loop longer than this, auto-dump "
+                        "flight recorder + trace + lag stats into the "
+                        "instance dir (0 = record lag histograms only, "
+                        "never capture)")
+    p.add_argument("--stall-dumps", type=int, default=8, metavar="N",
+                   help="keep at most N stall dump files")
+    p.add_argument("--task-trace-capacity", type=int, default=16384,
+                   metavar="N",
+                   help="bound the per-task distributed-trace store to N "
+                        "tasks (`hq task trace`; 0 disables tracing "
+                        "entirely, including trace headers on the wire)")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)
@@ -2366,6 +2416,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("task_id", type=int, nargs="?", default=None,
                    help="task id (legacy two-argument form)")
     p.set_defaults(fn=cmd_task_explain)
+    p = tsub.add_parser(
+        "trace",
+        help="the task's distributed trace: client submit -> journal "
+             "commit -> solve/dispatch -> worker spawn -> completion",
+    )
+    _add_common(p)
+    p.add_argument("target",
+                   help="<job> or <job>.<task> (task defaults to 0)")
+    p.add_argument("task_id", type=int, nargs="?", default=None,
+                   help="task id (two-argument form)")
+    p.set_defaults(fn=cmd_task_trace)
     p = tsub.add_parser("notify",
                         help="send a notification from inside a task")
     _add_common(p)
@@ -2391,6 +2452,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--replay", default=None, metavar="JOURNAL",
                    help="replay a finished journal offline with time scrub")
     p.set_defaults(fn=cmd_dashboard)
+
+    # top: push-fed live cluster view (subscribe RPC — no polling)
+    p = sub.add_parser(
+        "top", help="live cluster view streamed from the subscribe RPC"
+    )
+    _add_common(p)
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="metric-sample refresh interval (seconds)")
+    p.add_argument("--once", action="store_true",
+                   help="print one sample and exit (scriptable)")
+    p.set_defaults(fn=cmd_top)
 
     # doc + completion
     p = sub.add_parser("doc", help="show documentation topics")
@@ -2475,6 +2547,60 @@ def cmd_task_explain(args) -> None:
                     )
 
 
+def cmd_task_trace(args) -> None:
+    """The task's assembled distributed trace: every span from client
+    submit through journal commit, solve dispatch, worker spawn, run and
+    completion uplink (`hq task trace <job>.<task>`)."""
+    job_id, task_id = _parse_explain_target(args)
+    with _session(args) as session:
+        result = session.request(
+            {"op": "task_trace", "job_id": job_id, "task_id": task_id or 0}
+        )
+    result.pop("op", None)
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(result)
+        return
+    spans = result.get("spans") or []
+    out.message(
+        f"task {result['job']}.{result['task']} trace "
+        f"{result['trace_id']} — {len(spans)} span(s), "
+        f"{'closed' if result.get('closed') else 'open'}, "
+        f"wall {result.get('wall_s', 0.0) * 1e3:.2f} ms"
+    )
+    if result.get("missing_hops") and result.get("closed"):
+        out.message(
+            "  missing hops: " + ", ".join(result["missing_hops"])
+        )
+    if not spans:
+        return
+    t_base = min(s["t0"] for s in spans)
+    out.message(
+        f"{'offset ms':>10} {'dur ms':>10}  "
+        f"{'span':<16} {'proc':<12} inst"
+    )
+    for s in spans:
+        out.message(
+            f"{(s['t0'] - t_base) * 1e3:>10.2f} "
+            f"{(s['t1'] - s['t0']) * 1e3:>10.2f}  "
+            f"{s['name']:<16} {s['proc']:<12} {s['instance']}"
+        )
+
+
+def cmd_top(args) -> None:
+    """Live cluster view fed by the subscribe RPC (push, not polling)."""
+    from hyperqueue_tpu.client.top import run_top
+
+    rc = run_top(
+        _server_dir(args),
+        interval=args.interval,
+        once=args.once,
+        output_mode=args.output_mode,
+    )
+    if rc:
+        raise SystemExit(rc)
+
+
 def cmd_job_submit_file(args) -> None:
     from hyperqueue_tpu.client.jobfile import JobFileError, load_job_file
 
@@ -2483,7 +2609,13 @@ def cmd_job_submit_file(args) -> None:
     except JobFileError as e:
         fail(str(e))
     with _session(args) as session:
-        response = session.request({"op": "submit", "job": job_desc})
+        from hyperqueue_tpu.transport.framing import attach_trace
+        from hyperqueue_tpu.utils.trace import new_trace_id
+
+        response = session.request(attach_trace(
+            {"op": "submit", "job": job_desc},
+            new_trace_id(), sent_at=time.time(),
+        ))
         job_id = response["job_id"]
         out = make_output(args.output_mode)
         if args.output_mode == "quiet":

@@ -186,14 +186,13 @@ class ClientSession:
         self.close()
 
 
-def stream_events(server_dir: Path, history: bool = False, filters=(),
-                  on_subscribed=None, overviews: bool = False):
-    """Generator of event records from the server's client-plane stream.
-
-    Blocking-recv based (read_frame is not cancellation-safe, so no
-    wait_for timeouts may wrap it); shared by `hq journal stream` and the
-    dashboard. on_subscribed, when given, is called once the subscription
-    request is on the wire — before the first record is read."""
+def _streaming_request(server_dir: Path, request: dict, on_subscribed=None):
+    """One authenticated client connection turned into a frame generator:
+    send `request`, yield every received frame until the server closes or
+    the consumer breaks out. Blocking-recv based (read_frame is not
+    cancellation-safe, so no wait_for timeouts may wrap it).
+    on_subscribed, when given, is called once the request is on the wire —
+    before the first frame is read."""
 
     async def _connect():
         access = serverdir.load_access(Path(server_dir))
@@ -203,13 +202,7 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
         conn = await do_authentication(
             reader, writer, ROLE_CLIENT, ROLE_SERVER, access.client_key_bytes()
         )
-        await conn.send(
-            {"op": "stream_events", "history": history,
-             "filter": list(filters),
-             # ask the server to force worker hw overviews on while this
-             # stream is attached (dashboards; SetOverviewIntervalOverride)
-             "overviews": overviews}
-        )
+        await conn.send(request)
         return conn
 
     loop = asyncio.new_event_loop()
@@ -219,8 +212,7 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
         if on_subscribed is not None:
             on_subscribed()
         while True:
-            msg = loop.run_until_complete(conn.recv())
-            yield msg
+            yield loop.run_until_complete(conn.recv())
     finally:
         # the consumer may break out of the generator at any point
         # (dashboard quit, Ctrl-C in `hq journal stream`): close the
@@ -232,3 +224,39 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
             except Exception:
                 pass
         loop.close()
+
+
+def subscribe(server_dir: Path, filters=(), sample_interval: float = 0.0,
+              buffer: int = 4096, overviews: bool = False,
+              on_subscribed=None):
+    """Generator of frames from the server's `subscribe` RPC: coalesced
+    lifecycle-event frames ({"op": "events", "records": [...]}) plus
+    periodic metric samples ({"op": "sample", ...}) when sample_interval
+    is set. This is the push feed `hq top` and the autoscaler consume —
+    no polling; a consumer that falls behind the server's bounded
+    per-subscriber queue receives a final {"op": "sub_dropped"} frame."""
+    request = {
+        "op": "subscribe",
+        "filter": list(filters),
+        "sample_interval": sample_interval,
+        "buffer": buffer,
+        "overviews": overviews,
+    }
+    for msg in _streaming_request(server_dir, request, on_subscribed):
+        yield msg
+        if msg.get("op") == "sub_dropped":
+            return
+
+
+def stream_events(server_dir: Path, history: bool = False, filters=(),
+                  on_subscribed=None, overviews: bool = False):
+    """Generator of event records from the server's client-plane stream;
+    shared by `hq journal stream` and the dashboard."""
+    request = {
+        "op": "stream_events", "history": history,
+        "filter": list(filters),
+        # ask the server to force worker hw overviews on while this
+        # stream is attached (dashboards; SetOverviewIntervalOverride)
+        "overviews": overviews,
+    }
+    yield from _streaming_request(server_dir, request, on_subscribed)

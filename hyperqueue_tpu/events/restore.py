@@ -68,6 +68,14 @@ class _RestoreAcc:
         self.task_variants: dict[tuple[int, int], int] = {}
         self.task_crashes: dict[tuple[int, int], int] = {}
         self.job_descs: dict[int, list[dict]] = {}
+        # distributed-trace reconstruction (ISSUE 8): trace ids + stamps
+        # replayed from events (or seeded whole from a snapshot), so a
+        # restored server answers `hq task trace` with the SAME unbroken
+        # trace the crashed one was assembling
+        self.task_submit_trace: dict[tuple[int, int], dict] = {}
+        self.task_wtrace: dict[tuple[int, int], dict] = {}
+        self.task_finish_wtrace: dict[tuple[int, int], dict] = {}
+        self.task_trace_seed: dict[int, dict] = {}
         # restore generation: every boot that owned this journal wrote one
         # server-uid record; a snapshot folds the pre-watermark count into
         # n_boots and tail records add to it. Fencing jumps re-issued tasks
@@ -139,6 +147,8 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
                 acc.task_instances[key] = t["instance"]
                 acc.task_variants[key] = t["variant"]
                 acc.task_maybe_running[key] = False
+    for task_id, rec in (state.get("traces") or {}).items():
+        acc.task_trace_seed[int(task_id)] = rec
     acc.n_boots = state["n_boots"]
     server.journal_uids.update(state.get("server_uids") or ())
     if state["seq"] > server._event_seq:
@@ -177,6 +187,13 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
                 job.tasks[t.get("id", 0)].submitted_at = submit_time
         job.submits.append(submit_record(desc, len(expanded)))
         acc.job_descs.setdefault(job_id, []).extend(expanded)
+        tctx = record.get("trace")
+        if isinstance(tctx, dict) and tctx.get("id"):
+            # per task, not per job: an open job accumulates submits, each
+            # with its own trace id and clocks
+            sub_trace = {**tctx, "commit_at": float(record.get("time", 0.0))}
+            for t in expanded:
+                acc.task_submit_trace[(job_id, t.get("id", 0))] = sub_trace
     elif kind == "job-opened":
         if job_id not in server.jobs.jobs:
             server.jobs.create_job(
@@ -201,6 +218,9 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
         acc.task_finished_at[(job_id, record["task"])] = float(
             record.get("time", 0.0)
         )
+        tctx = record.get("trace")
+        if isinstance(tctx, dict):
+            acc.task_finish_wtrace[(job_id, record["task"])] = tctx
     elif kind == "task-started":
         key = (job_id, record["task"])
         acc.task_instances[key] = max(
@@ -214,6 +234,12 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
             float(record.get("started_at", 0.0))
             or float(record.get("time", 0.0)),
         )
+        tctx = record.get("trace")
+        if isinstance(tctx, dict):
+            wt = dict(tctx)
+            wt["_worker"] = (record.get("workers") or [0])[0]
+            wt["_instance"] = record.get("instance", 0)
+            acc.task_wtrace[key] = wt
     elif kind == "task-restarted":
         key = (job_id, record["task"])
         acc.task_crashes[key] = record.get(
@@ -226,6 +252,98 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
     elif kind == "server-uid":
         server.journal_uids.add(record.get("server_uid") or "")
         acc.n_boots += 1
+
+
+def _rebuild_traces(server, acc: _RestoreAcc) -> None:
+    """Reassemble the per-task trace store from what the journal (and/or
+    snapshot) preserved, mirroring the spans the live EventBridge records.
+    Span dedup on (name, instance) makes seeding + tail replay idempotent,
+    so a snapshot-seeded trace merged with tail events stays ONE trace."""
+    traces = server.core.traces
+    if not traces.enabled:
+        return
+    for task_id, rec in acc.task_trace_seed.items():
+        traces.seed(task_id, rec)
+    keys = (
+        set(acc.task_submit_trace)
+        | set(acc.task_wtrace)
+        | set(acc.task_finish_wtrace)
+    )
+    for key in sorted(keys):
+        job_id, job_task_id = key
+        task_id = make_task_id(job_id, job_task_id)
+        sub = acc.task_submit_trace.get(key) or {}
+        wt = acc.task_wtrace.get(key) or {}
+        fin = acc.task_finish_wtrace.get(key) or {}
+        trace_id = sub.get("id") or wt.get("id") or fin.get("id")
+        if traces.get(task_id) is None:
+            if not trace_id:
+                continue
+            traces.begin(task_id, trace_id)
+        instance = wt.get("_instance", acc.task_instances.get(key, 0))
+        wid = wt.get("_worker", 0)
+        parent = None
+        sent = float(sub.get("sent_at") or 0.0)
+        recv = float(sub.get("recv_at") or 0.0)
+        commit = float(sub.get("commit_at") or 0.0)
+        if sent and recv:
+            parent = traces.span(
+                task_id, "client/submit", sent, recv, "client",
+            ) or parent
+        if recv and commit:
+            parent = traces.span(
+                task_id, "server/submit", recv, commit, "server",
+                parent=parent,
+            ) or parent
+        stamps = acc.task_started_at.get(key)
+        if stamps is not None:
+            queued, assigned, _started = stamps
+            if queued and assigned:
+                parent = traces.span(
+                    task_id, "server/queue", queued, assigned, "server",
+                    instance, parent,
+                ) or parent
+            accepted = wt.get("accepted_at")
+            if assigned and accepted:
+                parent = traces.span(
+                    task_id, "server/dispatch", assigned, accepted,
+                    "server", instance, parent,
+                ) or parent
+            launch = wt.get("launch_at")
+            if accepted and launch:
+                parent = traces.span(
+                    task_id, "worker/accept", accepted, launch,
+                    f"worker:{wid}", instance, parent,
+                ) or parent
+            spawned = wt.get("spawned_at")
+            if launch and spawned:
+                parent = traces.span(
+                    task_id, "worker/spawn", launch, spawned,
+                    f"worker:{wid}", instance, parent,
+                ) or parent
+        if fin:
+            terminal_at = acc.task_finished_at.get(key, 0.0)
+            spawned = fin.get("spawned_at") or (
+                stamps[2] if stamps else 0.0
+            )
+            exited = fin.get("exited_at")
+            if spawned and exited:
+                parent = traces.span(
+                    task_id, "worker/run", spawned, exited,
+                    f"worker:{wid}", instance, parent,
+                ) or parent
+            sent_up = fin.get("sent_at")
+            if sent_up and terminal_at:
+                parent = traces.span(
+                    task_id, "worker/uplink", sent_up, terminal_at,
+                    f"worker:{wid}", instance, parent,
+                ) or parent
+            if terminal_at:
+                traces.span(
+                    task_id, "server/commit", terminal_at, terminal_at,
+                    "server", instance, parent,
+                )
+            traces.close(task_id)
 
 
 def restore_from_journal(server) -> None:
@@ -399,6 +517,7 @@ def restore_from_journal(server) -> None:
         if new_tasks:
             reactor.on_new_tasks(server.core, server.comm, new_tasks)
             resubmitted += len(new_tasks)
+    _rebuild_traces(server, acc)
     duration = time.perf_counter() - t_restore0
     _RESTORE_SECONDS.observe(duration)
     server.last_restore = {

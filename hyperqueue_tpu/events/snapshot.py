@@ -173,9 +173,20 @@ def capture_state(server) -> dict:
             "done": done,
             "pending": pending,
         })
+    # live tasks' distributed traces (utils/trace.py TaskTraceStore): the
+    # GC'd journal prefix held their submit/start events, so the snapshot
+    # must carry the assembled spans or a snapshot-seeded restore would
+    # break the "one unbroken trace across restart" contract. Terminal
+    # tasks are excluded — bounded by live state like everything else here.
+    live_task_ids = [
+        make_task_id(jd["id"], t["id"])
+        for jd in jobs_out
+        for t in jd["pending"]
+    ]
     return {
         "version": VERSION,
         "time": time.time(),
+        "traces": core.traces.snapshot_live(live_task_ids),
         # event-seq watermark: every event with seq < this is folded into
         # the snapshot; restore replays only seq >= this from the journal
         "seq": server._event_seq,

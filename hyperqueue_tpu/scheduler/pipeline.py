@@ -45,6 +45,10 @@ class PendingSolve:
     backend: str | None       # model.last_backend at dispatch
     backend_reason: str       # model.last_backend_reason at dispatch
     dispatched_at: float = field(default_factory=_time.perf_counter)
+    # wall-clock dispatch stamp: the Perfetto export places the pipelined
+    # solve's execution window by these recorded stamps instead of charging
+    # it to the tick that happens to MAP it (PR 8 satellite)
+    dispatched_wall: float = field(default_factory=_time.time)
     # (membership_epoch, queues.version, total_ready) at dispatch: the
     # reactor stamps it and, when this solve maps EMPTY and the signature
     # still matches (and no worker row moved), skips re-dispatching — an
@@ -122,6 +126,10 @@ class TickPipeline:
                 # kept separately for context
                 "solve_ms": round(self.last_wait_ms, 4),
                 "inflight_ms": round((_t1 - pending.dispatched_at) * 1e3, 1),
+                # recorded dispatch/readback wall stamps: the trace export
+                # renders the solve where it actually EXECUTED
+                "dispatched_at_wall": pending.dispatched_wall,
+                "mapped_at_wall": _time.time(),
                 "objective": int(np.asarray(counts).sum()),
             }
         assignments = _map_counts(

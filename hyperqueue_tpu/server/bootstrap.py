@@ -58,6 +58,21 @@ _WORKER_MESSAGES_TOTAL = REGISTRY.counter(
     "uplink messages processed on the worker plane",
     labels=("op",),
 )
+_SUBSCRIBERS_DROPPED = REGISTRY.counter(
+    "hq_subscribers_dropped_total",
+    "subscribe-RPC consumers dropped because their bounded event queue "
+    "overflowed (slow consumer)",
+)
+_SUB_EVENTS_DROPPED = REGISTRY.counter(
+    "hq_sub_events_dropped_total",
+    "events not delivered to subscribers whose queue had overflowed",
+)
+_REACTOR_STALLS = REGISTRY.counter(
+    "hq_reactor_stalls_total",
+    "reactor stall-watchdog captures: a work class held the event loop "
+    "past --stall-budget (flight recorder + trace dumped)",
+    labels=("plane",),
+)
 
 # reusable/stateless, so one instance serves every frame
 _NOOP_BATCH = contextlib.nullcontext()
@@ -110,6 +125,12 @@ class CommSender:
         # ~512 serialized bodies into 1
         shared: list[dict] = []
         index: dict[int, int] = {}
+        # trace ids dedup the same way: one submit's array shares ONE
+        # trace id, so the frame carries it once and each task an index —
+        # on the pure-python ChaCha fallback the 17-byte id string per
+        # task was measurable encryption work at 512-task batches
+        shared_traces: list = []
+        trace_index: dict[str, int] = {}
         out = []
         for msg in tasks:
             body = msg.get("body")
@@ -122,11 +143,19 @@ class CommSender:
             slim = dict(msg)
             del slim["body"]
             slim["b"] = idx
+            tr = slim.get("trace")
+            if tr is not None:
+                ti = trace_index.get(tr[0])
+                if ti is None:
+                    ti = len(shared_traces)
+                    trace_index[tr[0]] = ti
+                    shared_traces.append(tr[0])
+                slim["trace"] = [ti, tr[1]]
             out.append(slim)
-        self._send(
-            worker_id,
-            {"op": "compute", "tasks": out, "shared_bodies": shared},
-        )
+        payload = {"op": "compute", "tasks": out, "shared_bodies": shared}
+        if shared_traces:
+            payload["shared_traces"] = shared_traces
+        self._send(worker_id, payload)
 
     def send_cancel(self, worker_id: int, task_ids: list[int]) -> None:
         self._send(worker_id, {"op": "cancel", "task_ids": task_ids})
@@ -157,13 +186,118 @@ class CommSender:
         self.scheduling_event.set()
 
 
+class _Subscriber:
+    """One subscribe-RPC consumer: a BOUNDED event queue plus its filter.
+
+    The reactor never blocks on a subscriber: events are put_nowait into
+    the queue, and a full queue marks the subscriber dead (dropped with a
+    counter) instead of growing without bound — the backpressure contract
+    the autoscaler feed and `hq top` rely on.
+    """
+
+    __slots__ = ("queue", "prefixes", "sample_interval", "dropped", "dead")
+
+    def __init__(self, prefixes: tuple, sample_interval: float,
+                 buffer: int = 4096):
+        self.queue: asyncio.Queue = asyncio.Queue(
+            maxsize=min(max(int(buffer), 64), 65536)
+        )
+        self.prefixes = prefixes
+        self.sample_interval = sample_interval
+        self.dropped = 0
+        self.dead = False
+
+
 class EventBridge:
     """reactor.EventSink -> jobs layer + waiters (+ journal, task 6)."""
 
     def __init__(self, server: "Server"):
         self.server = server
 
-    def on_task_started(self, task_id, instance_id, worker_ids, variant=0):
+    def _record_start_spans(
+        self, task, task_id, instance_id, worker_ids, wtrace
+    ) -> None:
+        """Fold the worker's task_running stamps + the core task's
+        lifecycle stamps into the trace store. Deduplicated on
+        (span, instance), so a reattach re-reporting the same incarnation
+        keeps ONE unbroken trace."""
+        traces = self.server.core.traces
+        if not traces.enabled or task is None:
+            return
+        wt = wtrace or {}
+        wid = worker_ids[0] if worker_ids else 0
+        parent = traces.last_span_id(task_id)
+        if task.t_ready and task.t_assigned:
+            parent = traces.span(
+                task_id, "server/queue", task.t_ready, task.t_assigned,
+                "server", instance_id, parent,
+            ) or parent
+        accepted = wt.get("accepted_at")
+        if task.t_assigned and accepted:
+            parent = traces.span(
+                task_id, "server/dispatch", task.t_assigned, accepted,
+                "server", instance_id, parent,
+            ) or parent
+        launch = wt.get("launch_at")
+        if accepted and launch:
+            parent = traces.span(
+                task_id, "worker/accept", accepted, launch,
+                f"worker:{wid}", instance_id, parent,
+            ) or parent
+        spawned = wt.get("spawned_at")
+        if launch and spawned:
+            traces.span(
+                task_id, "worker/spawn", launch, spawned,
+                f"worker:{wid}", instance_id, parent,
+            )
+
+    def _record_finish_spans(self, task_id, wtrace) -> None:
+        """Completion-side spans (run / uplink / commit) from the worker's
+        task_finished/task_failed stamps. The worker re-sends spawned_at so
+        a trace whose start event died in a crashed server's lost journal
+        tail still closes with the execution span intact."""
+        traces = self.server.core.traces
+        if not traces.enabled:
+            return
+        rec = traces.get(task_id)
+        task = self.server.core.tasks.get(task_id)
+        instance = task.instance_id if task else 0
+        if rec is None and task is None:
+            return
+        wt = wtrace or {}
+        now = time.time()
+        # the reactor released resources (assigned_worker = 0) before this
+        # sink fires: the worker identity lives in the earlier worker spans
+        wid = task.assigned_worker if task else 0
+        if not wid and rec is not None:
+            for s in reversed(rec["spans"]):
+                if s["proc"].startswith("worker:"):
+                    wid = s["proc"].partition(":")[2]
+                    break
+        parent = traces.last_span_id(task_id)
+        spawned = wt.get("spawned_at") or (task.t_started if task else 0.0)
+        exited = wt.get("exited_at")
+        if spawned and exited:
+            parent = traces.span(
+                task_id, "worker/run", spawned, exited,
+                f"worker:{wid}", instance, parent,
+            ) or parent
+        sent = wt.get("sent_at")
+        if sent:
+            parent = traces.span(
+                task_id, "worker/uplink", sent, now,
+                f"worker:{wid}", instance, parent,
+            ) or parent
+        # commit time == receive time at trace resolution: the journal
+        # group-commit covers the whole frame at block exit
+        traces.span(
+            task_id, "server/commit", now, now, "server",
+            instance, parent,
+        )
+        traces.close(task_id)
+
+    def on_task_started(self, task_id, instance_id, worker_ids, variant=0,
+                        wtrace=None):
         task = self.server.core.tasks.get(task_id)
         # the core task's lifecycle stamps ride along: started_at survives a
         # reattach (the task never stopped running through the outage), and
@@ -174,18 +308,26 @@ class EventBridge:
             task_id_job(task_id), task_id, worker_ids,
             started_at=started_at or None,
         )
+        self._record_start_spans(task, task_id, instance_id, worker_ids,
+                                 wtrace)
         # instance + chosen variant ride along (reference task-started
         # events carry instance/worker/variant, tests/test_events.py
         # test_event_running_variant)
-        self.server.emit_event(
-            "task-started",
-            {"job": task_id_job(task_id), "task": task_id_task(task_id),
-             "workers": worker_ids, "instance": instance_id,
-             "variant": variant,
-             "queued_at": task.t_ready if task else 0.0,
-             "assigned_at": task.t_assigned if task else 0.0,
-             "started_at": started_at},
-        )
+        payload = {
+            "job": task_id_job(task_id), "task": task_id_task(task_id),
+            "workers": worker_ids, "instance": instance_id,
+            "variant": variant,
+            "queued_at": task.t_ready if task else 0.0,
+            "assigned_at": task.t_assigned if task else 0.0,
+            "started_at": started_at,
+        }
+        # the worker-side stamps + trace id ride the journal event so a
+        # restored server rebuilds the SAME trace (replay feeds them back
+        # through events/restore.py)
+        trace_id = self.server.core.traces.trace_id(task_id)
+        if trace_id is not None:
+            payload["trace"] = {"id": trace_id, **(wtrace or {})}
+        self.server.emit_event("task-started", payload)
 
     def on_task_restarted(self, task_id):
         self.server.jobs.on_task_restarted(task_id_job(task_id), task_id)
@@ -199,31 +341,42 @@ class EventBridge:
              "instance": task.instance_id if task else 0},
         )
 
-    def on_task_finished(self, task_id):
+    def _terminal_trace_payload(self, task_id, wtrace) -> dict | None:
+        trace_id = self.server.core.traces.trace_id(task_id)
+        if trace_id is None:
+            return None
+        return {"id": trace_id, **(wtrace or {})}
+
+    def on_task_finished(self, task_id, wtrace=None):
         self.server.reattach_pending.pop(task_id, None)
         self.server.jobs.on_task_finished(task_id_job(task_id), task_id)
-        self.server.emit_event(
-            "task-finished",
-            {"job": task_id_job(task_id), "task": task_id_task(task_id)},
-        )
+        self._record_finish_spans(task_id, wtrace)
+        payload = {"job": task_id_job(task_id), "task": task_id_task(task_id)}
+        trace = self._terminal_trace_payload(task_id, wtrace)
+        if trace is not None:
+            payload["trace"] = trace
+        self.server.emit_event("task-finished", payload)
         self.server.check_job_completion(task_id_job(task_id))
 
-    def on_task_failed(self, task_id, message):
+    def on_task_failed(self, task_id, message, wtrace=None):
         self.server.reattach_pending.pop(task_id, None)
         to_cancel = self.server.jobs.on_task_failed(
             task_id_job(task_id), task_id, message
         )
-        self.server.emit_event(
-            "task-failed",
-            {"job": task_id_job(task_id), "task": task_id_task(task_id),
-             "error": message},
-        )
+        self._record_finish_spans(task_id, wtrace)
+        payload = {"job": task_id_job(task_id), "task": task_id_task(task_id),
+                   "error": message}
+        trace = self._terminal_trace_payload(task_id, wtrace)
+        if trace is not None:
+            payload["trace"] = trace
+        self.server.emit_event("task-failed", payload)
         if to_cancel:
             self.server.schedule_cancel(to_cancel)
         self.server.check_job_completion(task_id_job(task_id))
 
     def on_task_canceled(self, task_id):
         self.server.reattach_pending.pop(task_id, None)
+        self.server.core.traces.close(task_id)  # eviction candidate
         self.server.jobs.on_task_canceled(task_id_job(task_id), task_id)
         self.server.emit_event(
             "task-canceled",
@@ -289,6 +442,9 @@ class Server:
         metrics_host: str = "0.0.0.0",
         flight_recorder_ticks: int = 512,
         tick_pipeline: bool = False,
+        stall_budget: float = 1.0,
+        stall_dumps: int = 8,
+        task_trace_capacity: int = 16384,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -363,8 +519,28 @@ class Server:
         # dumped by `hq server flight-recorder dump` and joined by
         # `hq task explain` / `hq server trace export`
         from hyperqueue_tpu.utils.flight import FlightRecorder
+        from hyperqueue_tpu.utils.trace import LagTracker, TaskTraceStore
 
         self.core.flight = FlightRecorder(flight_recorder_ticks)
+        # per-task distributed traces (`hq task trace`): bounded store,
+        # `--task-trace-capacity 0` disables the whole plane (no store, no
+        # trace headers on compute messages, no worker stamps)
+        self.core.traces = TaskTraceStore(task_trace_capacity)
+        # reactor loop-lag tracking + stall watchdog: every work class
+        # (rpc/journal/solve/fanout) and the loop's own sleep-overshoot
+        # feed hq_reactor_lag_seconds; an observation over --stall-budget
+        # seconds auto-captures a flight-recorder + trace dump
+        # (`--stall-budget 0` keeps the histograms but never captures)
+        self.lag = LagTracker()
+        self.stall_budget = float(stall_budget)
+        self.stall_dumps = max(int(stall_dumps), 1)
+        self.stalls_captured = 0
+        self.last_stall: dict | None = None
+        self._last_stall_capture = 0.0
+        # subscribe-RPC consumers: bounded per-subscriber queues; slow
+        # consumers are dropped (counter), never allowed to grow the queue
+        # without bound (the autoscaler/`hq top` feed)
+        self._subscribers: list[_Subscriber] = []
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
@@ -526,6 +702,7 @@ class Server:
         self.autoalloc.start()
         self._tasks.append(self._spawn_loop(self._scheduler_loop))
         self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
+        self._tasks.append(self._spawn_loop(self._loop_lag_monitor))
         if self.journal is not None and (
             self.journal_flush_period > 0 or self.journal_fsync == "periodic"
         ):
@@ -627,6 +804,24 @@ class Server:
         REGISTRY.gauge(
             "hq_event_listeners", "attached event-stream clients"
         ).set(len(self._event_listeners))
+        # subscription plane (subscribe RPC) + per-task trace store health
+        REGISTRY.gauge(
+            "hq_event_subscribers", "attached subscribe-RPC consumers"
+        ).set(len(self._subscribers))
+        REGISTRY.gauge(
+            "hq_sub_queue_depth",
+            "deepest per-subscriber backlog of undelivered events",
+        ).set(
+            max((s.queue.qsize() for s in self._subscribers), default=0)
+        )
+        trace_stats = core.traces.stats()
+        REGISTRY.gauge(
+            "hq_task_traces", "tasks with spans in the bounded trace store"
+        ).set(trace_stats["tasks"])
+        REGISTRY.counter(
+            "hq_task_trace_evictions_total",
+            "task traces evicted from the bounded store",
+        ).set_total(trace_stats["evictions"])
         REGISTRY.gauge(
             "hq_event_stream_depth",
             "deepest per-listener backlog of undelivered events",
@@ -815,7 +1010,11 @@ class Server:
                 kind,
                 {k: v for k, v in payload.items() if k != "desc"},
             )
-        if self.journal is None and not self._event_listeners:
+        if (
+            self.journal is None
+            and not self._event_listeners
+            and not self._subscribers
+        ):
             return  # nobody consumes events; skip record construction
         record = {"time": time.time(), "seq": self._event_seq,
                   "event": kind, **payload}
@@ -845,6 +1044,21 @@ class Server:
             chaos.fire("server.event", event=kind)
         for q in self._event_listeners:
             q.put_nowait(record)
+        for sub in self._subscribers:
+            if sub.dead:
+                _SUB_EVENTS_DROPPED.inc()
+                continue
+            if sub.prefixes and not kind.startswith(sub.prefixes):
+                continue
+            try:
+                sub.queue.put_nowait(record)
+            except asyncio.QueueFull:
+                # slow consumer: drop IT, not the reactor's latency — its
+                # streaming loop notices `dead` and closes the connection
+                sub.dead = True
+                sub.dropped += 1
+                _SUBSCRIBERS_DROPPED.inc()
+                _SUB_EVENTS_DROPPED.inc()
 
     def schedule_cancel(self, task_ids: list[int]) -> None:
         reactor.on_cancel_tasks(self.core, self.comm, self.events, task_ids)
@@ -926,6 +1140,9 @@ class Server:
             t0 = time.perf_counter()
             n = reactor.schedule(self.core, self.comm, self.events, self.model)
             TRACER.record("scheduler/tick", time.perf_counter() - t0)
+            # the tick runs synchronously on the loop: its duration IS the
+            # solve plane's loop occupancy (stall watchdog included)
+            self.note_plane("solve", time.perf_counter() - t0)
             if n:
                 logger.debug(
                     "tick assigned %d tasks in %.2f ms",
@@ -1385,10 +1602,12 @@ class Server:
                 batch = injected
                 if not batch:
                     continue
+            t0 = time.perf_counter()
             if len(batch) == 1:
                 await conn.send(batch[0])
             else:
                 await conn.send({"op": "batch", "msgs": batch})
+            self.note_plane("fanout", time.perf_counter() - t0)
 
     async def _worker_recv_loop(self, conn: Connection, worker: Worker) -> None:
         while True:
@@ -1415,20 +1634,26 @@ class Server:
             # event the batch produced, and nothing externally visible
             # (sender queues, client replies, event listeners) runs before
             # the commit, preserving durability-before-visibility
+            t0 = time.perf_counter()
             with self._journal_group_commit():
                 for sub in subs:
                     self._process_worker_message(worker, sub)
+            # frame processing + group commit hold the loop synchronously:
+            # that is the journal plane's loop occupancy
+            self.note_plane("journal", time.perf_counter() - t0)
 
     def _process_worker_message(self, worker: Worker, msg: dict) -> None:
             op = msg.get("op")
             _WORKER_MESSAGES_TOTAL.labels(str(op)).inc()
             if op == "task_running":
                 reactor.on_task_running(
-                    self.core, self.events, msg["id"], msg["instance"]
+                    self.core, self.events, msg["id"], msg["instance"],
+                    wtrace=msg.get("trace"),
                 )
             elif op == "task_finished":
                 reactor.on_task_finished(
-                    self.core, self.comm, self.events, msg["id"], msg["instance"]
+                    self.core, self.comm, self.events, msg["id"],
+                    msg["instance"], wtrace=msg.get("trace"),
                 )
             elif op == "task_failed":
                 reactor.on_task_failed(
@@ -1438,6 +1663,7 @@ class Server:
                     msg["id"],
                     msg["instance"],
                     msg.get("error", "task failed"),
+                    wtrace=msg.get("trace"),
                 )
             elif op == "retract_response":
                 reactor.on_retract_response(
@@ -1492,6 +1718,9 @@ class Server:
                 if msg.get("op") == "stream_events":
                     await self._stream_events(conn, msg)
                     break
+                if msg.get("op") == "subscribe":
+                    await self._subscribe(conn, msg)
+                    break
                 response = await self._handle_client_message(msg)
                 if response is not None:
                     await conn.send(response)
@@ -1505,6 +1734,14 @@ class Server:
         finally:
             writer.close()
 
+    # client ops that legitimately await external progress (job completion,
+    # executor-offloaded compaction, manager dry-runs): their wall time is
+    # waiting, not loop occupancy, so they stay out of the rpc lag plane
+    _RPC_LAG_EXEMPT = frozenset({
+        "job_wait", "journal_compact", "journal_prune", "alloc_add",
+        "alloc_dry_run", "alloc_remove",
+    })
+
     async def _handle_client_message(self, msg: dict) -> dict | None:
         op = msg.get("op")
         if not isinstance(op, str):
@@ -1512,11 +1749,15 @@ class Server:
         handler = getattr(self, f"_client_{op.replace('-', '_')}", None)
         if handler is None:
             return {"op": "error", "message": f"unknown operation {op!r}"}
+        t0 = time.perf_counter()
         try:
             return await handler(msg)
         except Exception as e:  # noqa: BLE001 - client errors must not kill the server
             logger.exception("error handling client %r", op)
             return {"op": "error", "message": str(e)}
+        finally:
+            if op not in self._RPC_LAG_EXEMPT:
+                self.note_plane("rpc", time.perf_counter() - t0)
 
     async def _client_server_info(self, msg: dict) -> dict:
         return {
@@ -1565,6 +1806,16 @@ class Server:
             "reattach_pending": len(self.reattach_pending),
             "journal": await self._journal_stats_brief(),
             "trace": TRACER.snapshot(recent=0),
+            # ISSUE 8: loop-lag per plane, stall captures, trace store +
+            # subscription plane health
+            "lag": self.lag.snapshot(),
+            "stalls": {
+                "budget_s": self.stall_budget,
+                "captured": self.stalls_captured,
+                "last": self.last_stall,
+            },
+            "task_traces": self.core.traces.stats(),
+            "subscribers": len(self._subscribers),
         }
 
     async def _journal_stats_brief(self) -> dict | None:
@@ -1605,6 +1856,11 @@ class Server:
 
         REGISTRY.reset()
         TRACER.reset()
+        # the rolling per-plane lag SpanStats live OUTSIDE the registry
+        # (they feed `hq server stats` + stall dumps) and must clear with
+        # the rest of the window, like the hq_span_seconds SpanStats do —
+        # a steady-state measurement must not inherit startup lag maxima
+        self.lag.reset()
         self.core.tick_stats = TickPhaseStats()
         self.model.reset_stats()
         self.core.tick_cache.full_rebuilds = 0
@@ -1703,6 +1959,7 @@ class Server:
         return {"op": "ok"}
 
     async def _client_submit(self, msg: dict) -> dict:
+        recv_at = time.time()
         job_desc = msg["job"]
         job_id = job_desc.get("job_id")
         if job_id is not None and job_id in self.jobs.jobs:
@@ -1719,10 +1976,36 @@ class Server:
             )
         new_tasks = self._build_tasks(job, job_desc)
         job.submits.append(submit_record(job_desc, len(new_tasks)))
+        # trace-context (ISSUE 8): the client stamped a trace id + its send
+        # clock; every task of this submit joins that trace, and the ids
+        # ride the journal event so restore rebuilds the SAME trace
+        from hyperqueue_tpu.transport.framing import read_trace
+        from hyperqueue_tpu.utils.trace import new_trace_id
+
+        tctx = read_trace(msg) or {}
+        trace_id = tctx.get("id") or new_trace_id()
+        sent_at = float(tctx.get("sent_at") or 0.0)
         self.emit_event(
             "job-submitted", {"job": job.job_id, "desc": job_desc,
-                              "n_tasks": len(new_tasks)}
+                              "n_tasks": len(new_tasks),
+                              "trace": {"id": trace_id, "sent_at": sent_at,
+                                        "recv_at": recv_at}}
         )
+        traces = self.core.traces
+        if traces.enabled:
+            commit_at = time.time()
+            for task in new_tasks:
+                traces.begin(task.task_id, trace_id)
+                parent = None
+                if sent_at:
+                    parent = traces.span(
+                        task.task_id, "client/submit", sent_at, recv_at,
+                        "client",
+                    )
+                traces.span(
+                    task.task_id, "server/submit", recv_at, commit_at,
+                    "server", parent=parent,
+                )
         reactor.on_new_tasks(self.core, self.comm, new_tasks)
         return {"op": "submit_response", "job_id": job.job_id,
                 "n_tasks": len(new_tasks)}
@@ -2244,7 +2527,8 @@ class Server:
             name_worker(wid, past.get("hostname", ""))
 
         # scheduler row: one slice per recorded tick + a ready-queue counter
-        for rec in self.core.flight.ticks():
+        ticks = self.core.flight.ticks()
+        for rec in ticks:
             ts = rec["time"] * 1e6
             events.append({
                 "ph": "X", "pid": 0, "tid": 0, "ts": ts,
@@ -2265,7 +2549,61 @@ class Server:
                 },
             })
 
-        # worker rows: one slice per task execution span
+        # solver row (pid 1): one slice per solve, placed by its RECORDED
+        # dispatch/readback wall stamps. Under --tick-pipeline, tick k+1
+        # maps the solve DISPATCHED at tick k — charging its solve_ms to
+        # the mapping tick's row misattributes the span (it shows the
+        # readback wait at the wrong time and hides the overlapped device
+        # execution).  The wall stamps render the true execution window;
+        # sync solves draw inside their own tick with solve_ms.
+        events.append({
+            "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+            "args": {"name": "hq-solver"},
+        })
+        events.append({
+            "ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+            "args": {"name": "solve plane"},
+        })
+        for rec in ticks:
+            solver = rec.get("solver") or {}
+            solve_ms = solver.get("solve_ms") or 0.0
+            if solver.get("pipelined"):
+                disp = solver.get("dispatched_at_wall") or 0.0
+                mapped = solver.get("mapped_at_wall") or 0.0
+                if disp and mapped:
+                    events.append({
+                        "ph": "X", "pid": 1, "tid": 0, "ts": disp * 1e6,
+                        "dur": max((mapped - disp) * 1e6, 1.0),
+                        "cat": "solve",
+                        "name": f"solve → tick {rec['tick']}",
+                        "args": {
+                            "pipelined": True,
+                            "backend": solver.get("backend"),
+                            # the tick-critical-path cost vs the full
+                            # dispatch->map window (DecisionRecord
+                            # solve_ms vs inflight_ms)
+                            "readback_wait_ms": solve_ms,
+                            "inflight_ms": solver.get("inflight_ms"),
+                            "objective": solver.get("objective"),
+                        },
+                    })
+            elif solve_ms:
+                events.append({
+                    "ph": "X", "pid": 1, "tid": 0, "ts": rec["time"] * 1e6,
+                    "dur": max(solve_ms * 1e3, 1.0),
+                    "cat": "solve", "name": f"solve tick {rec['tick']}",
+                    "args": {
+                        "pipelined": False,
+                        "backend": solver.get("backend"),
+                        "solve_ms": solve_ms,
+                        "objective": solver.get("objective"),
+                    },
+                })
+
+        # worker rows: one slice per task execution span, linked to the
+        # scheduler row with flow events (the per-task causal trace made
+        # visible: dispatch on the scheduler row flows into the execution
+        # slice on the worker row)
         for job in self.jobs.jobs.values():
             for info in job.tasks.values():
                 if not info.started_at:
@@ -2273,9 +2611,9 @@ class Server:
                 wid = info.worker_ids[0] if info.worker_ids else 0
                 name_worker(wid)
                 end = info.finished_at or now
-                core_task = self.core.tasks.get(
-                    make_task_id(job.job_id, info.job_task_id)
-                )
+                task_id = make_task_id(job.job_id, info.job_task_id)
+                core_task = self.core.tasks.get(task_id)
+                trace_rec = self.core.traces.get(task_id)
                 events.append({
                     "ph": "X", "pid": 0, "tid": wid,
                     "ts": info.started_at * 1e6,
@@ -2290,8 +2628,25 @@ class Server:
                             core_task.t_assigned if core_task else 0.0
                         ),
                         "workers": info.worker_ids,
+                        "trace_id": (
+                            trace_rec["trace_id"] if trace_rec else None
+                        ),
                     },
                 })
+                assigned_at = core_task.t_assigned if core_task else 0.0
+                if assigned_at and wid:
+                    flow = {
+                        "cat": "dispatch", "name": "dispatch",
+                        "id": task_id,
+                    }
+                    events.append({
+                        "ph": "s", "pid": 0, "tid": 0,
+                        "ts": assigned_at * 1e6, **flow,
+                    })
+                    events.append({
+                        "ph": "f", "bp": "e", "pid": 0, "tid": wid,
+                        "ts": info.started_at * 1e6, **flow,
+                    })
         return {"op": "trace_export", "traceEvents": events}
 
     def _record_past_worker(self, worker_id: int, reason: str) -> None:
@@ -2490,6 +2845,285 @@ class Server:
                 self._overview_listeners -= 1
                 if self._overview_listeners == 0:
                     self.comm.broadcast_overview_override(None)
+
+    # --- live subscription plane (ISSUE 8b) ---------------------------
+    def _build_sample(self) -> dict:
+        """One metric sample pushed to subscribers: the cluster signals the
+        autoscaler (ROADMAP item 4) and `hq top` need without polling.
+        O(workers + queues), never O(tasks)."""
+        core = self.core
+        workers = []
+        running_total = 0
+        for w in core.workers.values():
+            running_total += len(w.assigned_tasks)
+            hw = (w.last_overview or {}).get("hw") or {}
+            workers.append({
+                "id": w.worker_id,
+                "hostname": w.configuration.hostname,
+                "running": len(w.assigned_tasks),
+                "prefilled": len(w.prefilled_tasks),
+                "cpu": hw.get("cpu_usage_percent"),
+            })
+        latest = core.flight.latest() or {}
+        pending_reasons: dict[str, int] = {}
+        for entry in latest.get("unplaced") or ():
+            reason = entry.get("reason")
+            if reason:
+                pending_reasons[reason] = (
+                    pending_reasons.get(reason, 0) + entry.get("count", 0)
+                )
+        jobs = self.jobs.jobs
+        job_counts: dict[str, int] = {}
+        for job in jobs.values():
+            status = job.status()
+            job_counts[status] = job_counts.get(status, 0) + 1
+        return {
+            "op": "sample",
+            "time": time.time(),
+            "uptime": round(time.time() - self.started_at, 1),
+            "event_seq": self._event_seq,
+            "workers": workers,
+            "n_workers": len(core.workers),
+            "n_jobs": len(jobs),
+            "job_counts": job_counts,
+            "tasks_known": len(core.tasks),
+            "ready": core.queues.total_ready(),
+            "mn_queued": len(core.mn_queue),
+            "running": running_total,
+            "pending_reasons": pending_reasons,
+            "tick": core.tick_counter,
+            "tick_last_ms": (core.tick_stats.snapshot().get("phases") or {})
+            .get("total", {}).get("last_ms"),
+            "lag": self.lag.snapshot(),
+            "stalls": self.stalls_captured,
+            "subscribers": len(self._subscribers),
+        }
+
+    async def _subscribe(self, conn: Connection, msg: dict) -> None:
+        """Stream lifecycle events + periodic metric samples to one client
+        over the existing framing until it disconnects or falls behind.
+
+        Backpressure contract: the per-subscriber queue is bounded; a
+        consumer that cannot keep up is DROPPED (final `sub_dropped`
+        frame, counted in hq_subscribers_dropped_total) rather than
+        allowed to hold server memory or reactor latency hostage."""
+        # validate the filter: emit_event runs kind.startswith(prefixes)
+        # on the reactor's hottest paths, where a non-str element would
+        # raise out of the WORKER recv loop — one malformed subscriber
+        # must not tear down worker connections. A bare string is treated
+        # as one prefix, not a tuple of characters.
+        raw_filter = msg.get("filter") or ()
+        if isinstance(raw_filter, str):
+            raw_filter = (raw_filter,)
+        sub = _Subscriber(
+            prefixes=tuple(p for p in raw_filter if isinstance(p, str)),
+            sample_interval=max(float(msg.get("sample_interval") or 0.0), 0.0),
+            buffer=msg.get("buffer") or 4096,
+        )
+        self._subscribers.append(sub)
+        wants_overviews = bool(msg.get("overviews"))
+        if wants_overviews:
+            self._overview_listeners += 1
+            if self._overview_listeners == 1:
+                self.comm.broadcast_overview_override(
+                    OVERVIEW_OVERRIDE_INTERVAL
+                )
+        try:
+            await conn.send({"op": "sub_live", "seq": self._event_seq})
+            if sub.sample_interval:
+                await conn.send(self._build_sample())
+            next_sample = (
+                time.monotonic() + sub.sample_interval
+                if sub.sample_interval else None
+            )
+            eof = asyncio.ensure_future(conn.recv())
+            try:
+                while not sub.dead:
+                    timeout = (
+                        max(next_sample - time.monotonic(), 0.0)
+                        if next_sample is not None else None
+                    )
+                    getter = asyncio.ensure_future(sub.queue.get())
+                    done, _pending = await asyncio.wait(
+                        (getter, eof),
+                        timeout=timeout,
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if eof in done:
+                        getter.cancel()
+                        eof.exception()  # retrieve (EOF/conn reset)
+                        return
+                    if getter in done:
+                        # coalesce a burst into one frame (one encryption +
+                        # one syscall, like the downlink batcher)
+                        records = [getter.result()]
+                        while len(records) < 128:
+                            try:
+                                records.append(sub.queue.get_nowait())
+                            except asyncio.QueueEmpty:
+                                break
+                        await conn.send(
+                            {"op": "events", "records": records}
+                        )
+                    else:
+                        getter.cancel()
+                    if (
+                        next_sample is not None
+                        and time.monotonic() >= next_sample
+                    ):
+                        await conn.send(self._build_sample())
+                        next_sample = time.monotonic() + sub.sample_interval
+                # fell behind: say so, then hang up
+                await conn.send(
+                    {"op": "sub_dropped", "dropped": sub.dropped}
+                )
+            finally:
+                if not eof.done():
+                    eof.cancel()
+                    try:
+                        await eof
+                    except (asyncio.CancelledError, Exception):
+                        pass
+        except (ConnectionError, OSError):
+            pass  # consumer went away mid-send
+        finally:
+            self._subscribers.remove(sub)
+            if wants_overviews:
+                self._overview_listeners -= 1
+                if self._overview_listeners == 0:
+                    self.comm.broadcast_overview_override(None)
+
+    # --- task traces (ISSUE 8a) ---------------------------------------
+    async def _client_task_trace(self, msg: dict) -> dict:
+        """The assembled causal trace of one task: every recorded span
+        from client submit through worker spawn to completion commit
+        (`hq task trace <job>.<task>`)."""
+        job_id = msg["job_id"]
+        job_task_id = msg.get("task_id") or 0
+        task_id = make_task_id(job_id, job_task_id)
+        rec = self.core.traces.get(task_id)
+        if rec is None:
+            if not self.core.traces.enabled:
+                return {"op": "error",
+                        "message": "task tracing is disabled "
+                                   "(--task-trace-capacity 0)"}
+            return {"op": "error",
+                    "message": f"no trace recorded for task "
+                               f"{job_id}.{job_task_id} (evicted, or the "
+                               "task predates this server's trace store)"}
+        from hyperqueue_tpu.utils.trace import REQUIRED_HOPS, SPAN_ORDER
+
+        order = {name: i for i, name in enumerate(SPAN_ORDER)}
+        spans = sorted(
+            rec["spans"],
+            key=lambda s: (s["instance"], s["t0"], order.get(s["name"], 99)),
+        )
+        t0 = min((s["t0"] for s in spans), default=0.0)
+        t1 = max((s["t1"] for s in spans), default=0.0)
+        names = {s["name"] for s in spans}
+        return {
+            "op": "task_trace",
+            "job": job_id,
+            "task": job_task_id,
+            "trace_id": rec["trace_id"],
+            "closed": bool(rec.get("done")),
+            "complete": rec.get("done") and REQUIRED_HOPS <= names,
+            "missing_hops": sorted(REQUIRED_HOPS - names),
+            "wall_s": round(max(t1 - t0, 0.0), 6),
+            "span_sum_s": round(
+                sum(s["t1"] - s["t0"] for s in spans), 6
+            ),
+            "spans": spans,
+        }
+
+    # --- reactor lag + stall watchdog (ISSUE 8c) ----------------------
+    STALL_CAPTURE_MIN_INTERVAL = 5.0
+
+    def note_plane(self, plane: str, dt: float) -> None:
+        """Record how long one work class held the event loop; past the
+        stall budget, auto-capture a diagnosis dump."""
+        self.lag.observe(plane, dt)
+        if self.stall_budget > 0 and dt >= self.stall_budget:
+            self._capture_stall(plane, dt)
+
+    def _capture_stall(self, plane: str, duration_s: float) -> None:
+        now = time.monotonic()
+        _REACTOR_STALLS.labels(plane).inc()
+        self.core.flight.record_event(
+            "reactor-stall",
+            {"plane": plane, "duration_s": round(duration_s, 4),
+             "budget_s": self.stall_budget},
+        )
+        if now - self._last_stall_capture < self.STALL_CAPTURE_MIN_INTERVAL:
+            self.stalls_captured += 1
+            return  # rate-limit the (file-writing) capture, keep counting
+        self._last_stall_capture = now
+        self.stalls_captured += 1
+        dump = {
+            "time": time.time(),
+            "plane": plane,
+            "duration_s": round(duration_s, 4),
+            "budget_s": self.stall_budget,
+            "tick": self.core.tick_counter,
+            "lag": self.lag.snapshot(),
+            "trace": TRACER.snapshot(),
+            "queues": {
+                "ready": self.core.queues.total_ready(),
+                "mn_queued": len(self.core.mn_queue),
+                "workers": len(self.core.workers),
+                "event_listeners": len(self._event_listeners),
+                "subscribers": len(self._subscribers),
+            },
+            "flight": self.core.flight.dump(),
+        }
+        self.last_stall = {
+            k: dump[k] for k in ("time", "plane", "duration_s", "tick")
+        }
+        instance_dir = getattr(self, "_instance_dir", None)
+        if instance_dir is None:
+            return  # stalled before start() finished; counted, not dumped
+        stall_dir = Path(instance_dir) / "stalls"
+        try:
+            import json as _json
+
+            stall_dir.mkdir(exist_ok=True)
+            out = stall_dir / f"stall-{self.stalls_captured:04d}.json"
+            out.write_text(_json.dumps(dump, default=str))
+            self.last_stall["dump"] = str(out)
+
+            def seq_of(p: Path) -> int:
+                # numeric, not lexicographic: past capture 9999 the name
+                # outgrows the padding and a string sort would prune the
+                # NEWEST dumps
+                try:
+                    return int(p.stem.rpartition("-")[2])
+                except ValueError:
+                    return -1
+
+            dumps = sorted(stall_dir.glob("stall-*.json"), key=seq_of)
+            for old in dumps[: max(len(dumps) - self.stall_dumps, 0)]:
+                old.unlink(missing_ok=True)
+        except OSError:
+            logger.exception("stall dump write failed")
+        logger.critical(
+            "reactor stall: %s plane held the loop %.3fs (budget %.3fs); "
+            "diagnosis dumped to %s",
+            plane, duration_s, self.stall_budget,
+            self.last_stall.get("dump", "<memory only>"),
+        )
+
+    async def _loop_lag_monitor(self) -> None:
+        """Measure the event loop's own scheduling lag: the overshoot of a
+        short sleep is exactly how long other work held the loop. Feeds
+        the `loop` plane of hq_reactor_lag_seconds and the stall
+        watchdog (a long stall shows up here even when the blocking work
+        class was never instrumented)."""
+        interval = 0.1
+        while True:
+            before = time.monotonic()
+            await asyncio.sleep(interval)
+            overshoot = time.monotonic() - before - interval
+            self.note_plane("loop", max(overshoot, 0.0))
 
     async def _client_journal_flush(self, msg: dict) -> dict:
         if self.journal is None:

@@ -20,6 +20,7 @@ from hyperqueue_tpu.scheduler.tick_cache import TickPhaseStats, TickStateCache
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
 from hyperqueue_tpu.utils.flight import FlightRecorder
+from hyperqueue_tpu.utils.trace import TaskTraceStore
 
 
 @dataclass
@@ -67,6 +68,10 @@ class Core:
     # events (utils/flight.py); reactor.schedule records into it and the
     # explain/flight-recorder/trace RPCs read it
     flight: FlightRecorder = field(default_factory=FlightRecorder)
+    # per-task distributed traces (utils/trace.py TaskTraceStore): spans
+    # from client submit through worker spawn to completion commit are
+    # assembled here and queried by the task_trace RPC / `hq task trace`
+    traces: TaskTraceStore = field(default_factory=TaskTraceStore)
     # rq_id -> (membership_epoch, amount_capable, lifetime_ok) memo for
     # decision.classify_class (pure in the worker set per class)
     capable_memo: dict = field(default_factory=dict)

@@ -20,6 +20,7 @@ from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
+from hyperqueue_tpu.transport.framing import attach_trace_wire
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.trace import TRACER
 
@@ -77,10 +78,13 @@ class EventSink(Protocol):
     """
 
     def on_task_started(self, task_id: int, instance_id: int,
-                        worker_ids: list[int], variant: int = 0) -> None: ...
+                        worker_ids: list[int], variant: int = 0,
+                        wtrace: dict | None = None) -> None: ...
     def on_task_restarted(self, task_id: int) -> None: ...
-    def on_task_finished(self, task_id: int) -> None: ...
-    def on_task_failed(self, task_id: int, message: str) -> None: ...
+    def on_task_finished(self, task_id: int,
+                         wtrace: dict | None = None) -> None: ...
+    def on_task_failed(self, task_id: int, message: str,
+                       wtrace: dict | None = None) -> None: ...
     def on_task_canceled(self, task_id: int) -> None: ...
     def on_worker_new(self, worker: Worker) -> None: ...
     def on_worker_lost(self, worker_id: int, reason: str) -> None: ...
@@ -373,7 +377,8 @@ def requeue_reattach_expired(core: Core, comm: Comm, task: Task) -> None:
 
 
 def on_task_running(
-    core: Core, events: EventSink, task_id: int, instance_id: int
+    core: Core, events: EventSink, task_id: int, instance_id: int,
+    wtrace: dict | None = None
 ) -> None:
     task = core.tasks.get(task_id)
     if task is None or task.instance_id != instance_id or task.is_done:
@@ -396,19 +401,21 @@ def on_task_running(
         task.t_started = _time.time()
         workers = list(task.mn_workers) or [task.assigned_worker]
         events.on_task_started(
-            task_id, instance_id, workers, task.assigned_variant
+            task_id, instance_id, workers, task.assigned_variant,
+            wtrace=wtrace,
         )
 
 
 def on_task_finished(
-    core: Core, comm: Comm, events: EventSink, task_id: int, instance_id: int
+    core: Core, comm: Comm, events: EventSink, task_id: int, instance_id: int,
+    wtrace: dict | None = None
 ) -> None:
     task = core.tasks.get(task_id)
     if task is None or task.instance_id != instance_id or task.is_done:
         return
     _release_task_resources(core, task)
     task.state = TaskState.FINISHED
-    events.on_task_finished(task_id)
+    events.on_task_finished(task_id, wtrace=wtrace)
     for consumer_id in sorted(task.consumers):
         consumer = core.tasks.get(consumer_id)
         if consumer is None or consumer.state is not TaskState.WAITING:
@@ -427,21 +434,23 @@ def on_task_failed(
     task_id: int,
     instance_id: int,
     message: str,
+    wtrace: dict | None = None,
 ) -> None:
     task = core.tasks.get(task_id)
     if task is None or task.instance_id != instance_id or task.is_done:
         return
     _release_task_resources(core, task)
     task.state = TaskState.FAILED
-    _propagate_failure(core, events, task, message)
+    _propagate_failure(core, events, task, message, wtrace=wtrace)
     comm.ask_for_scheduling()
 
 
 def _propagate_failure(
-    core: Core, events: EventSink, task: Task, message: str
+    core: Core, events: EventSink, task: Task, message: str,
+    wtrace: dict | None = None
 ) -> None:
     """Fail the task and transitively cancel waiting consumers."""
-    events.on_task_failed(task.task_id, message)
+    events.on_task_failed(task.task_id, message, wtrace=wtrace)
     stack = sorted(task.consumers)
     task.consumers.clear()
     while stack:
@@ -1308,4 +1317,13 @@ def _compute_message(core: Core, task: Task, variant: int) -> dict:
     }
     if task.entry is not None:
         msg["entry"] = task.entry
+    # trace-context header: the worker stamps accept/launch/spawn clocks
+    # against this id and echoes the parent span in its uplinks, so the
+    # server-side trace assembly can link the hops causally (the cost on
+    # the per-task dispatch path is one small dict)
+    traces = core.traces
+    if traces.enabled:
+        ctx = traces.wire_ctx(task.task_id)
+        if ctx is not None:
+            attach_trace_wire(msg, ctx[0], ctx[1])
     return msg

@@ -14,8 +14,9 @@ a profiler.
 from __future__ import annotations
 
 import logging
+import secrets
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -102,3 +103,247 @@ class Tracer:
 # process-wide tracer (one server or worker per process)
 TRACER = Tracer()
 span = TRACER.span
+
+
+# ----------------------------------------------------------------------
+# Distributed per-task traces (ISSUE 8).
+#
+# One trace follows a task from client submit through journal commit,
+# solve/dispatch, worker spawn and completion uplink.  Identity is carried
+# as a trace id (stamped at submit, journaled, preserved across restore
+# and reattach) plus a parent span id on the control-plane messages
+# (transport/framing.py attach_trace/read_trace).  Spans are assembled
+# SERVER-side in this bounded store — workers only stamp wall clocks onto
+# the messages they already send, so the hot dispatch path gains a couple
+# of dict writes, never an extra message.
+# ----------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    return secrets.token_hex(8)
+
+
+# span names, in causal order, for a task launched on a real worker; the
+# trace-smoke gate asserts a completed trace contains REQUIRED_HOPS
+SPAN_ORDER = (
+    "client/submit",   # client send -> server receive (client-stamped)
+    "server/submit",   # receive -> tasks built + journal commit
+    "server/queue",    # ready -> assigned (scheduler backlog)
+    "server/dispatch", # assigned -> worker accepted the compute message
+    "worker/accept",   # accepted -> launch dispatched
+    "worker/spawn",    # launch dispatched -> process spawned
+    "worker/run",      # spawned -> exit
+    "worker/uplink",   # completion enqueued -> server received it
+    "server/commit",   # received -> state applied + journal commit
+)
+REQUIRED_HOPS = frozenset(SPAN_ORDER) - {"client/submit"}
+
+
+class TaskTraceStore:
+    """Bounded per-task causal traces (flight-recorder pattern:
+    O(1) per span, hard memory bound regardless of uptime).
+
+    One record per task: ``{"trace_id", "spans": [...], "done"}``.  Spans
+    are closed intervals ``{"name", "t0", "t1", "proc", "instance", "id",
+    "parent"}`` deduplicated on (name, instance) — a reattach or a journal
+    replay re-reporting a hop must not double it (the single-timeline
+    contract from PR 3).  ``capacity=0`` disables the store entirely.
+    """
+
+    def __init__(self, capacity: int = 16384):
+        self.capacity = max(int(capacity), 0)
+        self.enabled = self.capacity > 0
+        self._traces: OrderedDict[int, dict] = OrderedDict()
+        # closed task ids in close() order: the O(1) eviction feed (a
+        # full-store scan per insert would make a 1M-task submit O(n*cap)
+        # on the reactor loop); entries may be stale (already evicted or
+        # re-seeded) and are validated when popped
+        self._closed: deque = deque()
+        self.evictions = 0
+        self._span_counter = 0
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    def new_span_id(self) -> str:
+        self._span_counter += 1
+        return f"s{self._span_counter:x}"
+
+    def begin(self, task_id: int, trace_id: str) -> dict | None:
+        if not self.enabled:
+            return None
+        rec = self._traces.get(task_id)
+        if rec is None:
+            rec = {"trace_id": trace_id, "spans": [], "done": False}
+            self._traces[task_id] = rec
+            self._evict()
+        return rec
+
+    def seed(self, task_id: int, rec: dict) -> None:
+        """Adopt a restored record (journal replay / snapshot restore)."""
+        if not self.enabled or not isinstance(rec, dict):
+            return
+        done = bool(rec.get("done"))
+        self._traces[task_id] = {
+            "trace_id": rec.get("trace_id") or new_trace_id(),
+            "spans": list(rec.get("spans") or ()),
+            "done": done,
+        }
+        self._traces.move_to_end(task_id)
+        if done:
+            self._closed.append(task_id)
+        self._evict()
+
+    def span(
+        self,
+        task_id: int,
+        name: str,
+        t0: float,
+        t1: float,
+        proc: str,
+        instance: int = 0,
+        parent: str | None = None,
+    ) -> str | None:
+        """Record one closed span; returns its id (None when disabled,
+        deduplicated, or the stamps are unusable)."""
+        if not self.enabled or not t0 or not t1:
+            return None
+        rec = self._traces.get(task_id)
+        if rec is None:
+            rec = self.begin(task_id, new_trace_id())
+        for existing in rec["spans"]:
+            if existing["name"] == name and existing["instance"] == instance:
+                return existing["id"]  # reattach/replay duplicate
+        span_id = self.new_span_id()
+        rec["spans"].append({
+            "name": name,
+            "t0": t0,
+            "t1": max(t1, t0),  # cross-process clock skew must not make a
+            "proc": proc,       # span negative
+            "instance": instance,
+            "id": span_id,
+            "parent": parent,
+        })
+        return span_id
+
+    def get(self, task_id: int) -> dict | None:
+        return self._traces.get(task_id)
+
+    def trace_id(self, task_id: int) -> str | None:
+        rec = self._traces.get(task_id)
+        return rec["trace_id"] if rec is not None else None
+
+    def last_span_id(self, task_id: int) -> str | None:
+        rec = self._traces.get(task_id)
+        if rec is None or not rec["spans"]:
+            return None
+        return rec["spans"][-1]["id"]
+
+    def wire_ctx(self, task_id: int) -> tuple[str, str | None] | None:
+        """(trace_id, last_span_id) in one lookup — the per-task dispatch
+        hot path stamps this onto every compute message."""
+        rec = self._traces.get(task_id)
+        if rec is None:
+            return None
+        spans = rec["spans"]
+        return rec["trace_id"], (spans[-1]["id"] if spans else None)
+
+    def close(self, task_id: int) -> None:
+        rec = self._traces.get(task_id)
+        if rec is not None and not rec["done"]:
+            rec["done"] = True
+            self._closed.append(task_id)
+
+    def snapshot_live(self, task_ids) -> dict:
+        """{task_id: record} for the given (live) tasks — the piece of
+        trace state a journal snapshot must carry so a snapshot-seeded
+        restore keeps traces unbroken (the superseded journal prefix that
+        held the submit/start events is GC'd).
+
+        Records are COPIED (span dicts are append-only, so copying the
+        list suffices): the snapshot payload is serialized on an executor
+        thread while the reactor keeps appending spans, and every other
+        capture_state field is freshly built for the same reason."""
+        out = {}
+        for tid in task_ids:
+            rec = self._traces.get(tid)
+            if rec is not None:
+                out[tid] = {
+                    "trace_id": rec["trace_id"],
+                    "spans": list(rec["spans"]),
+                    "done": rec["done"],
+                }
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "tasks": len(self._traces),
+            "evictions": self.evictions,
+            "spans": sum(len(r["spans"]) for r in self._traces.values()),
+        }
+
+    def _evict(self) -> None:
+        while len(self._traces) > self.capacity:
+            # prefer evicting closed traces (oldest-closed first, from the
+            # O(1) feed); fall back to the oldest live one so the bound is
+            # hard either way
+            victim = None
+            while self._closed:
+                tid = self._closed.popleft()
+                rec = self._traces.get(tid)
+                if rec is not None and rec["done"]:
+                    victim = tid
+                    break
+            if victim is None:
+                victim = next(iter(self._traces))
+            del self._traces[victim]
+            self.evictions += 1
+
+
+# ----------------------------------------------------------------------
+# Reactor loop-lag tracking (ISSUE 8c): per-plane histograms of how long
+# each work class held the server's event loop, plus the loop's own
+# sleep-overshoot.  The rolling SpanStats mirror the TRACER shape for
+# `hq server stats`; the histogram feeds Prometheus.  The stall watchdog
+# (server/bootstrap.py) compares each observation against --stall-budget.
+# ----------------------------------------------------------------------
+
+LAG_PLANES = ("rpc", "journal", "solve", "fanout", "loop")
+
+_REACTOR_LAG_SECONDS = REGISTRY.histogram(
+    "hq_reactor_lag_seconds",
+    "time one reactor work class held the server event loop "
+    "(rpc/journal/solve/fanout) or the loop's own sleep-overshoot (loop)",
+    labels=("plane",),
+)
+
+
+class LagTracker:
+    """Rolling per-plane loop-occupancy statistics + the shared
+    `hq_reactor_lag_seconds` histogram."""
+
+    def __init__(self):
+        self.stats: dict[str, SpanStats] = {}
+
+    def observe(self, plane: str, dt: float) -> None:
+        entry = self.stats.get(plane)
+        if entry is None:
+            entry = self.stats[plane] = SpanStats()
+        entry.record(dt)
+        _REACTOR_LAG_SECONDS.labels(plane).observe(dt)
+
+    def snapshot(self) -> dict:
+        return {
+            plane: {
+                "count": s.count,
+                "total_ms": round(s.total_s * 1000, 3),
+                "mean_ms": round(s.total_s / s.count * 1000, 4),
+                "max_ms": round(s.max_s * 1000, 3),
+                "last_ms": round(s.last_s * 1000, 4),
+            }
+            for plane, s in sorted(self.stats.items())
+        }
+
+    def reset(self) -> None:
+        self.stats.clear()
+        _REACTOR_LAG_SECONDS.reset()

@@ -26,6 +26,7 @@ import asyncio
 import os
 import shutil
 import signal
+import time
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -75,6 +76,9 @@ class LaunchedTask:
     pumps: tuple = ()  # stream-mode output pump tasks
     rm_if_finished: tuple = ()  # stdio paths removed on successful exit
     cleanup_dirs: tuple = ()  # task dirs removed once the task completes
+    # wall clock of the actual process spawn, for the task's distributed
+    # trace (worker/spawn span); 0.0 when unknown (zero-worker mode)
+    spawned_wall: float = 0.0
 
     async def started(self) -> int:
         """Parity with PooledProcess.started(): the in-loop path has
@@ -281,6 +285,7 @@ async def launch_task(
         pumps=pumps,
         rm_if_finished=tuple(rm_paths),
         cleanup_dirs=tuple(cleanup_dirs),
+        spawned_wall=time.time(),
     )
 
 

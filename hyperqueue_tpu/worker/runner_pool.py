@@ -79,6 +79,7 @@ class PooledProcess:
     __slots__ = (
         "_runner", "key", "pid", "spawned", "exited",
         "stdout_path", "stderr_path", "rm_if_finished", "cleanup_dirs",
+        "spawned_wall",
     )
 
     def __init__(self, runner: "_Runner", key: int, spec: dict,
@@ -86,6 +87,10 @@ class PooledProcess:
         self._runner = runner
         self.key = key
         self.pid = 0
+        # wall clock of the runner's spawn ack (trace worker/spawn span);
+        # stays 0.0 on the no-ack hot path, where the in-order dispatch
+        # itself is the spawn and the worker's own stamp stands in
+        self.spawned_wall = 0.0
         loop = asyncio.get_running_loop()
         self.spawned: asyncio.Future | None = (
             loop.create_future() if ack else None
@@ -192,6 +197,7 @@ class _Runner:
             return
         if op == "spawned":
             task.pid = msg.get("pid", 0)
+            task.spawned_wall = time.time()
             if task.spawned is not None and not task.spawned.done():
                 task.spawned.set_result(task.pid)
         elif op == "spawn_error":

@@ -44,6 +44,7 @@ from hyperqueue_tpu.transport.auth import (
     Connection,
     do_authentication,
 )
+from hyperqueue_tpu.transport.framing import read_trace
 from hyperqueue_tpu.utils import chaos
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.retry import jittered_backoff
@@ -731,11 +732,23 @@ class WorkerRuntime:
         op = msg.get("op")
         if op == "compute":
             shared = msg.get("shared_bodies")
+            shared_tr = msg.get("shared_traces")
             for task_msg in msg["tasks"]:
                 if shared is not None and "b" in task_msg:
                     # resolve the shared/separate split; the body dict
                     # stays shared between tasks (read-only downstream)
                     task_msg["body"] = shared[task_msg.pop("b")]
+                tr = task_msg.get("trace")
+                if (
+                    shared_tr is not None
+                    and isinstance(tr, list)
+                    and tr
+                    and isinstance(tr[0], int)
+                ):
+                    # resolve the frame-level trace-id dedup
+                    task_msg["trace"] = [
+                        shared_tr[tr[0]], tr[1] if len(tr) > 1 else None,
+                    ]
                 key = (task_msg["id"], task_msg.get("instance", 0))
                 if key in self._recent_tasks:
                     # duplicate delivery of the same incarnation (chaos
@@ -749,6 +762,14 @@ class WorkerRuntime:
                 self._recent_tasks[key] = None
                 while len(self._recent_tasks) > self.RECENT_TASKS_MAX:
                     self._recent_tasks.popitem(last=False)
+                tctx = read_trace(task_msg)
+                if tctx is not None:
+                    # distributed trace (server-side assembly): normalize
+                    # the compact wire header, stamp the accept clock;
+                    # launch/spawn clocks follow in _run_task and
+                    # everything is echoed on the task_running uplink
+                    tctx["accepted_at"] = time.time()
+                    task_msg["trace"] = tctx
                 self._try_start(task_msg)
         elif op == "cancel":
             for task_id in msg["task_ids"]:
@@ -882,17 +903,35 @@ class WorkerRuntime:
             if self.localcomm is not None:
                 extra_env["HQ_LOCAL_SOCKET"] = self.localcomm.socket_path
                 extra_env["HQ_TOKEN"] = self.localcomm.register_task(task_id)
+            tctx = task_msg.get("trace")
+            if tctx is not None:
+                tctx["launch_at"] = time.time()
             _t_spawn = time.perf_counter()
             launched = await self._launch(
                 task_msg, allocation, streamer, extra_env
             )
             _SPAWN_SECONDS.observe(time.perf_counter() - _t_spawn)
+            if tctx is not None:
+                # the true spawn clock when the handle recorded one (runner
+                # ack / in-loop subprocess); dispatch-complete otherwise
+                tctx["spawned_at"] = (
+                    getattr(launched, "spawned_wall", 0.0) or time.time()
+                )
             rt = self.running.get(task_id)
             if rt is not None:
                 rt.launched = launched
-            await self._send(
-                {"op": "task_running", "id": task_id, "instance": instance}
-            )
+            running_msg = {
+                "op": "task_running", "id": task_id, "instance": instance,
+            }
+            if tctx is not None:
+                running_msg["trace"] = {
+                    "id": tctx.get("id"),
+                    "parent": tctx.get("parent"),
+                    "accepted_at": tctx.get("accepted_at"),
+                    "launch_at": tctx.get("launch_at"),
+                    "spawned_at": tctx.get("spawned_at"),
+                }
+            await self._send(running_msg)
             # per-task time limit (reference: task futures carry stop
             # reasons; program.rs timeout path): kill and fail on expiry
             time_limit = (task_msg.get("body") or {}).get("time_limit")
@@ -913,6 +952,8 @@ class WorkerRuntime:
                     code, detail = -1, ""
             else:
                 code, detail = await launched.wait()
+            if tctx is not None:
+                tctx["exited_at"] = time.time()
             if task_id in self._discarded:
                 # killed as a stale incarnation at reconnect: exit silently
                 # (a report could pass the fence against a re-issued copy
@@ -924,34 +965,36 @@ class WorkerRuntime:
                 if streamer is not None:
                     streamer.close_task(task_id, instance)
                 _TASKS_DONE.labels("timeout").inc()
-                await self._send(
-                    {
-                        "op": "task_failed",
-                        "id": task_id,
-                        "instance": instance,
-                        "error": f"time limit of {time_limit}s exceeded",
-                    }
-                )
+                msg = {
+                    "op": "task_failed",
+                    "id": task_id,
+                    "instance": instance,
+                    "error": f"time limit of {time_limit}s exceeded",
+                }
+                self._attach_finish_trace(msg, tctx)
+                await self._send(msg)
                 return
             if streamer is not None:
                 streamer.close_task(task_id, instance)
             _TASKS_DONE.labels("finished" if code == 0 else "failed").inc()
             if code == 0:
-                await self._send(
-                    {"op": "task_finished", "id": task_id, "instance": instance}
-                )
+                msg = {
+                    "op": "task_finished", "id": task_id, "instance": instance,
+                }
+                self._attach_finish_trace(msg, tctx)
+                await self._send(msg)
             else:
                 error = f"program exited with code {code}"
                 if detail:
                     error += f"\nstderr (tail):\n{detail}"
-                await self._send(
-                    {
-                        "op": "task_failed",
-                        "id": task_id,
-                        "instance": instance,
-                        "error": error,
-                    }
-                )
+                msg = {
+                    "op": "task_failed",
+                    "id": task_id,
+                    "instance": instance,
+                    "error": error,
+                }
+                self._attach_finish_trace(msg, tctx)
+                await self._send(msg)
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 - report, don't kill the worker
@@ -959,14 +1002,14 @@ class WorkerRuntime:
                              extra={"task": task_id})
             if task_id not in self._discarded:
                 try:
-                    await self._send(
-                        {
-                            "op": "task_failed",
-                            "id": task_id,
-                            "instance": instance,
-                            "error": f"failed to launch: {e}",
-                        }
-                    )
+                    msg = {
+                        "op": "task_failed",
+                        "id": task_id,
+                        "instance": instance,
+                        "error": f"failed to launch: {e}",
+                    }
+                    self._attach_finish_trace(msg, task_msg.get("trace"))
+                    await self._send(msg)
                 except (ConnectionError, OSError):
                     pass
         finally:
@@ -980,6 +1023,26 @@ class WorkerRuntime:
             if rt is not None and rt.allocation is not None:
                 self.allocator.release(rt.allocation)
             self._retry_blocked()
+
+    @staticmethod
+    def _attach_finish_trace(msg: dict, tctx: dict | None) -> None:
+        """Echo the trace context + completion clocks on a terminal uplink.
+
+        spawned_at rides AGAIN (it already went out on task_running) so a
+        restarted server whose journal lost the start event in its
+        unflushed tail can still close the trace with the execution span
+        intact; sent_at is the uplink-enqueue clock — the worker-side end
+        of the uplink span the server closes at receive time."""
+        if tctx is None:
+            return
+        now = time.time()
+        msg["trace"] = {
+            "id": tctx.get("id"),
+            "parent": tctx.get("parent"),
+            "spawned_at": tctx.get("spawned_at"),
+            "exited_at": tctx.get("exited_at") or now,
+            "sent_at": now,
+        }
 
     # --- dispatch: runner pool fast path vs in-loop asyncio spawn --------
     MAX_LAUNCH_PLANS = 512

@@ -45,6 +45,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "trace: distributed task tracing, subscription plane, and reactor "
+        "stall-detector tests (ISSUE 8)",
+    )
+    config.addinivalue_line(
+        "markers",
         "multichip: sharded multi-device solver tests; run on the virtual "
         "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
         "count=8, set above) so tier-1 exercises the 8-device path on "

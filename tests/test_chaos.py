@@ -160,10 +160,17 @@ def test_reattach_window_expiry_requeues_with_fencing(env, tmp_path):
     )
     # the re-execution runs under the restore boot's generation base: the
     # dead incarnation (0) — and anything the crashed boot could have
-    # issued past it inside its lost journal tail — is fenced out
+    # issued past it inside its lost journal tail — is fenced out.
+    # RUNNING is reported at spawn dispatch, so the marker line can land a
+    # few ms later — poll for it instead of racing the bash startup
     from hyperqueue_tpu.server.task import INSTANCE_GENERATION_STRIDE
 
-    lines = marker.read_text().splitlines()
+    lines = wait_until(
+        lambda: (
+            lns if len(lns := marker.read_text().splitlines()) >= 2 else None
+        ),
+        timeout=10, message="re-execution marker line",
+    )
     assert int(lines[-1].split(":")[1]) >= INSTANCE_GENERATION_STRIDE
 
 

@@ -549,10 +549,12 @@ def test_explain_rpc_end_to_end(env):
     events = trace["traceEvents"]
     assert isinstance(events, list) and events
     for ev in events:
-        assert ev["ph"] in ("M", "X", "C")
+        # M/X/C rows plus the s/f flow events linking dispatch on the
+        # scheduler row to the execution slice on the worker row
+        assert ev["ph"] in ("M", "X", "C", "s", "f")
         assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
         assert isinstance(ev["name"], str) and ev["name"]
-        if ev["ph"] in ("X", "C"):
+        if ev["ph"] in ("X", "C", "s", "f"):
             assert isinstance(ev["ts"], (int, float))
         if ev["ph"] == "X":
             assert ev["dur"] >= 1

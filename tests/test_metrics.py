@@ -1,7 +1,11 @@
 """Metrics-plane tests: registry semantics, exposition golden, HTTP scrape
-smoke against a real server (`--metrics-port 0`)."""
+smoke against a real server (`--metrics-port 0`), and the metrics-catalog
+checker (no `hq_*` metric ships undocumented — the docs twin of the
+reason-code checker in test_explain.py)."""
 
 import json
+import re
+from pathlib import Path
 
 import pytest
 
@@ -273,3 +277,61 @@ def test_worker_metrics_endpoint(tmp_path):
         )
         assert finished == 10
         assert "hq_worker_running_tasks" in parsed
+
+
+# ------------------------------------------------------ docs catalog checker
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def registered_metric_names() -> set[str]:
+    """Every hq_* metric name registered anywhere in the source tree.
+
+    Static scan of REGISTRY.counter/gauge/histogram call sites. Plain
+    string literals are taken verbatim; the f-string families (e.g.
+    f"hq_solver_{key}_total") are expanded from the `for key in (...)`
+    loop that drives them — both shapes this codebase uses. Dynamic
+    names (the worker-sample re-export fan-out) are intentionally out of
+    scope: they re-export already-registered hq_worker_* metrics.
+    """
+    names: set[str] = set()
+    call = re.compile(
+        r'REGISTRY\.(?:counter|gauge|histogram)\(\s*(f?)"(hq_[a-z0-9_{}]+)"'
+    )
+    for path in (REPO_ROOT / "hyperqueue_tpu").rglob("*.py"):
+        text = path.read_text()
+        for m in call.finditer(text):
+            is_f, name = m.group(1), m.group(2)
+            if not is_f:
+                names.add(name)
+                continue
+            var_m = re.search(r"\{(\w+)", name)
+            assert var_m, f"{path}: unsupported f-string metric {name!r}"
+            var = var_m.group(1)
+            loop_pat = rf"for\s+{var}\s+in\s*\("
+            loops = list(re.finditer(loop_pat, text[: m.start()]))
+            assert loops, (
+                f"{path}: f-string metric {name!r} without a preceding "
+                f"`for {var} in (...)` to expand from"
+            )
+            tail = text[loops[-1].end():]
+            tuple_src = tail[: tail.index(")")]
+            values = re.findall(r'"([a-z0-9_]+)"', tuple_src)
+            assert values, f"{path}: empty expansion for {name!r}"
+            for value in values:
+                names.add(name.replace("{" + var + "}", value))
+    assert len(names) > 40, "the scan regressed; found too few metrics"
+    return names
+
+
+def test_metrics_catalog_documented():
+    """No hq_* metric ships undocumented: every registered name (PR 7's
+    hq_resident_*/hq_tick_pipeline_* families included) must appear in
+    the docs/observability.md catalog."""
+    docs = (REPO_ROOT / "docs" / "observability.md").read_text()
+    missing = sorted(
+        name for name in registered_metric_names() if name not in docs
+    )
+    assert not missing, (
+        "metrics missing from the docs/observability.md catalog: "
+        + ", ".join(missing)
+    )

@@ -259,6 +259,24 @@ def test_tick_pipeline_e2e_array_completes(tmp_path):
         pipe = stats.get("pipeline")
         assert pipe is not None
         assert pipe["mapped"] + pipe["drains"] >= 1
+        # Perfetto export renders pipelined solves on the solver row from
+        # their RECORDED dispatch/readback wall stamps — the solve mapped
+        # at tick k+1 must not be charged to tick k+1's row (ISSUE 8
+        # satellite: truthful pipelined rendering)
+        out = tmp_path / "pipeline-trace.json"
+        env.command(["server", "trace", "export", str(out)])
+        events = __import__("json").loads(out.read_text())["traceEvents"]
+        solves = [e for e in events if e.get("cat") == "solve"
+                  and e["args"].get("pipelined")]
+        assert solves, "no pipelined solve slice on the solver row"
+        for e in solves:
+            assert e["pid"] == 1
+            assert e["args"].get("inflight_ms") is not None
+            # the slice spans the dispatch->map window (recorded stamps),
+            # not the mapping tick's own duration
+            assert e["dur"] == pytest.approx(
+                e["args"]["inflight_ms"] * 1e3, rel=0.05, abs=2e3
+            )
 
 
 def test_pipeline_decision_record_carries_backend_and_pipelined_flag():

@@ -1,0 +1,382 @@
+"""Distributed task tracing, live subscriptions, and the stall detector.
+
+Covers ISSUE 8: the TaskTraceStore unit semantics, the end-to-end trace
+chain (client submit -> server -> worker -> runner -> completion) through
+real processes, the subscribe RPC's push delivery + slow-consumer drop,
+the reactor loop-lag/stall watchdog, and (chaos-marked) trace continuity
+across server kill -9 + snapshot restore + worker reattach.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from hyperqueue_tpu.transport.framing import attach_trace, read_trace
+from hyperqueue_tpu.utils.trace import (
+    REQUIRED_HOPS,
+    LagTracker,
+    TaskTraceStore,
+    new_trace_id,
+)
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.trace
+
+
+# ---------------------------------------------------------------- units
+def test_trace_store_dedup_and_order():
+    store = TaskTraceStore(capacity=8)
+    store.begin(1, "t1")
+    a = store.span(1, "server/submit", 10.0, 11.0, "server")
+    b = store.span(1, "server/queue", 11.0, 12.0, "server", parent=a)
+    # duplicate (name, instance) returns the EXISTING span id (reattach /
+    # journal replay re-reporting a hop must not double it)
+    assert store.span(1, "server/queue", 99.0, 100.0, "server") == b
+    rec = store.get(1)
+    assert rec["trace_id"] == "t1"
+    assert [s["name"] for s in rec["spans"]] == [
+        "server/submit", "server/queue",
+    ]
+    assert rec["spans"][1]["parent"] == a
+    # a NEW instance of the same hop is a distinct span (true re-run)
+    assert store.span(1, "server/queue", 20.0, 21.0, "server", instance=1)
+    assert len(store.get(1)["spans"]) == 3
+
+
+def test_trace_store_clock_skew_clamped():
+    store = TaskTraceStore(capacity=4)
+    store.begin(5, "t5")
+    store.span(5, "server/dispatch", 100.0, 99.5, "server")
+    s = store.get(5)["spans"][0]
+    assert s["t1"] >= s["t0"]  # cross-process skew never yields negatives
+
+
+def test_trace_store_bounded_eviction_prefers_closed():
+    store = TaskTraceStore(capacity=4)
+    for tid in range(4):
+        store.begin(tid, f"t{tid}")
+    store.close(2)
+    store.begin(100, "t100")  # over capacity: the closed trace goes first
+    assert store.get(2) is None
+    assert store.get(0) is not None
+    assert store.evictions == 1
+    # with no closed traces the bound is still hard (oldest live evicted)
+    store.begin(101, "t101")
+    assert len(store) == 4
+
+
+def test_trace_store_seed_round_trip():
+    store = TaskTraceStore(capacity=8)
+    store.begin(7, "t7")
+    store.span(7, "server/submit", 1.0, 2.0, "server")
+    store.close(7)
+    rec = store.get(7)
+    other = TaskTraceStore(capacity=8)
+    other.seed(7, rec)
+    assert other.get(7)["trace_id"] == "t7"
+    assert other.get(7)["done"]
+    # seeding + replaying the same span stays ONE span (dedupe)
+    other.span(7, "server/submit", 1.0, 2.0, "server")
+    assert len(other.get(7)["spans"]) == 1
+
+
+def test_trace_store_disabled_is_noop():
+    store = TaskTraceStore(capacity=0)
+    assert store.begin(1, "t") is None
+    assert store.span(1, "x", 1.0, 2.0, "server") is None
+    assert store.get(1) is None
+
+
+def test_framing_trace_header_round_trip():
+    msg = {"op": "submit"}
+    tid = new_trace_id()
+    attach_trace(msg, tid, parent="s1", sent_at=12.5)
+    ctx = read_trace(msg)
+    assert ctx == {"id": tid, "parent": "s1", "sent_at": 12.5}
+    assert read_trace({"op": "x"}) is None
+    assert read_trace({"trace": "bogus"}) is None
+
+
+def test_lag_tracker_snapshot_and_reset():
+    lag = LagTracker()
+    lag.observe("solve", 0.01)
+    lag.observe("solve", 0.03)
+    lag.observe("rpc", 0.002)
+    snap = lag.snapshot()
+    assert snap["solve"]["count"] == 2
+    assert snap["solve"]["max_ms"] == 30.0
+    lag.reset()
+    assert lag.snapshot() == {}
+    from hyperqueue_tpu.utils.metrics import REGISTRY
+
+    metric = REGISTRY.get("hq_reactor_lag_seconds")
+    assert metric is not None
+    for series in metric.series.values():
+        assert series.count == 0  # reset cleared the histogram too
+
+
+def test_subscriber_overflow_drops_consumer(tmp_path):
+    """A slow subscribe consumer is dropped (with a counter), never allowed
+    to grow its queue without bound or stall emit_event."""
+    from hyperqueue_tpu.server.bootstrap import Server, _Subscriber
+
+    server = Server(server_dir=tmp_path)
+    sub = _Subscriber(prefixes=(), sample_interval=0.0, buffer=64)
+    server._subscribers.append(sub)
+    for i in range(65):
+        server.emit_event("job-submitted", {"job": i, "n_tasks": 0})
+    assert sub.dead
+    assert sub.dropped == 1
+    assert sub.queue.qsize() == 64
+    # further events skip the dead subscriber entirely
+    server.emit_event("job-submitted", {"job": 99, "n_tasks": 0})
+    assert sub.queue.qsize() == 64
+
+
+def test_subscriber_prefix_filter(tmp_path):
+    from hyperqueue_tpu.server.bootstrap import Server, _Subscriber
+
+    server = Server(server_dir=tmp_path)
+    sub = _Subscriber(prefixes=("task-",), sample_interval=0.0)
+    server._subscribers.append(sub)
+    server.emit_event("worker-connected", {"id": 1})
+    server.emit_event("task-finished", {"job": 1, "task": 0})
+    assert sub.queue.qsize() == 1
+    assert sub.queue.get_nowait()["event"] == "task-finished"
+
+
+# ------------------------------------------------------------------ e2e
+def _get_trace(env, sel: str) -> dict:
+    return json.loads(env.command(
+        ["task", "trace", sel, "--output-mode", "json"]
+    ))
+
+
+def test_trace_e2e_full_chain(tmp_path):
+    """One submit through real server + worker processes yields a closed
+    causal trace with every hop, span-sum <= wall; the subscribe RPC
+    pushes the lifecycle events live; `hq top --once` reads one sample;
+    reset-metrics clears the lag window."""
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+
+        # subscription opened BEFORE the submit: every lifecycle event
+        # must arrive by push, no polling
+        pushed: list = []
+        seen_finished = threading.Event()
+        subscribed = threading.Event()
+
+        def consume():
+            from hyperqueue_tpu.client.connection import subscribe
+
+            for msg in subscribe(env.server_dir, filters=("task-", "job-"),
+                                 sample_interval=0.5,
+                                 on_subscribed=subscribed.set):
+                if msg.get("op") == "events":
+                    pushed.extend(msg["records"])
+                    if any(r.get("event") == "task-finished"
+                           for r in pushed):
+                        seen_finished.set()
+                        return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        assert subscribed.wait(10)
+
+        env.command(["submit", "--array", "0-2", "--wait", "--", "true"],
+                    timeout=90)
+        assert seen_finished.wait(15), "no task-finished pushed to subscriber"
+        kinds = {r.get("event") for r in pushed}
+        assert "job-submitted" in kinds and "task-started" in kinds
+
+        for sel in ("1.0", "1.1", "1.2"):
+            out = _get_trace(env, sel)
+            assert out["closed"], out
+            assert out["complete"], (sel, out["missing_hops"])
+            names = [s["name"] for s in out["spans"]]
+            assert set(names) >= REQUIRED_HOPS
+            # spans chain causally: sum of durations never exceeds wall
+            assert out["span_sum_s"] <= out["wall_s"] + 1e-6
+            # every non-root span names its parent
+            parents = {s["id"] for s in out["spans"]}
+            assert all(
+                s["parent"] in parents
+                for s in out["spans"] if s["parent"] is not None
+            )
+
+        # all three tasks share the submit's trace id
+        ids = {_get_trace(env, f"1.{i}")["trace_id"] for i in range(3)}
+        assert len(ids) == 1
+
+        top = json.loads(env.command(
+            ["top", "--once", "--output-mode", "json"]
+        ))
+        assert top["n_workers"] == 1
+        assert "lag" in top and "solve" in top["lag"]
+
+        # Perfetto export (same env — a boot here costs tier-1 seconds):
+        # flow events link dispatch to execution, solves render on the
+        # dedicated solver row
+        out = tmp_path / "trace.json"
+        env.command(["server", "trace", "export", str(out)])
+        trace = json.loads(out.read_text())
+        events = trace["traceEvents"]
+        flows = [e for e in events if e.get("ph") in ("s", "f")]
+        assert flows, "no flow events linking dispatch to execution"
+        starts = [e for e in flows if e["ph"] == "s"]
+        ends = [e for e in flows if e["ph"] == "f"]
+        assert {e["id"] for e in starts} == {e["id"] for e in ends}
+        # a flow terminates on the worker row at the task slice start
+        task_slices = {
+            (e["tid"], e["ts"])
+            for e in events if e.get("cat") == "task"
+        }
+        assert all((e["tid"], e["ts"]) in task_slices for e in ends)
+        # sync solves render on the dedicated solver row (pid 1)
+        solver = [e for e in events if e.get("cat") == "solve"]
+        assert solver and all(e["pid"] == 1 for e in solver)
+        assert all(not e["args"]["pipelined"] for e in solver)
+
+        # reset-metrics clears the lag window AND hq_span_seconds (the
+        # steady-state measurement contract, ISSUE 8 satellite)
+        stats = json.loads(env.command(
+            ["server", "stats", "--output-mode", "json"]
+        ))
+        assert stats["lag"]["solve"]["count"] > 0
+        assert stats["trace"]  # hq_span_seconds rolling SpanStats
+        env.command(["server", "reset-metrics"])
+        stats = json.loads(env.command(
+            ["server", "stats", "--output-mode", "json"]
+        ))
+        # the reset-metrics rpc itself may have been observed since; the
+        # pre-reset history (solve ticks, submit rpcs) must be gone
+        assert stats["lag"].get("solve", {}).get("count", 0) == 0
+        assert not stats["trace"].get("scheduler/tick")
+
+
+def test_stall_detector_dumps_on_slow_tick(tmp_path):
+    """An injected slow solve (chaos delay) breaches --stall-budget: the
+    watchdog auto-captures a flight-recorder + trace dump and counts it."""
+    plan = json.dumps({
+        "rules": [
+            {"site": "solve", "action": "delay", "delay_ms": 300, "at": 1}
+        ]
+    })
+    with HqEnv(tmp_path) as env:
+        env.start_server("--stall-budget", "0.1",
+                         env_extra={"HQ_FAULT_PLAN": plan})
+        env.start_worker("--zero-worker", cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--array", "0-3", "--wait", "--", "true"],
+                    timeout=60)
+
+        def stalled():
+            stats = json.loads(env.command(
+                ["server", "stats", "--output-mode", "json"]
+            ))
+            return stats["stalls"]["captured"] >= 1 and stats["stalls"]
+
+        stalls = wait_until(stalled, timeout=15, message="stall capture")
+        last = stalls["last"]
+        assert last["plane"] == "solve"
+        assert last["duration_s"] >= 0.1
+        dump_path = Path(last["dump"])
+        assert dump_path.exists()
+        dump = json.loads(dump_path.read_text())
+        # the dump is a self-contained diagnosis: flight recorder ring,
+        # tracer spans, per-plane lag, queue depths
+        assert dump["plane"] == "solve"
+        assert "ticks" in dump["flight"]
+        assert "scheduler/tick" in dump["trace"]
+        assert dump["lag"]["solve"]["count"] >= 1
+        # the lag histogram saw the stall too
+        assert stalls["captured"] == json.loads(env.command(
+            ["server", "stats", "--output-mode", "json"]
+        ))["stalls"]["captured"]
+
+
+# ---------------------------------------------------------------- chaos
+@pytest.mark.chaos
+def test_trace_unbroken_across_kill9_snapshot_restore_and_reattach(tmp_path):
+    """Server kill -9 mid-run + snapshot-seeded restore + worker reattach:
+    `hq task trace` afterwards shows ONE trace — original trace id, one
+    spawn span, all hops — per the PR 3 single-timeline contract."""
+    with HqEnv(tmp_path) as env:
+        journal = tmp_path / "journal.bin"
+        env.start_server("--journal", str(journal))
+        env.start_worker("--on-server-lost", "reconnect", cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--", "sleep", "4"])
+
+        def running():
+            jobs = json.loads(env.command(
+                ["job", "info", "1", "--output-mode", "json"]
+            ))
+            return jobs and jobs[0]["counters"]["running"] >= 1
+
+        wait_until(running, timeout=30, message="task running")
+        before = _get_trace(env, "1.0")
+        assert {"server/queue", "worker/spawn"} <= {
+            s["name"] for s in before["spans"]
+        }
+        # compact: the restore will be SNAPSHOT-seeded (the trace rides
+        # the snapshot; the GC'd prefix held the submit/start events)
+        env.command(["journal", "compact"])
+        env.kill_process("server")
+        env.start_server("--journal", str(journal))
+        env.command(["job", "wait", "1"], timeout=60)
+
+        after = _get_trace(env, "1.0")
+        assert after["trace_id"] == before["trace_id"]
+        assert after["closed"] and after["complete"], after
+        names = [s["name"] for s in after["spans"]]
+        # one unbroken trace: exactly ONE spawn and ONE run span — the
+        # reattach must not have opened a second incarnation
+        assert names.count("worker/spawn") == 1
+        assert names.count("worker/run") == 1
+        spawn = next(s for s in after["spans"]
+                     if s["name"] == "worker/spawn")
+        orig = next(s for s in before["spans"]
+                    if s["name"] == "worker/spawn")
+        assert spawn["t0"] == pytest.approx(orig["t0"], abs=1e-6)
+        # the run span covers the outage (started before the kill,
+        # finished after the restart) — a single unbroken execution
+        run = next(s for s in after["spans"] if s["name"] == "worker/run")
+        assert run["t1"] - run["t0"] > 3.0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_trace_unbroken_across_restart_journal_tail_only(tmp_path):
+    """Second seed: the same continuity without a snapshot — the restore
+    rebuilds the trace purely from replayed journal events."""
+    with HqEnv(tmp_path) as env:
+        journal = tmp_path / "journal.bin"
+        env.start_server("--journal", str(journal))
+        env.start_worker("--on-server-lost", "reconnect", cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--", "sleep", "4"])
+
+        def running():
+            jobs = json.loads(env.command(
+                ["job", "info", "1", "--output-mode", "json"]
+            ))
+            return jobs and jobs[0]["counters"]["running"] >= 1
+
+        wait_until(running, timeout=30, message="task running")
+        before = _get_trace(env, "1.0")
+        env.kill_process("server")
+        env.start_server("--journal", str(journal))
+        env.command(["job", "wait", "1"], timeout=60)
+        after = _get_trace(env, "1.0")
+        assert after["trace_id"] == before["trace_id"]
+        assert after["closed"] and after["complete"], after
+        names = [s["name"] for s in after["spans"]]
+        assert names.count("worker/spawn") == 1
+        assert names.count("worker/run") == 1

@@ -63,16 +63,17 @@ class TestEvents:
     workers_new: list[int] = field(default_factory=list)
     workers_lost: list[tuple[int, str]] = field(default_factory=list)
 
-    def on_task_started(self, task_id, instance_id, worker_ids, variant=0):
+    def on_task_started(self, task_id, instance_id, worker_ids, variant=0,
+                        wtrace=None):
         self.started.append(task_id)
 
     def on_task_restarted(self, task_id):
         self.restarted.append(task_id)
 
-    def on_task_finished(self, task_id):
+    def on_task_finished(self, task_id, wtrace=None):
         self.finished.append(task_id)
 
-    def on_task_failed(self, task_id, message):
+    def on_task_failed(self, task_id, message, wtrace=None):
         self.failed.append((task_id, message))
 
     def on_task_canceled(self, task_id):
