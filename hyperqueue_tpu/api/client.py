@@ -144,6 +144,43 @@ class Client:
         )
         return response["job_id"]
 
+    def submit_stream(self, name: str, body: dict, entries,
+                      chunk_size: int = 16384, request: dict | None = None,
+                      max_fails: int | None = None,
+                      window: int | None = None) -> tuple[int, int]:
+        """Streaming chunked array submit (ISSUE 10): one task per entry
+        (HQ_ENTRY), pipelined to the server in `chunk_size` chunks over
+        the chunked ingest plane. `entries` may be any iterable — a
+        generator is never buffered beyond one chunk plus the in-flight
+        window, so arbitrarily long streams submit in bounded memory.
+        Returns (job_id, n_tasks)."""
+        from hyperqueue_tpu.client.connection import SubmitStream
+
+        stream = SubmitStream(
+            self._session,
+            {"name": name, "submit_dir": os.getcwd(),
+             "max_fails": max_fails},
+            window=window,
+        )
+        base = {"body": body, "request": request or {}}
+        next_id = 0
+        buf: list = []
+        for entry in entries:
+            buf.append(entry if isinstance(entry, str) else str(entry))
+            if len(buf) >= max(chunk_size, 1):
+                stream.send_chunk(array={
+                    **base, "id_range": [next_id, next_id + len(buf)],
+                    "entries": buf,
+                })
+                next_id += len(buf)
+                buf = []
+        if buf:
+            stream.send_chunk(array={
+                **base, "id_range": [next_id, next_id + len(buf)],
+                "entries": buf,
+            })
+        return stream.finish()
+
     def wait_for_jobs(self, job_ids: list[int], raise_on_fail: bool = True,
                       progress=None):
         """progress: optional callback(done, total) polled while waiting

@@ -162,6 +162,9 @@ def cmd_server_start(args) -> None:
             stall_budget=args.stall_budget,
             stall_dumps=args.stall_dumps,
             task_trace_capacity=args.task_trace_capacity,
+            client_plane=args.client_plane,
+            ingest_window=args.ingest_window,
+            lazy_array_threshold=args.lazy_array_threshold,
         )
         access = await server.start()
         print(
@@ -955,9 +958,57 @@ def _subset_array_entries(
     return ids, [entry_values[i] for i in ids]
 
 
+def _iter_array_chunks(array: dict, chunk_size: int):
+    """Split one wire array description into submit chunks; contiguous id
+    runs travel as compact "id_range" [start, stop) — O(1) per chunk on
+    the wire and in the server's lazy store."""
+    ids = array["ids"]
+    entries = array.get("entries")
+    base = {k: v for k, v in array.items() if k not in ("ids", "entries")}
+    for start in range(0, len(ids), chunk_size):
+        part = ids[start:start + chunk_size]
+        chunk = dict(base)
+        if part[-1] - part[0] + 1 == len(part):
+            chunk["id_range"] = [part[0], part[0] + len(part)]
+        else:
+            chunk["ids"] = part
+        if entries is not None:
+            chunk["entries"] = entries[start:start + chunk_size]
+        yield chunk
+
+
+def _iter_stdin_chunks(array_base: dict, chunk_size: int, lines=None):
+    """`hq submit --from-stdin`: one task per stdin line (entry in
+    HQ_ENTRY), yielded in chunks WITHOUT ever materializing the whole
+    task list client-side — memory is bounded by chunk_size plus the
+    in-flight window, no matter how many lines arrive."""
+    source = lines if lines is not None else sys.stdin
+    next_id = 0
+    entries: list[str] = []
+    for line in source:
+        entries.append(line.rstrip("\n"))
+        if len(entries) >= chunk_size:
+            chunk = dict(array_base)
+            chunk["id_range"] = [next_id, next_id + len(entries)]
+            chunk["entries"] = entries
+            next_id += len(entries)
+            entries = []
+            yield chunk
+    if entries:
+        chunk = dict(array_base)
+        chunk["id_range"] = [next_id, next_id + len(entries)]
+        chunk["entries"] = entries
+        yield chunk
+
+
 def cmd_submit(args) -> None:
     if not args.command:
         fail("no command given")
+    if args.from_stdin and (
+        args.array or args.each_line or args.from_json or args.stdin
+    ):
+        fail("--from-stdin cannot be combined with --array/--each-line/"
+             "--from-json/--stdin")
     submit_dir = os.getcwd()
     body_base = {
         "cmd": list(args.command),
@@ -988,7 +1039,7 @@ def cmd_submit(args) -> None:
     _check_submit_placeholders(
         args,
         is_array=args.array is not None or args.each_line is not None
-        or args.from_json is not None,
+        or args.from_json is not None or args.from_stdin,
     )
     if args.each_line:
         with open(args.each_line) as f:
@@ -1030,16 +1081,49 @@ def cmd_submit(args) -> None:
     notify_runner = None
     if args.on_notify and (args.wait or args.progress):
         notify_runner = _NotifyRunner(args)
+    # streaming chunked ingest (ISSUE 10): stdin feeds, and arrays larger
+    # than --chunk-size, go through the pipelined submit_chunk plane
+    chunks_iter = None
+    chunk_size = max(args.chunk_size, 1) if args.chunk_size else 0
+    if args.from_stdin:
+        array_base = {
+            "body": body_base, "request": request,
+            "priority": args.priority, "crash_limit": args.crash_limit,
+        }
+        chunks_iter = _iter_stdin_chunks(array_base, chunk_size or 16384)
+    elif (
+        chunk_size
+        and job_desc.get("array")
+        and len(job_desc["array"].get("ids") or ()) > chunk_size
+    ):
+        chunks_iter = _iter_array_chunks(job_desc["array"], chunk_size)
     with _session(args) as session:
         # trace-context stamp: the client's send clock opens every task's
         # distributed trace (`hq task trace` client/submit span)
         from hyperqueue_tpu.transport.framing import attach_trace
         from hyperqueue_tpu.utils.trace import new_trace_id
 
-        response = session.request(attach_trace(
-            {"op": "submit", "job": job_desc},
-            new_trace_id(), sent_at=time.time(),
-        ))
+        if chunks_iter is not None:
+            from hyperqueue_tpu.client.connection import SubmitStream
+
+            header = {
+                "name": job_desc["name"], "submit_dir": submit_dir,
+                "max_fails": args.max_fails,
+            }
+            if args.job is not None:
+                header["job_id"] = args.job
+            stream = SubmitStream(
+                session, header, window=args.submit_window
+            )
+            for chunk in chunks_iter:
+                stream.send_chunk(array=chunk)
+            stream_job_id, stream_n = stream.finish()
+            response = {"job_id": stream_job_id, "n_tasks": stream_n}
+        else:
+            response = session.request(attach_trace(
+                {"op": "submit", "job": job_desc},
+                new_trace_id(), sent_at=time.time(),
+            ))
         job_id = response["job_id"]
         if notify_runner is not None:
             notify_runner.set_job_id(job_id)
@@ -2008,6 +2092,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "never capture)")
     p.add_argument("--stall-dumps", type=int, default=8, metavar="N",
                    help="keep at most N stall dump files")
+    p.add_argument("--client-plane", choices=["thread", "reactor"],
+                   default="thread",
+                   help="where client connections are served: 'thread' "
+                        "(default) runs accept/auth/framing/decode on a "
+                        "dedicated connection-plane thread with a batched "
+                        "handoff to the scheduler reactor; 'reactor' keeps "
+                        "them on the reactor loop (escape hatch)")
+    p.add_argument("--ingest-window", type=int, default=64, metavar="N",
+                   help="per-client cap on handed-off, unanswered requests "
+                        "before the connection plane pauses reading that "
+                        "client (backpressure)")
+    p.add_argument("--lazy-array-threshold", type=int, default=4096,
+                   metavar="N",
+                   help="array submits with at least N tasks are stored as "
+                        "lazy chunks and materialized at dispatch "
+                        "(0 disables lazy materialization)")
     p.add_argument("--task-trace-capacity", type=int, default=16384,
                    metavar="N",
                    help="bound the per-task distributed-trace store to N "
@@ -2185,6 +2285,17 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--array", default=None)
         p.add_argument("--each-line", default=None)
         p.add_argument("--from-json", default=None)
+        p.add_argument("--from-stdin", action="store_true",
+                       help="one task per stdin line (entry in HQ_ENTRY), "
+                            "streamed to the server in chunks — the task "
+                            "list is never buffered whole on either side")
+        p.add_argument("--chunk-size", type=int, default=16384,
+                       help="tasks per streamed submit chunk; arrays "
+                            "larger than this use the pipelined chunked "
+                            "ingest plane (0 disables chunking)")
+        p.add_argument("--submit-window", type=int, default=None,
+                       help="max in-flight unacked chunks "
+                            "(default HQ_SUBMIT_WINDOW or 8)")
         p.add_argument("--env", action="append")
         p.add_argument("--cwd", default=None)
         p.add_argument("--stdout", default=None)
@@ -2278,6 +2389,10 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("job_file")
     p.add_argument("--wait", action="store_true")
+    p.add_argument("--chunk-size", type=int, default=16384,
+                   help="stream jobfiles larger than this many tasks in "
+                        "chunks over the pipelined ingest plane (0 = one "
+                        "monolithic submit)")
     p.set_defaults(fn=cmd_job_submit_file)
 
     # alloc
@@ -2612,10 +2727,28 @@ def cmd_job_submit_file(args) -> None:
         from hyperqueue_tpu.transport.framing import attach_trace
         from hyperqueue_tpu.utils.trace import new_trace_id
 
-        response = session.request(attach_trace(
-            {"op": "submit", "job": job_desc},
-            new_trace_id(), sent_at=time.time(),
-        ))
+        tasks = job_desc.get("tasks") or []
+        chunk_size = max(getattr(args, "chunk_size", 16384) or 0, 0)
+        if chunk_size and len(tasks) > chunk_size:
+            # big jobfile: stream the task graph in chunks (deps always
+            # reference tasks defined ABOVE, so in-order chunking keeps
+            # every dependency in an earlier-or-same chunk)
+            from hyperqueue_tpu.client.connection import SubmitStream
+
+            stream = SubmitStream(session, {
+                "name": job_desc["name"],
+                "submit_dir": job_desc["submit_dir"],
+                "max_fails": job_desc.get("max_fails"),
+            })
+            for start in range(0, len(tasks), chunk_size):
+                stream.send_chunk(tasks=tasks[start:start + chunk_size])
+            job_id, n_tasks = stream.finish()
+            response = {"job_id": job_id, "n_tasks": n_tasks}
+        else:
+            response = session.request(attach_trace(
+                {"op": "submit", "job": job_desc},
+                new_trace_id(), sent_at=time.time(),
+            ))
         job_id = response["job_id"]
         out = make_output(args.output_mode)
         if args.output_mode == "quiet":

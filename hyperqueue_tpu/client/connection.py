@@ -186,6 +186,179 @@ class ClientSession:
         self.close()
 
 
+class SubmitStream:
+    """Pipelined chunked submit over one ClientSession (ISSUE 10).
+
+    Chunks are tagged with (stream uid, chunk index) and sent without
+    waiting for each response; the client keeps a bounded in-flight
+    window (HQ_SUBMIT_WINDOW, default 8) and reads per-chunk acks as the
+    window fills — so a giant array streams to the server at pipeline
+    speed with bounded memory on BOTH ends.
+
+    Exactly-once across failures: on a transport error (server restart
+    window) the stream reconnects through the session's retry machinery
+    and re-sends every unacked chunk. The server deduplicates on
+    (uid, index) — journaled with each chunk — so replayed chunks yield
+    idempotent duplicate acks, never duplicate tasks. After the first ack
+    the job id is pinned into the header, so chunks replayed against a
+    restored server land on the SAME job.
+
+    `n_tasks` is the stream's acknowledged task coverage (counted from
+    the chunks themselves, so a chunk whose first ack was lost and whose
+    replay acked `dup` still counts once); `dup_chunks` counts acks the
+    server deduplicated.
+    """
+
+    def __init__(self, session: ClientSession, header: dict,
+                 window: int | None = None, uid: str | None = None):
+        from hyperqueue_tpu.utils.trace import new_trace_id
+
+        self.session = session
+        self.header = dict(header)
+        if window is None:
+            try:
+                window = int(os.environ.get("HQ_SUBMIT_WINDOW", "8"))
+            except ValueError:
+                window = 8
+        self.window = max(window, 1)
+        self.uid = uid or new_trace_id()
+        self.job_id: int | None = None
+        self.n_tasks = 0
+        self.dup_chunks = 0
+        self._next_index = 0
+        self._unacked: dict[int, dict] = {}
+        self._sealed = False
+
+    # --- wire helpers (session-loop, with reconnect + replay) -----------
+    def _replay_unacked(self) -> None:
+        for i in sorted(self._unacked):
+            frame = self._unacked[i]
+            if self.job_id is not None:
+                frame["job"]["job_id"] = self.job_id
+            self.session._loop.run_until_complete(
+                self.session._conn.send(frame)
+            )
+
+    def _recover(self, deadline: float) -> None:
+        """Reconnect and replay every unacked chunk, retrying the whole
+        sequence (a second connection flap mid-replay must keep retrying
+        within the SAME window, not abort the stream)."""
+        while True:
+            if self.session._retries_exhausted(deadline):
+                raise ConnectionError(
+                    "submit stream: retry window exhausted"
+                )
+            self.session._conn.close()
+            self.session._conn = self.session._loop.run_until_complete(
+                self.session._connect_with_retry(deadline=deadline)
+            )
+            try:
+                self._replay_unacked()
+                return
+            except _RETRIABLE:
+                continue
+
+    def _with_retry(self, op) -> dict | None:
+        """Run one recv step; on a transport error reconnect + replay the
+        unacked chunks, then retry the step. (Sends do NOT use this — a
+        replay already re-sends the failed frame, so retrying the send
+        itself would put a duplicate on the wire whose extra ack desyncs
+        the session's request/response protocol.)"""
+        deadline = time.monotonic() + self.session.retry_window
+        while True:
+            try:
+                return self.session._loop.run_until_complete(op())
+            except _RETRIABLE:
+                self._recover(deadline)
+
+    def _recv_ack(self) -> None:
+        async def step():
+            return await self.session._conn.recv()
+
+        ack = self._with_retry(step)
+        if not isinstance(ack, dict) or ack.get("op") == "error":
+            msg = (ack or {}).get("message", "server error")
+            raise ClientError(msg)
+        index = ack["i"]
+        frame = self._unacked.pop(index, None)
+        if self.job_id is None:
+            self.job_id = ack["job_id"]
+            self.header["job_id"] = self.job_id
+        if ack.get("dup"):
+            self.dup_chunks += 1
+        # count tasks from the FRAME on its first ack, not from the
+        # server's n_tasks field: a chunk applied before a connection
+        # drop acks `dup` (n_tasks=0) on the replay, and the stream's
+        # total must still cover it
+        if frame is not None:
+            self.n_tasks += _frame_task_count(frame)
+
+    def _send_frame(self, frame: dict) -> None:
+        while len(self._unacked) >= self.window:
+            self._recv_ack()
+        self._unacked[frame["i"]] = frame
+        try:
+            self.session._loop.run_until_complete(
+                self.session._conn.send(frame)
+            )
+        except _RETRIABLE:
+            # the frame is already in _unacked: recovery's replay sends
+            # it exactly once on the new connection — do NOT also retry
+            # the send (the extra duplicate would earn an extra ack that
+            # finish() never drains, desyncing the session)
+            self._recover(time.monotonic() + self.session.retry_window)
+
+    # --- public API -------------------------------------------------------
+    def send_chunk(self, array: dict | None = None,
+                   tasks: list | None = None, last: bool = False) -> None:
+        """Queue one chunk: an array description ({"id_range": [lo, hi)}
+        or {"ids": [...]} plus shared body/request/...) or a graph task
+        list. Blocks only while the in-flight window is full."""
+        if self._sealed:
+            raise ClientError("submit stream already finished")
+        from hyperqueue_tpu.transport.framing import attach_trace
+        from hyperqueue_tpu.utils.trace import new_trace_id
+
+        frame: dict = {
+            "op": "submit_chunk",
+            "uid": self.uid,
+            "i": self._next_index,
+            "rid": self._next_index,
+            "job": dict(self.header),
+        }
+        if array is not None:
+            frame["array"] = array
+        if tasks is not None:
+            frame["tasks"] = tasks
+        if last:
+            frame["last"] = True
+            self._sealed = True
+        attach_trace(frame, new_trace_id(), sent_at=time.time())
+        self._next_index += 1
+        self._send_frame(frame)
+
+    def finish(self) -> tuple[int, int]:
+        """Seal the stream (empty final chunk if none was marked last),
+        drain every outstanding ack, and return (job_id, n_tasks)."""
+        if not self._sealed:
+            self.send_chunk(last=True)
+        while self._unacked:
+            self._recv_ack()
+        return self.job_id, self.n_tasks
+
+
+def _frame_task_count(frame: dict) -> int:
+    """Tasks carried by one submit_chunk frame (client-side count for the
+    stream total — independent of whether the server ack was a dup)."""
+    array = frame.get("array")
+    if array:
+        id_range = array.get("id_range")
+        if id_range is not None:
+            return int(id_range[1]) - int(id_range[0])
+        return len(array.get("ids") or ())
+    return len(frame.get("tasks") or ())
+
+
 def _streaming_request(server_dir: Path, request: dict, on_subscribed=None):
     """One authenticated client connection turned into a frame generator:
     send `request`, yield every received frame until the server closes or

@@ -76,6 +76,11 @@ class _RestoreAcc:
         self.task_wtrace: dict[tuple[int, int], dict] = {}
         self.task_finish_wtrace: dict[tuple[int, int], dict] = {}
         self.task_trace_seed: dict[int, dict] = {}
+        # unmaterialized lazy array chunks from a snapshot (ISSUE 10):
+        # (job_id, spec) pairs registered into the core's LazyStore at the
+        # end of restore, AFTER the journal tail names which of their ids
+        # gained per-task state and must materialize eagerly instead
+        self.lazy_chunks: list[tuple[int, dict]] = []
         # restore generation: every boot that owned this journal wrote one
         # server-uid record; a snapshot folds the pre-watermark count into
         # n_boots and tail records add to it. Fencing jumps re-issued tasks
@@ -114,6 +119,18 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
             acc.task_finished_at[key] = finished_at
             if started_at:
                 acc.task_started_at[key] = (0.0, 0.0, started_at)
+        for uid, s in (jd.get("streams") or {}).items():
+            job.streams[uid] = {
+                "applied": set(s["applied"]), "sealed": bool(s["sealed"]),
+            }
+            if not s["sealed"]:
+                job.open_streams += 1
+            server._stream_jobs[uid] = job_id
+        for spec in jd.get("lazy") or ():
+            resolved = dict(spec)
+            resolved["body"] = bodies[spec["b"]]
+            resolved["request"] = requests[spec["rq"]]
+            acc.lazy_chunks.append((job_id, resolved))
         descs = acc.job_descs.setdefault(job_id, [])
         for t in jd["pending"]:
             tid = t["id"]
@@ -159,6 +176,24 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
     server.jobs.job_id_counter.ensure_above(state.get("next_job_id", 1) - 1)
 
 
+def _array_replays_lazy(server, array: dict) -> bool:
+    """Should this journaled array desc stay compact through replay?
+    Mirrors the live ingest decision (_ingest_array_desc): at/above the
+    server's lazy threshold and single-node only (multi-node requests
+    never register lazily)."""
+    threshold = getattr(server, "lazy_array_threshold", 1 << 62)
+    id_range = array.get("id_range")
+    n = (
+        int(id_range[1]) - int(id_range[0])
+        if id_range is not None
+        else len(array.get("ids") or ())
+    )
+    if n < threshold:
+        return False
+    variants = (array.get("request") or {}).get("variants") or []
+    return not any(v.get("n_nodes") for v in variants)
+
+
 def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
     """One journal record into the accumulators (phase 2 / full replay)."""
     kind = record.get("event")
@@ -179,13 +214,64 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
             not job.tasks or submit_time < job.submitted_at
         ):
             job.submitted_at = submit_time
+        # chunked-submit stream bookkeeping (ISSUE 10): applied chunk
+        # indexes are the exactly-once fence a reconnecting client's
+        # retried chunks are deduplicated against — restored for BOTH the
+        # compact-lazy and the expanded replay paths below
+        chunk = record.get("chunk")
+        if isinstance(chunk, dict) and chunk.get("uid"):
+            uid = chunk["uid"]
+            server._stream_jobs[uid] = job_id
+            stream = job.streams.get(uid)
+            if stream is None:
+                stream = job.streams[uid] = {
+                    "applied": set(), "sealed": False,
+                }
+                job.open_streams += 1
+            stream["applied"].add(int(chunk.get("i", 0)))
+            if chunk.get("last") and not stream["sealed"]:
+                stream["sealed"] = True
+                job.open_streams = max(job.open_streams - 1, 0)
+        array = desc.get("array")
+        if array and _array_replays_lazy(server, array):
+            # keep the array COMPACT through replay: it re-registers as a
+            # lazy chunk at the end of restore (minus any journal-tail-
+            # touched ids), exactly like a snapshot's "lazy" table — a
+            # crash right after a 1M-task lazy submit must not make
+            # restore O(tasks)
+            id_range = array.get("id_range")
+            n_array = (
+                int(id_range[1]) - int(id_range[0])
+                if id_range is not None else len(array["ids"])
+            )
+            spec: dict = {
+                "request": array.get("request") or {},
+                "body": array.get("body") or {},
+                "priority": int(array.get("priority", 0)),
+                "crash_limit": int(array.get("crash_limit", 5)),
+                "submitted_at": submit_time,
+                "ready_at": submit_time,
+            }
+            if id_range is not None:
+                spec["id_range"] = [int(id_range[0]), int(id_range[1])]
+            else:
+                spec["ids"] = list(array["ids"])
+            if array.get("entries") is not None:
+                spec["entries"] = list(array["entries"])
+            tctx0 = record.get("trace")
+            if isinstance(tctx0, dict) and tctx0.get("id"):
+                spec["trace"] = {**tctx0, "commit_at": submit_time}
+            acc.lazy_chunks.append((job_id, spec))
+            job.submits.append(submit_record(desc, n_array))
+            return
         expanded = expand_desc_tasks(desc)
         for t in expanded:
             server.jobs.attach_task(job, t.get("id", 0))
             if submit_time:
                 # keep the ORIGINAL submit clock, not the restore's
                 job.tasks[t.get("id", 0)].submitted_at = submit_time
-        job.submits.append(submit_record(desc, len(expanded)))
+        if expanded:
+            job.submits.append(submit_record(desc, len(expanded)))
         acc.job_descs.setdefault(job_id, []).extend(expanded)
         tctx = record.get("trace")
         if isinstance(tctx, dict) and tctx.get("id"):
@@ -206,10 +292,27 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
         job = server.jobs.jobs.get(job_id)
         if job is not None:
             job.is_open = False
+            # a close seals abandoned chunk streams (mirrors the live
+            # _client_close_job), or the restored job could never end
+            job.seal_streams()
+    elif kind == "job-streams-sealed":
+        # a forced seal (cancel / rejected chunk) — no `last` chunk event
+        # exists for these, so the dedicated record re-seals on replay
+        job = server.jobs.jobs.get(job_id)
+        if job is not None:
+            for uid in record.get("uids") or ():
+                stream = job.streams.get(uid)
+                if stream is not None and not stream["sealed"]:
+                    stream["sealed"] = True
+                    job.open_streams = max(job.open_streams - 1, 0)
     elif kind == "job-completed":
         job = server.jobs.jobs.get(job_id)
-        if job is not None and record.get("cancel_reason"):
-            job.cancel_reason = record["cancel_reason"]
+        if job is not None:
+            if record.get("cancel_reason"):
+                job.cancel_reason = record["cancel_reason"]
+            # a job that reported completion has no open streams by
+            # definition (belt and braces for pre-seal-event journals)
+            job.seal_streams()
     elif kind in TERMINAL:
         acc.task_status[(job_id, record["task"])] = (
             TERMINAL[kind],
@@ -252,6 +355,90 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
     elif kind == "server-uid":
         server.journal_uids.add(record.get("server_uid") or "")
         acc.n_boots += 1
+
+
+def _apply_lazy_chunks(server, acc: _RestoreAcc) -> None:
+    """Re-register a snapshot's unmaterialized array chunks (ISSUE 10).
+
+    Each chunk re-enters the lazy store with its ORIGINAL clocks and
+    interned request; ids that gained per-task state in the journal tail
+    (started/crashed/terminal after the snapshot) are dropped from the
+    chunk and appended to acc.job_descs so the standard per-task restore
+    path (reattach holds, fencing, counters) handles them."""
+    from hyperqueue_tpu.server.lazy import ArrayChunk
+
+    touched: dict[int, set[int]] = {}
+    for key in (
+        set(acc.task_status)
+        | set(acc.task_instances)
+        | set(acc.task_maybe_running)
+        | set(acc.task_crashes)
+    ):
+        touched.setdefault(key[0], set()).add(key[1])
+    core = server.core
+    for job_id, spec in acc.lazy_chunks:
+        job = server.jobs.jobs.get(job_id)
+        if job is None:
+            continue
+        rqv = rqv_from_wire(spec.get("request") or {}, core.resource_map)
+        rq_id = core.intern_rqv(rqv)
+        if "id_range" in spec:
+            lo, hi = int(spec["id_range"][0]), int(spec["id_range"][1])
+            id_range, ids = (lo, hi), None
+            dead = [d for d in (spec.get("dead") or ()) if lo <= d < hi]
+            contains = lambda t: lo <= t < hi  # noqa: E731
+        else:
+            ids = [int(t) for t in spec["ids"]]
+            id_range = None
+            dead = []
+            id_set = set(ids)
+            contains = lambda t: t in id_set  # noqa: E731
+        hits = sorted(
+            t for t in touched.get(job_id, ()) if contains(t)
+        )
+        chunk = ArrayChunk(
+            job_id=job_id,
+            rq_id=rq_id,
+            priority=(int(spec.get("priority", 0)), -job_id),
+            body=spec.get("body") or {},
+            crash_limit=int(spec.get("crash_limit", 5)),
+            id_range=id_range,
+            ids=ids,
+            entries=spec.get("entries"),
+            submitted_at=float(spec.get("submitted_at") or 0.0),
+            ready_at=float(spec.get("ready_at") or 0.0),
+            trace=spec.get("trace"),
+        )
+        core.lazy.register(core, chunk)
+        for t in dead:
+            core.lazy.drop_id(core, job_id, t)
+        descs = acc.job_descs.setdefault(job_id, [])
+        for t in hits:
+            if not core.lazy.drop_id(core, job_id, t):
+                continue  # a dead id that also shows as touched
+            server.jobs.attach_task(job, t)
+            job.tasks[t].submitted_at = chunk.submitted_at
+            if chunk.trace and chunk.trace.get("id"):
+                # the chunk's submit stamps open this task's restored
+                # trace, same as materialization would have
+                acc.task_submit_trace.setdefault(
+                    (job_id, t), dict(chunk.trace)
+                )
+            desc = {
+                "id": t,
+                "body": chunk.body,
+                "request": spec.get("request") or {},
+                "priority": chunk.priority[0],
+                "crash_limit": chunk.crash_limit,
+                "deps": (),
+            }
+            if chunk.entries is not None:
+                index = chunk.index_of(t)
+                if index is not None:
+                    desc["entry"] = chunk.entries[index]
+            descs.append(desc)
+        if core.lazy.job_unmaterialized(job_id):
+            server.comm.ask_for_scheduling()
 
 
 def _rebuild_traces(server, acc: _RestoreAcc) -> None:
@@ -383,6 +570,7 @@ def restore_from_journal(server) -> None:
             server.jobs = JobManager()
             server.journal_uids = set()
             server._event_seq = 0
+            server._stream_jobs = {}
             acc = _RestoreAcc()
 
     # --- phase 2: journal tail replay ----------------------------------
@@ -407,6 +595,13 @@ def restore_from_journal(server) -> None:
                 continue
             n_events += 1
             _replay_record(server, acc, record)
+
+    # lazy snapshot chunks: ids the journal tail touched (a start, crash,
+    # or terminal event after the snapshot) must materialize through the
+    # normal per-task path; everything else re-registers as a lazy chunk —
+    # a restored 1M-task lazy array stays O(chunks + touched)
+    if acc.lazy_chunks:
+        _apply_lazy_chunks(server, acc)
 
     # apply terminal statuses to job counters (with the ORIGINAL clock so
     # `hq job timeline` of a restored job reports true phase durations)

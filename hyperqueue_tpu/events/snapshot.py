@@ -161,7 +161,7 @@ def capture_state(server) -> dict:
             if task.entry is not None:
                 entry["entry"] = task.entry
             pending.append(entry)
-        jobs_out.append({
+        jd = {
             "id": job.job_id,
             "name": job.name,
             "submit_dir": job.submit_dir,
@@ -172,7 +172,68 @@ def capture_state(server) -> dict:
             "submits": job.submits,
             "done": done,
             "pending": pending,
-        })
+        }
+        # chunked-submit streams (ISSUE 10): applied chunk indexes are the
+        # exactly-once fence for client retries; they must survive any
+        # restore the journal would have survived
+        if job.streams:
+            jd["streams"] = {
+                uid: {"applied": sorted(s["applied"]),
+                      "sealed": bool(s["sealed"])}
+                for uid, s in job.streams.items()
+            }
+        # unmaterialized lazy array chunks: O(chunks + tombstones) — the
+        # whole point is that a 1M-task lazy array snapshots (and
+        # restores) without expanding to per-task records
+        lazy_out = []
+        for seg in server.core.lazy.segments_of(job.job_id):
+            chunk = seg.chunk
+            body_key = id(chunk.body)
+            body_i = body_index.get(body_key)
+            if body_i is None:
+                body_i = len(bodies)
+                body_index[body_key] = body_i
+                bodies.append(chunk.body)
+            rq_i = request_index.get(chunk.rq_id)
+            if rq_i is None:
+                rq_i = len(requests)
+                request_index[chunk.rq_id] = rq_i
+                requests.append(
+                    rqv_to_wire(
+                        core.rq_map.get_variants(chunk.rq_id),
+                        core.resource_map,
+                    )
+                )
+            spec: dict = {
+                "b": body_i,
+                "rq": rq_i,
+                "priority": chunk.priority[0],
+                "crash_limit": chunk.crash_limit,
+                "submitted_at": chunk.submitted_at,
+                "ready_at": chunk.ready_at,
+            }
+            if chunk.trace:
+                spec["trace"] = chunk.trace
+            if chunk.id_range is not None and chunk.entries is None:
+                spec["id_range"] = [
+                    chunk.id_range[0] + seg.pos, chunk.id_range[1],
+                ]
+                dead = [
+                    chunk.id_at(i) for i in sorted(seg.dead) if i >= seg.pos
+                ]
+                if dead:
+                    spec["dead"] = dead
+            else:
+                remaining = list(seg.remaining_ids())
+                spec["ids"] = remaining
+                if chunk.entries is not None:
+                    spec["entries"] = [
+                        chunk.entries[chunk.index_of(t)] for t in remaining
+                    ]
+            lazy_out.append(spec)
+        if lazy_out:
+            jd["lazy"] = lazy_out
+        jobs_out.append(jd)
     # live tasks' distributed traces (utils/trace.py TaskTraceStore): the
     # GC'd journal prefix held their submit/start events, so the snapshot
     # must carry the assembled spans or a snapshot-seeded restore would

@@ -26,7 +26,13 @@ from hyperqueue_tpu.models.milp import MilpModel
 from hyperqueue_tpu.models.multichip import MultichipModel
 from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.core import Core
+from hyperqueue_tpu.server.ingest import (
+    INGEST_CHUNKS,
+    INGEST_TASKS,
+    IngestPlane,
+)
 from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
+from hyperqueue_tpu.server.lazy import ArrayChunk
 from hyperqueue_tpu.server.protocol import rqv_from_wire, submit_record
 from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
 from hyperqueue_tpu.server.task import Task, TaskState
@@ -445,6 +451,10 @@ class Server:
         stall_budget: float = 1.0,
         stall_dumps: int = 8,
         task_trace_capacity: int = 16384,
+        client_plane: str = "thread",
+        ingest_window: int = 64,
+        ingest_handoff_max: int = 8192,
+        lazy_array_threshold: int = 4096,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -541,9 +551,34 @@ class Server:
         # consumers are dropped (counter), never allowed to grow the queue
         # without bound (the autoscaler/`hq top` feed)
         self._subscribers: list[_Subscriber] = []
+        # client-connection plane (server/ingest.py): "thread" (default)
+        # moves accept/auth/framing/decode off the reactor loop onto a
+        # dedicated thread with a batched handoff; "reactor" keeps the
+        # pre-ISSUE-10 in-loop handling (operational escape hatch)
+        if client_plane not in ("thread", "reactor"):
+            raise ValueError(f"unknown client plane {client_plane!r}")
+        self.client_plane = client_plane
+        self.ingest_window = ingest_window
+        self.ingest_handoff_max = ingest_handoff_max
+        self.ingest_plane: IngestPlane | None = None
+        self._handoff_wake = asyncio.Event()
+        # streaming-op tasks spawned by the ingest drain loop, cancelled
+        # at shutdown (legacy plane ties their lifetime to the conn task)
+        self._client_tasks: set = set()
+        # arrays at/above this size are stored as lazy chunks
+        # (server/lazy.py) instead of per-task records; 0 disables
+        self.lazy_array_threshold = (
+            lazy_array_threshold if lazy_array_threshold > 0 else 1 << 62
+        )
+        # chunked-submit streams: submit uid -> job id (exactly-once chunk
+        # replay lands on the same job across client reconnects/restores)
+        self._stream_jobs: dict[str, int] = {}
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
+        # lazy materialization needs the CURRENT job manager (restore may
+        # swap it out on a snapshot fallback): bind a getter, not the object
+        self.core.lazy.jobs_getter = lambda: self.jobs
         if scheduler == "milp":
             base_model = MilpModel()
         elif scheduler == "multichip":
@@ -650,15 +685,33 @@ class Server:
             self.client_port = preshared.client_port
             self.worker_port = preshared.worker_port
 
-        client_srv = await asyncio.start_server(
-            self._handle_client_conn, "0.0.0.0", self.client_port
-        )
         worker_srv = await asyncio.start_server(
             self._handle_worker_conn, "0.0.0.0", self.worker_port
         )
-        self._servers = [client_srv, worker_srv]
-        self.client_port = client_srv.sockets[0].getsockname()[1]
+        self._servers = [worker_srv]
         self.worker_port = worker_srv.sockets[0].getsockname()[1]
+        if self.client_plane == "thread":
+            # decoupled connection plane (server/ingest.py): client
+            # sockets live on their own thread; decoded messages cross
+            # into this loop through the batched handoff drained by
+            # _ingest_drain_loop
+            self.ingest_plane = IngestPlane(
+                lambda: (
+                    self.access.client_key_bytes() if self.access else None
+                ),
+                window=self.ingest_window,
+                handoff_max=self.ingest_handoff_max,
+            )
+            self.client_port = self.ingest_plane.start(
+                "0.0.0.0", self.client_port,
+                asyncio.get_running_loop(), self._handoff_wake.set,
+            )
+        else:
+            client_srv = await asyncio.start_server(
+                self._handle_client_conn, "0.0.0.0", self.client_port
+            )
+            self._servers.append(client_srv)
+            self.client_port = client_srv.sockets[0].getsockname()[1]
 
         self._metrics_hook = self._collect_metrics
         REGISTRY.add_collect_hook(self._metrics_hook)
@@ -703,6 +756,8 @@ class Server:
         self._tasks.append(self._spawn_loop(self._scheduler_loop))
         self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
         self._tasks.append(self._spawn_loop(self._loop_lag_monitor))
+        if self.ingest_plane is not None:
+            self._tasks.append(self._spawn_loop(self._ingest_drain_loop))
         if self.journal is not None and (
             self.journal_flush_period > 0 or self.journal_fsync == "periodic"
         ):
@@ -746,8 +801,12 @@ class Server:
         await asyncio.sleep(0.05)
         for t in self._tasks:
             t.cancel()
+        for t in list(self._client_tasks):
+            t.cancel()
         for srv in self._servers:
             srv.close()
+        if self.ingest_plane is not None:
+            self.ingest_plane.stop()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if self._metrics_hook is not None:
@@ -814,6 +873,25 @@ class Server:
         ).set(
             max((s.queue.qsize() for s in self._subscribers), default=0)
         )
+        # ingest plane + lazy store: depth/client gauges are read here at
+        # scrape time (single-writer rule: the counters are bumped by the
+        # reactor/ingest threads, never from the scrape)
+        lazy_stats = core.lazy.stats()
+        REGISTRY.gauge(
+            "hq_tasks_lazy",
+            "unmaterialized lazy array tasks (registered as chunks, "
+            "per-task records deferred to dispatch)",
+        ).set(lazy_stats["unmaterialized"])
+        if self.ingest_plane is not None:
+            REGISTRY.gauge(
+                "hq_ingest_handoff_depth",
+                "decoded client messages queued between the connection "
+                "plane and the reactor",
+            ).set(len(self.ingest_plane.handoff))
+            REGISTRY.gauge(
+                "hq_ingest_clients",
+                "client connections held by the connection plane",
+            ).set(len(self.ingest_plane.clients))
         trace_stats = core.traces.stats()
         REGISTRY.gauge(
             "hq_task_traces", "tasks with spans in the bounded trace store"
@@ -1063,6 +1141,15 @@ class Server:
     def schedule_cancel(self, task_ids: list[int]) -> None:
         reactor.on_cancel_tasks(self.core, self.comm, self.events, task_ids)
 
+    def _seal_job_streams(self, job) -> None:
+        """Force-seal a job's chunk streams AND journal the seal (a
+        forced seal has no `last` chunk event to replay from)."""
+        sealed = job.seal_streams()
+        if sealed:
+            self.emit_event(
+                "job-streams-sealed", {"job": job.job_id, "uids": sealed}
+            )
+
     def check_job_completion(self, job_id: int) -> None:
         job = self.jobs.jobs.get(job_id)
         if job is None:
@@ -1073,6 +1160,14 @@ class Server:
                 {"job": job_id, "status": job.status(),
                  "cancel_reason": job.cancel_reason},
             )
+            # a terminated job's streams are dead: release their uid
+            # mappings and applied-index sets (a long-lived server must
+            # not grow per-stream state forever — retried chunks now get
+            # a "sealed" error instead of a dup ack, which is fine: the
+            # retrying client's stream already failed terminally)
+            for uid, stream in job.streams.items():
+                self._stream_jobs.pop(uid, None)
+                stream["applied"] = set()
         # waiters are satisfied when every task submitted SO FAR is terminal —
         # for open jobs that is the useful "wait" semantics (the job itself
         # terminates only when closed)
@@ -1150,6 +1245,128 @@ class Server:
                     (time.perf_counter() - t0) * 1e3,
                     extra={"tick": self.core.tick_counter},
                 )
+
+    # --- ingest drain loop (client-connection plane handoff) ------------
+    # max handoff items consumed per drain pass: bounds the reactor hold
+    # (one pass is one `ingest` lag-plane observation) while still
+    # amortizing journal group commits across a burst of submit chunks
+    INGEST_DRAIN_BATCH = 256
+
+    async def _ingest_drain_loop(self) -> None:
+        """Consume batches of decoded client messages from the connection
+        plane (server/ingest.py). Runs of consecutive `submit_chunk`
+        messages — across ALL clients — are applied under ONE journal
+        group commit, and their acks are queued only after that commit
+        lands (durability-before-visibility across chunk boundaries)."""
+        plane = self.ingest_plane
+        while True:
+            await self._handoff_wake.wait()
+            self._handoff_wake.clear()
+            while plane.handoff:
+                items = plane.pop_batch(self.INGEST_DRAIN_BATCH)
+                t0 = time.perf_counter()
+                acks: list = []
+                batch = None
+
+                def flush_chunks() -> None:
+                    nonlocal batch
+                    if batch is not None:
+                        batch.__exit__(None, None, None)
+                        batch = None
+                    for ch, resp in acks:
+                        ch.reply(resp)
+                    acks.clear()
+
+                try:
+                    for channel, msg in items:
+                        if msg is None:
+                            flush_chunks()
+                            self._on_channel_gone(channel)
+                            continue
+                        if not isinstance(msg, dict):
+                            # a malformed frame answers THAT client; it
+                            # must never crash the drain loop every
+                            # other client shares
+                            channel.reply({
+                                "op": "error",
+                                "message": "malformed request frame",
+                            })
+                            continue
+                        op = msg.get("op")
+                        if op == "submit_chunk":
+                            if batch is None:
+                                batch = self._journal_group_commit()
+                                batch.__enter__()
+                            try:
+                                resp = self._apply_submit_chunk(msg)
+                            except Exception as e:  # noqa: BLE001
+                                logger.exception("submit_chunk failed")
+                                resp = {"op": "error", "message": str(e),
+                                        "rid": msg.get("rid")}
+                            acks.append((channel, resp))
+                            continue
+                        # any non-chunk op is a durability barrier: commit
+                        # the open chunk batch and release its acks first,
+                        # preserving per-connection FIFO
+                        flush_chunks()
+                        if op in ("stream_events", "subscribe"):
+                            self._spawn_client_stream(channel, op, msg)
+                            continue
+                        if op in self._RPC_LAG_EXEMPT:
+                            # ops that await external progress (job_wait,
+                            # compaction, manager dry-runs) must not stall
+                            # the drain loop for every other client
+                            self._spawn_client_request(channel, msg)
+                            continue
+                        response = await self._handle_client_message(msg)
+                        if response is not None:
+                            channel.reply(response)
+                finally:
+                    flush_chunks()
+                self.note_plane("ingest", time.perf_counter() - t0)
+                plane.notify_drained()
+                # yield between batches: a sustained multi-client flood
+                # must round-robin with the scheduler tick and the worker
+                # plane, not hold the loop until the handoff runs dry
+                await asyncio.sleep(0)
+
+    def _spawn_client_request(self, channel, msg: dict) -> None:
+        async def run() -> None:
+            response = await self._handle_client_message(msg)
+            if response is not None:
+                channel.reply(response)
+
+        task = asyncio.ensure_future(run())
+        self._client_tasks.add(task)
+        task.add_done_callback(self._client_tasks.discard)
+
+    def _spawn_client_stream(self, channel, op: str, msg: dict) -> None:
+        handler = (
+            self._stream_events if op == "stream_events" else self._subscribe
+        )
+        gone = channel.reactor_gone_event()
+
+        async def run() -> None:
+            try:
+                await handler(channel.stream_send, gone, msg)
+            except (ConnectionError, OSError):
+                pass  # consumer went away mid-send
+            except Exception:  # noqa: BLE001 - never kill the drain plane
+                logger.exception("client stream handler crashed")
+            finally:
+                # the stream is this connection's terminal op (the legacy
+                # plane breaks out of its recv loop the same way)
+                channel.close()
+
+        task = asyncio.ensure_future(run())
+        channel.stream_task = task
+        self._client_tasks.add(task)
+        task.add_done_callback(self._client_tasks.discard)
+
+    def _on_channel_gone(self, channel) -> None:
+        channel.is_gone = True
+        if channel.gone is not None:
+            channel.gone.set()
 
     async def _journal_flush_loop(self) -> None:
         """Flush the journal on --journal-flush-period instead of per event
@@ -1715,11 +1932,34 @@ class Server:
             )
             while True:
                 msg = await conn.recv()
-                if msg.get("op") == "stream_events":
-                    await self._stream_events(conn, msg)
-                    break
-                if msg.get("op") == "subscribe":
-                    await self._subscribe(conn, msg)
+                if msg.get("op") in ("stream_events", "subscribe"):
+                    # adapt the connection to the sink interface shared
+                    # with the threaded plane: send = conn.send, and a
+                    # watcher task turns the read side's EOF into `gone`
+                    gone = asyncio.Event()
+
+                    async def _watch_eof() -> None:
+                        try:
+                            await conn.recv()
+                        except Exception:  # noqa: BLE001 - any end is EOF
+                            pass
+                        gone.set()
+
+                    watcher = asyncio.ensure_future(_watch_eof())
+                    handler = (
+                        self._stream_events
+                        if msg.get("op") == "stream_events"
+                        else self._subscribe
+                    )
+                    try:
+                        await handler(conn.send, gone, msg)
+                    finally:
+                        if not watcher.done():
+                            watcher.cancel()
+                            try:
+                                await watcher
+                            except (asyncio.CancelledError, Exception):
+                                pass
                     break
                 response = await self._handle_client_message(msg)
                 if response is not None:
@@ -1743,6 +1983,8 @@ class Server:
     })
 
     async def _handle_client_message(self, msg: dict) -> dict | None:
+        if not isinstance(msg, dict):
+            return {"op": "error", "message": "malformed request frame"}
         op = msg.get("op")
         if not isinstance(op, str):
             return {"op": "error", "message": f"malformed operation {op!r}"}
@@ -1816,7 +2058,28 @@ class Server:
             },
             "task_traces": self.core.traces.stats(),
             "subscribers": len(self._subscribers),
+            # ISSUE 10: connection-plane + lazy-materialization health
+            "ingest": self._ingest_stats(),
         }
+
+    def _ingest_stats(self) -> dict:
+        plane = self.ingest_plane
+        out = {
+            "plane": self.client_plane,
+            "lazy": self.core.lazy.stats(),
+            "open_streams": sum(
+                j.open_streams for j in self.jobs.jobs.values()
+            ),
+        }
+        if plane is not None:
+            out.update(
+                clients=len(plane.clients),
+                handoff_depth=len(plane.handoff),
+                window=plane.window,
+                chunks_total=int(INGEST_CHUNKS.labels().value),
+                tasks_total=int(INGEST_TASKS.labels().value),
+            )
+        return out
 
     async def _journal_stats_brief(self) -> dict | None:
         """Compact journal/snapshot block for `hq server stats` (stat-only;
@@ -1910,6 +2173,18 @@ class Server:
                     "run": pts[4] - pts[3],
                 } if info.finished_at else None,
             })
+        # unmaterialized lazy array tasks: pending since their CHUNK's
+        # submit stamp (per-chunk clocks keep phase sums exact for open
+        # jobs appending chunks over time)
+        for seg in self.core.lazy.segments_of(job.job_id):
+            chunk_submitted = seg.chunk.submitted_at
+            for tid in seg.remaining_ids():
+                rows.append({
+                    "id": tid, "status": "waiting",
+                    "submitted": chunk_submitted,
+                    "queued": chunk_submitted, "assigned": 0.0,
+                    "started": 0.0, "finished": 0.0, "phases": None,
+                })
         finished = [r for r in rows if r["phases"] is not None]
 
         def pct(sorted_vals: list, q: float) -> float:
@@ -1974,8 +2249,6 @@ class Server:
                 is_open=job_desc.get("open", False),
                 job_id=job_id,
             )
-        new_tasks = self._build_tasks(job, job_desc)
-        job.submits.append(submit_record(job_desc, len(new_tasks)))
         # trace-context (ISSUE 8): the client stamped a trace id + its send
         # clock; every task of this submit joins that trace, and the ids
         # ride the journal event so restore rebuilds the SAME trace
@@ -1985,70 +2258,302 @@ class Server:
         tctx = read_trace(msg) or {}
         trace_id = tctx.get("id") or new_trace_id()
         sent_at = float(tctx.get("sent_at") or 0.0)
+        trace = {"id": trace_id, "sent_at": sent_at, "recv_at": recv_at,
+                 "commit_at": time.time()}
+        array = job_desc.get("array")
+        if array:
+            n_new = self._ingest_array_desc(
+                job, array, submitted_at=recv_at, trace=trace
+            )
+        else:
+            new_tasks = self._build_tasks(job, job_desc)
+            n_new = len(new_tasks)
+        job.submits.append(submit_record(job_desc, n_new))
         self.emit_event(
             "job-submitted", {"job": job.job_id, "desc": job_desc,
-                              "n_tasks": len(new_tasks),
+                              "n_tasks": n_new,
                               "trace": {"id": trace_id, "sent_at": sent_at,
                                         "recv_at": recv_at}}
         )
-        traces = self.core.traces
-        if traces.enabled:
-            commit_at = time.time()
-            for task in new_tasks:
-                traces.begin(task.task_id, trace_id)
-                parent = None
-                if sent_at:
-                    parent = traces.span(
-                        task.task_id, "client/submit", sent_at, recv_at,
-                        "client",
-                    )
-                traces.span(
-                    task.task_id, "server/submit", recv_at, commit_at,
-                    "server", parent=parent,
-                )
-        reactor.on_new_tasks(self.core, self.comm, new_tasks)
+        if not array:
+            self._begin_submit_traces(new_tasks, trace)
+            reactor.on_new_tasks(self.core, self.comm, new_tasks)
         return {"op": "submit_response", "job_id": job.job_id,
-                "n_tasks": len(new_tasks)}
+                "n_tasks": n_new}
+
+    def _begin_submit_traces(self, new_tasks, trace: dict) -> None:
+        """Open each task's distributed trace with the client/submit and
+        server/submit spans (eager path; lazy chunks replay the same
+        stamps at materialization — server/lazy.py)."""
+        traces = self.core.traces
+        if not traces.enabled:
+            return
+        sent_at = trace["sent_at"]
+        recv_at = trace["recv_at"]
+        commit_at = trace.get("commit_at") or recv_at
+        for task in new_tasks:
+            traces.begin(task.task_id, trace["id"])
+            parent = None
+            if sent_at:
+                parent = traces.span(
+                    task.task_id, "client/submit", sent_at, recv_at,
+                    "client",
+                )
+            traces.span(
+                task.task_id, "server/submit", recv_at, commit_at,
+                "server", parent=parent,
+            )
+
+    @staticmethod
+    def _wire_array_ids(array: dict):
+        """(ids, id_range) from a wire array description. Chunked clients
+        send contiguous runs as "id_range": [start, stop) — O(1) on the
+        wire and in the lazy store; explicit id lists must be sorted."""
+        id_range = array.get("id_range")
+        if id_range is not None:
+            lo, hi = int(id_range[0]), int(id_range[1])
+            if hi <= lo:
+                raise ValueError(f"empty or inverted id_range {id_range}")
+            return None, (lo, hi)
+        ids = list(array["ids"])
+        if any(b <= a for a, b in zip(ids, ids[1:])):
+            ids = sorted(set(ids))
+        return ids, None
+
+    def _check_array_ids(self, job, ids, id_range) -> None:
+        """Duplicate-id guard in O(materialized + chunks), not O(array).
+
+        Against lazy chunks the check is by chunk BOUNDS: an append whose
+        id span overlaps an earlier chunk's span is rejected even if the
+        earlier chunk had holes the new ids would fit — precise hole
+        tracking would cost the O(tasks) scan laziness exists to avoid.
+        """
+        lo = id_range[0] if id_range else ids[0]
+        hi = id_range[1] if id_range else ids[-1] + 1
+        for seg in self.core.lazy.per_job.get(job.job_id, ()):
+            chunk = seg.chunk
+            if lo <= chunk.max_id() and chunk.min_id() < hi:
+                raise ValueError(
+                    f"task ids [{lo}, {hi}) overlap an earlier array "
+                    f"chunk [{chunk.min_id()}, {chunk.max_id()}] of job "
+                    f"{job.job_id}"
+                )
+        # iterate whichever side is SMALLER: a long stream of eager
+        # chunks (--lazy-array-threshold 0) must stay O(chunk) per chunk,
+        # not O(materialized-so-far) — quadratic over a 1M-line stdin
+        n_new = (hi - lo) if id_range is not None else len(ids)
+        if n_new < len(job.tasks):
+            tasks = job.tasks
+            for tid in (range(lo, hi) if id_range is not None else ids):
+                if tid in tasks:
+                    raise ValueError(f"duplicate task id {tid}")
+        else:
+            id_set = None if id_range is not None else set(ids)
+            for tid in job.tasks:
+                if lo <= tid < hi and (id_set is None or tid in id_set):
+                    raise ValueError(f"duplicate task id {tid}")
+
+    def _ingest_array_desc(self, job, array: dict, submitted_at: float,
+                           trace: dict | None) -> int:
+        """Ingest one wire array description — the JASDA atomization seam.
+
+        Arrays at/above --lazy-array-threshold (single-node only) register
+        ONE ArrayChunk: O(1) allocations here, per-task records deferred
+        to dispatch (server/lazy.py). Smaller arrays keep the eager path.
+        Reference: server/client/submit.rs build_tasks_array; the
+        shared/separate wire split (messages/worker.rs:28-54) means a
+        million-task array never ships a million bodies either way.
+        """
+        ids, id_range = self._wire_array_ids(array)
+        n = (id_range[1] - id_range[0]) if id_range else len(ids)
+        self._check_array_ids(job, ids, id_range)
+        rqv = rqv_from_wire(
+            array.get("request") or {}, self.core.resource_map
+        )
+        rq_id = self.core.intern_rqv(rqv)
+        shared_body = array.get("body", {})
+        entries = array.get("entries")
+        priority = (int(array.get("priority", 0)), -job.job_id)
+        crash_limit = int(array.get("crash_limit", 5))
+        if not rqv.is_multi_node and n >= self.lazy_array_threshold:
+            chunk = ArrayChunk(
+                job_id=job.job_id,
+                rq_id=rq_id,
+                priority=priority,
+                body=shared_body,
+                crash_limit=crash_limit,
+                id_range=id_range,
+                ids=ids,
+                entries=list(entries) if entries is not None else None,
+                submitted_at=submitted_at,
+                ready_at=time.time(),
+                trace=dict(trace) if trace else None,
+            )
+            held = job.job_id in self.core.paused_jobs
+            self.core.lazy.register(self.core, chunk, held=held)
+            if not held:
+                self.comm.ask_for_scheduling()
+            return n
+        # eager path: per-task records now, stamped with THIS submit's
+        # clock (per-chunk submitted_at keeps `hq job timeline` exact for
+        # open jobs appending chunks over time)
+        new_tasks: list[Task] = []
+        ids_iter = ids if ids is not None else range(*id_range)
+        for i, job_task_id in enumerate(ids_iter):
+            if job_task_id in job.tasks:
+                raise ValueError(f"duplicate task id {job_task_id}")
+            job.tasks[job_task_id] = JobTaskInfo(
+                job_task_id=job_task_id, submitted_at=submitted_at
+            )
+            new_tasks.append(
+                Task(
+                    task_id=make_task_id(job.job_id, job_task_id),
+                    rq_id=rq_id,
+                    priority=priority,
+                    body=shared_body,  # one dict for the whole array
+                    entry=entries[i] if entries is not None else None,
+                    crash_limit=crash_limit,
+                )
+            )
+        if trace:
+            self._begin_submit_traces(new_tasks, trace)
+        reactor.on_new_tasks(self.core, self.comm, new_tasks)
+        return len(new_tasks)
+
+    def _apply_submit_chunk(self, msg: dict) -> dict:
+        """One streamed submit chunk (op=submit_chunk), applied
+        synchronously so the ingest drain loop can group-commit a whole
+        run of chunks as ONE journal append+fsync.
+
+        Exactly-once across retries and restarts: every chunk is keyed
+        (stream uid, chunk index); applied indexes are journaled with the
+        chunk's job-submitted event and replayed into Job.streams, so a
+        client re-sending an unacked chunk after a server crash gets an
+        idempotent duplicate ack instead of duplicate tasks."""
+        from hyperqueue_tpu.transport.framing import read_trace
+        from hyperqueue_tpu.utils.trace import new_trace_id
+
+        recv_at = time.time()
+        uid = msg.get("uid")
+        rid = msg.get("rid")
+        if not isinstance(uid, str) or not uid:
+            return {"op": "error", "rid": rid,
+                    "message": "submit_chunk requires a stream uid"}
+        index = int(msg.get("i", 0))
+        header = msg.get("job") or {}
+        job_id = self._stream_jobs.get(uid)
+        if job_id is not None:
+            job = self.jobs.jobs.get(job_id)
+            if job is None:
+                return {"op": "error", "rid": rid,
+                        "message": f"stream {uid}: job {job_id} vanished"}
+        else:
+            jid = header.get("job_id")
+            if jid is not None and jid in self.jobs.jobs:
+                job = self.jobs.jobs[jid]
+                if not job.is_open and uid not in job.streams:
+                    return {"op": "error", "rid": rid,
+                            "message": f"job {jid} is not open"}
+            else:
+                job = self.jobs.create_job(
+                    name=header.get("name", "job"),
+                    submit_dir=header.get("submit_dir", os.getcwd()),
+                    max_fails=header.get("max_fails"),
+                    is_open=bool(header.get("open", False)),
+                    job_id=jid,
+                )
+            self._stream_jobs[uid] = job.job_id
+        stream = job.streams.get(uid)
+        if stream is None:
+            stream = job.streams[uid] = {"applied": set(), "sealed": False}
+            job.open_streams += 1
+        if index in stream["applied"]:
+            # ack replay (client retry after a lost ack): idempotent
+            return {"op": "chunk_ack", "rid": rid, "job_id": job.job_id,
+                    "i": index, "n_tasks": 0, "dup": True}
+        if stream["sealed"]:
+            return {"op": "error", "rid": rid,
+                    "message": f"stream {uid} is already sealed"}
+        tctx = read_trace(msg) or {}
+        trace = {
+            "id": tctx.get("id") or new_trace_id(),
+            "sent_at": float(tctx.get("sent_at") or 0.0),
+            "recv_at": recv_at,
+            "commit_at": time.time(),
+        }
+        desc: dict = {
+            "name": job.name, "submit_dir": job.submit_dir,
+            "max_fails": job.max_fails, "open": job.is_open,
+        }
+        array = msg.get("array")
+        graph_tasks = msg.get("tasks")
+        n_new = 0
+        try:
+            if array:
+                n_new = self._ingest_array_desc(
+                    job, array, submitted_at=recv_at, trace=trace
+                )
+                desc["array"] = array
+            elif graph_tasks:
+                new_tasks = self._build_tasks(job, {"tasks": graph_tasks})
+                n_new = len(new_tasks)
+                desc["tasks"] = graph_tasks
+                self._begin_submit_traces(new_tasks, trace)
+                reactor.on_new_tasks(self.core, self.comm, new_tasks)
+        except Exception as e:  # noqa: BLE001 - bad chunk answers the client
+            # a rejected chunk BREAKS the stream: seal it (journaled, so
+            # restore cannot resurrect it open) so the job can still
+            # terminate — the client aborts on the error and must
+            # restart with a fresh stream uid
+            if not stream["sealed"]:
+                stream["sealed"] = True
+                job.open_streams = max(job.open_streams - 1, 0)
+                self.emit_event(
+                    "job-streams-sealed",
+                    {"job": job.job_id, "uids": [uid]},
+                )
+                self.check_job_completion(job.job_id)
+            return {"op": "error", "rid": rid,
+                    "message": f"chunk {index} rejected: {e}"}
+        stream["applied"].add(index)
+        last = bool(msg.get("last"))
+        if last:
+            stream["sealed"] = True
+            job.open_streams = max(job.open_streams - 1, 0)
+        if n_new:
+            job.submits.append(submit_record(desc, n_new))
+        self.emit_event(
+            "job-submitted",
+            {"job": job.job_id, "desc": desc, "n_tasks": n_new,
+             "chunk": {"uid": uid, "i": index, "last": last},
+             "trace": {"id": trace["id"], "sent_at": trace["sent_at"],
+                       "recv_at": recv_at}},
+        )
+        INGEST_CHUNKS.inc()
+        if n_new:
+            INGEST_TASKS.inc(n_new)
+        if last:
+            # the stream seal may be what lets the job terminate
+            self.check_job_completion(job.job_id)
+        return {"op": "chunk_ack", "rid": rid, "job_id": job.job_id,
+                "i": index, "n_tasks": n_new, "dup": False}
+
+    async def _client_submit_chunk(self, msg: dict) -> dict:
+        """submit_chunk over the legacy in-loop client plane
+        (--client-plane reactor): apply one chunk under its own group
+        commit. The threaded plane batches chunk runs in the drain loop
+        instead and never reaches this handler."""
+        with self._journal_group_commit():
+            return self._apply_submit_chunk(msg)
 
     def _build_tasks(self, job, job_desc: dict) -> list[Task]:
-        """Convert a submit description into core tasks.
+        """Convert a GRAPH submit description into core tasks (arrays go
+        through _ingest_array_desc).
 
-        Reference: server/client/submit.rs build_tasks_array/build_tasks_graph.
-        Arrays arrive in compressed form — ONE shared body/request plus ids
-        (and optional per-task entries) — mirroring the reference's
-        JobTaskDescription::Array and the shared/separate wire split
-        (messages/worker.rs:28-54); a million-task array must not ship a
-        million copies of its body.
+        Reference: server/client/submit.rs build_tasks_graph.
         """
         new_tasks: list[Task] = []
         used = set(job.tasks)
-        array = job_desc.get("array")
-        if array:
-            rqv = rqv_from_wire(
-                array.get("request") or {}, self.core.resource_map
-            )
-            rq_id = self.core.intern_rqv(rqv)
-            shared_body = array.get("body", {})
-            entries = array.get("entries")
-            priority = int(array.get("priority", 0))
-            crash_limit = int(array.get("crash_limit", 5))
-            for i, job_task_id in enumerate(array["ids"]):
-                if job_task_id in used:
-                    raise ValueError(f"duplicate task id {job_task_id}")
-                used.add(job_task_id)
-                job.tasks[job_task_id] = JobTaskInfo(job_task_id=job_task_id)
-                task_id = make_task_id(job.job_id, job_task_id)
-                new_tasks.append(
-                    Task(
-                        task_id=task_id,
-                        rq_id=rq_id,
-                        priority=(priority, -job.job_id),
-                        body=shared_body,  # one dict for the whole array
-                        entry=entries[i] if entries is not None else None,
-                        crash_limit=crash_limit,
-                    )
-                )
-            return new_tasks
         for t in job_desc.get("tasks", []):
             job_task_id = t.get("id")
             if job_task_id is None:
@@ -2058,7 +2563,9 @@ class Server:
                 # it through this same path — without the id every such task
                 # would collapse to id 0 on replay
                 t["id"] = job_task_id
-            if job_task_id in used:
+            if job_task_id in used or self.core.lazy.owns(
+                job.job_id, job_task_id
+            ):
                 raise ValueError(f"duplicate task id {job_task_id}")
             used.add(job_task_id)
             rqv = rqv_from_wire(t.get("request") or {}, self.core.resource_map)
@@ -2113,12 +2620,29 @@ class Server:
             jobs.append(info)
         return {"op": "job_list", "jobs": jobs}
 
+    def _job_detail(self, job) -> dict:
+        """job.to_detail() plus synthesized rows for unmaterialized lazy
+        array tasks (status "waiting" — they have no per-task state yet,
+        which is the point)."""
+        detail = job.to_detail()
+        if job.n_lazy:
+            rows = detail["tasks"]
+            for seg in self.core.lazy.segments_of(job.job_id):
+                for tid in seg.remaining_ids():
+                    rows.append({
+                        "id": tid, "status": "waiting", "error": "",
+                        "workers": [], "started_at": 0.0,
+                        "finished_at": 0.0,
+                    })
+            rows.sort(key=lambda r: r["id"])
+        return detail
+
     async def _client_job_info(self, msg: dict) -> dict:
         out = []
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)
             if job is not None:
-                detail = job.to_detail()
+                detail = self._job_detail(job)
                 detail["paused"] = job_id in self.core.paused_jobs
                 if job.n_waiting() - job.counters["running"] > 0:
                     detail["pending_reasons"] = self._job_pending_reasons(
@@ -2146,6 +2670,15 @@ class Server:
             job = self.jobs.jobs.get(job_id)
             if job is None:
                 continue
+            # lazy array tasks must exist to be canceled (per-task events,
+            # counters); a cancel is O(tasks) with or without laziness
+            if job.n_lazy:
+                self.core.lazy.materialize_job(self.core, job_id)
+            # cancel implies the client gave up on any in-flight chunk
+            # stream: seal so the job can reach a terminal state — and
+            # JOURNAL the forced seal, or a restore would resurrect the
+            # stream as open and the job could never terminate
+            self._seal_job_streams(job)
             task_ids = [
                 make_task_id(job_id, t.job_task_id)
                 for t in job.tasks.values()
@@ -2171,6 +2704,9 @@ class Server:
                 self.core.tasks.pop(make_task_id(job_id, job_task_id), None)
             self.core.paused_jobs.discard(job_id)
             self.core.paused_held.pop(job_id, None)
+            self.core.lazy.forget_job(job_id)
+            for uid in job.streams:
+                self._stream_jobs.pop(uid, None)
             forgotten += 1
         return {"op": "job_forget", "forgotten": forgotten}
 
@@ -2188,8 +2724,12 @@ class Server:
         closed = []
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)
-            if job is not None and job.is_open:
+            if job is not None and (job.is_open or job.open_streams):
                 job.is_open = False
+                # a close also seals abandoned chunk streams (a client
+                # that died mid-stream must not wedge the job forever);
+                # the job-closed record seals them again on replay
+                job.seal_streams()
                 closed.append(job_id)
                 self.emit_event("job-closed", {"job": job_id})
                 self.check_job_completion(job_id)
@@ -2288,12 +2828,33 @@ class Server:
             )
             if pending:
                 job_task_id = pending[0]
+            elif job.n_lazy:
+                # first LIVE lazy id (the chunk min may already have
+                # materialized — or finished — past the segment cursor)
+                job_task_id = min(
+                    next(iter(seg.remaining_ids()))
+                    for seg in self.core.lazy.segments_of(job_id)
+                )
             elif job.tasks:
                 job_task_id = min(job.tasks)
             else:
                 return {"op": "error",
                         "message": f"job {job_id} has no tasks"}
         task = self.core.tasks.get(make_task_id(job_id, job_task_id))
+        if task is None and self.core.lazy.owns(job_id, job_task_id):
+            # materialize the ONE asked-about lazy task so the explain
+            # walk sees exactly what an eager submit would have produced
+            # (it re-enters the queues at its priority level's tail)
+            task = self.core.lazy.extract(self.core, job_id, job_task_id)
+            if task is not None:
+                if job_id in self.core.paused_jobs:
+                    self.core.paused_held.setdefault(
+                        job_id, set()
+                    ).add(task.task_id)
+                else:
+                    self.core.queues.add(
+                        task.rq_id, task.priority, task.task_id
+                    )
         if task is None:
             if job is not None and job_task_id in job.tasks:
                 info = job.tasks[job_task_id]
@@ -2774,13 +3335,17 @@ class Server:
         job = self.jobs.jobs.get(msg["job_id"])
         if job is None:
             return {"op": "error", "message": f"job {msg['job_id']} not found"}
-        return {"op": "task_list", "job": job.to_detail()}
+        return {"op": "task_list", "job": self._job_detail(job)}
 
-    async def _stream_events(self, conn: Connection, msg: dict) -> None:
+    async def _stream_events(self, send, gone: asyncio.Event,
+                             msg: dict) -> None:
         """Stream events to this client until it disconnects.
 
         Reference: event/streamer.rs fan-out with EventFilterFlags
         (streamer.rs:36-44); `history=True` first replays the journal.
+        `send` is the connection sink (conn.send on the legacy in-loop
+        plane, ClientChannel.stream_send on the threaded plane — both
+        apply backpressure to this handler); `gone` fires on disconnect.
         """
         prefixes = tuple(msg.get("filter") or ())
         queue: asyncio.Queue = asyncio.Queue()
@@ -2806,13 +3371,14 @@ class Server:
                     if isinstance(seq, int) and seq > replayed_seq:
                         replayed_seq = seq
                     if not prefixes or record.get("event", "").startswith(prefixes):
-                        await conn.send({"op": "event", "record": record})
-            await conn.send({"op": "stream_live"})
-            # the stream is send-only from here: watch the read side so a
-            # client detach is noticed IMMEDIATELY (not at the next failed
-            # send, which for an overview listener can lag two cadences and
-            # leave workers sampling hw after the dashboard is gone)
-            eof = asyncio.ensure_future(conn.recv())
+                        await send({"op": "event", "record": record})
+            await send({"op": "stream_live"})
+            # the stream is send-only from here: watch the disconnect
+            # event so a client detach is noticed IMMEDIATELY (not at the
+            # next failed send, which for an overview listener can lag two
+            # cadences and leave workers sampling hw after the dashboard
+            # is gone)
+            eof = asyncio.ensure_future(gone.wait())
             try:
                 while True:
                     getter = asyncio.ensure_future(queue.get())
@@ -2821,7 +3387,6 @@ class Server:
                     )
                     if eof in done:
                         getter.cancel()
-                        eof.exception()  # retrieve (EOF/conn reset)
                         break
                     record = getter.result()
                     if record.get("seq", -1) <= replayed_seq:
@@ -2829,7 +3394,7 @@ class Server:
                     if not prefixes or record.get("event", "").startswith(
                         prefixes
                     ):
-                        await conn.send({"op": "event", "record": record})
+                        await send({"op": "event", "record": record})
             finally:
                 if not eof.done():
                     eof.cancel()
@@ -2899,7 +3464,8 @@ class Server:
             "subscribers": len(self._subscribers),
         }
 
-    async def _subscribe(self, conn: Connection, msg: dict) -> None:
+    async def _subscribe(self, send, gone: asyncio.Event,
+                         msg: dict) -> None:
         """Stream lifecycle events + periodic metric samples to one client
         over the existing framing until it disconnects or falls behind.
 
@@ -2929,14 +3495,14 @@ class Server:
                     OVERVIEW_OVERRIDE_INTERVAL
                 )
         try:
-            await conn.send({"op": "sub_live", "seq": self._event_seq})
+            await send({"op": "sub_live", "seq": self._event_seq})
             if sub.sample_interval:
-                await conn.send(self._build_sample())
+                await send(self._build_sample())
             next_sample = (
                 time.monotonic() + sub.sample_interval
                 if sub.sample_interval else None
             )
-            eof = asyncio.ensure_future(conn.recv())
+            eof = asyncio.ensure_future(gone.wait())
             try:
                 while not sub.dead:
                     timeout = (
@@ -2951,7 +3517,6 @@ class Server:
                     )
                     if eof in done:
                         getter.cancel()
-                        eof.exception()  # retrieve (EOF/conn reset)
                         return
                     if getter in done:
                         # coalesce a burst into one frame (one encryption +
@@ -2962,7 +3527,7 @@ class Server:
                                 records.append(sub.queue.get_nowait())
                             except asyncio.QueueEmpty:
                                 break
-                        await conn.send(
+                        await send(
                             {"op": "events", "records": records}
                         )
                     else:
@@ -2971,10 +3536,10 @@ class Server:
                         next_sample is not None
                         and time.monotonic() >= next_sample
                     ):
-                        await conn.send(self._build_sample())
+                        await send(self._build_sample())
                         next_sample = time.monotonic() + sub.sample_interval
                 # fell behind: say so, then hang up
-                await conn.send(
+                await send(
                     {"op": "sub_dropped", "dropped": sub.dropped}
                 )
             finally:

@@ -17,6 +17,7 @@ from hyperqueue_tpu.resources.request import ResourceRequestVariants
 from hyperqueue_tpu.scheduler.queues import TaskQueues
 from hyperqueue_tpu.scheduler.tick import WorkerRow
 from hyperqueue_tpu.scheduler.tick_cache import TickPhaseStats, TickStateCache
+from hyperqueue_tpu.server.lazy import LazyStore
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
 from hyperqueue_tpu.utils.flight import FlightRecorder
@@ -79,6 +80,15 @@ class Core:
     # scheduler queues (paused_held[job_id] = task ids) until resume
     paused_jobs: set[int] = field(default_factory=set)
     paused_held: dict[int, set[int]] = field(default_factory=dict)
+    # unmaterialized lazy array tasks (server/lazy.py): chunked array
+    # submits register O(chunks) records here; the queues materialize
+    # per-task state only at dispatch/prefill time
+    lazy: LazyStore = field(default_factory=LazyStore)
+
+    def __post_init__(self) -> None:
+        # the queues consult the lazy store for batch sizing and
+        # materializing takes; takes need the core for task creation
+        self.queues.bind_lazy(self.lazy, self)
 
     def bump_membership(self) -> None:
         self.membership_epoch += 1

@@ -54,6 +54,17 @@ class Job:
     # echoed in job detail (reference JobDetail.submit_descs)
     submits: list = field(default_factory=list)
     tasks: dict[int, JobTaskInfo] = field(default_factory=dict)  # job_task_id ->
+    # unmaterialized lazy array tasks owned by this job (server/lazy.py
+    # maintains the count; the task records themselves live in the core's
+    # LazyStore until the scheduler materializes them)
+    n_lazy: int = 0
+    # chunked-submit streams (ingest plane): uid -> {"applied": set of
+    # chunk indexes already ingested (exactly-once ack replay), "sealed"}.
+    # While any stream is unsealed the job cannot terminate — a fast
+    # worker finishing chunk k must not fire job-completed while chunk
+    # k+1 is still on the wire.
+    streams: dict = field(default_factory=dict)
+    open_streams: int = 0
     counters: dict[str, int] = field(
         default_factory=lambda: {
             "running": 0,
@@ -64,7 +75,7 @@ class Job:
     )
 
     def n_tasks(self) -> int:
-        return len(self.tasks)
+        return len(self.tasks) + self.n_lazy
 
     def n_waiting(self) -> int:
         return self.n_tasks() - sum(self.counters.values()) + self.counters["running"]
@@ -79,8 +90,22 @@ class Job:
         )
         return done == self.n_tasks()
 
+    def seal_streams(self) -> list:
+        """Force-seal every chunk stream (job close / explicit cancel):
+        a client that died mid-stream must not leave the job unable to
+        terminate forever. Returns the uids that were still open, so the
+        caller can journal the forced seal (restore must not resurrect
+        the stream as open)."""
+        sealed = [
+            uid for uid, s in self.streams.items() if not s["sealed"]
+        ]
+        for stream in self.streams.values():
+            stream["sealed"] = True
+        self.open_streams = 0
+        return sealed
+
     def is_terminated(self) -> bool:
-        if self.is_open:
+        if self.is_open or self.open_streams > 0:
             return False
         done = (
             self.counters["finished"]

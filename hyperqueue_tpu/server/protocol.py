@@ -37,6 +37,15 @@ def submit_record(job_desc: dict, n_tasks: int) -> dict:
     return {"n_tasks": n_tasks, "requests": distinct}
 
 
+def array_desc_ids(array: dict) -> list[int]:
+    """The task ids of a wire array description: explicit "ids" list or
+    the chunked-submit "id_range" [start, stop) compact form."""
+    id_range = array.get("id_range")
+    if id_range is not None:
+        return list(range(int(id_range[0]), int(id_range[1])))
+    return list(array["ids"])
+
+
 def expand_desc_tasks(job_desc: dict) -> list[dict]:
     """Expand a submit description into per-task dicts (array or graph form).
 
@@ -49,7 +58,7 @@ def expand_desc_tasks(job_desc: dict) -> list[dict]:
     out = []
     entries = array.get("entries")
     shared_body = array.get("body", {})
-    for i, task_id in enumerate(array["ids"]):
+    for i, task_id in enumerate(array_desc_ids(array)):
         task = {
             "id": task_id,
             # ONE body object for the whole array; the entry travels as its

@@ -142,6 +142,11 @@ def pause_jobs(core: Core, comm: Comm, job_ids: list[int]) -> tuple[int, int]:
     wanted = set(job_ids)
     core.paused_jobs |= wanted
     held = 0
+    for job_id in wanted:
+        # lazy array segments leave the scheduler levels as whole chunks
+        # (no materialization — a paused 1M-task array stays O(chunks));
+        # resume_jobs re-enqueues them the same way
+        held += core.lazy.detach_job(core, job_id)
     for _rq_id, queue in core.queues.items():
         for task_id in queue.all_tasks():
             if task_id_job(task_id) in wanted:
@@ -184,6 +189,7 @@ def resume_jobs(core: Core, comm: Comm, job_ids: list[int]) -> int:
     mn_added = False
     for job_id in job_ids:
         core.paused_jobs.discard(job_id)
+        released += core.lazy.requeue_job(core, job_id)
         held = core.paused_held.pop(job_id, None)
         if not held:
             continue

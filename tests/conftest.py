@@ -50,6 +50,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "ingest: submit-plane tests (streaming chunked ingest, client-"
+        "connection plane, lazy array materialization; ISSUE 10)",
+    )
+    config.addinivalue_line(
+        "markers",
         "multichip: sharded multi-device solver tests; run on the virtual "
         "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
         "count=8, set above) so tier-1 exercises the 8-device path on "

@@ -1,0 +1,549 @@
+"""High-throughput submit plane (ISSUE 10): streaming chunked ingest,
+the decoupled client-connection plane, and lazy array materialization.
+
+Covers the exactly-once contract across chunk boundaries (kill -9
+mid-stream + restore + idempotent ack replay), trace continuity for
+chunked submits, per-chunk submitted_at stamps in `hq job timeline`,
+bounded-memory stdin streaming, pause/resume of lazy chunks, and the
+--client-plane reactor escape hatch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from hyperqueue_tpu.client.connection import ClientSession, SubmitStream
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.ingest
+
+BODY = {"cmd": ["true"], "env": {}}
+
+
+def _body(env):
+    return {**BODY, "submit_dir": str(env.work_dir)}
+
+
+def _job_info(env, job_id: int) -> dict:
+    return json.loads(
+        env.command(["job", "info", str(job_id), "--output-mode", "json"])
+    )[0]
+
+
+def _stats(env) -> dict:
+    return json.loads(
+        env.command(["server", "stats", "--output-mode", "json"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# lazy store units
+# ---------------------------------------------------------------------------
+def test_lazy_store_take_materializes_with_chunk_stamps():
+    from hyperqueue_tpu.server.core import Core
+    from hyperqueue_tpu.server.jobs import JobManager
+    from hyperqueue_tpu.server.lazy import ArrayChunk
+    from hyperqueue_tpu.server.protocol import rqv_from_wire
+
+    core = Core()
+    jobs = JobManager()
+    core.lazy.jobs_getter = lambda: jobs
+    job = jobs.create_job(name="j", submit_dir="/tmp")
+    rq_id = core.intern_rqv(rqv_from_wire({}, core.resource_map))
+    chunk = ArrayChunk(
+        job_id=job.job_id, rq_id=rq_id, priority=(0, -job.job_id),
+        body={"cmd": ["true"]}, crash_limit=5, id_range=(10, 110),
+        submitted_at=123.0, ready_at=124.0,
+    )
+    core.lazy.register(core, chunk)
+    assert job.n_tasks() == 100 and job.n_lazy == 100
+    assert core.queues.total_ready() == 100
+    assert not core.tasks  # O(chunks): nothing materialized at ingest
+
+    q = core.queues.queue(rq_id)
+    sizes = dict(q.priority_sizes())
+    assert sizes[(0, -job.job_id)] == 100
+    taken = q.take((0, -job.job_id), 7)
+    assert len(taken) == 7 and len(core.tasks) == 7
+    task = core.tasks[taken[0]]
+    assert task.t_ready == 124.0  # chunk clock, not materialization time
+    info = job.tasks[10]
+    assert info.submitted_at == 123.0  # per-chunk stamp
+    assert job.n_lazy == 93 and core.queues.total_ready() == 93
+
+    # single-task extraction (explain/cancel path) skips the cursor
+    t = core.lazy.extract(core, job.job_id, 50)
+    assert t is not None and t.task_id in core.tasks
+    assert job.n_lazy == 92
+    # the extracted id never comes out of a later take
+    rest = q.take((0, -job.job_id), 200)
+    assert len(rest) == 92
+    assert t.task_id not in rest
+    assert job.n_lazy == 0 and core.lazy.stats()["unmaterialized"] == 0
+    # drained segments are retired everywhere: no chunk bodies/entries
+    # retained for the server's lifetime
+    assert not core.lazy.per_job and not core.lazy.levels
+
+
+def test_lazy_store_ids_list_and_drop():
+    from hyperqueue_tpu.server.core import Core
+    from hyperqueue_tpu.server.jobs import JobManager
+    from hyperqueue_tpu.server.lazy import ArrayChunk
+    from hyperqueue_tpu.server.protocol import rqv_from_wire
+
+    core = Core()
+    jobs = JobManager()
+    core.lazy.jobs_getter = lambda: jobs
+    job = jobs.create_job(name="j", submit_dir="/tmp")
+    rq_id = core.intern_rqv(rqv_from_wire({}, core.resource_map))
+    ids = [1, 3, 5, 9, 11]
+    chunk = ArrayChunk(
+        job_id=job.job_id, rq_id=rq_id, priority=(0, -job.job_id),
+        body={}, crash_limit=5, ids=ids,
+        entries=[f"e{i}" for i in ids], submitted_at=1.0, ready_at=1.0,
+    )
+    core.lazy.register(core, chunk)
+    assert core.lazy.drop_id(core, job.job_id, 5)
+    assert not core.lazy.drop_id(core, job.job_id, 5)  # idempotent
+    assert job.n_lazy == 4
+    taken = core.queues.queue(rq_id).take((0, -job.job_id), 10)
+    got = sorted(core.tasks[t].entry for t in taken)
+    assert got == ["e1", "e11", "e3", "e9"]  # 5 was dropped
+
+
+# ---------------------------------------------------------------------------
+# e2e: chunked CLI submit + lazy lifecycle
+# ---------------------------------------------------------------------------
+def test_chunked_submit_lazy_cancel(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "50")
+        env.command(["submit", "--array", "0-499", "--chunk-size", "100",
+                     "--", "true"])
+        stats = _stats(env)
+        assert stats["ingest"]["plane"] == "thread"
+        assert stats["ingest"]["lazy"]["unmaterialized"] == 500
+        assert stats["ingest"]["lazy"]["chunks"] == 5
+        info = _job_info(env, 1)
+        assert info["n_tasks"] == 500 and info["status"] == "waiting"
+        # detail synthesizes rows for unmaterialized ids
+        assert len(info["tasks"]) == 500
+        # cancel materializes, then cancels every task exactly once
+        env.command(["job", "cancel", "1"])
+        info = _job_info(env, 1)
+        assert info["counters"]["canceled"] == 500
+        assert _stats(env)["ingest"]["lazy"]["unmaterialized"] == 0
+
+
+def test_chunked_submit_runs_to_completion(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "20")
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--array", "0-99", "--chunk-size", "25",
+                     "--wait", "--", "true"], timeout=120)
+        info = _job_info(env, 1)
+        assert info["counters"]["finished"] == 100
+        lazy = _stats(env)["ingest"]["lazy"]
+        assert lazy["unmaterialized"] == 0
+        assert lazy["materialized_total"] == 100
+
+
+def test_pause_resume_holds_lazy_chunks(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "10")
+        env.command(["submit", "--array", "0-199", "--chunk-size", "50",
+                     "--", "true"])
+        env.command(["job", "pause", "1"])
+        lazy = _stats(env)["ingest"]["lazy"]
+        assert lazy["held"] == 200 and lazy["ready"] == 0
+        env.command(["job", "resume", "1"])
+        lazy = _stats(env)["ingest"]["lazy"]
+        assert lazy["held"] == 0 and lazy["ready"] == 200
+
+
+def test_per_chunk_submitted_at_in_timeline(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "10")
+        out = env.command(["job", "open", "--name", "chunky"])
+        job_id = int(out.strip().split()[-1])
+        env.command(["submit", "--job", str(job_id), "--array", "0-39",
+                     "--", "true"])
+        time.sleep(0.8)
+        env.command(["submit", "--job", str(job_id), "--array", "100-139",
+                     "--", "true"])
+        tl = json.loads(env.command(
+            ["job", "timeline", str(job_id), "--tasks",
+             "--output-mode", "json"]
+        ))[0]
+        stamps = {r["id"]: r["submitted"] for r in tl["tasks"]}
+        assert tl["n_tasks"] == 80
+        # every task carries ITS chunk's clock, not the job's
+        assert stamps[100] - stamps[0] >= 0.5
+        assert abs(stamps[39] - stamps[0]) < 0.3
+        assert abs(stamps[139] - stamps[100]) < 0.3
+
+
+# ---------------------------------------------------------------------------
+# streaming submit protocol
+# ---------------------------------------------------------------------------
+def test_multi_client_concurrent_streams(tmp_path):
+    n_clients, n_tasks, chunk = 4, 1000, 50
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "10")
+        results: dict[int, tuple] = {}
+        errors: list = []
+
+        def client(k: int) -> None:
+            try:
+                with ClientSession(env.server_dir) as s:
+                    stream = SubmitStream(
+                        s, {"name": f"bulk{k}",
+                            "submit_dir": str(env.work_dir)},
+                        window=2,
+                    )
+                    for lo in range(0, n_tasks, chunk):
+                        stream.send_chunk(array={
+                            "id_range": [lo, lo + chunk],
+                            "body": _body(env), "request": {},
+                            "priority": 0, "crash_limit": 5,
+                        })
+                    results[k] = stream.finish()
+            except Exception as e:  # noqa: BLE001
+                errors.append((k, e))
+
+        threads = [
+            threading.Thread(target=client, args=(k,))
+            for k in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        assert len(results) == n_clients
+        job_ids = {jid for jid, _ in results.values()}
+        assert len(job_ids) == n_clients  # one job per stream
+        for jid, n in results.values():
+            assert n == n_tasks
+            info = _job_info(env, jid)
+            assert info["n_tasks"] == n_tasks
+        stats = _stats(env)
+        assert stats["ingest"]["open_streams"] == 0
+        assert stats["ingest"]["lazy"]["unmaterialized"] == (
+            n_clients * n_tasks
+        )
+
+
+def test_duplicate_chunks_are_idempotent(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "10")
+        with ClientSession(env.server_dir) as s:
+            stream = SubmitStream(
+                s, {"name": "dup", "submit_dir": str(env.work_dir)}
+            )
+            for lo in (0, 100, 200):
+                stream.send_chunk(array={
+                    "id_range": [lo, lo + 100], "body": _body(env),
+                    "request": {}, "priority": 0, "crash_limit": 5,
+                })
+            job_id, n = stream.finish()
+            assert n == 300
+        # a re-send of the WHOLE stream (same uid) must change nothing:
+        # every chunk acks as a duplicate
+        with ClientSession(env.server_dir) as s:
+            replay = SubmitStream(
+                s, {"name": "dup", "submit_dir": str(env.work_dir)},
+                uid=stream.uid,
+            )
+            for lo in (0, 100, 200):
+                replay.send_chunk(array={
+                    "id_range": [lo, lo + 100], "body": _body(env),
+                    "request": {}, "priority": 0, "crash_limit": 5,
+                })
+            jid2, n2 = replay.finish()
+        # the replayed stream's coverage is the full 300 (all acked, all
+        # as duplicates) — but the server created nothing new
+        assert jid2 == job_id and n2 == 300
+        assert replay.dup_chunks == 4  # 3 chunks + the seal frame
+        assert _job_info(env, job_id)["n_tasks"] == 300
+
+
+@pytest.mark.chaos
+def test_kill9_mid_stream_exactly_once(tmp_path):
+    """kill -9 the server mid-stream; the client's reconnect replays its
+    unacked chunks against the restored server. After restore + replay:
+    no lost tasks, no duplicate tasks, duplicate acks idempotent."""
+    n_chunks, chunk = 10, 40
+    with HqEnv(tmp_path) as env:
+        env.start_server(
+            "--journal", str(tmp_path / "journal.bin"),
+            "--lazy-array-threshold", "10",
+        )
+        with ClientSession(env.server_dir) as s:
+            stream = SubmitStream(
+                s, {"name": "survivor", "submit_dir": str(env.work_dir)}
+            )
+            for i in range(n_chunks // 2):
+                stream.send_chunk(array={
+                    "id_range": [i * chunk, (i + 1) * chunk],
+                    "body": _body(env), "request": {},
+                    "priority": 0, "crash_limit": 5,
+                })
+            # drain acks so the first half is definitely applied+acked
+            while stream._unacked:
+                stream._recv_ack()
+            env.kill_process("server")
+            env.start_server(
+                "--journal", str(tmp_path / "journal.bin"),
+                "--lazy-array-threshold", "10",
+            )
+            # deliberately RE-SEND an already-acked chunk (a client that
+            # crashed before persisting its ack state would do this):
+            # the restored applied-index set must dedupe it
+            stream._unacked[0] = {
+                "op": "submit_chunk", "uid": stream.uid, "i": 0,
+                "rid": 0, "job": dict(stream.header),
+                "array": {"id_range": [0, chunk], "body": _body(env),
+                          "request": {}, "priority": 0, "crash_limit": 5},
+            }
+            for i in range(n_chunks // 2, n_chunks):
+                stream.send_chunk(array={
+                    "id_range": [i * chunk, (i + 1) * chunk],
+                    "body": _body(env), "request": {},
+                    "priority": 0, "crash_limit": 5,
+                })
+            job_id, n_new = stream.finish()
+        assert stream.dup_chunks >= 1  # the replayed chunk 0
+        info = _job_info(env, job_id)
+        assert info["n_tasks"] == n_chunks * chunk  # no loss, no dupes
+        ids = [t["id"] for t in info["tasks"]]
+        assert sorted(ids) == list(range(n_chunks * chunk))
+        assert len(set(ids)) == len(ids)
+        stats = _stats(env)
+        assert stats["ingest"]["open_streams"] == 0
+        # second restart: restore alone (snapshot-less journal replay)
+        # must reproduce the exact task set
+        env.kill_process("server1")
+        env.start_server(
+            "--journal", str(tmp_path / "journal.bin"),
+            "--lazy-array-threshold", "10",
+        )
+        info = _job_info(env, job_id)
+        assert info["n_tasks"] == n_chunks * chunk
+
+
+def test_trace_continuity_chunked(tmp_path):
+    """Chunked submits still yield one closed trace per task, with the
+    client/submit span opened from the CHUNK's stamps even though the
+    task materialized lazily at dispatch."""
+    from hyperqueue_tpu.utils.trace import REQUIRED_HOPS
+
+    n = 24
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "5")
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+        env.command(["submit", "--array", f"0-{n - 1}", "--chunk-size",
+                     "6", "--wait", "--", "true"], timeout=120)
+        for i in range(n):
+            out = json.loads(env.command(
+                ["task", "trace", f"1.{i}", "--output-mode", "json"]
+            ))
+            names = {s["name"] for s in out["spans"]}
+            assert out["closed"], (i, out)
+            assert REQUIRED_HOPS <= names, (i, sorted(names))
+            assert "client/submit" in names, (i, sorted(names))
+            assert out["span_sum_s"] <= out["wall_s"] + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# stdin / bounded-memory streaming
+# ---------------------------------------------------------------------------
+def test_stdin_chunker_bounded_buffering():
+    from hyperqueue_tpu.client.cli import _iter_stdin_chunks
+
+    pulled = 0
+
+    def lines():
+        nonlocal pulled
+        i = 0
+        while True:  # endless source: only bounded pulls can terminate
+            pulled += 1
+            yield f"line-{i}\n"
+            i += 1
+
+    chunks = _iter_stdin_chunks({"body": {}, "request": {}}, 100,
+                                lines=lines())
+    first = next(chunks)
+    assert first["id_range"] == [0, 100]
+    assert first["entries"][0] == "line-0"
+    # bounded memory: pulling ONE chunk consumed exactly chunk_size lines
+    assert pulled == 100
+    second = next(chunks)
+    assert second["id_range"] == [100, 200]
+    assert pulled == 200
+
+
+def test_from_stdin_e2e(tmp_path):
+    import subprocess
+    import sys as _sys
+
+    from utils_e2e import REPO_ROOT, _env_base
+
+    with HqEnv(tmp_path) as env:
+        env.start_server("--lazy-array-threshold", "10")
+        payload = "".join(f"item{i}\n" for i in range(100))
+        r = subprocess.run(
+            [_sys.executable, "-m", "hyperqueue_tpu", "submit",
+             "--from-stdin", "--chunk-size", "30", "--",
+             "bash", "-c", "echo $HQ_ENTRY"],
+            input=payload, capture_output=True, text=True,
+            env={**_env_base(), "HQ_SERVER_DIR": str(env.server_dir)},
+            cwd=str(REPO_ROOT), timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "(100 tasks)" in r.stdout
+        assert _job_info(env, 1)["n_tasks"] == 100
+        # 30+30+30+10 = 4 chunks streamed
+        assert _stats(env)["ingest"]["chunks_total"] >= 4
+
+
+def test_malformed_frame_answered_not_fatal(tmp_path):
+    """A non-dict frame from one client must answer THAT client with an
+    error — never crash the drain loop every other client shares."""
+    with HqEnv(tmp_path) as env:
+        env.start_server()
+        with ClientSession(env.server_dir) as s:
+            resp = s._loop.run_until_complete(_roundtrip(s, [1, 2, 3]))
+            assert resp.get("op") == "error"
+        # the server (and its drain loop) is still fully alive
+        assert _stats(env)["ingest"]["plane"] == "thread"
+
+
+async def _roundtrip(session, frame):
+    await session._conn.send(frame)
+    return await session._conn.recv()
+
+
+def test_rejected_chunk_seals_stream(tmp_path):
+    """An invalid chunk (overlapping ids) errors AND seals the stream so
+    the job can still terminate instead of waiting forever for a client
+    that already aborted."""
+    from hyperqueue_tpu.client.connection import ClientError
+
+    with HqEnv(tmp_path) as env:
+        env.start_server("--journal", str(tmp_path / "journal.bin"),
+                         "--lazy-array-threshold", "10")
+        with ClientSession(env.server_dir) as s:
+            stream = SubmitStream(
+                s, {"name": "broken", "submit_dir": str(env.work_dir)},
+                window=1,
+            )
+            stream.send_chunk(array={
+                "id_range": [0, 100], "body": _body(env), "request": {},
+                "priority": 0, "crash_limit": 5,
+            })
+            with pytest.raises(ClientError, match="rejected"):
+                stream.send_chunk(array={
+                    "id_range": [50, 150], "body": _body(env),
+                    "request": {}, "priority": 0, "crash_limit": 5,
+                })
+                stream.finish()
+        stats = _stats(env)
+        assert stats["ingest"]["open_streams"] == 0
+        # chunk 0's tasks survived; the overlap was rejected atomically
+        info = _job_info(env, 1)
+        assert info["n_tasks"] == 100
+        # the forced seal is journaled: a restart must NOT resurrect the
+        # stream as open (which would block termination forever)
+        env.kill_process("server")
+        env.start_server("--journal", str(tmp_path / "journal.bin"),
+                         "--lazy-array-threshold", "10")
+        assert _stats(env)["ingest"]["open_streams"] == 0
+        # cancel-forced seals restore the same way
+        env.command(["job", "cancel", "1"])
+        assert _job_info(env, 1)["status"] == "canceled"
+        env.kill_process("server1")
+        env.start_server("--journal", str(tmp_path / "journal.bin"),
+                         "--lazy-array-threshold", "10")
+        assert _job_info(env, 1)["status"] == "canceled"
+        # terminated: forget must work (is_terminated true post-restore)
+        assert "1" in env.command(["job", "forget", "1"])
+
+
+@pytest.mark.chaos
+def test_journal_only_restore_keeps_chunks_lazy(tmp_path):
+    """kill -9 right after a lazy submit, NO snapshot: the journal-tail
+    replay must re-register the array as chunks, not expand it to
+    per-task records (restore stays O(chunks + touched))."""
+    with HqEnv(tmp_path) as env:
+        env.start_server(
+            "--journal", str(tmp_path / "journal.bin"),
+            "--lazy-array-threshold", "10",
+        )
+        env.command(["submit", "--array", "0-799", "--chunk-size", "200",
+                     "--", "true"])
+        assert _stats(env)["ingest"]["lazy"]["chunks"] == 4
+        env.kill_process("server")  # no snapshot was ever written
+        env.start_server(
+            "--journal", str(tmp_path / "journal.bin"),
+            "--lazy-array-threshold", "10",
+        )
+        lazy = _stats(env)["ingest"]["lazy"]
+        assert lazy["unmaterialized"] == 800
+        assert lazy["chunks"] == 4  # chunk records, not 800 tasks
+        assert _job_info(env, 1)["n_tasks"] == 800
+
+
+# ---------------------------------------------------------------------------
+# plane escape hatch + backpressure accounting
+# ---------------------------------------------------------------------------
+def test_reactor_plane_escape_hatch(tmp_path):
+    with HqEnv(tmp_path) as env:
+        env.start_server("--client-plane", "reactor",
+                         "--lazy-array-threshold", "10")
+        stats = _stats(env)
+        assert stats["ingest"]["plane"] == "reactor"
+        # chunked submit works over the in-loop plane too
+        env.command(["submit", "--array", "0-199", "--chunk-size", "50",
+                     "--", "true"])
+        info = _job_info(env, 1)
+        assert info["n_tasks"] == 200
+        assert _stats(env)["ingest"]["lazy"]["unmaterialized"] == 200
+
+
+def test_snapshot_restore_keeps_chunks_lazy(tmp_path):
+    """A snapshot + restore round trip re-registers unmaterialized chunks
+    as chunks — O(chunks) through compaction, and the exactly-once
+    applied-index set survives with them."""
+    with HqEnv(tmp_path) as env:
+        env.start_server(
+            "--journal", str(tmp_path / "journal.bin"),
+            "--lazy-array-threshold", "10",
+        )
+        env.command(["submit", "--array", "0-999", "--chunk-size", "250",
+                     "--", "true"])
+        assert _stats(env)["ingest"]["lazy"]["chunks"] == 4
+        env.command(["journal", "compact"])
+        env.kill_process("server")
+        env.start_server(
+            "--journal", str(tmp_path / "journal.bin"),
+            "--lazy-array-threshold", "10",
+        )
+        lazy = _stats(env)["ingest"]["lazy"]
+        assert lazy["unmaterialized"] == 1000
+        assert lazy["chunks"] == 4  # restored as chunks, not 1000 tasks
+        info = _job_info(env, 1)
+        assert info["n_tasks"] == 1000
+        # and the restored job still runs
+        env.start_worker(cpus=4)
+        env.wait_workers(1)
+
+        def done():
+            return _job_info(env, 1)["counters"]["finished"] == 1000
+
+        wait_until(done, timeout=120, message="restored lazy job finished")
