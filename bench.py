@@ -1027,6 +1027,120 @@ def run_chaos_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_federation_smoke() -> None:
+    """Federated failover gate (ISSUE 11): 2 shards + a warm standby.
+
+    A reconnect-mode worker runs blocked tasks on shard 1; shard 1 is
+    SIGKILLed mid-job. Measures the failover time — kill to the FIRST
+    task completion committed by the promoted successor — and asserts
+    the bound (lease detection + restore + reattach + completion). Also
+    audits exactly-once: every task exactly one start line, instance 0,
+    and a second submit against the promoted shard completes."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv, wait_until
+
+    lease_timeout = 1.0
+    # generous on the slow 2-core gVisor box: detection (~1-2 lease
+    # timeouts) + journal restore + worker reconnect backoff (<= 5 s
+    # jittered cap) + one task round trip
+    bound_s = 20.0
+    failures = []
+    t_wall = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        with HqEnv(tmp) as env:
+            marker = env.work_dir / "starts.txt"
+            flag = env.work_dir / "flag"
+            env.start_shard(0, 2, "--lease-timeout", str(lease_timeout))
+            env.start_shard(1, 2, "--lease-timeout", str(lease_timeout))
+            env.start_standby("--lease-timeout", str(lease_timeout),
+                              "--no-coordinator")
+            env.start_worker("--shard", "1", "--on-server-lost",
+                             "reconnect", cpus=4)
+            env.wait_workers(1)
+            os.environ["HQ_SHARD"] = "1"
+            try:
+                env.command([
+                    "submit", "--array", "0-3", "--", "bash", "-c",
+                    f'echo "start:$HQ_TASK_ID:$HQ_INSTANCE_ID" >> {marker}'
+                    f"; while [ ! -f {flag} ]; do sleep 0.2; done",
+                ])
+            finally:
+                os.environ.pop("HQ_SHARD", None)
+            wait_until(
+                lambda: marker.exists()
+                and len(marker.read_text().splitlines()) == 4,
+                timeout=30, message="tasks running on shard 1",
+            )
+            flag.touch()  # tasks exit as soon as they can
+            t_kill = time.perf_counter()
+            env.kill_process("shard1-0")
+
+            def first_completion() -> bool:
+                try:
+                    out = json.loads(env.command(
+                        ["job", "list", "--all", "--output-mode", "json"],
+                        timeout=30,
+                    ))
+                except Exception:  # noqa: BLE001 - mid-failover blips
+                    return False
+                return bool(out) and out[0]["counters"]["finished"] > 0
+
+            try:
+                wait_until(first_completion, timeout=bound_s + 10,
+                           interval=0.1, message="successor completion")
+                failover_s = time.perf_counter() - t_kill
+            except TimeoutError:
+                failover_s = float("inf")
+                failures.append("no successor-side completion")
+            env.command(["job", "wait", "all"], timeout=60)
+            starts = sorted(marker.read_text().splitlines())
+            if starts != sorted(f"start:{i}:0" for i in range(4)):
+                failures.append(f"duplicate/missing executions: {starts}")
+            # the promoted shard keeps serving: a fresh submit completes
+            os.environ["HQ_SHARD"] = "1"
+            try:
+                env.command(["submit", "--array", "0-3", "--wait", "--",
+                             "true"], timeout=60)
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"post-promotion submit failed: {e}")
+            finally:
+                os.environ.pop("HQ_SHARD", None)
+            stats = json.loads(env.command(
+                ["server", "stats", "--shard", "1", "--output-mode",
+                 "json"]
+            ))
+            if not (stats.get("federation") or {}).get("promoted"):
+                failures.append("shard 1 is not a promoted successor")
+            if failover_s != float("inf") and failover_s > bound_s:
+                failures.append(
+                    f"failover {failover_s:.2f}s over the {bound_s}s bound"
+                )
+    emit({
+        "experiment": "federation_smoke",
+        "metric": "failover_seconds",
+        # None on the no-completion failure path: float('inf') would
+        # serialize as the non-RFC-8259 token Infinity
+        "value": (
+            round(failover_s, 3) if failover_s != float("inf") else None
+        ),
+        "unit": "s",
+        "params": {"shards": 2, "lease_timeout_s": lease_timeout,
+                   "bound_s": bound_s, "successor": "standby"},
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    sys.exit(1 if failures else 0)
+
+
 def run_explain_smoke() -> None:
     """Explainability gate: run a deliberately unsatisfiable and a
     satisfiable workload against a real server, assert the reason codes
@@ -1764,6 +1878,11 @@ def main() -> None:
                              "chunked-ingest tasks/s, tick p95 before vs "
                              "during ingest, and O(chunks) lazy "
                              "materialization at ingest")
+    parser.add_argument("--federation-smoke", action="store_true",
+                        help="federated failover gate: 2 shards + warm "
+                             "standby, SIGKILL shard 1 mid-job, measure "
+                             "kill -> first successor-side completion, "
+                             "assert the bound + exactly-once starts")
     parser.add_argument("--restore-smoke", action="store_true",
                         help="bounded-restore gate: restore under 2 s from "
                              "a snapshot after --tasks (default 1M) "
@@ -1799,6 +1918,10 @@ def main() -> None:
 
     if args.submit_smoke:
         run_submit_smoke(args)
+        return
+
+    if args.federation_smoke:
+        run_federation_smoke()
         return
 
     if args.restore_smoke:

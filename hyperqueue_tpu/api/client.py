@@ -131,10 +131,12 @@ class Client:
     """Synchronous client to a running server."""
 
     def __init__(self, server_dir: str | Path | None = None):
-        from hyperqueue_tpu.client.connection import ClientSession
+        from hyperqueue_tpu.client.connection import open_session
         from hyperqueue_tpu.utils.serverdir import default_server_dir
 
-        self._session = ClientSession(
+        # open_session resolves a federation root to a routing
+        # FederatedSession (ISSUE 11); classic dirs get a ClientSession
+        self._session = open_session(
             Path(server_dir) if server_dir else default_server_dir()
         )
 

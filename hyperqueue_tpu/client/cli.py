@@ -17,7 +17,11 @@ import time
 from pathlib import Path
 
 from hyperqueue_tpu import __version__
-from hyperqueue_tpu.client.connection import ClientError, ClientSession
+from hyperqueue_tpu.client.connection import (
+    ClientError,
+    ClientSession,
+    open_session,
+)
 from hyperqueue_tpu.client.output import fail, make_output
 from hyperqueue_tpu.resources.amount import amount_from_str
 from hyperqueue_tpu.utils import serverdir
@@ -44,8 +48,10 @@ def _server_dir(args) -> Path:
 
 
 def _session(args) -> ClientSession:
+    # open_session routes through a FederatedSession when the server dir
+    # is a federation root (per-shard routing + fan-out; ISSUE 11)
     try:
-        return ClientSession(_server_dir(args))
+        return open_session(_server_dir(args))
     except FileNotFoundError as e:
         fail(str(e))
 
@@ -133,16 +139,40 @@ def cmd_server_start(args) -> None:
 
     profile_out = os.environ.get("HQ_PROFILE")
 
+    # --- federation (ISSUE 11) -----------------------------------------
+    shards = int(getattr(args, "shards", 0) or 0)
+    standby = bool(getattr(args, "standby", False))
+    if standby:
+        _run_standby(args, shards)
+        return
+    federated = shards >= 1
+    server_dir = _server_dir(args)
+    federation_root = None
+    journal = Path(args.journal) if args.journal else None
+    shard_id = int(getattr(args, "shard_id", 0) or 0)
+    if federated:
+        if args.journal:
+            fail(
+                "--journal cannot be combined with --shards: federated "
+                "shards always journal at <shard-dir>/journal.bin so a "
+                "failover successor knows where to restore from"
+            )
+        from hyperqueue_tpu.server.federation import shard_journal_path
+
+        federation_root = server_dir
+        server_dir = serverdir.shard_path(federation_root, shard_id)
+        journal = shard_journal_path(federation_root, shard_id)
+
     async def go():
         server = Server(
-            server_dir=_server_dir(args),
+            server_dir=server_dir,
             host=args.host,
             client_port=args.client_port,
             worker_port=args.worker_port,
             disable_client_auth=args.disable_client_authentication,
             disable_worker_auth=args.disable_worker_authentication,
             scheduler=args.scheduler,
-            journal_path=Path(args.journal) if args.journal else None,
+            journal_path=journal,
             idle_timeout=args.idle_timeout,
             journal_flush_period=args.journal_flush_period,
             access_file=Path(args.access_file) if args.access_file else None,
@@ -165,8 +195,19 @@ def cmd_server_start(args) -> None:
             client_plane=args.client_plane,
             ingest_window=args.ingest_window,
             lazy_array_threshold=args.lazy_array_threshold,
+            shard_id=shard_id,
+            shard_count=shards if federated else 1,
+            federation_root=federation_root,
+            lease_timeout=args.lease_timeout,
+            failover_watch=getattr(args, "failover_watch", False),
         )
         access = await server.start()
+        if federated:
+            print(
+                f"| shard {shard_id}/{shards} of federation "
+                f"{federation_root}",
+                flush=True,
+            )
         print(
             f"+-- HyperQueue TPU server [{access.server_uid}] --\n"
             f"| clients: {access.host}:{access.client_port}\n"
@@ -185,27 +226,112 @@ def cmd_server_start(args) -> None:
         asyncio.run(go())
 
 
+def _run_standby(args, shards: int) -> None:
+    """`hq server start --standby`: warm failover successor + federation
+    coordinator. Holds no shard of its own; claims dead shards through
+    the atomic lease and boots a full restored Server over each."""
+    import asyncio
+
+    from hyperqueue_tpu.server.federation import standby_main
+
+    root = _server_dir(args)
+    if shards >= 1:
+        # allow the standby to come up FIRST in a deployment: it can
+        # publish the federation descriptor the shards will join
+        serverdir.write_federation(root, shards)
+    # keep in lockstep with Server.federation_server_kwargs() — the
+    # peer-promotion path clones the same subset from a live Server, and
+    # a knob present in one list but not the other makes standby- and
+    # peer-promoted successors behave differently for the same shard
+    server_kwargs = dict(
+        scheduler=args.scheduler,
+        journal_fsync=args.journal_fsync,
+        journal_flush_period=args.journal_flush_period,
+        journal_compact_interval=args.journal_compact_interval,
+        journal_compact_threshold=args.journal_compact_threshold,
+        journal_salvage=args.journal_salvage,
+        heartbeat_timeout_factor=args.heartbeat_timeout_factor,
+        reattach_timeout=args.reattach_timeout,
+        idle_timeout=args.idle_timeout,
+        client_plane=args.client_plane,
+        lazy_array_threshold=args.lazy_array_threshold,
+    )
+    print(f"+-- HyperQueue TPU standby watching {root} --", flush=True)
+    asyncio.run(standby_main(
+        root,
+        server_kwargs=server_kwargs,
+        lease_timeout=args.lease_timeout,
+        coordinate=not getattr(args, "no_coordinator", False),
+        sample_interval=args.coordinator_interval,
+    ))
+
+
 def cmd_server_stop(args) -> None:
     with _session(args) as session:
         session.request({"op": "stop_server"})
     make_output(args.output_mode).message("server stopped")
 
 
+def _print_federation_block(fed: dict | None) -> None:
+    if not fed:
+        return
+    lease_age = fed.get("lease_age_seconds")
+    print(
+        f"federation: shard {fed.get('shard_id')}/{fed.get('shard_count')}"
+        f" — partition {fed.get('partition')}"
+        + (" [promoted successor]" if fed.get("promoted") else "")
+        + (" [FENCED]" if fed.get("fenced") else "")
+    )
+    print(
+        f"  lease: held by {fed.get('lease_owner')} "
+        f"(epoch {fed.get('lease_epoch')}, renewed "
+        + (f"{lease_age:.1f}s ago)" if lease_age is not None else "?)")
+    )
+    print(
+        f"  workers: {fed.get('workers_lent', 0)} lent, "
+        f"{fed.get('workers_borrowed', 0)} borrowed"
+    )
+
+
 def cmd_server_info(args) -> None:
     with _session(args) as session:
-        info = session.request({"op": "server_info"})
+        info = session.request(
+            {"op": "server_info", "shard": getattr(args, "shard", 0)}
+        )
     info.pop("op", None)
-    make_output(args.output_mode).record(info)
+    out = make_output(args.output_mode)
+    if "shards" in info and args.output_mode == "cli":
+        # --shard all: one record per shard
+        for rec in info["shards"]:
+            rec.pop("op", None)
+            out.record(rec)
+        return
+    out.record(info)
 
 
 def cmd_server_stats(args) -> None:
     """Per-phase tick latency breakdown + incremental-cache counters."""
     with _session(args) as session:
-        stats = session.request({"op": "server_stats"})
+        stats = session.request(
+            {"op": "server_stats", "shard": getattr(args, "shard", 0)}
+        )
     stats.pop("op", None)
     if args.output_mode != "cli":
         make_output(args.output_mode).record(stats)
         return
+    if "shards" in stats:
+        # --shard all: the cross-shard summary (full per-shard telemetry
+        # stays one `--shard k` away; latencies are never summed)
+        for rec in stats["shards"]:
+            if rec.get("error"):
+                print(f"shard {rec.get('shard_id')}: DOWN ({rec['error']})")
+                continue
+            _print_federation_block(rec.get("federation"))
+            tick = rec.get("tick") or {}
+            print(f"  ticks: {tick.get('ticks', 0)}, scheduler "
+                  f"{rec.get('scheduler')}")
+        return
+    _print_federation_block(stats.get("federation"))
     tick = stats.get("tick") or {}
     print(f"scheduler: {stats.get('scheduler')} "
           f"(backend {stats.get('solve_backend')})")
@@ -437,7 +563,21 @@ def cmd_worker_start(args) -> None:
 
     from hyperqueue_tpu.worker.manager import detect_manager
 
-    access = serverdir.load_access(_server_dir(args))
+    # a federation root resolves to ONE shard's nested server dir: the
+    # worker registers with that shard (and may later be lent to others
+    # by the coordinator). --shard pins it; default spreads randomly.
+    worker_dir = _server_dir(args)
+    fed = serverdir.load_federation(worker_dir)
+    if fed is not None:
+        import random as _random
+
+        shard = getattr(args, "shard", None)
+        if shard is None:
+            shard = _random.randrange(fed["shard_count"])
+        if not (0 <= shard < fed["shard_count"]):
+            fail(f"--shard {shard} outside 0..{fed['shard_count'] - 1}")
+        worker_dir = serverdir.shard_path(worker_dir, shard)
+    access = serverdir.load_access(worker_dir)
     manager_info = detect_manager(args.manager)
     descriptor = detect_resources(
         n_cpus=args.cpus,
@@ -503,8 +643,10 @@ def cmd_worker_start(args) -> None:
     worker_kwargs = {
         "zero_worker": args.zero_worker,
         # reconnect re-reads the access record from the server dir (a
-        # restarted server publishes new ports/keys)
-        "server_dir": _server_dir(args),
+        # restarted server publishes new ports/keys); under federation
+        # this is the SHARD dir, so a failover successor's record is
+        # picked up transparently
+        "server_dir": worker_dir,
         "metrics_port": args.metrics_port,
         "metrics_host": args.metrics_host,
     }
@@ -585,9 +727,9 @@ def cmd_worker_list(args) -> None:
 
 def cmd_worker_info(args) -> None:
     with _session(args) as session:
-        worker = session.request(
-            {"op": "worker_info", "worker_id": args.worker_id}
-        )["worker"]
+        worker = session.request(_worker_shard_msg(
+            args, {"op": "worker_info", "worker_id": args.worker_id}
+        ))["worker"]
     out = make_output(args.output_mode)
     if args.output_mode == "json":
         out.value(worker)
@@ -622,9 +764,9 @@ def cmd_task_notify(args) -> None:
 
 def cmd_worker_address(args) -> None:
     with _session(args) as session:
-        worker = session.request(
-            {"op": "worker_info", "worker_id": args.worker_id}
-        )["worker"]
+        worker = session.request(_worker_shard_msg(
+            args, {"op": "worker_info", "worker_id": args.worker_id}
+        ))["worker"]
     make_output(args.output_mode).value(worker["hostname"])
 
 
@@ -652,7 +794,7 @@ def cmd_server_wait(args) -> None:
     while True:
         try:
             # retry_window=0: this loop IS the retry policy
-            with ClientSession(_server_dir(args), retry_window=0) as session:
+            with open_session(_server_dir(args), retry_window=0) as session:
                 session.request({"op": "server_info"})
             make_output(args.output_mode).message("server is running")
             return
@@ -662,15 +804,46 @@ def cmd_server_wait(args) -> None:
             time.sleep(0.25)
 
 
+def _worker_shard_msg(args, msg: dict) -> dict:
+    # worker ids are per shard under federation: thread --shard through
+    # (FederatedSession requires it for worker-targeted ops)
+    shard = getattr(args, "shard", None)
+    if shard is not None:
+        msg["shard"] = shard
+    return msg
+
+
 def cmd_worker_stop(args) -> None:
     with _session(args) as session:
         ids = parse_selector(args.selector)
-        if not ids:
-            ids = [w["id"] for w in session.request({"op": "worker_list"})["workers"]]
-        result = session.request({"op": "worker_stop", "worker_ids": ids})
-    make_output(args.output_mode).message(
-        f"stopped workers: {result['stopped']}"
-    )
+        shards: list[int | None] = [getattr(args, "shard", None)]
+        if (
+            shards[0] is None
+            and getattr(session, "shard_count", 0) > 1
+            and not ids
+        ):
+            # federation `worker stop all` with no --shard: ids are per
+            # shard (and collide across shards), so resolve AND stop
+            # shard by shard
+            shards = list(range(session.shard_count))
+        stopped = []
+        for shard in shards:
+            msg: dict = {"op": "worker_list"}
+            stop: dict = {"op": "worker_stop"}
+            if shard is not None:
+                msg["shard"] = shard
+                stop["shard"] = shard
+            else:
+                _worker_shard_msg(args, msg)
+                _worker_shard_msg(args, stop)
+            shard_ids = ids or [
+                w["id"] for w in session.request(msg)["workers"]
+            ]
+            if not shard_ids:
+                continue
+            stop["worker_ids"] = shard_ids
+            stopped.extend(session.request(stop)["stopped"])
+    make_output(args.output_mode).message(f"stopped workers: {stopped}")
 
 
 # ---------------------------------------------------------------- submit
@@ -2113,18 +2286,54 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bound the per-task distributed-trace store to N "
                         "tasks (`hq task trace`; 0 disables tracing "
                         "entirely, including trace headers on the wire)")
+    p.add_argument("--shards", type=int, default=0, metavar="N",
+                   help="run as part of an N-shard federation: the server "
+                        "dir becomes the federation root with one nested "
+                        "server dir (+ journal + lease) per shard, job ids "
+                        "partition statically across shards, and clients "
+                        "route by job id (docs/deployment/federation.md)")
+    p.add_argument("--shard-id", type=int, default=0, metavar="K",
+                   help="with --shards N: which shard (0..N-1) this "
+                        "process owns")
+    p.add_argument("--standby", action="store_true",
+                   help="run a warm failover successor instead of a "
+                        "shard: watch every shard's lease, claim stale "
+                        "ones atomically, restore their journal and "
+                        "absorb their workers/clients; also runs the "
+                        "worker-lending coordinator")
+    p.add_argument("--lease-timeout", type=_parse_duration, default=15.0,
+                   help="shard lease staleness bound: a shard whose lease "
+                        "went unrenewed this long is claimable by a "
+                        "successor (renewal runs at a third of this)")
+    p.add_argument("--failover-watch", action="store_true",
+                   help="this shard also volunteers as a successor for "
+                        "dead sibling shards while its own backlog is "
+                        "empty (peer failover without a standby)")
+    p.add_argument("--no-coordinator", action="store_true",
+                   help="with --standby: watch leases only, never lend "
+                        "workers across shards")
+    p.add_argument("--coordinator-interval", type=_parse_duration,
+                   default=1.0,
+                   help="with --standby: subscribe-feed sample cadence "
+                        "driving the lending decisions")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)
     p.set_defaults(fn=cmd_server_stop)
     p = ssub.add_parser("info")
     _add_common(p)
+    p.add_argument("--shard", default="0", metavar="K|all",
+                   help="federation: which shard to query (default 0; "
+                        "'all' fans out, one record per shard)")
     p.set_defaults(fn=cmd_server_info)
     p = ssub.add_parser(
         "stats", help="scheduler telemetry: per-phase tick latency "
                       "breakdown + snapshot-cache counters"
     )
     _add_common(p)
+    p.add_argument("--shard", default="0", metavar="K|all",
+                   help="federation: which shard to query (default 0; "
+                        "'all' fans out, one record per shard)")
     p.set_defaults(fn=cmd_server_stats)
     p = ssub.add_parser("debug-dump", help="full server state as JSON")
     _add_common(p)
@@ -2228,6 +2437,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.environ.get("HQ_LOG_FORMAT", "plain"),
                    help="json: one JSON object per log line with "
                         "task/worker correlation fields")
+    p.add_argument("--shard", type=int, default=None, metavar="K",
+                   help="federation: register with shard K instead of a "
+                        "random one (the coordinator may lend the worker "
+                        "to other shards later)")
     p.set_defaults(fn=cmd_worker_start)
     p = wsub.add_parser("hw-detect", help="print detected node resources")
     _add_common(p)
@@ -2242,14 +2455,21 @@ def build_parser() -> argparse.ArgumentParser:
     p = wsub.add_parser("stop")
     _add_common(p)
     p.add_argument("selector")
+    p.add_argument("--shard", type=int, default=None, metavar="K",
+                   help="federation: worker ids are per shard — which "
+                        "shard's workers to stop")
     p.set_defaults(fn=cmd_worker_stop)
     p = wsub.add_parser("info")
     _add_common(p)
     p.add_argument("worker_id", type=int)
+    p.add_argument("--shard", type=int, default=None, metavar="K",
+                   help="federation: which shard owns this worker id")
     p.set_defaults(fn=cmd_worker_info)
     p = wsub.add_parser("address")
     _add_common(p)
     p.add_argument("worker_id", type=int)
+    p.add_argument("--shard", type=int, default=None, metavar="K",
+                   help="federation: which shard owns this worker id")
     p.set_defaults(fn=cmd_worker_address)
     p = wsub.add_parser("wait", help="wait until N workers are connected")
     _add_common(p)

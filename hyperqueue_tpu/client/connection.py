@@ -47,10 +47,19 @@ def _env_retry_secs() -> float:
 
 _BACKOFF_BASE = 0.2
 _BACKOFF_CAP = 2.0
+# per-attempt bound on connect+auth: a wedged-but-listening server (a
+# SIGSTOPped federation shard awaiting its fence, a paused VM) accepts
+# the TCP handshake and then never answers the auth exchange — without
+# this the client hangs forever instead of retrying against the fresh
+# access record a failover successor publishes
+_HANDSHAKE_TIMEOUT = 10.0
 
 # transient transport failures worth retrying; AuthError and malformed
-# access records are NOT here — retrying a bad key never helps
-_RETRIABLE = (ConnectionError, OSError, asyncio.IncompleteReadError)
+# access records are NOT here — retrying a bad key never helps.
+# asyncio.TimeoutError covers the per-attempt handshake bound above
+# (it subclasses OSError on 3.11+, listed explicitly for older runtimes)
+_RETRIABLE = (ConnectionError, OSError, asyncio.IncompleteReadError,
+              asyncio.TimeoutError)
 
 
 class ClientError(Exception):
@@ -92,20 +101,27 @@ class ClientSession:
             raise RuntimeError(
                 "access record has no client plane (worker-only split file?)"
             )
-        reader, writer = await asyncio.open_connection(
-            self.access.host, self.access.client_port
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(
+                self.access.host, self.access.client_port
+            ),
+            timeout=_HANDSHAKE_TIMEOUT,
         )
         try:
-            return await do_authentication(
-                reader,
-                writer,
-                ROLE_CLIENT,
-                ROLE_SERVER,
-                self.access.client_key_bytes(),
+            return await asyncio.wait_for(
+                do_authentication(
+                    reader,
+                    writer,
+                    ROLE_CLIENT,
+                    ROLE_SERVER,
+                    self.access.client_key_bytes(),
+                ),
+                timeout=_HANDSHAKE_TIMEOUT,
             )
         except BaseException:
             # a failed handshake must not leak its socket — the retry loop
             # can make a dozen attempts per CLI call during a restart
+            # (BaseException also covers the wait_for cancellation)
             writer.close()
             raise
 
@@ -186,6 +202,238 @@ class ClientSession:
         self.close()
 
 
+class FederatedSession:
+    """ClientSession-shaped facade over a federated server dir (ISSUE 11).
+
+    Routes each request to the shard that owns it — the job-id partition
+    is static ((job_id - 1) % shard_count), so any request naming a job
+    routes directly; cluster-wide reads (job_list, worker_list) fan out
+    to every live shard and merge; submits/open_job pick a shard
+    round-robin from a random start (pin with HQ_SHARD). Per-shard
+    ClientSessions open lazily and are reused, each with the full
+    reconnect/retry machinery — so a request that lands during a shard
+    failover rides it out exactly like against a restarting standalone
+    server.
+    """
+
+    # ops fanned out to every shard, responses merged; a shard with no
+    # running server is skipped (a cleanly-stopped shard's jobs are
+    # still listed by its siblings)
+    _FAN_OUT = frozenset({"job_list", "worker_list", "stop_server"})
+
+    def __init__(self, server_dir: Path, retry_window: float | None = None,
+                 shard_count: int | None = None):
+        self.server_dir = Path(server_dir)
+        self.retry_window = retry_window
+        if shard_count is None:
+            fed = serverdir.load_federation(self.server_dir)
+            if fed is None:
+                raise ValueError(f"no federation at {server_dir}")
+            shard_count = fed["shard_count"]
+        self.shard_count = shard_count
+        self._sessions: dict[int, ClientSession] = {}
+        env_shard = os.environ.get("HQ_SHARD")
+        self._pin_submits = env_shard not in (None, "")
+        if self._pin_submits:
+            try:
+                self._submit_shard = int(env_shard) % shard_count
+            except ValueError:
+                import logging
+
+                logging.getLogger("hq.client").warning(
+                    "ignoring malformed HQ_SHARD=%r; picking randomly",
+                    env_shard,
+                )
+                self._pin_submits = False
+        if not self._pin_submits:
+            self._submit_shard = random.randrange(shard_count)
+
+    # --- shard sessions -------------------------------------------------
+    def shard_session(self, shard_id: int) -> ClientSession:
+        session = self._sessions.get(shard_id)
+        if session is None:
+            try:
+                session = ClientSession(
+                    serverdir.shard_path(self.server_dir, shard_id),
+                    retry_window=self.retry_window,
+                )
+            except FileNotFoundError as e:
+                # sessions open lazily INSIDE request(), past the CLI's
+                # construction-time FileNotFoundError handling — surface
+                # a clean client error, not a raw traceback
+                raise ClientError(str(e)) from e
+            self._sessions[shard_id] = session
+        return session
+
+    def _drop_session(self, shard_id: int) -> None:
+        """Forget a shard's cached session, closing its socket + private
+        event loop (popping without close would leak both)."""
+        session = self._sessions.pop(shard_id, None)
+        if session is not None:
+            try:
+                session.close()
+            except Exception:  # noqa: BLE001 - already broken
+                pass
+
+    def session_for_job(self, job_id: int) -> ClientSession:
+        return self.shard_session(
+            serverdir.shard_for_job(job_id, self.shard_count)
+        )
+
+    def submit_session(self) -> ClientSession:
+        """The shard for a NEW job: round-robin from a random start so
+        independent clients spread; HQ_SHARD pins it."""
+        shard = self._submit_shard
+        if not self._pin_submits:
+            self._submit_shard = (shard + 1) % self.shard_count
+        return self.shard_session(shard)
+
+    # worker-targeted ops: worker ids are allocated PER SHARD and collide
+    # across shards, so these must name their shard explicitly — routing
+    # a bare id anywhere would silently hit the wrong shard's worker
+    _WORKER_OPS = frozenset({"worker_stop", "worker_info"})
+
+    # --- routing --------------------------------------------------------
+    def request(self, msg: dict, timeout: float | None = None) -> dict:
+        op = msg.get("op")
+        if op in self._WORKER_OPS or (
+            op == "worker_list" and msg.get("shard") is not None
+        ):
+            shard = msg.pop("shard", None)
+            if shard is None:
+                raise ClientError(
+                    "federation: worker ids are per shard; pass --shard K"
+                )
+            return self.shard_session(int(shard)).request(msg, timeout)
+        if op in self._FAN_OUT:
+            return self._fan_out(msg, timeout)
+        if "job_ids" in msg:
+            return self._by_job_ids(msg, timeout)
+        if "job_id" in msg and msg["job_id"] is not None:
+            return self.session_for_job(msg["job_id"]).request(msg, timeout)
+        if op in ("submit", "open_job"):
+            job_id = (msg.get("job") or {}).get("job_id")
+            if job_id:
+                return self.session_for_job(job_id).request(msg, timeout)
+            return self.submit_session().request(msg, timeout)
+        shard = msg.pop("shard", None)
+        if shard in ("all", -1, "-1") and op in (
+            "server_info", "server_stats"
+        ):
+            # per-shard fan-out: one record per shard (tick latencies and
+            # lease states are per-shard facts — never summed)
+            records = [
+                resp if resp is not None
+                else {"op": op, "shard_id": k, "error": str(err)}
+                for k, resp, err in self._per_shard(msg, timeout)
+            ]
+            return {"op": op, "shards": records}
+        try:
+            shard_id = int(shard) if shard is not None else 0
+        except (TypeError, ValueError):
+            # a typo'd --shard must not silently answer with shard 0's
+            # state (e.g. its lease/promoted flags) labeled as another's
+            raise ClientError(
+                f"invalid shard selector {shard!r}; pass "
+                f"0..{self.shard_count - 1} or 'all'"
+            ) from None
+        if not (0 <= shard_id < self.shard_count):
+            raise ClientError(
+                f"shard {shard_id} outside 0..{self.shard_count - 1}"
+            )
+        return self.shard_session(shard_id).request(msg, timeout)
+
+    def _per_shard(self, msg: dict, timeout):
+        """Request every shard in turn, yielding (shard, response, error)
+        with error set (and the dead session dropped) instead of raising
+        — a down shard must not fail a cluster-wide read."""
+        for shard in range(self.shard_count):
+            try:
+                yield shard, self.shard_session(shard).request(
+                    dict(msg), timeout
+                ), None
+            except (FileNotFoundError, ConnectionError, OSError,
+                    ClientError) as e:
+                self._drop_session(shard)
+                yield shard, None, e
+
+    def _by_job_ids(self, msg: dict, timeout) -> dict:
+        groups: dict[int, list[int]] = {}
+        for job_id in msg["job_ids"]:
+            groups.setdefault(
+                serverdir.shard_for_job(job_id, self.shard_count), []
+            ).append(job_id)
+        if not groups:
+            # empty selector: any shard answers the empty request
+            return self.shard_session(0).request(msg, timeout)
+        responses = []
+        for shard, ids in sorted(groups.items()):
+            sub = dict(msg)
+            sub["job_ids"] = ids
+            responses.append(self.shard_session(shard).request(sub, timeout))
+        return _merge_responses(responses)
+
+    def _fan_out(self, msg: dict, timeout) -> dict:
+        responses = []
+        errors: list[Exception] = []
+        for _shard, resp, err in self._per_shard(msg, timeout):
+            # a shard with no running server is skipped (its siblings
+            # still answer); errors kept in case ALL are down
+            if resp is not None:
+                responses.append(resp)
+            else:
+                errors.append(err)
+        if not responses:
+            raise errors[0] if errors else ClientError("no live shards")
+        return _merge_responses(responses)
+
+    def close(self) -> None:
+        for session in self._sessions.values():
+            try:
+                session.close()
+            except Exception:  # noqa: BLE001 - close the rest regardless
+                pass
+        self._sessions.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _merge_responses(responses: list[dict]) -> dict:
+    """Merge per-shard responses of one fan-out op: lists concatenate,
+    numbers sum, everything else keeps the first shard's value (`op` and
+    friends are identical across shards anyway)."""
+    if len(responses) == 1:
+        return responses[0]
+    merged: dict = dict(responses[0])
+    for resp in responses[1:]:
+        for key, value in resp.items():
+            if key not in merged:
+                merged[key] = value
+            elif isinstance(value, list) and isinstance(merged[key], list):
+                merged[key] = merged[key] + value
+            elif isinstance(value, (int, float)) and not isinstance(
+                value, bool
+            ) and isinstance(merged[key], (int, float)):
+                merged[key] = merged[key] + value
+    return merged
+
+
+def open_session(server_dir: Path, retry_window: float | None = None):
+    """ClientSession for a classic server dir, FederatedSession when
+    `server_dir` is a federation root — the CLI's one entry point."""
+    fed = serverdir.load_federation(Path(server_dir))
+    if fed is None:
+        return ClientSession(server_dir, retry_window=retry_window)
+    return FederatedSession(
+        server_dir, retry_window=retry_window,
+        shard_count=fed["shard_count"],
+    )
+
+
 class SubmitStream:
     """Pipelined chunked submit over one ClientSession (ISSUE 10).
 
@@ -213,6 +461,15 @@ class SubmitStream:
                  window: int | None = None, uid: str | None = None):
         from hyperqueue_tpu.utils.trace import new_trace_id
 
+        if isinstance(session, FederatedSession):
+            # a stream lives on ONE shard: the owning shard for a pinned
+            # job id, a submit shard otherwise (the job id acked by the
+            # first chunk then stays in that shard's partition)
+            job_id = header.get("job_id")
+            session = (
+                session.session_for_job(job_id)
+                if job_id else session.submit_session()
+            )
         self.session = session
         self.header = dict(header)
         if window is None:
@@ -359,13 +616,28 @@ def _frame_task_count(frame: dict) -> int:
     return len(frame.get("tasks") or ())
 
 
-def _streaming_request(server_dir: Path, request: dict, on_subscribed=None):
+def _resolve_stream_dir(server_dir: Path, shard: int = 0) -> Path:
+    """Streaming surfaces (journal stream, dashboard, subscribe) attach
+    to ONE server: against a federation root, resolve to a shard's
+    nested dir (default shard 0 — pass `shard`, or the shard dir itself,
+    for another; cross-shard event-stream merging is not a thing, each
+    shard's journal is its own lineage)."""
+    server_dir = Path(server_dir)
+    fed = serverdir.load_federation(server_dir)
+    if fed is not None:
+        return serverdir.shard_path(server_dir, shard)
+    return server_dir
+
+
+def _streaming_request(server_dir: Path, request: dict, on_subscribed=None,
+                       shard: int = 0):
     """One authenticated client connection turned into a frame generator:
     send `request`, yield every received frame until the server closes or
     the consumer breaks out. Blocking-recv based (read_frame is not
     cancellation-safe, so no wait_for timeouts may wrap it).
     on_subscribed, when given, is called once the request is on the wire —
     before the first frame is read."""
+    server_dir = _resolve_stream_dir(server_dir, shard)
 
     async def _connect():
         access = serverdir.load_access(Path(server_dir))
@@ -381,7 +653,12 @@ def _streaming_request(server_dir: Path, request: dict, on_subscribed=None):
     loop = asyncio.new_event_loop()
     conn = None
     try:
-        conn = loop.run_until_complete(_connect())
+        # bound the connect+auth+send preamble like ClientSession does (a
+        # wedged server must not hang the stream consumer forever); the
+        # recv loop below legitimately blocks between frames
+        conn = loop.run_until_complete(
+            asyncio.wait_for(_connect(), _HANDSHAKE_TIMEOUT)
+        )
         if on_subscribed is not None:
             on_subscribed()
         while True:
@@ -401,7 +678,7 @@ def _streaming_request(server_dir: Path, request: dict, on_subscribed=None):
 
 def subscribe(server_dir: Path, filters=(), sample_interval: float = 0.0,
               buffer: int = 4096, overviews: bool = False,
-              on_subscribed=None):
+              on_subscribed=None, shard: int = 0):
     """Generator of frames from the server's `subscribe` RPC: coalesced
     lifecycle-event frames ({"op": "events", "records": [...]}) plus
     periodic metric samples ({"op": "sample", ...}) when sample_interval
@@ -415,14 +692,16 @@ def subscribe(server_dir: Path, filters=(), sample_interval: float = 0.0,
         "buffer": buffer,
         "overviews": overviews,
     }
-    for msg in _streaming_request(server_dir, request, on_subscribed):
+    for msg in _streaming_request(server_dir, request, on_subscribed,
+                                  shard=shard):
         yield msg
         if msg.get("op") == "sub_dropped":
             return
 
 
 def stream_events(server_dir: Path, history: bool = False, filters=(),
-                  on_subscribed=None, overviews: bool = False):
+                  on_subscribed=None, overviews: bool = False,
+                  shard: int = 0):
     """Generator of event records from the server's client-plane stream;
     shared by `hq journal stream` and the dashboard."""
     request = {
@@ -432,4 +711,5 @@ def stream_events(server_dir: Path, history: bool = False, filters=(),
         # stream is attached (dashboards; SetOverviewIntervalOverride)
         "overviews": overviews,
     }
-    yield from _streaming_request(server_dir, request, on_subscribed)
+    yield from _streaming_request(server_dir, request, on_subscribed,
+                                  shard=shard)

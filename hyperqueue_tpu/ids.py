@@ -38,21 +38,33 @@ def parse_task_id(text: str) -> int:
 
 
 class IdCounter:
-    """Monotonic id allocator (1-based, 0 reserved as 'none')."""
+    """Monotonic id allocator (1-based, 0 reserved as 'none').
 
-    __slots__ = ("_next",)
+    With ``stride > 1`` the counter allocates only ids congruent to
+    ``start`` modulo ``stride`` — the static job-id partition of a
+    federated server shard (shard k of N allocates k+1, k+1+N, ...), so
+    N shards can allocate concurrently without coordination and a job id
+    alone names its owning shard.
+    """
 
-    def __init__(self, start: int = 1):
+    __slots__ = ("_next", "_stride")
+
+    def __init__(self, start: int = 1, stride: int = 1):
         self._next = start
+        self._stride = max(int(stride), 1)
 
     def next(self) -> int:
         value = self._next
-        self._next += 1
+        self._next += self._stride
         return value
 
     def peek(self) -> int:
         return self._next
 
     def ensure_above(self, used: int) -> None:
+        # advance past `used` while keeping the congruence class: a
+        # restored shard replays jobs from its own partition, but the
+        # snapshot's next_job_id watermark may land mid-class
         if used >= self._next:
-            self._next = used + 1
+            steps = (used - self._next) // self._stride + 1
+            self._next += steps * self._stride

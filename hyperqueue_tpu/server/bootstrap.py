@@ -177,6 +177,16 @@ class CommSender:
     def send_stop(self, worker_id: int) -> None:
         self._send(worker_id, {"op": "stop"})
 
+    def send_redirect(
+        self, worker_id: int, to_shard: int, from_shard: int
+    ) -> None:
+        # federation worker lending: the worker re-registers with the
+        # sibling shard dir (worker/runtime.py handles the op)
+        self._send(
+            worker_id,
+            {"op": "redirect", "shard": to_shard, "from_shard": from_shard},
+        )
+
     def send_overview_override(
         self, worker_id: int, interval: float | None
     ) -> None:
@@ -455,6 +465,12 @@ class Server:
         ingest_window: int = 64,
         ingest_handoff_max: int = 8192,
         lazy_array_threshold: int = 4096,
+        shard_id: int = 0,
+        shard_count: int = 1,
+        federation_root: Path | None = None,
+        lease_timeout: float = 15.0,
+        promoted: bool = False,
+        failover_watch: bool = False,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -573,6 +589,32 @@ class Server:
         # chunked-submit streams: submit uid -> job id (exactly-once chunk
         # replay lands on the same job across client reconnects/restores)
         self._stream_jobs: dict[str, int] = {}
+        # federation (ISSUE 11): this server owns shard `shard_id` of a
+        # `shard_count`-way static job-id partition rooted at
+        # `federation_root` (None = classic standalone server). The shard
+        # dir holds an atomic lease renewed by _lease_renew_loop; losing
+        # it to a successor FENCES this instance (it stops immediately).
+        if not (0 <= shard_id < max(shard_count, 1)):
+            raise ValueError(
+                f"shard id {shard_id} outside 0..{shard_count - 1}"
+            )
+        self.shard_id = shard_id
+        self.shard_count = max(int(shard_count), 1)
+        self.federation_root = (
+            Path(federation_root) if federation_root else None
+        )
+        self.lease_timeout = float(lease_timeout)
+        self.promoted = promoted
+        self.lease = None
+        self.fenced = False
+        # --failover-watch: this shard also volunteers as a successor for
+        # dead sibling shards (claims gated on being idle itself)
+        self.failover_watch = failover_watch
+        self._watcher = None
+        # cross-shard worker lending: wid -> target shard for workers this
+        # shard ordered to re-register elsewhere (coordinator-driven)
+        self._lent_workers: dict[int, int] = {}
+        self.workers_lent_total = 0
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
@@ -643,6 +685,32 @@ class Server:
 
         gc.set_threshold(100_000, 50, 25)
 
+        if self.federation_root is not None:
+            import secrets as _secrets
+
+            from hyperqueue_tpu.utils.lease import ShardLease
+
+            serverdir.write_federation(self.federation_root, self.shard_count)
+            # claim the shard BEFORE touching the journal: the lease is
+            # what guarantees one journal appender per shard — a double
+            # start (or a failover race) must fail here, not interleave
+            # records. Raises LeaseHeldError while the holder is alive.
+            self.lease = ShardLease(self.server_dir, self.lease_timeout)
+            self.lease_owner = f"{socket.gethostname()}:{os.getpid()}:" + (
+                _secrets.token_hex(4)
+            )
+            lease_rec = self.lease.acquire(self.lease_owner)
+            logger.info(
+                "shard %d/%d lease acquired (epoch %d%s)",
+                self.shard_id, self.shard_count, lease_rec["epoch"],
+                ", promoted successor" if self.promoted else "",
+            )
+            # renew from the moment the claim lands: a promotion whose
+            # journal restore outlasts --lease-timeout must not look
+            # stale to ANOTHER successor mid-restore (two claimants =
+            # two journal appenders, the exact thing the lease forbids)
+            self._tasks.append(self._spawn_loop(self._lease_renew_loop))
+
         if self.journal_path is not None:
             from hyperqueue_tpu.events import snapshot as snapshot_mod
             from hyperqueue_tpu.events.journal import Journal
@@ -656,8 +724,18 @@ class Server:
             if self.journal_path.exists() or snapshot_mod.have_snapshot(
                 self.journal_path
             ):
-                restore_from_journal(self)
+                # off the event loop: nothing else references this Server
+                # yet, and a peer shard promoting a dead sibling
+                # (--failover-watch) runs THIS start() on its own live
+                # reactor — a multi-second journal replay inline would
+                # freeze its scheduler, heartbeats, and worker plane
+                await asyncio.get_running_loop().run_in_executor(
+                    None, restore_from_journal, self
+                )
             self.journal.open_for_append()
+        # after the restore (which may replace self.jobs): pin this
+        # shard's job-id allocator to its congruence class
+        self._apply_job_id_partition()
 
         # pre-shared deployment (reference generate-access + serverdir.rs):
         # an access file pins ports and both plane keys so workers/clients on
@@ -756,6 +834,20 @@ class Server:
         self._tasks.append(self._spawn_loop(self._scheduler_loop))
         self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
         self._tasks.append(self._spawn_loop(self._loop_lag_monitor))
+        if self.federation_root is not None and self.failover_watch:
+            # idle-peer successor mode: this shard claims dead siblings,
+            # but only while its own ready backlog is empty (a drowning
+            # shard leaves the claim to the standby or another peer)
+            from hyperqueue_tpu.server.federation import FailoverWatcher
+
+            self._watcher = FailoverWatcher(
+                self.federation_root,
+                server_kwargs=self.federation_server_kwargs(),
+                lease_timeout=self.lease_timeout,
+                own_shard=self.shard_id,
+                eligible=lambda: self.core.queues.total_ready() == 0,
+            )
+            self._tasks.append(self._spawn_loop(self._watcher.run))
         if self.ingest_plane is not None:
             self._tasks.append(self._spawn_loop(self._ingest_drain_loop))
         if self.journal is not None and (
@@ -796,9 +888,18 @@ class Server:
     async def shutdown(self) -> None:
         if getattr(self, "autoalloc", None) is not None:
             self.autoalloc.stop()
-        for wid in list(self._worker_conns):
-            self.comm.send_stop(wid)
-        await asyncio.sleep(0.05)
+        if self._watcher is not None:
+            # peer-successor mode: shards this process promoted into are
+            # full Servers of their own — stop them with us
+            await self._watcher.shutdown()
+        if not self.fenced:
+            for wid in list(self._worker_conns):
+                self.comm.send_stop(wid)
+            await asyncio.sleep(0.05)
+        # a FENCED instance must NOT stop its workers: they are the
+        # promoted successor's fleet now — closing the connections below
+        # makes them reconnect (and reattach) to it, a `stop` op would
+        # kill them unconditionally
         for t in self._tasks:
             t.cancel()
         for t in list(self._client_tasks):
@@ -815,6 +916,12 @@ class Server:
             conn.close()
         if self.journal is not None:
             self.journal.close()
+        if self.lease is not None:
+            # clean stop: retire the lease so failover watchers never
+            # promote a successor for a deliberately-stopped shard. A
+            # FENCED instance skips this implicitly (release() refuses to
+            # delete a lease it no longer owns).
+            self.lease.release()
         # a clean stop retires the hq-current symlink so clients see "no
         # server" instead of a dead address (reference server stop removes
         # the symlink; test_server.py delete_symlink_after_server_stop).
@@ -832,6 +939,135 @@ class Server:
                 link.unlink()
         except OSError:
             pass  # cleanup is best-effort; a dead link is still harmless
+
+    # --- federation (ISSUE 11) ------------------------------------------
+    def federation_server_kwargs(self) -> dict:
+        """The config subset a promoted sibling Server clones from this
+        one (FailoverWatcher in peer-successor mode). Ports and keys are
+        NOT cloned — a successor publishes a fresh access record and the
+        reconnect machinery re-reads it. Keep in lockstep with the
+        standby path's server_kwargs in cli._run_standby."""
+        return dict(
+            scheduler=self.scheduler_kind,
+            schedule_min_delay=self.schedule_min_delay,
+            journal_fsync=self.journal_fsync,
+            journal_flush_period=self.journal_flush_period,
+            journal_compact_interval=self.journal_compact_interval,
+            journal_compact_threshold=self.journal_compact_threshold,
+            journal_salvage=self.journal_salvage,
+            heartbeat_timeout_factor=self.heartbeat_timeout_factor,
+            reattach_timeout=self.reattach_timeout,
+            idle_timeout=self.idle_timeout,
+            client_plane=self.client_plane,
+            lazy_array_threshold=(
+                self.lazy_array_threshold
+                if self.lazy_array_threshold < (1 << 62) else 0
+            ),
+        )
+
+    def _apply_job_id_partition(self) -> None:
+        """Pin the job-id allocator to this shard's congruence class:
+        shard k of N allocates ids with (id - 1) % N == k, so shards
+        never collide and a job id alone routes a client. Applied after
+        the journal restore — the restored watermark is carried into the
+        strided counter."""
+        if self.shard_count <= 1:
+            return
+        counter = self.jobs.job_id_counter
+        from hyperqueue_tpu.ids import IdCounter
+
+        strided = IdCounter(
+            start=self.shard_id + 1, stride=self.shard_count
+        )
+        strided.ensure_above(counter.peek() - 1)
+        self.jobs.job_id_counter = strided
+
+    async def _lease_renew_loop(self) -> None:
+        """Renew this shard's lease on ~timeout/3; a renewal that finds a
+        successor's claim means this instance was presumed dead and has
+        been FENCED — stop immediately rather than keep a second
+        scheduler + journal appender alive."""
+        interval = max(self.lease.timeout / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                ok = self.lease.renew()
+            except OSError as e:
+                # a transient FS error must not fence a healthy shard;
+                # the NEXT renewal either succeeds or the staleness clock
+                # runs out honestly
+                logger.warning("lease renew failed (%s); retrying", e)
+                continue
+            if not ok:
+                claim = self.lease.read() or {}
+                logger.critical(
+                    "shard %d lease claimed by successor %r (epoch %s); "
+                    "this instance is fenced — stopping",
+                    self.shard_id, claim.get("owner"), claim.get("epoch"),
+                )
+                self.fenced = True
+                self.stop()
+                return
+
+    def _federation_block(self) -> dict | None:
+        """The federation section of `hq server info`/`stats` (None on a
+        standalone server)."""
+        if self.federation_root is None:
+            return None
+        lease = (self.lease.read() if self.lease else None) or {}
+        borrowed = sum(
+            1
+            for w in self.core.workers.values()
+            if getattr(w.configuration, "lent_from", -1) >= 0
+        )
+        age = self.lease.age_seconds() if self.lease else None
+        return {
+            "shard_id": self.shard_id,
+            "shard_count": self.shard_count,
+            "partition": (
+                f"(job_id - 1) % {self.shard_count} == {self.shard_id}"
+            ),
+            "lease_owner": lease.get("owner"),
+            "lease_epoch": lease.get("epoch"),
+            "lease_age_seconds": (
+                round(age, 3) if age is not None else None
+            ),
+            "promoted": self.promoted,
+            "fenced": self.fenced,
+            "workers_lent": self.workers_lent_total,
+            "workers_borrowed": borrowed,
+        }
+
+    async def _client_worker_lend(self, msg: dict) -> dict:
+        """Lend an IDLE worker to another shard: order it to re-register
+        there (federation coordinator RPC). No task state moves — that is
+        the whole point: elasticity without migration."""
+        wid = int(msg["worker_id"])
+        target = int(msg["to_shard"])
+        if self.federation_root is None:
+            return {"op": "error", "message": "not a federated server"}
+        if not (0 <= target < self.shard_count) or target == self.shard_id:
+            return {"op": "error", "message": f"bad target shard {target}"}
+        worker = self.core.workers.get(wid)
+        if worker is None:
+            return {"op": "error", "message": f"worker {wid} not found"}
+        if worker.assigned_tasks or worker.prefilled_tasks:
+            # never lend a busy worker: its running tasks belong to THIS
+            # shard's journal and must finish (or reattach) here
+            return {"op": "worker_lend", "lent": False, "reason": "busy"}
+        if worker.configuration.on_server_lost != "reconnect":
+            # a lent worker must survive the borrower dying (reattach to
+            # its successor) — any other policy would make the lend a
+            # one-way trip to a worker exit on the first hiccup
+            return {"op": "worker_lend", "lent": False, "reason": "policy"}
+        self._lent_workers[wid] = target
+        self.workers_lent_total += 1
+        self.comm.send_redirect(wid, target, self.shard_id)
+        logger.info(
+            "lending idle worker %d to shard %d", wid, target,
+            extra={"worker": wid},
+        )
+        return {"op": "worker_lend", "lent": True, "to_shard": target}
 
     # --- metrics --------------------------------------------------------
     def _collect_metrics(self) -> None:
@@ -892,6 +1128,23 @@ class Server:
                 "hq_ingest_clients",
                 "client connections held by the connection plane",
             ).set(len(self.ingest_plane.clients))
+        if self.federation_root is not None:
+            fed = self._federation_block() or {}
+            REGISTRY.gauge(
+                "hq_federation_lease_age_seconds",
+                "seconds since this shard's lease was last renewed "
+                "(staleness past the timeout makes the shard claimable)",
+            ).set(fed.get("lease_age_seconds") or 0.0)
+            REGISTRY.counter(
+                "hq_federation_workers_lent_total",
+                "idle workers this shard ordered to re-register with "
+                "another shard (federation coordinator lending)",
+            ).set_total(self.workers_lent_total)
+            REGISTRY.gauge(
+                "hq_federation_workers_borrowed",
+                "currently-registered workers lent to this shard by a "
+                "sibling (register carried lent_from)",
+            ).set(fed.get("workers_borrowed") or 0)
         trace_stats = core.traces.stats()
         REGISTRY.gauge(
             "hq_task_traces", "tasks with spans in the bounded trace store"
@@ -1698,10 +1951,19 @@ class Server:
                 if worker is not None:
                     # a requested stop disconnects too — record the true
                     # reason, not a generic connection loss (reference
-                    # LostWorkerReason::Stopped vs ConnectionLost)
-                    reason = (
-                        "stopped" if worker.clean_stop else "connection lost"
-                    )
+                    # LostWorkerReason::Stopped vs ConnectionLost); a
+                    # redirect-ordered departure is a lend, not a loss
+                    lent_to = self._lent_workers.pop(worker_id, None)
+                    if worker.clean_stop:
+                        reason = "stopped"
+                    elif lent_to is not None and not worker.assigned_tasks:
+                        # only an IDLE departure is the lend completing; a
+                        # worker that picked up work in the lend window
+                        # aborts the redirect, so a busy disconnect here
+                        # is a genuine loss (its tasks requeue/reattach)
+                        reason = f"lent to shard {lent_to}"
+                    else:
+                        reason = "connection lost"
                     self._record_past_worker(worker_id, reason)
                     reactor.on_remove_worker(
                         self.core, self.comm, self.events, worker_id, reason
@@ -2015,6 +2277,7 @@ class Server:
             "n_jobs": len(self.jobs.jobs),
             "scheduler": self.scheduler_kind,
             "metrics_port": self.metrics_port,
+            "federation": self._federation_block(),
         }
 
     async def _client_server_stats(self, msg: dict) -> dict:
@@ -2060,6 +2323,8 @@ class Server:
             "subscribers": len(self._subscribers),
             # ISSUE 10: connection-plane + lazy-materialization health
             "ingest": self._ingest_stats(),
+            # ISSUE 11: shard identity, lease health, lending counters
+            "federation": self._federation_block(),
         }
 
     def _ingest_stats(self) -> dict:

@@ -1,0 +1,443 @@
+"""Federated control plane: shard failover + cross-shard worker lending.
+
+ISSUE 11 / ROADMAP item 3. A federation is N server shards, each owning a
+static partition of the job-id space with its own journal, snapshot
+lineage, solve loop, and ports (utils/serverdir.py federation layout).
+This module adds the two cross-shard actors:
+
+``FailoverWatcher`` — runs inside a warm standby (``hq server start
+--standby``) or an idle peer shard (``--failover-watch``). It polls every
+shard's lease; a stale lease means the owning process died (kill -9
+included). The watcher claims the shard through the atomic lease protocol
+(utils/lease.py — exactly one of many racing watchers wins), then boots a
+full Server over the dead shard's dir: the existing two-phase restore
+(events/restore.py) replays its journal+snapshot, n_boots/server-uid
+lineage bumps fence the dead incarnation, and publishing a fresh instance
+dir + access record triggers the whole reconnect choreography PRs 2/6/9
+built — workers ``--on-server-lost reconnect`` and REATTACH their running
+tasks, client SubmitStreams replay unacked chunks exactly-once, and
+subscribers resume.
+
+``FederationCoordinator`` — the thin elasticity loop: one subscribe feed
+per shard (PR 8's sample stream: backlog depth, insufficient-capacity
+pending reasons, per-worker idleness) drives ``plan_lending``, a pure
+function mapping shard samples to (lender, worker, borrower) moves; each
+move is a ``worker_lend`` RPC ordering an idle worker to re-register with
+the starved shard. No task state migrates — capacity moves, tasks stay
+with their journal (Gavel, arxiv 2008.09213; JASDA's scheduler-driven
+atomization, arxiv 2510.14599, motivates chunks as the cross-shard unit).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from pathlib import Path
+
+from hyperqueue_tpu.utils import serverdir
+from hyperqueue_tpu.utils.lease import (
+    LeaseHeldError,
+    LeaseRaceLost,
+    ShardLease,
+)
+from hyperqueue_tpu.utils.metrics import REGISTRY
+
+logger = logging.getLogger("hq.federation")
+
+_FAILOVERS = REGISTRY.counter(
+    "hq_federation_failovers_total",
+    "dead shards claimed and promoted by this process's failover watcher",
+)
+
+JOURNAL_NAME = "journal.bin"
+
+
+def shard_journal_path(root: Path, shard_id: int) -> Path:
+    """Federated shards journal at a FIXED path inside their shard dir so
+    a successor knows where to restore from without out-of-band config."""
+    return serverdir.shard_path(root, shard_id) / JOURNAL_NAME
+
+
+# --------------------------------------------------------------- lending
+#: a sample older than this many seconds is dead data — never lend on it
+SAMPLE_FRESH_SECS = 10.0
+#: per-borrower cooldown: one lend, then wait for the next samples to
+#: reflect it before lending again (prevents thrash on a slow feed)
+LEND_COOLDOWN_SECS = 3.0
+
+# pending reasons that mean "more workers would help" (scheduler/
+# decision.py REASON_*); anything else (paused, dependencies, matching)
+# is not solved by capacity
+_CAPACITY_REASONS = ("insufficient-capacity", "worker-lifetime")
+
+
+def _idle_workers(sample: dict) -> list[int]:
+    return [
+        w["id"]
+        for w in sample.get("workers") or ()
+        if not w.get("running") and not w.get("prefilled")
+    ]
+
+
+def _backlog(sample: dict) -> int:
+    return int(sample.get("ready") or 0) + int(sample.get("mn_queued") or 0)
+
+
+def _wants_capacity(sample: dict) -> bool:
+    if _backlog(sample) <= 0:
+        return False
+    if not sample.get("n_workers"):
+        return True  # backlog and literally nobody to run it
+    if _idle_workers(sample):
+        return False  # transient: it has idle capacity of its own
+    reasons = sample.get("pending_reasons") or {}
+    return any(reasons.get(r) for r in _CAPACITY_REASONS)
+
+
+def plan_lending(samples: dict[int, dict | None],
+                 exclude=frozenset()) -> list[dict]:
+    """Map the latest per-shard samples to worker moves.
+
+    Pure and deterministic (unit-testable): neediest borrowers first
+    (deepest backlog), one worker per borrower per round, drawn from the
+    lender with the most idle workers and no backlog of its own. Shards
+    without a fresh sample neither lend nor borrow. `exclude` holds
+    (shard, worker_id) pairs the lender refused recently (wrong
+    --on-server-lost policy, raced busy) — without it the planner would
+    re-pick the same doomed worker every round and starve the borrower
+    even though a lendable sibling idles right next to it.
+    """
+    now = time.time()
+    fresh = {
+        k: s
+        for k, s in samples.items()
+        if s is not None and now - float(s.get("time") or 0.0) <= (
+            SAMPLE_FRESH_SECS
+        )
+    }
+    borrowers = sorted(
+        (k for k, s in fresh.items() if _wants_capacity(s)),
+        key=lambda k: -_backlog(fresh[k]),
+    )
+    idle_pool = {}
+    for k, s in fresh.items():
+        if _backlog(s) != 0:
+            continue
+        idle = [w for w in _idle_workers(s) if (k, w) not in exclude]
+        if idle:
+            idle_pool[k] = idle
+    moves: list[dict] = []
+    for borrower in borrowers:
+        lenders = sorted(
+            (k for k in idle_pool if k != borrower and idle_pool[k]),
+            key=lambda k: -len(idle_pool[k]),
+        )
+        if not lenders:
+            break
+        lender = lenders[0]
+        moves.append({
+            "from": lender,
+            "worker_id": idle_pool[lender].pop(),
+            "to": borrower,
+        })
+    return moves
+
+
+class FederationCoordinator:
+    """Thread-based lending loop: one subscribe feed per shard feeding
+    ``plan_lending``; each move becomes a ``worker_lend`` RPC against the
+    lender. Shard death is routine here — a dead feed clears its sample
+    and keeps retrying until the shard's successor comes up."""
+
+    def __init__(self, root: Path, sample_interval: float = 1.0,
+                 cooldown: float = LEND_COOLDOWN_SECS):
+        self.root = Path(root)
+        self.sample_interval = sample_interval
+        self.cooldown = cooldown
+        self.samples: dict[int, dict | None] = {}
+        self.moves_issued = 0
+        self._last_lend: dict[int, float] = {}
+        # (shard, worker_id) the lender refused, with expiry stamps: a
+        # 'policy' worker stays unlendable, but worker ids churn and a
+        # 'busy' race clears, so entries age out instead of pinning
+        self._refused: dict[tuple[int, int], float] = {}
+        self.refusal_ttl = 60.0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # --- feeds ----------------------------------------------------------
+    def _feed(self, shard_id: int) -> None:
+        from hyperqueue_tpu.client import connection
+
+        shard_dir = serverdir.shard_path(self.root, shard_id)
+        while not self._stop.is_set():
+            try:
+                for frame in connection.subscribe(
+                    shard_dir, filters=("__samples_only__",),
+                    sample_interval=self.sample_interval,
+                ):
+                    if self._stop.is_set():
+                        return
+                    if frame.get("op") == "sample":
+                        self.samples[shard_id] = frame
+            except Exception as e:  # noqa: BLE001 - shard down is routine
+                logger.debug("shard %d feed down (%s)", shard_id, e)
+            # the feed ended (shard died or dropped us): its last sample
+            # is no longer trustworthy
+            self.samples[shard_id] = None
+            self._stop.wait(min(self.sample_interval, 1.0))
+
+    def _control(self) -> None:
+        while not self._stop.wait(self.sample_interval):
+            try:
+                now = time.monotonic()
+                self._refused = {
+                    key: t for key, t in self._refused.items()
+                    if now - t < self.refusal_ttl
+                }
+                moves = plan_lending(
+                    dict(self.samples), exclude=set(self._refused)
+                )
+                for move in moves:
+                    if now - self._last_lend.get(move["to"], 0.0) < (
+                        self.cooldown
+                    ):
+                        continue
+                    if self._issue(move):
+                        self._last_lend[move["to"]] = now
+                        self.moves_issued += 1
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("lending pass failed")
+
+    def _issue(self, move: dict) -> bool:
+        from hyperqueue_tpu.client.connection import ClientSession
+
+        lender_dir = serverdir.shard_path(self.root, move["from"])
+        try:
+            with ClientSession(lender_dir, retry_window=2.0) as session:
+                resp = session.request({
+                    "op": "worker_lend",
+                    "worker_id": move["worker_id"],
+                    "to_shard": move["to"],
+                })
+            lent = bool(resp.get("lent"))
+            if lent:
+                logger.info(
+                    "lent worker %d: shard %d -> shard %d",
+                    move["worker_id"], move["from"], move["to"],
+                )
+            else:
+                # a refused worker (policy/busy) must not be re-picked
+                # every pass while lendable siblings idle beside it
+                self._refused[(move["from"], move["worker_id"])] = (
+                    time.monotonic()
+                )
+                logger.info(
+                    "shard %d refused to lend worker %d (%s)",
+                    move["from"], move["worker_id"],
+                    resp.get("reason", "?"),
+                )
+            return lent
+        except Exception as e:  # noqa: BLE001 - lender may just have died
+            logger.debug("worker_lend to shard %d failed: %s",
+                         move["from"], e)
+            return False
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        fed = serverdir.load_federation(self.root)
+        if fed is None:
+            raise ValueError(f"no federation at {self.root}")
+        for k in range(fed["shard_count"]):
+            t = threading.Thread(
+                target=self._feed, args=(k,), daemon=True,
+                name=f"hq-fed-feed-{k}",
+            )
+            t.start()
+            self._threads.append(t)
+        ctl = threading.Thread(
+            target=self._control, daemon=True, name="hq-fed-coordinator"
+        )
+        ctl.start()
+        self._threads.append(ctl)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# -------------------------------------------------------------- failover
+class FailoverWatcher:
+    """Scan shard leases; claim and promote stale ones.
+
+    ``server_kwargs`` seeds each promoted Server (scheduler kind, fsync
+    policy, ...); server_dir/shard identity/journal/lease settings are
+    filled in per shard. ``own_shard`` (peer-shard mode) is never
+    scanned, and ``eligible`` — when given — gates claiming (an idle-peer
+    policy hook: a shard drowning in its own backlog should leave the
+    claim to the standby).
+    """
+
+    def __init__(
+        self,
+        root: Path,
+        server_kwargs: dict | None = None,
+        lease_timeout: float = 15.0,
+        poll: float | None = None,
+        own_shard: int = -1,
+        eligible=None,
+    ):
+        self.root = Path(root)
+        self.server_kwargs = dict(server_kwargs or {})
+        self.lease_timeout = float(lease_timeout)
+        self.poll = poll if poll is not None else max(lease_timeout / 3, 0.1)
+        self.own_shard = own_shard
+        self.eligible = eligible
+        self.promoted: dict[int, object] = {}
+        self._promoted_tasks: dict[int, asyncio.Task] = {}
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll)
+            try:
+                await self.scan_once()
+            except Exception:  # noqa: BLE001 - watcher must outlive scans
+                logger.exception("failover scan failed")
+
+    async def scan_once(self) -> None:
+        fed = serverdir.load_federation(self.root)
+        if fed is None:
+            return
+        # a promoted server that has since stopped (operator `server
+        # stop`, a fence, a crash of its own) no longer covers its shard:
+        # prune it so a LATER death of that shard is claimable again
+        for shard_id, task in list(self._promoted_tasks.items()):
+            if task.done():
+                self.promoted.pop(shard_id, None)
+                del self._promoted_tasks[shard_id]
+        for shard_id in range(fed["shard_count"]):
+            if shard_id == self.own_shard or shard_id in self.promoted:
+                continue
+            shard_dir = serverdir.shard_path(self.root, shard_id)
+            lease = ShardLease(shard_dir, self.lease_timeout)
+            if lease.state() != "stale":
+                # "absent" = never started or cleanly stopped: an operator
+                # decision, not a death — nothing to fail over
+                continue
+            if self.eligible is not None and not self.eligible():
+                logger.info(
+                    "shard %d lease is stale but this peer is busy; "
+                    "leaving the claim to another successor", shard_id,
+                )
+                continue
+            await self.promote(shard_id, fed["shard_count"])
+
+    async def promote(self, shard_id: int, shard_count: int) -> None:
+        """Claim + boot a Server over the dead shard's dir. The Server's
+        own start() performs the atomic lease acquisition (so a lost race
+        aborts before any journal access) and the two-phase restore."""
+        from hyperqueue_tpu.server.bootstrap import Server
+
+        shard_dir = serverdir.shard_path(self.root, shard_id)
+        kwargs = dict(self.server_kwargs)
+        kwargs.update(
+            server_dir=shard_dir,
+            shard_id=shard_id,
+            shard_count=shard_count,
+            federation_root=self.root,
+            lease_timeout=self.lease_timeout,
+            journal_path=shard_journal_path(self.root, shard_id),
+            promoted=True,
+        )
+        server = Server(**kwargs)
+        t0 = time.perf_counter()
+        try:
+            await server.start()
+        except (LeaseHeldError, LeaseRaceLost) as e:
+            logger.info(
+                "shard %d claim lost to a racing successor (%s); backing "
+                "off", shard_id, e,
+            )
+            return
+        except Exception:
+            # claimed but could not finish promotion: tear down whatever
+            # start() already brought up (the lease RENEW loop included —
+            # a leaked renewer would keep the claim alive forever) and
+            # release, so the next scan can try again instead of waiting
+            # a full staleness window
+            logger.exception("shard %d promotion failed", shard_id)
+            try:
+                await server.shutdown()
+            except Exception:  # noqa: BLE001 - release is what matters
+                logger.exception("shard %d promotion cleanup failed",
+                                 shard_id)
+                if server.lease is not None:
+                    server.lease.release()
+            return
+        _FAILOVERS.inc()
+        self.promoted[shard_id] = server
+        self._promoted_tasks[shard_id] = asyncio.create_task(
+            server.run_until_stopped()
+        )
+        logger.warning(
+            "promoted to shard %d/%d in %.2fs (restore: %s)",
+            shard_id, shard_count, time.perf_counter() - t0,
+            server.last_restore,
+        )
+
+    async def shutdown(self) -> None:
+        for server in self.promoted.values():
+            server.stop()
+        for task in self._promoted_tasks.values():
+            try:
+                await asyncio.wait_for(task, timeout=5.0)
+            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                task.cancel()
+
+
+async def standby_main(
+    root: Path,
+    server_kwargs: dict | None = None,
+    lease_timeout: float = 15.0,
+    poll: float | None = None,
+    coordinate: bool = True,
+    sample_interval: float = 1.0,
+) -> None:
+    """`hq server start --standby`: a warm successor process.
+
+    Waits for the federation descriptor, then watches every shard's
+    lease and promotes into dead shards; optionally also runs the
+    lending coordinator (the federation needs exactly one — run it on
+    the standby, the one process with no shard of its own to favor).
+    The process stays warm: the server modules, solver stack, and jax
+    are already imported, so a promotion pays restore + bind time only.
+    """
+    while serverdir.load_federation(root) is None:
+        await asyncio.sleep(0.25)
+    # warm the heavy imports up front, not at promotion time
+    from hyperqueue_tpu.server import bootstrap  # noqa: F401
+
+    fed = serverdir.load_federation(root)
+    coordinator = None
+    if coordinate:
+        coordinator = FederationCoordinator(
+            root, sample_interval=sample_interval
+        )
+        coordinator.start()
+    watcher = FailoverWatcher(
+        root,
+        server_kwargs=server_kwargs,
+        lease_timeout=lease_timeout,
+        poll=poll,
+    )
+    logger.warning(
+        "standby ready: watching %d shard(s) at %s (lease timeout %.1fs)",
+        fed["shard_count"], root, lease_timeout,
+    )
+    try:
+        await watcher.run()
+    finally:
+        if coordinator is not None:
+            coordinator.stop()
+        await watcher.shutdown()

@@ -46,6 +46,10 @@ class WorkerConfiguration:
     # bounded coalescing delay of the uplink send drainer: completions
     # within the window share one frame (0 = send-as-ready)
     uplink_flush_secs: float = 0.002
+    # federation: home shard this worker was lent FROM after a coordinator
+    # redirect (-1 = not a borrowed worker); lets the borrowing shard
+    # count its borrowed pool in `hq server stats`
+    lent_from: int = -1
 
     def to_wire(self) -> dict:
         return {
@@ -65,6 +69,7 @@ class WorkerConfiguration:
             "alloc_id": self.alloc_id,
             "runner_pool": self.runner_pool,
             "uplink_flush_secs": self.uplink_flush_secs,
+            "lent_from": self.lent_from,
         }
 
     @classmethod
@@ -86,6 +91,7 @@ class WorkerConfiguration:
             alloc_id=data.get("alloc_id", ""),
             runner_pool=data.get("runner_pool", -1),
             uplink_flush_secs=data.get("uplink_flush_secs", 0.002),
+            lent_from=data.get("lent_from", -1),
         )
 
 

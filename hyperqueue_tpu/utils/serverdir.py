@@ -5,6 +5,14 @@ directory (default ~/.hq-tpu-server/NNN) holding access.json with host/ports
 and the two pre-shared secret keys (client plane, worker plane), plus an
 `hq-current` symlink to the newest instance. `generate-access` style
 pre-shared deployment works by copying this file.
+
+Federation (ISSUE 11): a federated deployment nests one classic server dir
+per shard under the root (``<root>/shard-0000``, ``shard-0001``, ...), each
+with its own instance dirs, journal, and lease file, plus a root-level
+``federation.json`` naming the shard count. Job ids partition statically:
+shard k of N owns every job id with ``(job_id - 1) % N == k``, so a job id
+alone routes a client to its shard and shards allocate without
+coordination.
 """
 
 from __future__ import annotations
@@ -12,11 +20,18 @@ from __future__ import annotations
 import json
 import os
 import secrets
+import time
 from dataclasses import dataclass
 from pathlib import Path
 
 ACCESS_FILE = "access.json"
 CURRENT_LINK = "hq-current"
+FEDERATION_FILE = "federation.json"
+# failover rewrites the access record while workers/clients re-read it
+# under --on-server-lost reconnect; a reader that catches the rename
+# window (or a torn legacy writer) retries briefly instead of failing
+LOAD_ACCESS_RETRY_SECS = 0.5
+_LOAD_ACCESS_POLL = 0.02
 
 
 @dataclass
@@ -126,7 +141,9 @@ def store_access(instance_dir: Path, record: AccessRecord) -> None:
     # atomic: the hq-current symlink already points at this instance dir
     # (create_instance_dir flips it first), so reconnecting workers and
     # retrying clients poll this path — they must see nothing or the whole
-    # record, never a torn write
+    # record, never a torn write. The rename must also survive a crash of
+    # the PUBLISHER (a promoted successor dying right after failover must
+    # not leave the old, dead address on disk): fsync the dir too.
     path = instance_dir / ACCESS_FILE
     tmp = instance_dir / f".{ACCESS_FILE}.tmp"
     with open(tmp, "w") as f:
@@ -135,19 +152,130 @@ def store_access(instance_dir: Path, record: AccessRecord) -> None:
         os.fsync(f.fileno())
     os.chmod(tmp, 0o600)
     tmp.replace(path)
+    from hyperqueue_tpu.events.journal import fsync_dir
+
+    fsync_dir(instance_dir)
 
 
-def load_access(server_dir: Path) -> AccessRecord:
-    """Load the current instance's access record."""
-    direct = server_dir / ACCESS_FILE
-    if direct.exists():
-        with open(direct) as f:
-            return AccessRecord.from_json(json.load(f))
-    current = server_dir / CURRENT_LINK
-    path = current / ACCESS_FILE
-    if not path.exists():
-        raise FileNotFoundError(
-            f"no running server found in {server_dir} (missing {ACCESS_FILE})"
-        )
+def _read_access_file(path: Path) -> AccessRecord:
     with open(path) as f:
         return AccessRecord.from_json(json.load(f))
+
+
+def load_access(
+    server_dir: Path, retry_secs: float | None = None
+) -> AccessRecord:
+    """Load the current instance's access record.
+
+    Tolerates a record mid-rewrite: shard failover publishes a NEW
+    instance dir + access record while reconnecting workers and retrying
+    clients re-read this path, and a non-atomic writer (an out-of-tree
+    tool editing access.json in place) can expose a torn prefix. A parse
+    error or a file vanishing between the symlink hop and the open is
+    retried for a short window before it propagates.
+    """
+    window = LOAD_ACCESS_RETRY_SECS if retry_secs is None else retry_secs
+    deadline = time.monotonic() + window
+    while True:
+        direct = server_dir / ACCESS_FILE
+        try:
+            if direct.exists():
+                return _read_access_file(direct)
+            current = server_dir / CURRENT_LINK
+            path = current / ACCESS_FILE
+            if not path.exists():
+                raise FileNotFoundError(
+                    f"no running server found in {server_dir} "
+                    f"(missing {ACCESS_FILE})"
+                )
+            return _read_access_file(path)
+        except FileNotFoundError:
+            # the instance dir exists but its record does not (yet): only
+            # a publish-in-progress window is worth riding out — with no
+            # hq-current symlink at all, fail fast with the clear message
+            if not (server_dir / CURRENT_LINK).is_symlink():
+                raise
+            if time.monotonic() >= deadline:
+                raise
+        except (ValueError, KeyError, TypeError):
+            # torn/mid-rewrite record (json decode errors are ValueError);
+            # retry briefly, then let the real error out
+            if time.monotonic() >= deadline:
+                raise
+        time.sleep(_LOAD_ACCESS_POLL)
+
+
+# ------------------------------------------------------------- federation
+def shard_dir_name(shard_id: int) -> str:
+    return f"shard-{shard_id:04d}"
+
+
+def shard_path(root: Path, shard_id: int) -> Path:
+    return Path(root) / shard_dir_name(shard_id)
+
+
+def shard_id_of(server_dir: Path) -> int | None:
+    """Shard id encoded in a shard server-dir name, or None."""
+    name = Path(server_dir).name
+    if name.startswith("shard-") and name[6:].isdigit():
+        return int(name[6:])
+    return None
+
+
+def shard_for_job(job_id: int, shard_count: int) -> int:
+    """The shard owning a job id (static partition; ids are 1-based)."""
+    return (int(job_id) - 1) % max(int(shard_count), 1)
+
+
+def write_federation(root: Path, shard_count: int) -> dict:
+    """Publish (or validate) the root-level federation descriptor and
+    create the shard dirs. Idempotent; a conflicting shard count is a
+    hard error — the partition is static for the journal lineages'
+    lifetime (re-sharding would re-home job ids between journals). The
+    check-then-write runs under a flock so N concurrently-booting shards
+    with DISAGREEING --shards values cannot both pass validation."""
+    import fcntl
+
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    lock_fd = os.open(root / ".federation.lock", os.O_CREAT | os.O_RDWR,
+                      0o600)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        existing = load_federation(root)
+        if existing is not None:
+            if existing["shard_count"] != shard_count:
+                raise ValueError(
+                    f"federation at {root} has {existing['shard_count']} "
+                    f"shard(s); refusing to restart it with {shard_count}"
+                )
+            return existing
+        record = {"version": 1, "shard_count": int(shard_count)}
+        tmp = root / f".{FEDERATION_FILE}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        tmp.replace(root / FEDERATION_FILE)
+        from hyperqueue_tpu.events.journal import fsync_dir
+
+        fsync_dir(root)
+        for k in range(shard_count):
+            shard_path(root, k).mkdir(exist_ok=True)
+        return record
+    finally:
+        os.close(lock_fd)
+
+
+def load_federation(root: Path) -> dict | None:
+    """The federation descriptor at `root`, or None for a classic
+    single-server dir."""
+    path = Path(root) / FEDERATION_FILE
+    if not path.exists():
+        return None
+    with open(path) as f:
+        data = json.load(f)
+    if int(data.get("shard_count", 0)) < 1:
+        raise ValueError(f"malformed federation descriptor {path}")
+    data["shard_count"] = int(data["shard_count"])
+    return data

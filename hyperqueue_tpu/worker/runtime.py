@@ -206,6 +206,11 @@ class WorkerRuntime:
         # the killed copy would pass the fence and fail the live one
         self._discarded: set[int] = set()
         self._stop = asyncio.Event()
+        # federation worker lending (ISSUE 11): a `redirect` message sets
+        # the sibling shard dir to re-register with and fires this event;
+        # the session winds down and run() registers fresh over there
+        self._redirect = asyncio.Event()
+        self._redirect_target: Path | None = None
         # warm runner pool (worker/runner_pool.py): None while disabled
         # (--runner-pool 0, zero-worker mode, or the restart budget blew);
         # plan cache: (job_id, id(body)) -> LaunchPlan, LRU-bounded. Plans
@@ -382,6 +387,54 @@ class WorkerRuntime:
                 outcome = await self._run_session()
                 if outcome == "stop":
                     return
+                if outcome == "redirect":
+                    if self.running or self.blocked:
+                        # a compute raced in between the redirect
+                        # handler's idle check and the session teardown:
+                        # abort the lend — the running task's uplinks
+                        # belong to the HOME shard's journal. Reconnect
+                        # home with reattach so the race-delivered task
+                        # keeps its single execution.
+                        logger.warning(
+                            "aborting lend: %d task(s) raced in; "
+                            "re-registering with the home shard",
+                            len(self.running) + self._n_blocked,
+                        )
+                        self._redirect_target = None
+                        self._redirect.clear()
+                        self.configuration.lent_from = -1
+                        if not await self._reconnect_with_backoff():
+                            return
+                        continue
+                    # lent to a sibling shard: drop the old identity and
+                    # register FRESH with the target shard dir (no
+                    # reattach — only idle workers are lent). From here
+                    # on, server loss/reconnect handling points at the
+                    # NEW shard: if the borrower dies mid-task later, the
+                    # worker reattaches to the borrower's successor.
+                    self.server_dir = self._redirect_target
+                    self._redirect_target = None
+                    self._redirect.clear()
+                    self.worker_id = 0
+                    self.server_uid = ""
+                    self._clear_launch_plans()
+                    if self._conn:
+                        self._conn.close()
+                    logger.warning(
+                        "lent to shard dir %s; re-registering",
+                        self.server_dir,
+                    )
+                    # the borrower may itself be mid-failover when the
+                    # redirect lands: register with the reconnect-style
+                    # backoff window, never a single brittle attempt
+                    # (the server only lends reconnect-policy workers,
+                    # so _initial_connect retries here)
+                    await self._initial_connect()
+                    logger.info(
+                        "registered as worker %d", self.worker_id,
+                        extra={"worker": self.worker_id},
+                    )
+                    continue
                 # server lost
                 policy = self.configuration.on_server_lost
                 if policy == "finish-running":
@@ -684,21 +737,25 @@ class WorkerRuntime:
         # while a dashboard listens (set_overview_override)
         tasks.append(asyncio.create_task(self._overview_loop()))
         stop_wait = asyncio.create_task(self._stop.wait())
+        redirect_wait = asyncio.create_task(self._redirect.wait())
+        waiters = (stop_wait, redirect_wait)
         try:
             done, _pending = await asyncio.wait(
-                tasks + [stop_wait], return_when=asyncio.FIRST_COMPLETED
+                tasks + list(waiters), return_when=asyncio.FIRST_COMPLETED
             )
             for t in done:
-                if t is not stop_wait and t.exception():
+                if t not in waiters and t.exception():
                     raise t.exception()
+            if self._redirect.is_set() and not self._stop.is_set():
+                return "redirect"
             return "stop"
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             logger.warning("server connection lost (%s)", e)
             return "lost"
         finally:
-            for t in tasks + [stop_wait]:
+            for t in tasks + list(waiters):
                 t.cancel()
-            await asyncio.gather(*tasks, stop_wait, return_exceptions=True)
+            await asyncio.gather(*tasks, *waiters, return_exceptions=True)
             if self._conn:
                 self._conn.close()
 
@@ -797,6 +854,46 @@ class WorkerRuntime:
                 float(interval) if interval is not None else None
             )
             self._overview_wake.set()
+        elif op == "redirect":
+            # federation lending: re-register with a sibling shard. The
+            # target is derived from OUR OWN server dir (shard dirs are
+            # siblings under the federation root) — the server never
+            # dictates filesystem paths across hosts.
+            from hyperqueue_tpu.utils import serverdir as _serverdir
+
+            target = int(msg.get("shard", -1))
+            if self.server_dir is None or (
+                _serverdir.shard_id_of(self.server_dir) is None
+            ):
+                logger.warning(
+                    "ignoring redirect to shard %d: this worker was not "
+                    "started against a federation shard dir", target,
+                )
+            elif self.configuration.on_server_lost != "reconnect":
+                # a lent worker must ride out the borrower dying later;
+                # the server checks this too — refuse defensively
+                logger.warning(
+                    "ignoring redirect to shard %d: --on-server-lost is "
+                    "not 'reconnect'", target,
+                )
+            elif self.running or self.blocked:
+                # the server only lends idle workers, but a task may have
+                # raced in; refuse rather than strand its uplinks
+                logger.warning(
+                    "ignoring redirect to shard %d: %d task(s) running",
+                    target, len(self.running),
+                )
+            else:
+                self._redirect_target = _serverdir.shard_path(
+                    self.server_dir.parent, target
+                )
+                # remember the home shard so the borrower can count its
+                # borrowed pool (register config carries it)
+                home = _serverdir.shard_id_of(self.server_dir)
+                self.configuration.lent_from = int(
+                    msg.get("from_shard", home if home is not None else -1)
+                )
+                self._redirect.set()
         elif op == "stop":
             self._stop.set()
             return True

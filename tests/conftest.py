@@ -55,6 +55,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "federation: sharded control plane tests (per-shard journals, "
+        "lease-fenced failover, cross-shard worker lending; ISSUE 11)",
+    )
+    config.addinivalue_line(
+        "markers",
         "multichip: sharded multi-device solver tests; run on the virtual "
         "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
         "count=8, set above) so tier-1 exercises the 8-device path on "

@@ -203,6 +203,65 @@ class HqEnv:
         )
         return process
 
+    # --- federation (ISSUE 11) -----------------------------------------
+    def shard_dir(self, shard_id: int) -> Path:
+        from hyperqueue_tpu.utils.serverdir import shard_path
+
+        return shard_path(self.server_dir, shard_id)
+
+    def start_shard(
+        self, shard_id: int, shard_count: int, *extra: str, env_extra=None
+    ) -> str:
+        """Start one federation shard process; returns the process name
+        (pass to kill_process). Waits for the shard's access record."""
+        shard_dir = self.shard_dir(shard_id)
+        before = {
+            p.name for p in shard_dir.iterdir() if p.name.isdigit()
+        } if shard_dir.exists() else set()
+        n = sum(
+            1 for name, _ in self.processes
+            if name.startswith(f"shard{shard_id}-")
+        )
+        name = f"shard{shard_id}-{n}"
+        process = self._spawn(
+            name,
+            ["server", "start", "--server-dir", str(self.server_dir),
+             "--shards", str(shard_count), "--shard-id", str(shard_id),
+             *extra],
+            env_extra=env_extra,
+        )
+
+        def ready():
+            if process.poll() is not None:
+                return True
+            if not shard_dir.exists():
+                return False
+            fresh = {
+                p.name for p in shard_dir.iterdir() if p.name.isdigit()
+            } - before
+            return any(
+                (shard_dir / d / "access.json").exists() for d in fresh
+            )
+
+        wait_until(ready, timeout=60.0, message=f"shard {shard_id} access")
+        assert process.poll() is None, self.read_log(name)
+        return name
+
+    def start_standby(self, *extra: str, env_extra=None) -> str:
+        """Start a warm standby (failover watcher + lending coordinator)
+        over this env's federation root; returns the process name."""
+        n = sum(
+            1 for name, _ in self.processes if name.startswith("standby")
+        )
+        name = "standby" if n == 0 else f"standby{n}"
+        self._spawn(
+            name,
+            ["server", "start", "--server-dir", str(self.server_dir),
+             "--standby", *extra],
+            env_extra=env_extra,
+        )
+        return name
+
     def start_worker(
         self, *extra: str, cpus: int | None = 4, env_extra=None
     ) -> subprocess.Popen:
