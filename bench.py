@@ -1465,18 +1465,16 @@ def run_submit_smoke(args) -> None:
     chunk = 16384
     failures = []
     results: dict = {}
-    # The GATE runs on plaintext transport (same policy as --trace-smoke):
-    # without a C crypto wheel the pure-python ChaCha fallback burns ~6 us
-    # of GIL-holding bytecode per wire byte in EACH direction, so an
-    # encrypted run on this box measures the missing wheel's GIL
-    # contention, not the connection plane. The encrypted ratio is
-    # recorded informationally below.
+    # The GATE runs ENCRYPTED (ISSUE 12): with the AEAD backend ladder
+    # (transport/aead.py — native/numpy instead of the ~6 us/wire-byte
+    # pure-python fallback) the sealed wire is the production
+    # configuration, so the production configuration is what gets gated.
+    # A plaintext burst run afterwards records the encrypted/plaintext
+    # ratio as its own db.jsonl row, gated at ~15%.
     with tempfile.TemporaryDirectory() as td:
         with HqEnv(Path(td)) as env:
             env.start_server(
                 "--journal", str(Path(td) / "journal.bin"),
-                "--disable-client-authentication",
-                "--disable-worker-authentication",
             )
             env.start_worker(cpus=2)
             env.wait_workers(1)
@@ -1664,21 +1662,68 @@ def run_submit_smoke(args) -> None:
             results["entries_tasks_per_s"] = round(
                 eacked / max(entries_s, 1e-9), 1
             )
-            # honesty note (cf. spawn_floor_ms): per-entry payloads are
-            # crypto-bound on boxes without a C crypto wheel — the
-            # pure-python ChaCha fallback costs ~6 us per wire byte in
-            # each direction, which dominates the entries variant
-            from hyperqueue_tpu.transport import auth as _auth
+            from hyperqueue_tpu.transport.aead import WIRE_BACKEND
 
-            results["transport"] = (
-                "pure-python-chacha"
-                if _auth.ChaCha20Poly1305.__module__.startswith(
-                    "hyperqueue_tpu"
-                )
-                else "c-chacha"
-            )
+            results["transport"] = f"encrypted-{WIRE_BACKEND}"
             stop.set()
             th.join(timeout=5)
+
+        # --- encrypted/plaintext ratio (ISSUE 12 satellite): the same
+        # unpaced burst preload against a plaintext server; the sealed
+        # wire must stay within ~15% of it on the native/numpy backends
+        # (the pure-python fallback is exempt from the gate — it exists
+        # for compatibility, and its ratio is recorded honestly) -------
+        with HqEnv(Path(td) / "plain") as env2:
+            env2.start_server(
+                "--journal", str(Path(td) / "plain-journal.bin"),
+                "--disable-client-authentication",
+                "--disable-worker-authentication",
+            )
+            env2.start_worker(cpus=2)
+            env2.wait_workers(1)
+            body2 = {"cmd": ["true"], "env": {},
+                     "submit_dir": str(env2.work_dir)}
+            with ClientSession(env2.server_dir) as s4:
+                stream = SubmitStream(
+                    s4, {"name": "plain-burst",
+                         "submit_dir": str(env2.work_dir)}
+                )
+                t0 = time.perf_counter()
+                for lo in range(0, n_tasks, chunk):
+                    stream.send_chunk(array={
+                        "id_range": [lo, min(lo + chunk, n_tasks)],
+                        "body": body2, "request": {},
+                        "priority": 0, "crash_limit": 5,
+                    })
+                _job, plain_acked = stream.finish()
+                plain_burst = plain_acked / max(
+                    time.perf_counter() - t0, 1e-9
+                )
+        enc_ratio = results["burst_tasks_per_s"] / max(plain_burst, 1e-9)
+        results["plaintext_burst_tasks_per_s"] = round(plain_burst, 1)
+        results["encrypted_over_plaintext"] = round(enc_ratio, 4)
+        ratio_failures = []
+        from hyperqueue_tpu.transport.aead import WIRE_BACKEND as _WB
+
+        if _WB != "python" and enc_ratio < 0.85:
+            msg = (
+                f"encrypted burst ingest is {enc_ratio:.2f}x plaintext "
+                f"on the {_WB} backend (< 0.85 = outside the ~15% budget)"
+            )
+            ratio_failures.append(msg)
+            failures.append(msg)
+        emit({
+            "experiment": "wire_encrypted_ratio",
+            "metric": "encrypted_over_plaintext_burst",
+            "ok": not ratio_failures,
+            "failures": ratio_failures,
+            "value": round(enc_ratio, 4),
+            "unit": "x",
+            "wire_backend": _WB,
+            "encrypted_burst_tasks_per_s": results["burst_tasks_per_s"],
+            "plaintext_burst_tasks_per_s": round(plain_burst, 1),
+            "n_tasks": n_tasks,
+        })
     emit({
         "experiment": "submit_smoke",
         "metric": "submit_smoke",
@@ -1756,12 +1801,11 @@ def run_trace_smoke() -> None:
     # windows inside one warm server and the MIN is compared (the standard
     # floor-measurement trick from the dask comparator).
     #
-    # The GATE runs on plaintext transport (auth disabled): with the
-    # pure-python ChaCha fallback this sandbox lacks a C crypto lib, so
-    # every wire byte costs ~6 us to seal + ~6 us to open, and the trace
-    # header's ~14 bytes/task would measure the box's crypto, not the
-    # tracing plane (frame-level trace-id dedup already amortizes the id).
-    # The encrypted ratio is recorded informationally.
+    # The GATE runs ENCRYPTED (ISSUE 12): the AEAD backend ladder
+    # (transport/aead.py) replaced the ~6 us/wire-byte pure-python seal
+    # that used to drown the trace header's ~14 bytes/task in crypto, so
+    # the sealed wire — the production configuration — is what gets
+    # gated. The plaintext ratio is recorded informationally.
     def timed_run(extra_server_args, plaintext: bool) -> float:
         auth = (
             ("--disable-worker-authentication",
@@ -1785,12 +1829,12 @@ def run_trace_smoke() -> None:
                 return best
 
     off_flag = ("--task-trace-capacity", "0")
-    on_s = min(timed_run((), True), timed_run((), True))
-    off_s = min(timed_run(off_flag, True), timed_run(off_flag, True))
-    on_enc_s = timed_run((), False)
-    off_enc_s = timed_run(off_flag, False)
+    on_s = min(timed_run((), False), timed_run((), False))
+    off_s = min(timed_run(off_flag, False), timed_run(off_flag, False))
+    on_plain_s = timed_run((), True)
+    off_plain_s = timed_run(off_flag, True)
     ratio = on_s / max(off_s, 1e-9)
-    enc_ratio = on_enc_s / max(off_enc_s, 1e-9)
+    plain_ratio = on_plain_s / max(off_plain_s, 1e-9)
     per_task_delta_ms = (on_s - off_s) / 500 * 1e3
     # the 5% gate, with an absolute floor so residual box noise cannot
     # fail a sub-0.1ms/task cost; the honest numbers are recorded anyway
@@ -1810,14 +1854,287 @@ def run_trace_smoke() -> None:
         "traces_off_s": round(off_s, 3),
         "overhead_ratio": round(ratio, 4),
         "overhead_ms_per_task": round(per_task_delta_ms, 4),
-        "encrypted_overhead_ratio": round(enc_ratio, 4),
-        "encrypted_note": (
-            "informational: includes this host's transport crypto "
-            "per-byte cost (pure-python ChaCha fallback when no C "
-            "crypto lib is present)"
+        "plaintext_overhead_ratio": round(plain_ratio, 4),
+        "wire_backend": __import__(
+            "hyperqueue_tpu.transport.aead", fromlist=["WIRE_BACKEND"]
+        ).WIRE_BACKEND,
+        "note": (
+            "gate runs encrypted (the production wire); the plaintext "
+            "ratio is informational"
         ),
         "total_s": round(time.perf_counter() - t0, 2),
     }))
+    sys.exit(1 if failures else 0)
+
+
+def run_wire_smoke() -> None:
+    """Wire-path micro-gate (ISSUE 12): µs/wire-byte to seal+open per
+    available AEAD backend (transport/aead.py), recorded every round so
+    the ~6 µs/wire-byte pure-python number stays tracked and a backend-
+    selection regression (the box silently falling off the ladder) is
+    caught at the source. Gate: the SELECTED backend seals 64 KiB frames
+    under 1 µs/byte unless it IS the pure-python fallback."""
+    import secrets
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+
+    from hyperqueue_tpu.transport import aead
+
+    sizes = (256, 4096, 65536)
+    reps = {256: 60, 4096: 30, 65536: 8}
+    backends: dict = {}
+    for name in aead.available_backends():
+        impl = aead.select_backend(name)[1]
+        per_size = {}
+        for size in sizes:
+            key = secrets.token_bytes(32)
+            nonce = secrets.token_bytes(12)
+            data = secrets.token_bytes(size)
+            obj = impl(key)
+            ct = obj.encrypt(nonce, data, None)
+            best_seal = best_open = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(reps[size]):
+                    obj.encrypt(nonce, data, None)
+                best_seal = min(
+                    best_seal, (time.perf_counter() - t0) / reps[size]
+                )
+                t0 = time.perf_counter()
+                for _ in range(reps[size]):
+                    obj.decrypt(nonce, ct, None)
+                best_open = min(
+                    best_open, (time.perf_counter() - t0) / reps[size]
+                )
+            per_size[size] = {
+                "seal_us_per_byte": round(best_seal / size * 1e6, 4),
+                "open_us_per_byte": round(best_open / size * 1e6, 4),
+            }
+        backends[name] = per_size
+    failures = []
+    selected = aead.WIRE_BACKEND
+    sel_64k = backends[selected][65536]["seal_us_per_byte"]
+    if selected != "python" and sel_64k > 1.0:
+        failures.append(
+            f"selected backend {selected} seals 64KiB frames at "
+            f"{sel_64k} us/byte (> 1.0) — the native wire path regressed"
+        )
+    emit({
+        "experiment": "wire_smoke",
+        "metric": "seal_us_per_byte_64k",
+        "ok": not failures,
+        "failures": failures,
+        "value": sel_64k,
+        "unit": "us/B",
+        "wire_backend": selected,
+        "backends": backends,
+    })
+    print("wire-smoke:", "OK" if not failures else failures)
+    sys.exit(1 if failures else 0)
+
+
+def run_saturation_smoke(args) -> None:
+    """Multi-core server gate (ISSUE 12): with the ingest, journal and
+    fan-out planes on their own threads and the wire encrypted, a
+    saturated server must sustain MORE THAN ONE CORE of process CPU —
+    the reactor is a pure scheduling loop, not the ceiling.
+
+    Load: zero-workers churning completions (uplink decode + completion
+    processing + journal commits + downlink fan-out), a subscriber
+    consuming the task-event firehose (per-peer encode+seal), and two
+    concurrent entries-heavy chunked ingest streams — every plane busy
+    at once. Server CPU is read from /proc/<pid>/stat (utime+stime
+    covers all threads), with the main-thread (reactor) vs off-loop
+    split recorded.
+
+    Box honesty: this bench box reports nproc=1 — NO process can exceed
+    1.0 cores here, so on such boxes the >1-core gate is unmeasurable
+    and the gate falls back to the property the refactor actually
+    created: a substantial OFF-REACTOR share of server CPU (the
+    pre-ISSUE-12 server ran ~95%+ of its cycles on the main thread).
+    On a multi-core box the >1-core gate applies as written."""
+    import os
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv
+
+    from hyperqueue_tpu.client.connection import (
+        ClientSession,
+        SubmitStream,
+        subscribe,
+    )
+    from hyperqueue_tpu.transport.aead import WIRE_BACKEND
+
+    hz = os.sysconf("SC_CLK_TCK")
+
+    def cpu_seconds(pid: int) -> float:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        return (int(parts[11]) + int(parts[12])) / hz
+
+    def thread_cpu(pid: int) -> dict:
+        """tid -> cpu seconds. tid == pid is the main (reactor) thread;
+        everything else is an off-loop plane (journal commit thread,
+        ingest loop, fan-out senders, executor workers)."""
+        out = {}
+        try:
+            for tid in os.listdir(f"/proc/{pid}/task"):
+                with open(f"/proc/{pid}/task/{tid}/stat") as f:
+                    raw = f.read()
+                parts = raw.rsplit(")", 1)[1].split()
+                out[tid] = (int(parts[11]) + int(parts[12])) / hz
+        except OSError:
+            pass
+        return out
+
+    n_tasks = 60_000
+    n_cpus = os.cpu_count() or 1
+    failures: list = []
+    results: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        with HqEnv(Path(td)) as env:
+            env.start_server(
+                "--journal", str(Path(td) / "journal.bin"),
+                "--fanout-senders", "4",
+            )
+            env.start_worker("--zero-worker", cpus=16)
+            env.wait_workers(1)
+            server_pid = env.processes[0][1].pid
+            body = {"cmd": ["true"], "env": {},
+                    "submit_dir": str(env.work_dir)}
+
+            events_seen = [0]
+            stop = threading.Event()
+
+            def consume() -> None:
+                try:
+                    for frame in subscribe(
+                        env.server_dir, filters=("task-", "job-")
+                    ):
+                        if frame.get("op") == "events":
+                            events_seen[0] += len(frame["records"])
+                        if stop.is_set():
+                            return
+                except Exception:  # noqa: BLE001 - teardown ends the feed
+                    pass
+
+            threading.Thread(target=consume, daemon=True).start()
+
+            ingested = [0]
+            # entries-heavy chunks: real per-task payloads, so every
+            # plane does real per-byte work (client seal -> ingest open/
+            # decode -> apply -> journal encode+write -> sealed ack);
+            # one shared entries list keeps the CLIENT side cheap
+            entries = [f"payload-{i:08d}-xxxxxxxxxxxxxxxx"
+                       for i in range(4096)]
+
+            def ingest_load(base: int) -> None:
+                try:
+                    with ClientSession(env.server_dir) as s:
+                        stream = SubmitStream(
+                            s, {"name": f"sat-ingest-{base}",
+                                "submit_dir": str(env.work_dir)}
+                        )
+                        lo = base
+                        while not stop.is_set():
+                            stream.send_chunk(array={
+                                "id_range": [lo, lo + 4096],
+                                "entries": entries,
+                                "body": body, "request": {},
+                                "priority": -1, "crash_limit": 5,
+                            })
+                            ingested[0] += 4096
+                            lo += 4096
+                        stream.finish()
+                except Exception:  # noqa: BLE001
+                    pass
+
+            # warm-up: pools, first ticks, jit
+            env.command(
+                ["submit", "--array", "0-499", "--wait", "--", "true"],
+                timeout=180,
+            )
+            loads = [
+                threading.Thread(target=ingest_load, args=(b,),
+                                 daemon=True)
+                for b in (10_000_000, 200_000_000)
+            ]
+            for th in loads:
+                th.start()
+            wall0 = time.perf_counter()
+            cpu0 = cpu_seconds(server_pid)
+            threads0 = thread_cpu(server_pid)
+            env.command(
+                ["submit", "--array", f"0-{n_tasks - 1}", "--wait",
+                 "--", "true"],
+                timeout=600,
+            )
+            wall = time.perf_counter() - wall0
+            cpu = cpu_seconds(server_pid) - cpu0
+            threads1 = thread_cpu(server_pid)
+            stop.set()
+            for th in loads:
+                th.join(timeout=10)
+            cores = cpu / max(wall, 1e-9)
+            main_cpu = (
+                threads1.get(str(server_pid), 0.0)
+                - threads0.get(str(server_pid), 0.0)
+            )
+            off_loop_cpu = max(cpu - main_cpu, 0.0)
+            off_share = off_loop_cpu / max(cpu, 1e-9)
+            results.update(
+                cores=round(cores, 3),
+                server_cpu_s=round(cpu, 2),
+                reactor_thread_cpu_s=round(main_cpu, 2),
+                off_reactor_cpu_s=round(off_loop_cpu, 2),
+                off_reactor_share=round(off_share, 3),
+                nproc=n_cpus,
+                wall_s=round(wall, 2),
+                tasks=n_tasks,
+                tasks_per_s=round(n_tasks / wall, 1),
+                subscriber_events=events_seen[0],
+                ingested_tasks=ingested[0],
+                wire_backend=WIRE_BACKEND,
+            )
+            if n_cpus > 1:
+                if cores <= 1.0:
+                    failures.append(
+                        f"server sustained {cores:.2f} cores (<= 1.0 "
+                        f"with {n_cpus} CPUs): the planes are not "
+                        "parallelizing"
+                    )
+            else:
+                # 1-CPU box: >1 core is unmeasurable for ANY process;
+                # gate the structural property instead and say so
+                results["note"] = (
+                    "nproc=1 box: the >1-core gate is unmeasurable "
+                    "here; gating the off-reactor CPU share instead "
+                    "(single-threaded baseline is ~0.05)"
+                )
+                if off_share < 0.25:
+                    failures.append(
+                        f"off-reactor share {off_share:.2f} < 0.25: the "
+                        "journal/fanout/ingest planes are not carrying "
+                        "their weight off the main thread"
+                    )
+    emit({
+        "experiment": "saturation_smoke",
+        "metric": "server_cores",
+        "ok": not failures,
+        "failures": failures,
+        "value": results.get("cores", 0.0),
+        "unit": "cores",
+        **results,
+    })
+    print("saturation-smoke:", "OK" if not failures else failures)
     sys.exit(1 if failures else 0)
 
 
@@ -1878,6 +2195,15 @@ def main() -> None:
                              "chunked-ingest tasks/s, tick p95 before vs "
                              "during ingest, and O(chunks) lazy "
                              "materialization at ingest")
+    parser.add_argument("--wire-smoke", action="store_true",
+                        help="wire-path micro-gate (ISSUE 12): µs/wire-"
+                             "byte seal+open per AEAD backend "
+                             "(native/numpy/python ladder)")
+    parser.add_argument("--saturation-smoke", action="store_true",
+                        help="multi-core server gate (ISSUE 12): "
+                             "journal+fanout+ingest planes on, encrypted "
+                             "wire, assert >1 core of sustained server "
+                             "process CPU under saturation")
     parser.add_argument("--federation-smoke", action="store_true",
                         help="federated failover gate: 2 shards + warm "
                              "standby, SIGKILL shard 1 mid-job, measure "
@@ -1918,6 +2244,14 @@ def main() -> None:
 
     if args.submit_smoke:
         run_submit_smoke(args)
+        return
+
+    if args.wire_smoke:
+        run_wire_smoke()
+        return
+
+    if args.saturation_smoke:
+        run_saturation_smoke(args)
         return
 
     if args.federation_smoke:

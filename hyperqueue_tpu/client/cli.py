@@ -193,6 +193,8 @@ def cmd_server_start(args) -> None:
             stall_dumps=args.stall_dumps,
             task_trace_capacity=args.task_trace_capacity,
             client_plane=args.client_plane,
+            journal_plane=args.journal_plane,
+            fanout_senders=args.fanout_senders,
             ingest_window=args.ingest_window,
             lazy_array_threshold=args.lazy_array_threshold,
             shard_id=shard_id,
@@ -254,6 +256,8 @@ def _run_standby(args, shards: int) -> None:
         reattach_timeout=args.reattach_timeout,
         idle_timeout=args.idle_timeout,
         client_plane=args.client_plane,
+        journal_plane=args.journal_plane,
+        fanout_senders=args.fanout_senders,
         lazy_array_threshold=args.lazy_array_threshold,
     )
     print(f"+-- HyperQueue TPU standby watching {root} --", flush=True)
@@ -399,6 +403,25 @@ def cmd_server_stats(args) -> None:
                 + ("snapshot" if lr.get("snapshot") else "full replay")
                 + f", {lr['tail_events']} tail events"
             )
+    jp = stats.get("journal_plane") or {}
+    if jp.get("mode") == "thread":
+        print(
+            f"journal plane: thread — {jp.get('commits', 0)} group "
+            f"commit(s), mean batch {jp.get('mean_batch', 0)} "
+            f"(max {jp.get('max_batch', 0)}), "
+            f"{jp.get('depth', 0)} pending"
+        )
+    elif jp.get("mode"):
+        print(f"journal plane: {jp['mode']} (inline group commit)")
+    fo = stats.get("fanout") or {}
+    if fo:
+        print(
+            f"fan-out plane: {fo.get('senders', 0)} sender(s), "
+            f"wire backend {fo.get('wire_backend')}, "
+            f"{fo.get('frames_total', 0)} frame(s) / "
+            f"{fo.get('bytes_total', 0)} bytes, "
+            f"{fo.get('send_stalls', 0)} send stall(s)"
+        )
     lag = stats.get("lag") or {}
     if lag:
         print(f"{'loop lag':<16}{'mean ms':>10}{'last ms':>10}{'max ms':>10}")
@@ -2272,6 +2295,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "dedicated connection-plane thread with a batched "
                         "handoff to the scheduler reactor; 'reactor' keeps "
                         "them on the reactor loop (escape hatch)")
+    p.add_argument("--journal-plane", choices=["thread", "reactor"],
+                   default="thread",
+                   help="where the journal group commit + fsync runs: "
+                        "'thread' (default) drains event batches onto a "
+                        "dedicated commit thread and releases acks/"
+                        "completions at the durability watermark; "
+                        "'reactor' keeps the inline group-commit block "
+                        "(escape hatch)")
+    p.add_argument("--fanout-senders", type=int, default=2, metavar="N",
+                   help="sender-pool threads running the downlink "
+                        "msgpack-encode + AEAD-seal (worker compute "
+                        "batches, client responses/streams, subscriber "
+                        "fan-out); 0 keeps encodes inline on the owning "
+                        "loop (escape hatch)")
     p.add_argument("--ingest-window", type=int, default=64, metavar="N",
                    help="per-client cap on handed-off, unanswered requests "
                         "before the connection plane pauses reading that "

@@ -31,12 +31,15 @@ from hyperqueue_tpu.server.ingest import (
     INGEST_TASKS,
     IngestPlane,
 )
+from hyperqueue_tpu.server.fanout import SendPool
 from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
+from hyperqueue_tpu.server.journal_plane import JournalPlane
 from hyperqueue_tpu.server.lazy import ArrayChunk
 from hyperqueue_tpu.server.protocol import rqv_from_wire, submit_record
 from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+from hyperqueue_tpu.transport.aead import WIRE_BACKEND
 from hyperqueue_tpu.utils import chaos
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.trace import TRACER
@@ -120,7 +123,9 @@ class CommSender:
     def _send(self, worker_id: int, message: dict) -> None:
         q = self._queues.get(worker_id)
         if q is not None:
-            q.put_nowait(message)
+            # the enqueue stamp feeds the fan-out plane's handoff-latency
+            # probe (reactor enqueue -> frame on the wire)
+            q.put_nowait((time.monotonic(), message))
 
     # reactor.Comm protocol
     def send_compute(self, worker_id: int, tasks: list[dict]) -> None:
@@ -462,6 +467,8 @@ class Server:
         stall_dumps: int = 8,
         task_trace_capacity: int = 16384,
         client_plane: str = "thread",
+        journal_plane: str = "thread",
+        fanout_senders: int = 2,
         ingest_window: int = 64,
         ingest_handoff_max: int = 8192,
         lazy_array_threshold: int = 4096,
@@ -574,6 +581,19 @@ class Server:
         if client_plane not in ("thread", "reactor"):
             raise ValueError(f"unknown client plane {client_plane!r}")
         self.client_plane = client_plane
+        # journal plane (server/journal_plane.py): "thread" (default)
+        # moves group commit + fsync onto a commit thread with
+        # watermark-gated visibility; "reactor" keeps the inline
+        # group-commit block (escape hatch, mirrors --client-plane)
+        if journal_plane not in ("thread", "reactor"):
+            raise ValueError(f"unknown journal plane {journal_plane!r}")
+        self.journal_plane = journal_plane
+        self.jplane: JournalPlane | None = None
+        # fan-out plane (server/fanout.py): N sender threads running the
+        # msgpack-encode + AEAD-seal half of every downlink send; 0 keeps
+        # encodes inline on the owning loop
+        self.fanout_senders = max(int(fanout_senders), 0)
+        self.sendpool = SendPool(self.fanout_senders)
         self.ingest_window = ingest_window
         self.ingest_handoff_max = ingest_handoff_max
         self.ingest_plane: IngestPlane | None = None
@@ -733,6 +753,16 @@ class Server:
                     None, restore_from_journal, self
                 )
             self.journal.open_for_append()
+            if self.journal_plane == "thread":
+                self.jplane = JournalPlane(
+                    self.journal,
+                    fsync_always=self.journal_fsync == "always",
+                    flush_each=not self.journal_flush_period,
+                    loop=asyncio.get_running_loop(),
+                    lag=self.lag,
+                    on_fatal=self.stop,
+                )
+                self.jplane.start()
         # after the restore (which may replace self.jobs): pin this
         # shard's job-id allocator to its congruence class
         self._apply_job_id_partition()
@@ -779,6 +809,7 @@ class Server:
                 ),
                 window=self.ingest_window,
                 handoff_max=self.ingest_handoff_max,
+                sendpool=self.sendpool,
             )
             self.client_port = self.ingest_plane.start(
                 "0.0.0.0", self.client_port,
@@ -914,7 +945,13 @@ class Server:
             REGISTRY.remove_collect_hook(self._metrics_hook)
         for conn in self._worker_conns.values():
             conn.close()
-        if self.journal is not None:
+        self.sendpool.stop()
+        # drain + join the commit thread, then close the appender; a
+        # plane that failed to drain keeps the appender open rather
+        # than closing the file under a still-writing thread
+        plane_drained = self.jplane.stop() if self.jplane is not None \
+            else True
+        if self.journal is not None and plane_drained:
             self.journal.close()
         if self.lease is not None:
             # clean stop: retire the lease so failover watchers never
@@ -959,6 +996,8 @@ class Server:
             reattach_timeout=self.reattach_timeout,
             idle_timeout=self.idle_timeout,
             client_plane=self.client_plane,
+            journal_plane=self.journal_plane,
+            fanout_senders=self.fanout_senders,
             lazy_array_threshold=(
                 self.lazy_array_threshold
                 if self.lazy_array_threshold < (1 << 62) else 0
@@ -1118,6 +1157,17 @@ class Server:
             "unmaterialized lazy array tasks (registered as chunks, "
             "per-task records deferred to dispatch)",
         ).set(lazy_stats["unmaterialized"])
+        if self.jplane is not None:
+            REGISTRY.gauge(
+                "hq_journal_plane_depth",
+                "journal records enqueued to the commit thread, not yet "
+                "committed (sustained growth = the disk is the bottleneck)",
+            ).set(self.jplane.depth())
+        REGISTRY.gauge(
+            "hq_fanout_plane_senders",
+            "sender-pool threads running the downlink encode+seal "
+            "(--fanout-senders; 0 = inline on the owning loop)",
+        ).set(self.fanout_senders)
         if self.ingest_plane is not None:
             REGISTRY.gauge(
                 "hq_ingest_handoff_depth",
@@ -1323,7 +1373,9 @@ class Server:
         always`) at exit. The block MUST NOT await — group commit is
         correct only while no external effect can run before the commit."""
         journal = self.journal
-        if journal is None or journal.in_batch:
+        if journal is None or journal.in_batch or self.jplane is not None:
+            # with the journal plane on, the commit thread owns batching
+            # (emit_event enqueues; visibility rides the watermark)
             return _NOOP_BATCH
         return _journal_batch(
             journal,
@@ -1350,7 +1402,13 @@ class Server:
         record = {"time": time.time(), "seq": self._event_seq,
                   "event": kind, **payload}
         self._event_seq += 1
-        if self.journal is not None:
+        if self.jplane is not None:
+            # journal plane (server/journal_plane.py): the append is an
+            # enqueue; the commit thread group-writes (+ flushes/fsyncs
+            # per policy) off the loop, and deliveries to listeners/
+            # subscribers are released only at the durability watermark
+            self.jplane.append(record)
+        elif self.journal is not None:
             self.journal.write(record)
             # default: flush to the OS on every event, so a crashed server
             # process restores everything (fsync-against-OS-crash happens on
@@ -1368,11 +1426,28 @@ class Server:
             # kill-at-event-K injection sits AFTER the journal write+flush:
             # a chaos test killing the server here proves exactly what the
             # configured flush/fsync policy persisted. A pending group
-            # commit gets a durability barrier first so the guarantee
-            # holds at the injection point too.
-            if self.journal is not None and self.journal.in_batch:
+            # commit (or the journal plane's in-flight batch) gets a
+            # durability barrier first so the guarantee holds at the
+            # injection point too.
+            if self.jplane is not None:
+                self.jplane.barrier(sync=self.journal_fsync == "always")
+            elif self.journal is not None and self.journal.in_batch:
                 self.journal.flush(sync=self.journal_fsync == "always")
             chaos.fire("server.event", event=kind)
+        if self.jplane is not None and (
+            self._event_listeners or self._subscribers
+        ):
+            self.jplane.when_durable(
+                lambda r=record, k=kind: self._deliver_event(k, r)
+            )
+        else:
+            self._deliver_event(kind, record)
+
+    def _deliver_event(self, kind: str, record: dict) -> None:
+        """Fan one journaled record out to event listeners and
+        subscribers. With the journal plane on this runs at the
+        durability watermark — a completion a subscriber sees is already
+        as durable as the fsync policy promises."""
         for q in self._event_listeners:
             q.put_nowait(record)
         for sub in self._subscribers:
@@ -1390,6 +1465,29 @@ class Server:
                 sub.dropped += 1
                 _SUBSCRIBERS_DROPPED.inc()
                 _SUB_EVENTS_DROPPED.inc()
+
+    # --- durability-before-visibility gating ---------------------------
+    def reply_visible(self, channel, frame: dict) -> None:
+        """Queue a client reply, released only once every event emitted
+        so far is committed (journal plane) — the watermark gate that
+        keeps an ack from outrunning the durability it implies. Without
+        the plane the synchronous group-commit block already provides
+        the ordering, so the reply goes straight out."""
+        if self.jplane is not None:
+            self.jplane.when_durable(lambda: channel.reply(frame))
+        else:
+            channel.reply(frame)
+
+    async def _visibility_barrier(self) -> None:
+        """Await the durability watermark (legacy in-loop client plane's
+        equivalent of reply_visible)."""
+        if self.jplane is None:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self.jplane.when_durable(
+            lambda: fut.done() or fut.set_result(None)
+        )
+        await fut
 
     def schedule_cancel(self, task_ids: list[int]) -> None:
         reactor.on_cancel_tasks(self.core, self.comm, self.events, task_ids)
@@ -1512,6 +1610,12 @@ class Server:
         group commit, and their acks are queued only after that commit
         lands (durability-before-visibility across chunk boundaries)."""
         plane = self.ingest_plane
+        # with the journal plane on, chunk acks (and every other reply)
+        # ride the durability watermark instead of an inline group-commit
+        # block: the commit thread batches whole runs of chunks on its
+        # own, and reply_visible releases the acks in FIFO order once
+        # the covering commit lands
+        gated = self.jplane is not None
         while True:
             await self._handoff_wake.wait()
             self._handoff_wake.clear()
@@ -1547,7 +1651,7 @@ class Server:
                             continue
                         op = msg.get("op")
                         if op == "submit_chunk":
-                            if batch is None:
+                            if batch is None and not gated:
                                 batch = self._journal_group_commit()
                                 batch.__enter__()
                             try:
@@ -1556,7 +1660,10 @@ class Server:
                                 logger.exception("submit_chunk failed")
                                 resp = {"op": "error", "message": str(e),
                                         "rid": msg.get("rid")}
-                            acks.append((channel, resp))
+                            if gated:
+                                self.reply_visible(channel, resp)
+                            else:
+                                acks.append((channel, resp))
                             continue
                         # any non-chunk op is a durability barrier: commit
                         # the open chunk batch and release its acks first,
@@ -1573,7 +1680,7 @@ class Server:
                             continue
                         response = await self._handle_client_message(msg)
                         if response is not None:
-                            channel.reply(response)
+                            self.reply_visible(channel, response)
                 finally:
                     flush_chunks()
                 self.note_plane("ingest", time.perf_counter() - t0)
@@ -1587,7 +1694,7 @@ class Server:
         async def run() -> None:
             response = await self._handle_client_message(msg)
             if response is not None:
-                channel.reply(response)
+                self.reply_visible(channel, response)
 
         task = asyncio.ensure_future(run())
         self._client_tasks.add(task)
@@ -1629,7 +1736,13 @@ class Server:
         period = self.journal_flush_period or 30.0
         while True:
             await asyncio.sleep(period)
-            self.journal.flush(sync=self.journal_fsync != "never")
+            if self.jplane is not None:
+                # non-blocking: the commit thread flushes when it drains
+                self.jplane.request_flush(
+                    sync=self.journal_fsync != "never"
+                )
+            else:
+                self.journal.flush(sync=self.journal_fsync != "never")
 
     async def _journal_compact_loop(self) -> None:
         """Compact on --journal-compact-interval and/or whenever the
@@ -1705,9 +1818,15 @@ class Server:
             t0 = time.perf_counter()
             loop = asyncio.get_running_loop()
             # phase 1: barrier + capture (no awaits until stop_at is read)
-            if self.journal.in_batch:
-                self.journal.commit_batch()
-            self.journal.flush(sync=True)
+            if self.jplane is not None:
+                # blocks the loop until the commit thread has everything
+                # on disk — the same stop-the-world barrier the inline
+                # path gets from commit+fsync below
+                self.jplane.barrier(sync=True)
+            else:
+                if self.journal.in_batch:
+                    self.journal.commit_batch()
+                self.journal.flush(sync=True)
             state = snapshot_mod.capture_state(self)
             watermark = state["seq"]
             stop_at = self.journal_path.stat().st_size
@@ -1755,8 +1874,13 @@ class Server:
                 )
                 if chaos.ACTIVE:
                     chaos.fire("server.compact", event="pre-swap")
-                # phase 4: synchronous swap — no awaits, so no event can be
-                # appended between close and reopen
+                # phase 4: synchronous swap — no awaits, so no event can
+                # be appended between close and reopen; the journal
+                # plane's commit thread is drained + parked around the
+                # handle swap (it keeps appending to the SAME Journal
+                # object, which reopens onto the published file)
+                if self.jplane is not None:
+                    self.jplane.suspend()
                 self.journal.close()
                 try:
                     Journal.gc_finalize(self.journal_path, tmp, stop_at)
@@ -1765,6 +1889,8 @@ class Server:
                     # published), the appender MUST come back or every
                     # subsequent emit_event would crash the handlers
                     self.journal.open_for_append()
+                    if self.jplane is not None:
+                        self.jplane.resume()
             except BaseException:
                 tmp.unlink(missing_ok=True)
                 raise
@@ -2057,14 +2183,19 @@ class Server:
         """Drain the per-worker queue into batch frames: a tick's burst
         (compute batches, retract fan-out, cancels) leaves as one
         encryption + one syscall instead of one per message — the downlink
-        half of the pipelined assignment delivery. Chaos actions apply per
-        LOGICAL message so fault plans behave identically under batching."""
+        half of the pipelined assignment delivery. The encryption half
+        runs on the fan-out sender pool (server/fanout.py) when enabled,
+        so N workers' downlinks seal on N threads instead of serializing
+        on this loop. Chaos actions apply per LOGICAL message so fault
+        plans behave identically under batching."""
+        loop = asyncio.get_running_loop()
+        pool = self.sendpool
         while True:
-            msg = await queue.get()
+            enq_ts, msg = await queue.get()
             batch = [msg]
             while len(batch) < 256:
                 try:
-                    batch.append(queue.get_nowait())
+                    batch.append(queue.get_nowait()[1])
                 except asyncio.QueueEmpty:
                     break
             if chaos.ACTIVE:
@@ -2082,11 +2213,20 @@ class Server:
                 if not batch:
                     continue
             t0 = time.perf_counter()
-            if len(batch) == 1:
-                await conn.send(batch[0])
-            else:
-                await conn.send({"op": "batch", "msgs": batch})
-            self.note_plane("fanout", time.perf_counter() - t0)
+            payload = (
+                batch[0] if len(batch) == 1
+                else {"op": "batch", "msgs": batch}
+            )
+            data = await pool.encode(loop, conn, payload)
+            await conn.send_bytes(data)
+            dt = time.perf_counter() - t0
+            pool.note_send(len(batch), len(data), dt)
+            # re-pointed `fanout` lag probe (ISSUE 12): handoff latency —
+            # reactor enqueue to frame-on-the-wire — not loop hold time
+            # (the encode no longer holds the loop at all)
+            self.lag.observe("fanout", time.monotonic() - enq_ts)
+            if self.stall_budget > 0 and dt >= self.stall_budget:
+                self._capture_stall("fanout", dt)
 
     async def _worker_recv_loop(self, conn: Connection, worker: Worker) -> None:
         while True:
@@ -2108,18 +2248,33 @@ class Server:
                     self._process_worker_message(worker, sub)
                 continue
             # batched completion plane: the whole frame is processed
-            # synchronously (no awaits), then the journal group-commits —
-            # ONE write (+ fsync under --journal-fsync always) covers every
-            # event the batch produced, and nothing externally visible
-            # (sender queues, client replies, event listeners) runs before
-            # the commit, preserving durability-before-visibility
+            # synchronously (no awaits). With the journal plane on, the
+            # events it produced are enqueued to the commit thread and
+            # every CLIENT-visible effect (acks, replies, listener/
+            # subscriber deliveries) is watermark-gated. Worker-bound
+            # messages (cancels/retracts this frame may trigger) are
+            # deliberately NOT gated: dispatches were never journaled —
+            # the tick already sends compute messages with no durability
+            # coupling — and a pre-durable incarnation that dies with
+            # the server is fenced + killed at reattach (instance
+            # fencing), the same crash semantics as before. With
+            # --journal-plane reactor the inline group commit covers the
+            # frame as it always did (ONE write + fsync per batch).
             t0 = time.perf_counter()
-            with self._journal_group_commit():
+            if self.jplane is not None:
                 for sub in subs:
                     self._process_worker_message(worker, sub)
-            # frame processing + group commit hold the loop synchronously:
-            # that is the journal plane's loop occupancy
-            self.note_plane("journal", time.perf_counter() - t0)
+                # in-loop completion processing (sans journal I/O) is its
+                # own lag plane now; `journal` measures handoff latency
+                # on the commit thread (see JournalPlane)
+                self.note_plane("completion", time.perf_counter() - t0)
+            else:
+                with self._journal_group_commit():
+                    for sub in subs:
+                        self._process_worker_message(worker, sub)
+                # frame processing + group commit hold the loop
+                # synchronously: the journal plane's loop occupancy
+                self.note_plane("journal", time.perf_counter() - t0)
 
     def _process_worker_message(self, worker: Worker, msg: dict) -> None:
             op = msg.get("op")
@@ -2225,6 +2380,9 @@ class Server:
                     break
                 response = await self._handle_client_message(msg)
                 if response is not None:
+                    # durability gate (journal plane): the reply leaves
+                    # only at/below the committed watermark
+                    await self._visibility_barrier()
                     await conn.send(response)
         except (
             AuthError,
@@ -2278,6 +2436,13 @@ class Server:
             "scheduler": self.scheduler_kind,
             "metrics_port": self.metrics_port,
             "federation": self._federation_block(),
+            # ISSUE 12: which AEAD implementation seals this server's
+            # wire, and where the journal/fan-out work runs
+            "wire_backend": WIRE_BACKEND,
+            "journal_plane": (
+                self.journal_plane if self.journal is not None else None
+            ),
+            "fanout_senders": self.fanout_senders,
         }
 
     async def _client_server_stats(self, msg: dict) -> dict:
@@ -2325,6 +2490,27 @@ class Server:
             "ingest": self._ingest_stats(),
             # ISSUE 11: shard identity, lease health, lending counters
             "federation": self._federation_block(),
+            # ISSUE 12: journal commit thread + fan-out sender pool
+            "journal_plane": (
+                self.jplane.stats() if self.jplane is not None
+                else {"mode": self.journal_plane}
+            ),
+            "fanout": self._fanout_stats(),
+        }
+
+    def _fanout_stats(self) -> dict:
+        from hyperqueue_tpu.server.fanout import (
+            FANOUT_BYTES,
+            FANOUT_FRAMES,
+            FANOUT_STALLS,
+        )
+
+        return {
+            "senders": self.fanout_senders,
+            "wire_backend": WIRE_BACKEND,
+            "frames_total": int(FANOUT_FRAMES.labels().value),
+            "bytes_total": int(FANOUT_BYTES.labels().value),
+            "send_stalls": int(FANOUT_STALLS.labels().value),
         }
 
     def _ingest_stats(self) -> dict:
@@ -3630,7 +3816,13 @@ class Server:
             if msg.get("history") and self.journal_path is not None:
                 from hyperqueue_tpu.events.journal import Journal
 
-                self.journal.flush()
+                if self.jplane is not None:
+                    # sync=True: the replay re-reads the FILE, so the
+                    # commit thread's buffered tail must be on disk
+                    # (sync=False only guarantees the appender saw it)
+                    self.jplane.barrier(sync=True)
+                else:
+                    self.journal.flush()
                 for record in Journal.read_all(self.journal_path):
                     seq = record.get("seq")
                     if isinstance(seq, int) and seq > replayed_seq:
@@ -3958,7 +4150,10 @@ class Server:
     async def _client_journal_flush(self, msg: dict) -> dict:
         if self.journal is None:
             return {"op": "error", "message": "server runs without a journal"}
-        self.journal.flush(sync=True)
+        if self.jplane is not None:
+            self.jplane.barrier(sync=True)
+        else:
+            self.journal.flush(sync=True)
         return {"op": "ok"}
 
     async def _client_journal_prune(self, msg: dict) -> dict:
@@ -3987,10 +4182,18 @@ class Server:
                 return {"op": "error", "message": stats["skipped"]}
             return {"op": "ok", "kept_records": stats["kept_records"],
                     "live_jobs": sorted(live)}
-        self.journal.close()
-        kept = Journal.prune(self.journal_path, live,
-                             salvage=self.journal_salvage)
-        self.journal.open_for_append()
+        # quiesce the commit thread around the close/rewrite/reopen (no
+        # awaits in between — see JournalPlane.suspend)
+        if self.jplane is not None:
+            self.jplane.suspend()
+        try:
+            self.journal.close()
+            kept = Journal.prune(self.journal_path, live,
+                                 salvage=self.journal_salvage)
+            self.journal.open_for_append()
+        finally:
+            if self.jplane is not None:
+                self.jplane.resume()
         # live jobs' submit events survived the prune; re-log nothing
         return {"op": "ok", "kept_records": kept, "live_jobs": sorted(live)}
 
@@ -4008,7 +4211,11 @@ class Server:
             return {"op": "error", "message": "server runs without a journal"}
         from hyperqueue_tpu.events import snapshot as snapshot_mod
 
-        self.journal.flush()
+        if self.jplane is not None:
+            # sync=True so the size/segment stats below see the full tail
+            self.jplane.barrier(sync=True)
+        else:
+            self.journal.flush()
         journal_bytes = (
             self.journal_path.stat().st_size
             if self.journal_path.exists()

@@ -30,6 +30,7 @@ import asyncio
 import collections
 import logging
 import threading
+import time
 
 from hyperqueue_tpu.transport.auth import (
     ROLE_CLIENT,
@@ -158,8 +159,13 @@ class IngestPlane:
     """The client-plane thread: accept/auth/decode + batched handoff."""
 
     def __init__(self, key_getter, window: int = 64,
-                 handoff_max: int = 8192):
+                 handoff_max: int = 8192, sendpool=None):
         self.key_getter = key_getter
+        # shared fan-out sender pool (server/fanout.py): client response/
+        # stream frames (subscriber fan-out included) encode+seal on the
+        # pool's threads instead of this plane's loop; None/disabled =
+        # inline encode on this thread (still off the reactor)
+        self.sendpool = sendpool
         self.window = max(int(window), 1)
         self.handoff_max = max(int(handoff_max), self.window)
         self.handoff: collections.deque = collections.deque()
@@ -332,12 +338,19 @@ class IngestPlane:
 
     async def _sender(self, channel: ClientChannel) -> None:
         conn = channel.conn
+        pool = self.sendpool
         while True:
             frame = await channel.outq.get()
             if frame is _CLOSE:
                 return
             try:
-                await conn.send(frame)
+                if pool is not None and pool.enabled:
+                    t0 = time.perf_counter()
+                    data = await pool.encode(self.loop, conn, frame)
+                    await conn.send_bytes(data)
+                    pool.note_send(1, len(data), time.perf_counter() - t0)
+                else:
+                    await conn.send(frame)
             except (ConnectionError, OSError):
                 channel.closed = True
                 conn.close()

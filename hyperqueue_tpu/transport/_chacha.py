@@ -98,12 +98,14 @@ class ChaCha20Poly1305:
             raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
         self._key = bytes(key)
 
-    def _tag(self, nonce: bytes, ciphertext: bytes,
+    def _tag(self, nonce: bytes, ciphertext,
              aad: bytes) -> bytes:
         otk = _chacha20_stream(self._key, nonce, 0, 32)
         mac_data = (
             aad + _pad16(aad)
-            + ciphertext + _pad16(ciphertext)
+            # bytes() is a no-op for bytes input and unwraps the
+            # zero-copy memoryview the framing layer hands decrypt()
+            + bytes(ciphertext) + _pad16(ciphertext)
             + struct.pack("<QQ", len(aad), len(ciphertext))
         )
         return _poly1305(mac_data, otk)

@@ -23,13 +23,11 @@ import hmac
 import os
 import struct
 
-try:
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-except ModuleNotFoundError:
-    # containers without the cryptography wheel fall back to the pure-
-    # Python RFC 8439 implementation (bit-identical wire format, slower;
-    # control-plane frames are small)
-    from hyperqueue_tpu.transport._chacha import ChaCha20Poly1305
+# backend ladder (transport/aead.py): cryptography's native AEAD, the
+# system libcrypto via ctypes, the numpy-vectorized implementation, then
+# the pure-python reference — bit-identical wire format across all four,
+# forcible via HQ_WIRE_BACKEND
+from hyperqueue_tpu.transport.aead import ChaCha20Poly1305
 
 from hyperqueue_tpu import PROTOCOL_VERSION
 from hyperqueue_tpu.transport.framing import (
@@ -67,11 +65,12 @@ class StreamSeal:
         self._counter += 1
         return nonce
 
-    def seal(self, data: bytes) -> bytes:
+    def seal(self, data) -> bytes:
         return self._aead.encrypt(self._next_nonce(), data, None)
 
-    def open(self, data: bytes) -> bytes:
-        return self._aead.decrypt(self._next_nonce(), data, None)
+    def open(self, data) -> bytes:
+        # memoryview in, so the backend slices ct/tag without copying
+        return self._aead.decrypt(self._next_nonce(), memoryview(data), None)
 
 
 class Connection:
@@ -89,11 +88,23 @@ class Connection:
         self._sealer = sealer
         self._opener = opener
 
-    async def send(self, obj) -> None:
+    def encode(self, obj) -> bytes:
+        """msgpack-encode + seal one frame body WITHOUT writing it —
+        the CPU-heavy half of send(), safe to run on a sender-pool
+        thread (server/fanout.py) as long as each connection's frames
+        are encoded in send order: the seal consumes one counter nonce
+        per call, and the peer opens frames in arrival order."""
         data = pack_payload(obj)
         if self._sealer is not None:
             data = self._sealer.seal(data)
+        return data
+
+    async def send_bytes(self, data: bytes) -> None:
+        """Write one pre-encoded frame body (see encode())."""
         await write_frame(self.writer, data)
+
+    async def send(self, obj) -> None:
+        await write_frame(self.writer, self.encode(obj))
 
     async def recv(self):
         data = await read_frame(self.reader)

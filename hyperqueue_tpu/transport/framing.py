@@ -24,14 +24,20 @@ def pack_payload(obj) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)
 
 
-def unpack_payload(data: bytes):
+def unpack_payload(data):
+    # accepts bytes OR a memoryview (the decrypted-in-place zero-copy
+    # path hands views through here)
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
 
 
 async def write_frame(writer: asyncio.StreamWriter, data: bytes) -> None:
     if len(data) > MAX_FRAME_SIZE:
         raise FrameError(f"frame too large: {len(data)}")
-    writer.write(_LEN.pack(len(data)) + data)
+    # two buffered writes instead of one header+body concatenation: the
+    # transport coalesces them, and a large sealed frame is not copied a
+    # second time just to prepend 4 bytes
+    writer.write(_LEN.pack(len(data)))
+    writer.write(data)
     await writer.drain()
 
 

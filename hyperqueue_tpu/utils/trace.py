@@ -308,12 +308,17 @@ class TaskTraceStore:
 # (server/bootstrap.py) compares each observation against --stall-budget.
 # ----------------------------------------------------------------------
 
-LAG_PLANES = ("rpc", "journal", "solve", "fanout", "loop")
+LAG_PLANES = (
+    "rpc", "journal", "solve", "fanout", "completion", "ingest", "loop",
+)
 
 _REACTOR_LAG_SECONDS = REGISTRY.histogram(
     "hq_reactor_lag_seconds",
-    "time one reactor work class held the server event loop "
-    "(rpc/journal/solve/fanout) or the loop's own sleep-overshoot (loop)",
+    "per-plane server latency: loop occupancy for in-loop work classes "
+    "(rpc/solve/completion/ingest) and the loop's own sleep-overshoot "
+    "(loop); for the off-loop planes (journal/fanout, ISSUE 12) the "
+    "observation is HANDOFF latency — reactor enqueue to durable commit "
+    "/ frame on the wire",
     labels=("plane",),
 )
 

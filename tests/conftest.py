@@ -60,6 +60,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "planes: server threading-model tests (journal commit thread, "
+        "fan-out sender pool, wire-backend ladder; ISSUE 12)",
+    )
+    config.addinivalue_line(
+        "markers",
         "multichip: sharded multi-device solver tests; run on the virtual "
         "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
         "count=8, set above) so tier-1 exercises the 8-device path on "

@@ -1,0 +1,245 @@
+"""Journal plane + fan-out plane e2e (ISSUE 12).
+
+The tentpole contract: with group commit on a dedicated thread, an ack
+(or any other externally visible effect) is released ONLY at/below the
+durability watermark — a client that heard "ok" can kill -9 the server
+and find its work in the journal, and a kill BETWEEN enqueue and commit
+means the client never heard "ok" (and restore shows nothing, which is
+consistent). The escape hatches (`--journal-plane reactor`,
+`--fanout-senders 0`) must keep the old single-threaded layout working.
+
+Timing in the durability tests is controlled by the
+HQ_JOURNAL_PLANE_TEST_DELAY hook (journal_plane.py), which stretches the
+enqueue->commit window to seconds — wall-clock sleeps on the commit
+thread, immune to box jitter.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.planes
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _stats(env):
+    return json.loads(
+        env.command(["server", "stats", "--output-mode", "json"])
+    )
+
+
+def _jobs(env):
+    return json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+
+
+def test_ack_waits_for_commit_watermark(env, tmp_path):
+    """durability-before-visibility, positive half: with the commit
+    thread slowed to one batch per second, every acked client RPC must
+    take at least one commit cycle — the ack rode the watermark."""
+    env.start_server(
+        "--journal", str(tmp_path / "journal.bin"),
+        "--journal-fsync", "always",
+        env_extra={"HQ_JOURNAL_PLANE_TEST_DELAY": "1.0"},
+    )
+    t0 = time.perf_counter()
+    out = env.command(
+        ["submit", "--output-mode", "quiet", "--", "true"], timeout=30,
+    )
+    elapsed = time.perf_counter() - t0
+    assert out.strip() == "1"
+    # the job-submitted event's batch slept >= 1.0 s before committing;
+    # an ack that beat it would return in milliseconds
+    assert elapsed >= 0.9, (
+        f"submit acked in {elapsed:.3f}s — the ack outran the journal "
+        "commit (durability-before-visibility regression)"
+    )
+
+
+def test_kill9_between_enqueue_and_commit_never_acked(env, tmp_path):
+    """durability-before-visibility, negative half: kill -9 while the
+    commit thread is still holding the batch. The client must NOT have
+    been acked, and the restored server must show no trace of the job —
+    unacked and undurable is the consistent pair."""
+    journal = tmp_path / "journal.bin"
+    env.start_server(
+        "--journal", str(journal), "--journal-fsync", "always",
+        env_extra={"HQ_JOURNAL_PLANE_TEST_DELAY": "2.5"},
+    )
+    result: dict = {}
+
+    def doomed_submit() -> None:
+        try:
+            result["out"] = env.command(
+                ["submit", "--name", "doomed", "--", "true"], timeout=15,
+            )
+        except Exception as e:  # noqa: BLE001 - failure IS the expectation
+            result["err"] = str(e)
+
+    th = threading.Thread(target=doomed_submit, daemon=True)
+    th.start()
+    # the submit's event is enqueued almost immediately; its commit
+    # cannot land before 2.5 s of wall clock — kill well inside that
+    time.sleep(1.0)
+    env.kill_process("server")
+    th.join(timeout=20)
+    assert "out" not in result, (
+        "client was acked for a submit whose journal commit never "
+        f"happened: {result.get('out')}"
+    )
+    # restart without the delay: the doomed job must not exist
+    env.start_server("--journal", str(journal))
+    names = {j.get("name") for j in _jobs(env)}
+    assert "doomed" not in names
+
+
+def test_acked_chunk_survives_kill9(env, tmp_path):
+    """The exactly-once contract through the plane: once the (gated) ack
+    arrives, kill -9 + restore must show the work. Complements the
+    negative half above — together they pin ack <=> durable."""
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal), "--journal-fsync", "always")
+    env.command(
+        ["submit", "--array", "0-99", "--name", "kept", "--", "true"],
+        timeout=30,
+    )
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    jobs = {j.get("name"): j for j in _jobs(env)}
+    assert "kept" in jobs
+    assert jobs["kept"]["n_tasks"] == 100
+
+
+def test_journal_plane_reactor_escape_hatch(env, tmp_path):
+    """--journal-plane reactor restores the inline group-commit block
+    end to end (submit -> execute -> journal survives a restart)."""
+    journal = tmp_path / "journal.bin"
+    env.start_server(
+        "--journal", str(journal), "--journal-plane", "reactor",
+    )
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    stats = _stats(env)
+    assert stats["journal_plane"]["mode"] == "reactor"
+    env.command(["submit", "--array", "0-19", "--wait", "--", "true"],
+                timeout=60)
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    assert _jobs(env)[0]["counters"]["finished"] == 20
+
+
+def test_journal_plane_stats_and_compaction(env, tmp_path):
+    """The thread plane reports commit batching in `hq server stats`,
+    and compaction's close/swap/reopen coexists with the live commit
+    thread (suspend/resume around the handle swap)."""
+    journal = tmp_path / "journal.bin"
+    env.start_server("--journal", str(journal))
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--array", "0-49", "--wait", "--", "true"],
+                timeout=60)
+    stats = _stats(env)
+    jp = stats["journal_plane"]
+    assert jp["mode"] == "thread"
+    assert jp["commits"] >= 1
+    assert jp["durable"] == jp["enqueued"] >= 50
+    # compaction with the plane live, then more work, then restore
+    env.command(["journal", "compact"])
+    env.command(["submit", "--array", "0-9", "--wait", "--", "true"],
+                timeout=60)
+    env.kill_process("server")
+    env.start_server("--journal", str(journal))
+    jobs = _jobs(env)
+    assert sorted(j["counters"]["finished"] for j in jobs) == [10, 50]
+
+
+def test_fanout_pool_and_inline_escape_hatch(env, tmp_path):
+    """Downlink correctness is sender-pool-agnostic: the same workload
+    completes with a 3-thread pool and with --fanout-senders 0, and the
+    pool run reports frames/batches in stats."""
+    env.start_server("--fanout-senders", "3")
+    env.start_worker(cpus=4)
+    env.wait_workers(1)
+    env.command(["submit", "--array", "0-199", "--wait", "--", "true"],
+                timeout=120)
+    fo = _stats(env)["fanout"]
+    assert fo["senders"] == 3
+    assert fo["frames_total"] > 0
+    assert fo["bytes_total"] > 0
+    assert fo["wire_backend"] in ("native", "openssl", "numpy", "python")
+    env.command(["server", "stop"])
+
+    env2_dir = tmp_path / "inline"
+    with HqEnv(env2_dir) as env2:
+        env2.start_server("--fanout-senders", "0")
+        env2.start_worker(cpus=4)
+        env2.wait_workers(1)
+        env2.command(
+            ["submit", "--array", "0-49", "--wait", "--", "true"],
+            timeout=120,
+        )
+        assert _stats(env2)["fanout"]["senders"] == 0
+
+
+def test_forced_python_wire_backend_e2e(env):
+    """HQ_WIRE_BACKEND=python end to end (server + worker on the compat
+    AEAD, encrypted transport): the fallback stays release-ready even
+    where faster backends are installed."""
+    forced = {"HQ_WIRE_BACKEND": "python"}
+    env.start_server(env_extra=forced)
+    env.start_worker(cpus=2, env_extra=forced)
+    env.wait_workers(1)
+    info = json.loads(env.command(
+        ["server", "info", "--output-mode", "json"]
+    ))
+    assert info["wire_backend"] == "python"
+    env.command(["submit", "--array", "0-9", "--wait", "--", "true"],
+                timeout=120)
+    jobs = _jobs(env)
+    assert jobs[0]["counters"]["finished"] == 10
+
+
+def test_subscriber_events_ride_watermark(env, tmp_path):
+    """Subscriber deliveries are watermark-gated too: with a slowed
+    commit thread, a lifecycle event reaches the subscriber only after
+    its commit — but it DOES reach it (no lost deliveries)."""
+    env.start_server(
+        "--journal", str(tmp_path / "journal.bin"),
+        env_extra={"HQ_JOURNAL_PLANE_TEST_DELAY": "0.3"},
+    )
+    from hyperqueue_tpu.client.connection import subscribe
+
+    got: list = []
+    stop = threading.Event()
+
+    def consume() -> None:
+        try:
+            for frame in subscribe(env.server_dir, filters=("job-",)):
+                if frame.get("op") == "events":
+                    got.extend(frame["records"])
+                if stop.is_set():
+                    return
+        except Exception:  # noqa: BLE001 - server teardown ends the stream
+            pass
+
+    th = threading.Thread(target=consume, daemon=True)
+    th.start()
+    time.sleep(0.5)  # let the subscription attach
+    env.command(["submit", "--", "true"], timeout=30)
+    wait_until(
+        lambda: any(r.get("event") == "job-submitted" for r in got),
+        timeout=15.0, message="job-submitted reaching the subscriber",
+    )
+    stop.set()

@@ -1,0 +1,161 @@
+"""AEAD backend ladder (transport/aead.py): parity, replay rejection,
+forced fallback, zero-copy framing.
+
+The wire format must be ONE format: any process may run any backend
+(native `cryptography`, the numpy-vectorized implementation, or the
+pure-python reference) and every pair must interoperate bit-for-bit in
+both directions — a worker on a cryptography-equipped node talks to a
+server on the baseline image.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hyperqueue_tpu.transport import aead
+from hyperqueue_tpu.transport.auth import StreamSeal
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# every backend importable here; the suite proves each pair interops
+BACKENDS = {name: aead.select_backend(name)[1]
+            for name in aead.available_backends()}
+
+# RFC 8439 section 2.8.2 test vector
+_RFC_KEY = bytes(range(0x80, 0xA0))
+_RFC_NONCE = bytes([0x07, 0, 0, 0, 0x40, 0x41, 0x42, 0x43,
+                    0x44, 0x45, 0x46, 0x47])
+_RFC_AAD = bytes([0x50, 0x51, 0x52, 0x53, 0xC0, 0xC1, 0xC2, 0xC3,
+                  0xC4, 0xC5, 0xC6, 0xC7])
+_RFC_PT = (
+    b"Ladies and Gentlemen of the class of '99: If I could offer you "
+    b"only one tip for the future, sunscreen would be it."
+)
+_RFC_TAG = "1ae10b594f09e26a7e902ecbd0600691"
+
+
+def test_backend_ladder_sane():
+    # numpy and python are always importable on the baseline image;
+    # native rides along where the wheel exists
+    assert "numpy" in BACKENDS
+    assert "python" in BACKENDS
+    assert aead.WIRE_BACKEND in BACKENDS
+
+
+@pytest.mark.parametrize("name", sorted(BACKENDS))
+def test_rfc8439_vector(name):
+    out = BACKENDS[name](_RFC_KEY).encrypt(_RFC_NONCE, _RFC_PT, _RFC_AAD)
+    assert out[-16:].hex() == _RFC_TAG
+    assert BACKENDS[name](_RFC_KEY).decrypt(_RFC_NONCE, out, _RFC_AAD) \
+        == _RFC_PT
+
+
+def test_backend_parity_both_directions():
+    """seal with A, open with B — every ordered pair, sizes straddling
+    every internal threshold (scalar/vector crossover, xor paths,
+    partial Poly1305 blocks, multi-chunk keystream)."""
+    sizes = (0, 1, 15, 16, 17, 63, 64, 65, 255, 256, 257,
+             1000, 4096, 70000)
+    for size in sizes:
+        key = secrets.token_bytes(32)
+        nonce = secrets.token_bytes(12)
+        data = secrets.token_bytes(size)
+        aad = None if size % 2 == 0 else secrets.token_bytes(size % 29)
+        sealed = {
+            name: impl(key).encrypt(nonce, data, aad)
+            for name, impl in BACKENDS.items()
+        }
+        # identical ciphertext+tag across backends
+        assert len(set(sealed.values())) == 1, f"size {size}"
+        for opener in BACKENDS.values():
+            for ct in sealed.values():
+                assert opener(key).decrypt(nonce, ct, aad) == data
+
+
+@pytest.mark.parametrize("name", sorted(BACKENDS))
+def test_tamper_rejected(name):
+    impl = BACKENDS[name]
+    key = secrets.token_bytes(32)
+    nonce = secrets.token_bytes(12)
+    ct = bytearray(impl(key).encrypt(nonce, b"payload", None))
+    ct[-1] ^= 1
+    with pytest.raises(Exception):
+        impl(key).decrypt(nonce, bytes(ct), None)
+
+
+def test_stream_seal_replay_and_reorder_rejected():
+    """The counter nonce makes replay/reorder within a connection fail
+    closed: frame N opens only as the N-th open() call."""
+    key = secrets.token_bytes(32)
+    sealer = StreamSeal(key, b"dirA")
+    frames = [sealer.seal(f"frame-{i}".encode()) for i in range(3)]
+
+    # in-order opens succeed
+    opener = StreamSeal(key, b"dirA")
+    for i, frame in enumerate(frames):
+        assert opener.open(frame) == f"frame-{i}".encode()
+
+    # replay: opening frame 0 twice fails on the second (counter moved)
+    opener = StreamSeal(key, b"dirA")
+    assert opener.open(frames[0]) == b"frame-0"
+    with pytest.raises(Exception):
+        opener.open(frames[0])
+
+    # reorder: frame 1 first fails immediately
+    opener = StreamSeal(key, b"dirA")
+    with pytest.raises(Exception):
+        opener.open(frames[1])
+
+    # direction confusion: dirB cannot open dirA's frames
+    opener = StreamSeal(key, b"dirB")
+    with pytest.raises(Exception):
+        opener.open(frames[0])
+
+
+def test_open_accepts_memoryview():
+    """The zero-copy read path hands memoryviews through seal/open."""
+    key = secrets.token_bytes(32)
+    data = secrets.token_bytes(5000)
+    sealed = StreamSeal(key, b"dirA").seal(data)
+    assert StreamSeal(key, b"dirA").open(memoryview(sealed)) == data
+
+
+def test_forced_backend_env(tmp_path):
+    """HQ_WIRE_BACKEND pins the selection at import (the CI lever that
+    keeps the compat path covered where faster tiers are installed);
+    an unknown name fails loudly instead of silently downgrading."""
+    script = (
+        "from hyperqueue_tpu.transport import aead; print(aead.WIRE_BACKEND)"
+    )
+    for forced in ("python", "numpy"):
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            env={**os.environ, "HQ_WIRE_BACKEND": forced,
+                 "PYTHONPATH": str(REPO_ROOT)},
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == forced
+    bad = subprocess.run(
+        [sys.executable, "-c", script],
+        env={**os.environ, "HQ_WIRE_BACKEND": "turbo",
+             "PYTHONPATH": str(REPO_ROOT)},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert bad.returncode != 0
+    assert "turbo" in bad.stderr
+
+
+def test_select_backend_direct():
+    name, impl = aead.select_backend("python")
+    assert name == "python"
+    assert impl.__module__.endswith("_chacha")
+    name, impl = aead.select_backend("numpy")
+    assert name == "numpy"
+    assert impl.__module__.endswith("_chacha_np")
