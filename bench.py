@@ -1141,6 +1141,133 @@ def run_federation_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_elasticity_smoke() -> None:
+    """Self-healing elasticity gate (ISSUE 13): burst submit against an
+    EMPTY local-handler pool.
+
+    Clean pass: measures scale-up latency (burst submit -> first
+    completion via a controller-spawned worker) and idle scale-down-to-
+    floor latency (last completion -> zero active allocations), asserting
+    both under generous bounds for this box. Chaos pass: the FIRST submit
+    fails (injected) and the FIRST spawned worker dies at boot — the loop
+    must still converge with zero failed tasks, proving backoff + crash
+    accounting contain the faults."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv, wait_until
+
+    # generous on the slow 2-core gVisor box: interpreter+import startup
+    # of a spawned worker alone is ~1-2 s, the autoalloc tick is 0.4 s
+    scale_up_bound_s = 30.0
+    scale_down_bound_s = 30.0
+    failures = []
+    t_wall = time.perf_counter()
+
+    def one_pass(tag: str, env_extra: dict) -> dict:
+        with tempfile.TemporaryDirectory() as td:
+            with HqEnv(Path(td)) as env:
+                env.start_server(env_extra={
+                    "HQ_AUTOALLOC_INTERVAL": "0.4", **env_extra,
+                })
+                env.command(["alloc", "add", "local", "--backlog", "2",
+                             "--idle-timeout", "1.5", "--no-dry-run"])
+                t0 = time.perf_counter()
+                env.command(["submit", "--array", "1-16", "--",
+                             "sleep", "0.1"])
+
+                def first_completion():
+                    out = json.loads(env.command(
+                        ["job", "list", "--all", "--output-mode", "json"]
+                    ))
+                    return out and out[0]["counters"]["finished"] > 0
+
+                wait_until(first_completion, timeout=90,
+                           message=f"{tag}: first completion")
+                scale_up_s = time.perf_counter() - t0
+                env.command(["job", "wait", "all"], timeout=120)
+                job = json.loads(env.command(
+                    ["job", "list", "--all", "--output-mode", "json"]
+                ))[0]
+                if job["counters"]["failed"]:
+                    failures.append(
+                        f"{tag}: {job['counters']['failed']} failed tasks"
+                    )
+                t1 = time.perf_counter()
+
+                def scaled_to_floor():
+                    qs = json.loads(env.command(
+                        ["alloc", "list", "--output-mode", "json"]
+                    ))
+                    return not [
+                        a for a in qs[0]["allocations"]
+                        if a["status"] in ("queued", "running")
+                    ]
+
+                wait_until(scaled_to_floor, timeout=90,
+                           message=f"{tag}: scale-down to floor")
+                scale_down_s = time.perf_counter() - t1
+                decisions = json.loads(env.command(
+                    ["alloc", "events", "--output-mode", "json"]
+                ))
+                return {
+                    "scale_up_s": round(scale_up_s, 2),
+                    "scale_down_s": round(scale_down_s, 2),
+                    "verdicts": sorted({d["verdict"] for d in decisions}),
+                }
+
+    clean = one_pass("clean", {})
+    if clean["scale_up_s"] > scale_up_bound_s:
+        failures.append(
+            f"clean scale-up {clean['scale_up_s']}s > {scale_up_bound_s}s"
+        )
+    if clean["scale_down_s"] > scale_down_bound_s:
+        failures.append(
+            f"clean scale-down {clean['scale_down_s']}s > "
+            f"{scale_down_bound_s}s"
+        )
+    if "scale-up" not in clean["verdicts"] or \
+            "scale-down" not in clean["verdicts"]:
+        failures.append(f"clean verdicts incomplete: {clean['verdicts']}")
+
+    # chaos: first submit fails, first spawned worker dies at boot —
+    # the loop converges anyway (no latency bound: backoff dominates)
+    plan = json.dumps({"rules": [
+        {"site": "autoalloc.submit", "action": "raise", "at": 1},
+        {"site": "autoalloc.spawn", "action": "raise", "at": 1},
+    ]})
+    chaotic = one_pass("chaos", {"HQ_FAULT_PLAN": plan})
+    if "scale-up-failed" not in chaotic["verdicts"]:
+        failures.append(
+            f"chaos pass never recorded the injected submit failure: "
+            f"{chaotic['verdicts']}"
+        )
+
+    emit({
+        "experiment": "elasticity_smoke",
+        "metric": "scale_up_seconds",
+        "value": clean["scale_up_s"],
+        "unit": "s",
+        "params": {
+            "tasks": 16, "backlog": 2, "idle_timeout_s": 1.5,
+            "scale_up_bound_s": scale_up_bound_s,
+            "scale_down_bound_s": scale_down_bound_s,
+        },
+        "scale_down_seconds": clean["scale_down_s"],
+        "chaos": chaotic,
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    print("elasticity-smoke:", "OK" if not failures else failures)
+    sys.exit(1 if failures else 0)
+
+
 def run_explain_smoke() -> None:
     """Explainability gate: run a deliberately unsatisfiable and a
     satisfiable workload against a real server, assert the reason codes
@@ -2204,6 +2331,12 @@ def main() -> None:
                              "journal+fanout+ingest planes on, encrypted "
                              "wire, assert >1 core of sustained server "
                              "process CPU under saturation")
+    parser.add_argument("--elasticity-smoke", action="store_true",
+                        help="self-healing elasticity gate: burst submit "
+                             "against an empty local-handler pool; "
+                             "scale-up/scale-down latency bounds + a "
+                             "FaultPlan pass (first submit fails, first "
+                             "worker dies at boot) that must converge")
     parser.add_argument("--federation-smoke", action="store_true",
                         help="federated failover gate: 2 shards + warm "
                              "standby, SIGKILL shard 1 mid-job, measure "
@@ -2256,6 +2389,10 @@ def main() -> None:
 
     if args.federation_smoke:
         run_federation_smoke()
+        return
+
+    if args.elasticity_smoke:
+        run_elasticity_smoke()
         return
 
     if args.restore_smoke:

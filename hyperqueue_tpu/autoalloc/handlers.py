@@ -1,25 +1,58 @@
-"""Queue handlers: build and submit PBS/Slurm allocations.
+"""Queue handlers: build and submit PBS/Slurm/local allocations.
 
 Reference: crates/hyperqueue/src/server/autoalloc/queue/{pbs,slurm,common}.rs —
 a QueueHandler trait with qsub/sbatch script builders and qstat/sacct status
 refresh. External binaries are resolved via PATH, which is also how the test
 mock takes over (reference tests/autoalloc/mock; ours: fake executables on
 PATH writing their argv to files).
+
+ISSUE 13 additions:
+
+- every external queue-manager subprocess is bounded by a hard timeout +
+  kill (`HQ_AUTOALLOC_MANAGER_TIMEOUT`, default 30 s): a hung
+  `sbatch`/`qstat` is a submit/refresh FAILURE, never a wedged autoalloc
+  tick loop (counted in ``hq_autoalloc_manager_timeouts_total``);
+- a ``local`` handler that spawns real worker processes on the server's
+  host — the whole autoscaling loop runs in CI without a batch scheduler,
+  and doubles as the FaultPlan chaos surface (submit fails, allocation
+  stuck queued, worker boots then dies, worker never registers);
+- submit scripts write their pid to ``<workdir>/pid`` so a crash between
+  the submit and its journal record leaves an adoptable trail instead of a
+  leaked allocation (events/restore.py + service reconciliation).
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import shlex
+import signal
 import sys
 from pathlib import Path
 
 from hyperqueue_tpu.autoalloc.state import QueueParams
+from hyperqueue_tpu.utils import chaos
+from hyperqueue_tpu.utils.metrics import REGISTRY
+
+# hard ceiling on any single qsub/sbatch/qstat/sacct/qdel/scancel call
+MANAGER_TIMEOUT_SECS = float(
+    os.environ.get("HQ_AUTOALLOC_MANAGER_TIMEOUT", "30.0")
+)
+
+_MANAGER_TIMEOUTS = REGISTRY.counter(
+    "hq_autoalloc_manager_timeouts_total",
+    "external queue-manager calls (qsub/sbatch/qstat/sacct/...) killed "
+    "after the hard timeout; counted as submit/refresh failures",
+)
 
 
 class SubmitError(Exception):
     pass
+
+
+class ManagerTimeout(SubmitError):
+    """An external manager binary exceeded the hard call timeout."""
 
 
 def _format_walltime(secs: float) -> str:
@@ -28,6 +61,11 @@ def _format_walltime(secs: float) -> str:
 
 
 def _worker_command(server_dir: str, queue_id: int, params: QueueParams) -> str:
+    # the elasticity controller owns scale-down: it DRAINS a worker once it
+    # has idled for the queue's idle timeout (masked from the solve, so no
+    # assignment can race its departure). The worker's own idle timeout is
+    # kept as a 4x fallback for when the server is unreachable and cannot
+    # drive the drain.
     args = [
         sys.executable,
         "-m",
@@ -37,7 +75,7 @@ def _worker_command(server_dir: str, queue_id: int, params: QueueParams) -> str:
         "--server-dir",
         server_dir,
         "--idle-timeout",
-        str(params.idle_timeout_secs),
+        str(params.idle_timeout_secs * 4),
         "--time-limit",
         str(params.worker_time_limit_secs or params.time_limit_secs),
         "--on-server-lost",
@@ -113,12 +151,17 @@ class QueueHandler:
         cmd = [self.submit_binary, *params.additional_args, str(path)]
         if dry_run:
             return f"dry-run:{path}", str(workdir)
+        if chaos.ACTIVE and chaos.decide(
+            "autoalloc.submit", op=self.manager
+        ) == "raise":
+            raise SubmitError("chaos: injected submit failure")
         process = await asyncio.create_subprocess_exec(
             *cmd,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
+            start_new_session=True,  # timeout kill covers the whole tree
         )
-        stdout, stderr = await process.communicate()
+        stdout, stderr = await self._communicate_bounded(process, cmd[0])
         if process.returncode != 0:
             raise SubmitError(
                 f"{self.submit_binary} failed "
@@ -133,13 +176,45 @@ class QueueHandler:
     async def remove_allocation(self, allocation_id: str) -> None:
         raise NotImplementedError
 
+    @staticmethod
+    async def _communicate_bounded(process, binary: str):
+        """communicate() with the hard manager timeout: on expiry the
+        process group is killed and ManagerTimeout propagates — a hung
+        manager binary becomes a failed call, never a hung autoalloc tick
+        loop (the caller's existing failure handling takes over)."""
+        try:
+            return await asyncio.wait_for(
+                process.communicate(), timeout=MANAGER_TIMEOUT_SECS
+            )
+        except asyncio.TimeoutError:
+            _MANAGER_TIMEOUTS.inc()
+            # kill the whole session: a child of the manager binary (e.g.
+            # a helper the site wrapped around sbatch) inheriting the
+            # output pipe would otherwise keep the reaping communicate()
+            # blocked until IT exits
+            try:
+                os.killpg(process.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    process.kill()
+                except ProcessLookupError:
+                    pass
+            # reap so the transport doesn't leak; the group was KILLed,
+            # so this returns promptly
+            await process.communicate()
+            raise ManagerTimeout(
+                f"{binary} did not answer within {MANAGER_TIMEOUT_SECS:.0f}s"
+                " (killed)"
+            ) from None
+
     async def _run(self, *cmd) -> tuple[int, str]:
         process = await asyncio.create_subprocess_exec(
             *cmd,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.STDOUT,
+            start_new_session=True,
         )
-        stdout, _ = await process.communicate()
+        stdout, _ = await self._communicate_bounded(process, cmd[0])
         return process.returncode, stdout.decode(errors="replace")
 
 
@@ -166,6 +241,10 @@ class PbsHandler(QueueHandler):
             "export HQ_ALLOC_QUEUE=%d" % queue_id,
             'export HQ_ALLOC_ID="$PBS_JOBID"',
         ]
+        if workdir is not None:
+            # adoption trail: a crash between submit and its journal
+            # record can find (and reconcile) this allocation by workdir
+            lines.append(f"echo $$ > {shlex.quote(str(workdir / 'pid'))}")
         node_cmd = _node_command(params, worker_cmd)
         if params.workers_per_alloc > 1:
             lines.append(
@@ -228,6 +307,8 @@ class SlurmHandler(QueueHandler):
             "export HQ_ALLOC_QUEUE=%d" % queue_id,
             'export HQ_ALLOC_ID="$SLURM_JOB_ID"',
         ]
+        if workdir is not None:
+            lines.append(f"echo $$ > {shlex.quote(str(workdir / 'pid'))}")
         node_cmd = _node_command(params, worker_cmd)
         if params.workers_per_alloc > 1:
             lines.append(f"srun --overlap bash -c {shlex.quote(node_cmd)}")
@@ -272,9 +353,185 @@ class SlurmHandler(QueueHandler):
         await self._run("scancel", allocation_id)
 
 
+# fault plan injected into a chaos-"raise" local spawn: the worker boots,
+# registers, then SIGKILLs itself on its first heartbeat send — the
+# deterministic "worker boots then dies" crash-loop surface
+_BOOT_DIE_PLAN = json.dumps({
+    "rules": [
+        {"site": "worker.send", "op": "heartbeat", "at": 1, "action": "kill"}
+    ]
+})
+
+
+class LocalHandler(QueueHandler):
+    """Spawn real worker processes on the server's own host.
+
+    The whole elasticity loop (demand query -> submit -> worker register ->
+    drain -> cancel) runs without PBS/Slurm — in CI, in `bench.py
+    --elasticity-smoke`, and on single-node deployments. Each "allocation"
+    is one detached process group running `workers_per_alloc` workers; the
+    allocation id is ``local-<pgid>``, so liveness/cancellation work by
+    pid across server restarts (allocation-exact restore reconciles
+    against `os.kill(pid, 0)` exactly like qstat/sacct).
+
+    FaultPlan chaos surface (site ``autoalloc.spawn``, see utils/chaos.py):
+    ``drop`` = allocation recorded but never spawned (stuck queued),
+    ``hang`` = the process runs but no worker ever starts (zombie:
+    reaches `running`, never registers), ``raise`` = the worker registers
+    then dies (crash loop). Site ``autoalloc.submit`` (all managers):
+    ``raise`` fails the submit.
+    """
+
+    manager = "local"
+    submit_binary = "bash"
+
+    def __init__(self, server_dir: str, work_dir: Path):
+        super().__init__(server_dir, work_dir)
+        self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._reapers: set[asyncio.Task] = set()
+        self._stuck_seq = 0
+
+    def build_script(
+        self, queue_id: int, params: QueueParams, workdir: Path | None = None,
+        spawn_action: str | None = None,
+    ) -> str:
+        worker_cmd = _worker_command(self.server_dir, queue_id, params)
+        lines = ["#!/bin/bash"]
+        if workdir is not None:
+            lines.append(f"echo $$ > {shlex.quote(str(workdir / 'pid'))}")
+        lines += [
+            "export HQ_ALLOC_QUEUE=%d" % queue_id,
+            'export HQ_ALLOC_ID="local-$$"',
+        ]
+        if spawn_action == "hang":
+            # allocation "runs" but no worker ever registers: the zombie
+            # reaper's prey
+            lines.append("exec sleep 100000")
+            return "\n".join(lines) + "\n"
+        if spawn_action == "raise":
+            lines.append(
+                f"export HQ_FAULT_PLAN={shlex.quote(_BOOT_DIE_PLAN)}"
+            )
+            # fast heartbeat so the boot-die fires right after registration
+            worker_cmd = worker_cmd + " --heartbeat 0.5"
+        node_cmd = _node_command(params, worker_cmd)
+        for _ in range(max(params.workers_per_alloc, 1)):
+            lines.append(f"( {node_cmd} ) &")
+        lines.append("wait")
+        return "\n".join(lines) + "\n"
+
+    def parse_submit_output(self, stdout: str) -> str:  # pragma: no cover
+        raise SubmitError("local allocations are spawned, not submitted")
+
+    def _worker_env(self) -> dict:
+        """Environment for spawned workers: the server's own fault plan
+        must NOT leak into them (each process loads its own plan);
+        HQ_LOCAL_WORKER_FAULT_PLAN explicitly opts workers into one."""
+        env = dict(os.environ)
+        env.pop("HQ_FAULT_PLAN", None)
+        worker_plan = env.pop("HQ_LOCAL_WORKER_FAULT_PLAN", None)
+        if worker_plan:
+            env["HQ_FAULT_PLAN"] = worker_plan
+        return env
+
+    async def submit_allocation(
+        self, queue_id: int, params: QueueParams, dry_run: bool = False
+    ) -> tuple[str, str]:
+        workdir = self._create_allocation_dir(queue_id, params)
+        if chaos.ACTIVE and chaos.decide(
+            "autoalloc.submit", op=self.manager
+        ) == "raise":
+            raise SubmitError("chaos: injected local submit failure")
+        spawn_action = (
+            chaos.decide("autoalloc.spawn", op=self.manager)
+            if chaos.ACTIVE else None
+        )
+        script = self.build_script(
+            queue_id, params, workdir, spawn_action=spawn_action
+        )
+        path = workdir / "hq-submit.sh"
+        path.write_text(script)
+        os.chmod(path, 0o755)
+        if dry_run:
+            return f"dry-run:{path}", str(workdir)
+        if spawn_action == "drop":
+            # recorded but never spawned: stuck queued forever (models a
+            # batch queue that accepts the job and never schedules it)
+            self._stuck_seq += 1
+            return f"local-q{self._stuck_seq}", str(workdir)
+        with open(workdir / "stdout", "wb") as out, \
+                open(workdir / "stderr", "wb") as err:
+            process = await asyncio.create_subprocess_exec(
+                "/bin/bash", str(path),
+                stdout=out, stderr=err,
+                start_new_session=True,  # killpg covers workers + hooks
+                env=self._worker_env(),
+            )
+        allocation_id = f"local-{process.pid}"
+        self._procs[allocation_id] = process
+        # reap on exit so finished allocations never linger as OS
+        # zombies; the strong ref keeps the reaper from being GC'd
+        # before it runs (the loop holds tasks weakly)
+        task = asyncio.ensure_future(process.wait())
+        self._reapers.add(task)
+        task.add_done_callback(self._reapers.discard)
+        return allocation_id, str(workdir)
+
+    @staticmethod
+    def _pid_of(allocation_id: str) -> int | None:
+        if not allocation_id.startswith("local-"):
+            return None
+        tail = allocation_id[len("local-"):]
+        return int(tail) if tail.isdigit() else None
+
+    async def refresh_statuses(self, allocation_ids):
+        out: dict[str, str] = {}
+        for allocation_id in allocation_ids:
+            pid = self._pid_of(allocation_id)
+            if pid is None:
+                # a chaos-stuck (never-spawned) allocation stays queued
+                out[allocation_id] = "queued"
+                continue
+            process = self._procs.get(allocation_id)
+            if process is not None and process.returncode is not None:
+                out[allocation_id] = (
+                    "finished" if process.returncode == 0 else "failed"
+                )
+                # terminal: drop the Process ref, or allocation churn on a
+                # long-lived server grows _procs without bound
+                self._procs.pop(allocation_id, None)
+                continue
+            if process is not None:
+                out[allocation_id] = "running"
+                continue
+            # adopted/restored allocation: pid liveness is the manager
+            try:
+                os.kill(pid, 0)
+                out[allocation_id] = "running"
+            except ProcessLookupError:
+                out[allocation_id] = "finished"
+            except PermissionError:
+                out[allocation_id] = "running"
+        return out
+
+    async def remove_allocation(self, allocation_id: str) -> None:
+        pid = self._pid_of(allocation_id)
+        self._procs.pop(allocation_id, None)
+        if pid is None:
+            return
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 def make_handler(manager: str, server_dir: str, work_dir: Path) -> QueueHandler:
     if manager == "pbs":
         return PbsHandler(server_dir, work_dir)
     if manager == "slurm":
         return SlurmHandler(server_dir, work_dir)
-    raise ValueError(f"unknown manager {manager!r} (expected pbs or slurm)")
+    if manager == "local":
+        return LocalHandler(server_dir, work_dir)
+    raise ValueError(
+        f"unknown manager {manager!r} (expected pbs, slurm or local)"
+    )

@@ -853,6 +853,12 @@ def cmd_worker_stop(args) -> None:
         for shard in shards:
             msg: dict = {"op": "worker_list"}
             stop: dict = {"op": "worker_stop"}
+            if getattr(args, "drain", False):
+                # graceful: the server masks the worker out of the solve,
+                # lets running tasks finish under the deadline, then stops
+                stop["drain"] = True
+                if getattr(args, "drain_timeout", None):
+                    stop["timeout"] = args.drain_timeout
             if shard is not None:
                 msg["shard"] = shard
                 stop["shard"] = shard
@@ -866,7 +872,8 @@ def cmd_worker_stop(args) -> None:
                 continue
             stop["worker_ids"] = shard_ids
             stopped.extend(session.request(stop)["stopped"])
-    make_output(args.output_mode).message(f"stopped workers: {stopped}")
+    verb = "draining" if getattr(args, "drain", False) else "stopped"
+    make_output(args.output_mode).message(f"{verb} workers: {stopped}")
 
 
 # ---------------------------------------------------------------- submit
@@ -1917,6 +1924,33 @@ def cmd_alloc_dry_run(args) -> None:
     out.message(response["script"])
 
 
+def cmd_alloc_events(args) -> None:
+    """Scale decision records: why the elasticity controller did (or
+    deliberately did not) scale each queue (ISSUE 13)."""
+    with _session(args) as session:
+        decisions = session.request({"op": "alloc_events"})["decisions"]
+    if args.queue_id is not None:
+        decisions = [d for d in decisions if d["queue"] == args.queue_id]
+    out = make_output(args.output_mode)
+    if args.output_mode == "json":
+        out.value(decisions)
+        return
+    out.table(
+        ["time", "queue", "verdict", "reason", "ticks", "detail"],
+        [
+            [
+                time.strftime("%H:%M:%S", time.localtime(d["time"])),
+                d["queue"],
+                d["verdict"],
+                d["reason"],
+                d["ticks"],
+                d.get("detail", ""),
+            ]
+            for d in decisions
+        ],
+    )
+
+
 # ---------------------------------------------------------------- journal
 def cmd_journal_export(args) -> None:
     from hyperqueue_tpu.events.journal import Journal
@@ -2495,6 +2529,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard", type=int, default=None, metavar="K",
                    help="federation: worker ids are per shard — which "
                         "shard's workers to stop")
+    p.add_argument("--drain", action="store_true",
+                   help="graceful: stop scheduling new tasks onto the "
+                        "worker, let running tasks finish, then stop it")
+    p.add_argument("--drain-timeout", type=_parse_duration, default=None,
+                   metavar="SECS",
+                   help="with --drain: escalate to an immediate (clean) "
+                        "stop after this long — running tasks requeue "
+                        "without a crash charge (default 120s)")
     p.set_defaults(fn=cmd_worker_stop)
     p = wsub.add_parser("info")
     _add_common(p)
@@ -2683,11 +2725,11 @@ def build_parser() -> argparse.ArgumentParser:
                        help="stop workers this long after start (default: "
                             "the allocation time limit)")
         p.add_argument("--on-server-lost",
-                       choices=["stop", "finish-running"],
+                       choices=["stop", "finish-running", "reconnect"],
                        default="finish-running")
         p.add_argument("--no-dry-run", action="store_true",
                        help="skip the probing allocation submit on `alloc add`")
-        p.add_argument("manager", choices=["pbs", "slurm"])
+        p.add_argument("manager", choices=["pbs", "slurm", "local"])
         p.add_argument("additional_args", nargs="*",
                        help="extra qsub/sbatch arguments after --")
 
@@ -2707,6 +2749,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("allocation_id")
     p.add_argument("channel", choices=["stdout", "stderr"])
     p.set_defaults(fn=cmd_alloc_log)
+    p = asub.add_parser(
+        "events", help="scale decision records (why did/didn't it scale)"
+    )
+    _add_common(p)
+    p.add_argument("queue_id", type=int, nargs="?", default=None)
+    p.set_defaults(fn=cmd_alloc_events)
     for name, fn in [("info", cmd_alloc_info), ("remove", cmd_alloc_remove),
                      ("pause", cmd_alloc_pause), ("resume", cmd_alloc_pause)]:
         p = asub.add_parser(name)

@@ -87,6 +87,105 @@ class _RestoreAcc:
         # to n_boots * stride, past everything a prior boot could have
         # issued in its lost journal tail.
         self.n_boots = 0
+        # allocation-exact restore (ISSUE 13): queue_id -> queue wire dict
+        # (with "_allocs": {alloc_id: alloc wire}) rebuilt from the
+        # snapshot's autoalloc table + alloc-* journal-tail events
+        self.autoalloc: dict[int, dict] = {}
+        self.next_alloc_queue_id = 1
+        # alloc-submit-attempt records with no journaled outcome: possible
+        # orphans the service pidfile-scans at start (_adopt_orphans)
+        self.alloc_attempts: list[dict] = []
+
+
+def _seed_autoalloc(acc: _RestoreAcc, table: dict | None) -> None:
+    if not table:
+        return
+    for qd in table.get("queues") or ():
+        q = dict(qd)
+        q["_allocs"] = {a["id"]: dict(a) for a in q.pop("allocations", ())}
+        acc.autoalloc[q["id"]] = q
+    acc.next_alloc_queue_id = max(
+        acc.next_alloc_queue_id, table.get("next_queue_id", 1)
+    )
+    acc.alloc_attempts.extend(dict(a) for a in table.get("attempts") or ())
+
+
+def _replay_alloc_record(acc: _RestoreAcc, kind: str, record: dict) -> None:
+    """One alloc-* journal record into the allocation accumulator. The
+    wire shapes mirror state.py to_wire/from_wire exactly, so the service
+    rebuilds the table the crashed server held at its last journal write."""
+    qid = record.get("queue_id")
+    if qid is None:
+        return
+    if kind == "alloc-queue-created":
+        acc.autoalloc[qid] = {
+            "id": qid, "state": "running",
+            "params": record.get("params")
+            or {"manager": record.get("manager", "slurm")},
+            "consecutive_failures": 0, "_allocs": {},
+        }
+        acc.next_alloc_queue_id = max(acc.next_alloc_queue_id, qid + 1)
+        return
+    queue = acc.autoalloc.get(qid)
+    if queue is None:
+        return  # e.g. the probe queue, never created
+    if kind == "alloc-queue-removed":
+        acc.autoalloc.pop(qid, None)
+        acc.alloc_attempts = [
+            a for a in acc.alloc_attempts if a.get("queue_id") != qid
+        ]
+    elif kind == "alloc-queue-paused":
+        queue["state"] = "paused"
+    elif kind == "alloc-queue-resumed":
+        queue["state"] = "running"
+        queue["quarantine_until"] = 0.0
+    elif kind == "alloc-queue-quarantined":
+        queue["state"] = "quarantined"
+        queue["quarantine_until"] = float(record.get("until", 0.0))
+        queue["quarantines"] = int(record.get("quarantines", 1))
+    elif kind == "alloc-submit-attempt":
+        acc.alloc_attempts.append(
+            {"queue_id": qid, "workdir": record.get("workdir", "")}
+        )
+    elif kind == "alloc-submit-failed":
+        _pop_attempt(acc, qid)
+    elif kind == "alloc-queued":
+        _pop_attempt(acc, qid)
+        aid = record.get("alloc")
+        if aid:
+            queue["_allocs"][aid] = {
+                "id": aid, "queue": qid,
+                "worker_count": record.get("worker_count", 1),
+                "status": "queued",
+                "queued_at": float(record.get("time", 0.0)),
+                "workdir": record.get("workdir", ""),
+            }
+    else:
+        alloc = queue["_allocs"].get(record.get("alloc"))
+        if alloc is None:
+            return
+        if kind == "alloc-started":
+            alloc["status"] = "running"
+            if not alloc.get("started_at"):
+                alloc["started_at"] = float(record.get("time", 0.0))
+        elif kind == "alloc-worker-bound":
+            alloc["ever_bound"] = True
+            if alloc["status"] == "queued":
+                alloc["status"] = "running"
+                alloc["started_at"] = float(record.get("time", 0.0))
+        elif kind in ("alloc-finished", "alloc-failed", "alloc-cancelled"):
+            alloc["status"] = kind[len("alloc-"):]
+            alloc["ended_at"] = float(record.get("time", 0.0))
+            if record.get("reason"):
+                alloc["reason"] = record["reason"]
+
+
+def _pop_attempt(acc: _RestoreAcc, qid: int) -> None:
+    """An attempt's outcome landed in the journal: it is not an orphan."""
+    for i, attempt in enumerate(acc.alloc_attempts):
+        if attempt.get("queue_id") == qid:
+            del acc.alloc_attempts[i]
+            return
 
 
 def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
@@ -166,6 +265,7 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
                 acc.task_maybe_running[key] = False
     for task_id, rec in (state.get("traces") or {}).items():
         acc.task_trace_seed[int(task_id)] = rec
+    _seed_autoalloc(acc, state.get("autoalloc"))
     acc.n_boots = state["n_boots"]
     server.journal_uids.update(state.get("server_uids") or ())
     if state["seq"] > server._event_seq:
@@ -355,6 +455,8 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
     elif kind == "server-uid":
         server.journal_uids.add(record.get("server_uid") or "")
         acc.n_boots += 1
+    elif isinstance(kind, str) and kind.startswith("alloc-"):
+        _replay_alloc_record(acc, kind, record)
 
 
 def _apply_lazy_chunks(server, acc: _RestoreAcc) -> None:
@@ -713,6 +815,23 @@ def restore_from_journal(server) -> None:
             reactor.on_new_tasks(server.core, server.comm, new_tasks)
             resubmitted += len(new_tasks)
     _rebuild_traces(server, acc)
+
+    # hand the reconstructed allocation table to the autoalloc service
+    # (created after restore in Server.start): restored active allocations
+    # are reconciled against the manager on the first refresh — never
+    # double-submitted, never leaked — and unresolved submit attempts are
+    # pidfile-scanned for orphans
+    if acc.autoalloc or acc.alloc_attempts:
+        queues_out = []
+        for q in acc.autoalloc.values():
+            qd = dict(q)
+            qd["allocations"] = list(qd.pop("_allocs", {}).values())
+            queues_out.append(qd)
+        server.restored_autoalloc = {
+            "queues": queues_out,
+            "next_queue_id": acc.next_alloc_queue_id,
+            "attempts": acc.alloc_attempts,
+        }
     duration = time.perf_counter() - t_restore0
     _RESTORE_SECONDS.observe(duration)
     server.last_restore = {

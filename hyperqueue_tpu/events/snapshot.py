@@ -244,9 +244,14 @@ def capture_state(server) -> dict:
         for jd in jobs_out
         for t in jd["pending"]
     ]
+    # allocation table (ISSUE 13): queues + allocation lifecycle + submits
+    # in flight, so a snapshot-seeded restore reconciles the live
+    # allocation set against the manager instead of forgetting it
+    autoalloc = getattr(server, "autoalloc", None)
     return {
         "version": VERSION,
         "time": time.time(),
+        "autoalloc": autoalloc.capture() if autoalloc is not None else None,
         "traces": core.traces.snapshot_live(live_task_ids),
         # event-seq watermark: every event with seq < this is folded into
         # the snapshot; restore replays only seq >= this from the journal

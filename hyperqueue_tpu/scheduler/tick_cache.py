@@ -147,7 +147,7 @@ class TickStateCache:
         eligible = []
         mu_blocked = False
         for w in core.workers.values():
-            if w.mn_task != 0 or w.mn_reserved != 0:
+            if w.mn_task != 0 or w.mn_reserved != 0 or w.draining:
                 continue
             if w.configuration.min_utilization > 0.001:
                 mu_blocked = True

@@ -82,6 +82,28 @@ _REACTOR_STALLS = REGISTRY.counter(
     "past --stall-budget (flight recorder + trace dumped)",
     labels=("plane",),
 )
+# graceful drain (ISSUE 13): counted under the autoalloc family because the
+# elasticity controller is the main driver; `source` separates manual
+# `hq worker stop --drain` from controller scale-down
+_DRAINS_TOTAL = REGISTRY.counter(
+    "hq_autoalloc_drains_total",
+    "graceful worker drains initiated (masked from the solve, running "
+    "tasks allowed to finish)",
+    labels=("source",),
+)
+_DRAIN_ESCALATIONS_TOTAL = REGISTRY.counter(
+    "hq_autoalloc_drain_escalations_total",
+    "drains that hit --drain-timeout and escalated to a clean stop "
+    "(running tasks requeue without a crash charge — zero task loss)",
+)
+_DRAIN_SECONDS = REGISTRY.histogram(
+    "hq_autoalloc_drain_seconds",
+    "drain latency: drain start to the worker being told to stop",
+    buckets=(0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 300.0),
+)
+
+# default deadline for a drain nobody bounded explicitly
+DRAIN_TIMEOUT_DEFAULT = 120.0
 
 # reusable/stateless, so one instance serves every frame
 _NOOP_BATCH = contextlib.nullcontext()
@@ -433,6 +455,12 @@ class EventBridge:
              "heartbeat_age": past.get("heartbeat_age"),
              "reattach_eligible": reason != "stopped"},
         )
+        self.server._draining.pop(worker_id, None)
+        # crash-loop containment: the autoalloc service tracks how long
+        # allocation-spawned workers survived after registration
+        autoalloc = getattr(self.server, "autoalloc", None)
+        if autoalloc is not None:
+            autoalloc.on_worker_lost(worker_id, reason)
 
 
 class Server:
@@ -631,6 +659,10 @@ class Server:
         # dead sibling shards (claims gated on being idle itself)
         self.failover_watch = failover_watch
         self._watcher = None
+        # graceful drains in flight (ISSUE 13): wid -> {deadline, started,
+        # source}; the drain reaper stops each worker once it settles idle
+        # or the deadline escalates the drain to a clean stop
+        self._draining: dict[int, dict] = {}
         # cross-shard worker lending: wid -> target shard for workers this
         # shard ordered to re-register elsewhere (coordinator-driven)
         self._lent_workers: dict[int, int] = {}
@@ -864,6 +896,7 @@ class Server:
         self.autoalloc.start()
         self._tasks.append(self._spawn_loop(self._scheduler_loop))
         self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
+        self._tasks.append(self._spawn_loop(self._drain_reaper))
         self._tasks.append(self._spawn_loop(self._loop_lag_monitor))
         if self.federation_root is not None and self.failover_watch:
             # idle-peer successor mode: this shard claims dead siblings,
@@ -919,6 +952,10 @@ class Server:
     async def shutdown(self) -> None:
         if getattr(self, "autoalloc", None) is not None:
             self.autoalloc.stop()
+            # in-flight qdel/scancel calls finish before the process
+            # exits (a lost cancel = a leaked cluster job the journal
+            # already believes cancelled)
+            await self.autoalloc.drain_background()
         if self._watcher is not None:
             # peer-successor mode: shards this process promoted into are
             # full Servers of their own — stop them with us
@@ -1959,6 +1996,93 @@ class Server:
                            "task": task_id_task(task_id)},
                 )
                 reactor.requeue_reattach_expired(self.core, self.comm, task)
+
+    # --- graceful drain (ISSUE 13) --------------------------------------
+    def start_drain(
+        self, worker_ids, timeout: float | None = None, source: str = "cli"
+    ) -> list[int]:
+        """Begin a graceful drain of `worker_ids`: each worker is masked
+        out of the solve/prefill/gang selection (Worker.draining — a
+        membership mask like the gang reservation), its queued-but-not-
+        started prefilled backlog is retracted, and the drain reaper stops
+        it once its running tasks finish — or, past the deadline, stops it
+        anyway with clean_stop so anything still running requeues without
+        a crash charge (zero task loss either way)."""
+        window = float(timeout) if timeout and timeout > 0 \
+            else DRAIN_TIMEOUT_DEFAULT
+        now = time.monotonic()
+        started: list[int] = []
+        for wid in worker_ids:
+            worker = self.core.workers.get(wid)
+            if worker is None or worker.draining:
+                continue
+            worker.draining = True
+            self.core.bump_membership()
+            # retract the queued backlog so the drain is bounded by the
+            # currently RUNNING tasks only (same move as the gang drain)
+            refs = []
+            for tid in sorted(worker.prefilled_tasks):
+                task = self.core.tasks[tid]
+                if task.retract_pending:
+                    continue
+                task.retract_pending = True
+                refs.append((tid, task.instance_id))
+            if refs:
+                self.comm.send_retract(wid, refs)
+            self._draining[wid] = {
+                "deadline": now + window, "started": now, "source": source,
+            }
+            _DRAINS_TOTAL.labels(source).inc()
+            self.emit_event(
+                "worker-draining",
+                {"id": wid, "timeout": window, "source": source,
+                 "running": len(worker.assigned_tasks)},
+            )
+            started.append(wid)
+        return started
+
+    async def _drain_reaper(self) -> None:
+        """Stop each draining worker once it settles idle; past the drain
+        deadline, escalate to an immediate clean stop (running tasks take
+        the normal worker-lost requeue path, no crash charge)."""
+        while True:
+            await asyncio.sleep(0.2)
+            if not self._draining:
+                continue
+            now = time.monotonic()
+            for wid, rec in list(self._draining.items()):
+                worker = self.core.workers.get(wid)
+                if worker is None:
+                    self._draining.pop(wid, None)
+                    continue
+                settled = (
+                    not worker.assigned_tasks
+                    and not worker.prefilled_tasks
+                    and worker.mn_task == 0
+                )
+                escalated = not settled and now >= rec["deadline"]
+                if not (settled or escalated):
+                    continue
+                self._draining.pop(wid, None)
+                worker.clean_stop = True
+                self.comm.send_stop(wid)
+                drain_s = now - rec["started"]
+                _DRAIN_SECONDS.observe(drain_s)
+                if escalated:
+                    _DRAIN_ESCALATIONS_TOTAL.inc()
+                    logger.warning(
+                        "drain of worker %d hit its %.0fs deadline with %d "
+                        "task(s) still running; escalating to stop "
+                        "(tasks requeue, no crash charge)",
+                        wid, rec["deadline"] - rec["started"],
+                        len(worker.assigned_tasks),
+                        extra={"worker": wid},
+                    )
+                self.emit_event(
+                    "worker-drained",
+                    {"id": wid, "escalated": escalated,
+                     "drain_s": round(drain_s, 3), "source": rec["source"]},
+                )
 
     async def _heartbeat_reaper(self) -> None:
         """Drop workers whose heartbeats stopped (beyond TCP-close detection;
@@ -3191,10 +3315,12 @@ class Server:
         from hyperqueue_tpu.autoalloc.state import QueueParams
 
         params = QueueParams.from_wire(msg["params"])
-        if params.manager not in ("pbs", "slurm"):
+        if params.manager not in ("pbs", "slurm", "local"):
             return {"op": "error",
                     "message": f"unknown manager {params.manager!r}"}
-        if not msg.get("no_dry_run"):
+        # the local handler has no external manager to probe — a probe
+        # would spawn (and instantly kill) a real worker for nothing
+        if not msg.get("no_dry_run") and params.manager != "local":
             error = await self.autoalloc.probe_submit(params)
             if error is not None:
                 return {"op": "error",
@@ -3203,7 +3329,10 @@ class Server:
         queue = self.autoalloc.state.add_queue(params)
         self.emit_event(
             "alloc-queue-created",
-            {"queue_id": queue.queue_id, "manager": params.manager},
+            {"queue_id": queue.queue_id, "manager": params.manager,
+             # full params ride the journal: restore rebuilds the queue
+             # exactly (allocation-exact restore, ISSUE 13)
+             "params": params.to_wire()},
         )
         return {"op": "alloc_add", "queue_id": queue.queue_id}
 
@@ -3214,18 +3343,24 @@ class Server:
         }
 
     async def _client_alloc_remove(self, msg: dict) -> dict:
-        queue = self.autoalloc.state.queues.pop(msg["queue_id"], None)
+        queue = self.autoalloc.state.queues.get(msg["queue_id"])
         if queue is None:
             return {"op": "error", "message": "allocation queue not found"}
-        handler = self.autoalloc.handler_for(queue)
-        for alloc in queue.active_allocations():
-            try:
-                await handler.remove_allocation(alloc.allocation_id)
-            except Exception:  # noqa: BLE001
-                logger.warning("failed to remove allocation %s",
-                               alloc.allocation_id)
+        cancels = [
+            # journals the cancellation + cancels the manager job
+            self.autoalloc.cancel_allocation(
+                queue, alloc, reason="queue-removed"
+            )
+            for alloc in queue.active_allocations()
+        ]
+        self.autoalloc.state.queues.pop(msg["queue_id"], None)
         self.autoalloc.forget_queue(msg["queue_id"])
         self.emit_event("alloc-queue-removed", {"queue_id": msg["queue_id"]})
+        if cancels:
+            # the reply must not outrun the manager cancels: a script
+            # doing `alloc remove && server stop` would otherwise exit
+            # with live batch jobs the journal believes cancelled
+            await asyncio.gather(*cancels, return_exceptions=True)
         return {"op": "ok"}
 
     async def _client_alloc_pause(self, msg: dict) -> dict:
@@ -3236,7 +3371,24 @@ class Server:
         if queue.state == "running":
             queue.consecutive_failures = 0
             queue.next_submit_at = 0.0
+            # operator resume also lifts a quarantine and forgets its
+            # backoff history
+            queue.clear_quarantine()
+        # journaled so a restore keeps the operator's pause/resume
+        self.emit_event(
+            "alloc-queue-paused" if queue.state == "paused"
+            else "alloc-queue-resumed",
+            {"queue_id": msg["queue_id"], "from": "operator"},
+        )
         return {"op": "ok", "state": queue.state}
+
+    async def _client_alloc_events(self, msg: dict) -> dict:
+        """Scale decision records: why the controller did / did not act
+        (`hq alloc events`)."""
+        return {
+            "op": "alloc_events",
+            "decisions": self.autoalloc.controller.to_wire(),
+        }
 
     async def _client_alloc_log(self, msg: dict) -> dict:
         """Locate an allocation so the client can read its manager-captured
@@ -3689,7 +3841,7 @@ class Server:
                 "hostname": w.configuration.hostname,
                 "group": w.group,
                 "alloc_id": w.configuration.alloc_id,
-                "status": "running",
+                "status": "draining" if w.draining else "running",
                 "n_running": len(w.assigned_tasks),
                 "resources": {
                     self.core.resource_map.name_of(i): amount
@@ -3720,6 +3872,7 @@ class Server:
                 "manager": w.configuration.manager,
                 "manager_job_id": w.configuration.manager_job_id,
                 "alloc_id": w.configuration.alloc_id,
+                "draining": w.draining,
                 "time_limit_secs": w.configuration.time_limit_secs,
                 "lifetime_secs": w.lifetime_secs(),
                 "descriptor": w.configuration.descriptor.to_dict(),
@@ -3773,6 +3926,12 @@ class Server:
         }
 
     async def _client_worker_stop(self, msg: dict) -> dict:
+        if msg.get("drain"):
+            # graceful: mask + let running tasks finish under the deadline
+            started = self.start_drain(
+                msg["worker_ids"], timeout=msg.get("timeout"), source="cli"
+            )
+            return {"op": "worker_stop", "stopped": started, "drain": True}
         stopped = []
         for wid in msg["worker_ids"]:
             worker = self.core.workers.get(wid)
@@ -3884,6 +4043,7 @@ class Server:
                 "hostname": w.configuration.hostname,
                 "running": len(w.assigned_tasks),
                 "prefilled": len(w.prefilled_tasks),
+                "draining": w.draining,
                 "cpu": hw.get("cpu_usage_percent"),
             })
         latest = core.flight.latest() or {}

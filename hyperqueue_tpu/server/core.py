@@ -97,7 +97,8 @@ class Core:
         return self.rq_map.get_or_create(rqv)
 
     def worker_rows(self) -> list[WorkerRow]:
-        """Snapshot rows for the tick; excludes workers reserved for gangs."""
+        """Snapshot rows for the tick; excludes workers reserved for gangs
+        and workers draining toward a graceful stop."""
         return [
             WorkerRow(
                 worker_id=w.worker_id,
@@ -108,7 +109,7 @@ class Core:
                 cpu_floor=w.cpu_floor(),
             )
             for w in self.workers.values()
-            if w.mn_task == 0 and w.mn_reserved == 0
+            if w.mn_task == 0 and w.mn_reserved == 0 and not w.draining
         ]
 
     def variant_amounts(

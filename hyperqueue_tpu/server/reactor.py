@@ -666,7 +666,7 @@ def schedule(
             for w in core.workers.values():
                 if w.mn_task or w.mn_reserved not in (0, task_id):
                     continue
-                if not _mn_member_eligible(w, req):
+                if w.draining or not _mn_member_eligible(w, req):
                     continue
                 groups.setdefault(w.group, []).append(w)
             chosen: list[Worker] | None = None
@@ -951,6 +951,7 @@ def schedule(
             for w in core.workers.values()
             if not w.mn_task
             and not w.mn_reserved
+            and not w.draining
             and (w.assigned_tasks or w.prefilled_tasks)
             and len(w.prefilled_tasks) < PREFILL_MAX
         }
@@ -965,7 +966,10 @@ def schedule(
         for batch in leftover_batches:
             rqv = core.rq_map.get_variants(batch.rq_id)
             for w in sorted(core.workers.values(), key=lambda w: w.worker_id):
-                if w.mn_task or w.mn_reserved or w.worker_id in reservations:
+                if (
+                    w.mn_task or w.mn_reserved or w.draining
+                    or w.worker_id in reservations
+                ):
                     continue
                 if w.resources.is_capable_of_rqv(rqv):
                     reservations[w.worker_id] = batch.priority
@@ -1126,6 +1130,7 @@ def schedule(
             w for w in core.workers.values()
             if w.is_idle()
             and not w.mn_reserved
+            and not w.draining
             and w.worker_id not in per_worker_msgs
         ]
         if idle:

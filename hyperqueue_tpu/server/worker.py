@@ -128,6 +128,12 @@ class Worker:
     # limit): its tasks requeue WITHOUT a crash-counter increment
     # (reference gateway.rs CrashLimit doc: stops don't count)
     clean_stop: bool = False
+    # graceful drain (ISSUE 13): the worker is masked out of the solve,
+    # prefill and gang selection (a membership mask like mn_reserved) so it
+    # converges to idle; running tasks finish normally, then the server
+    # stops it. Set by `hq worker stop --drain` and the elasticity
+    # controller's scale-down path; every flip MUST bump core membership.
+    draining: bool = False
     # dirty-tracking epoch for the persistent tick snapshot
     # (scheduler/tick_cache.TickStateCache): every mutation of the dense
     # scheduling state (free/nt_free) MUST bump this, or the cache serves

@@ -34,7 +34,14 @@ Sites threaded through the control plane:
 - ``server.compact`` — the journal compaction phases (match on ``event``:
   ``mid-snapshot-write`` / ``pre-rename`` / ``post-rename`` / ``mid-gc`` /
   ``pre-swap`` / ``post-swap``), so kill -9 can land inside every window
-  of the snapshot+GC crash matrix (docs/fault_tolerance.md).
+  of the snapshot+GC crash matrix (docs/fault_tolerance.md);
+- ``autoalloc.submit`` — one queue-manager submit attempt (raise = the
+  submit fails, kill = server death mid-submit);
+- ``autoalloc.spawn`` — the local allocation handler's worker spawn,
+  consulted via :func:`decide` with caller-defined action semantics
+  (autoalloc/handlers.py LocalHandler: ``drop`` = allocation stuck queued,
+  ``hang`` = allocation runs but the worker never registers,
+  ``raise`` = the worker boots, registers, then dies).
 
 Faults are injected at the MESSAGE level, not the raw frame level: the
 encrypted transport seals frames with counter nonces (transport/auth.py),
@@ -177,6 +184,24 @@ def fire(site: str, op=None, event=None) -> None:
             "chaos: action %r is not applicable at sync site %s; ignored",
             rule.action, site,
         )
+
+
+def decide(site: str, op=None, event=None) -> str | None:
+    """Matching injection point whose ACTION the caller interprets.
+
+    For sites where drop/dup/hang model domain behavior rather than a
+    message-plane fault (e.g. the local allocation handler's spawn step).
+    kill is still applied inline — "die here" means the same everywhere;
+    every other action name is returned for the caller to map onto its own
+    failure mode."""
+    if _PLAN is None:
+        return None
+    rule = _PLAN.match(site, op=op, event=event)
+    if rule is None:
+        return None
+    if rule.action == "kill":
+        _kill_self()
+    return rule.action
 
 
 async def on_message(site: str, op=None) -> str | None:

@@ -65,6 +65,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "autoalloc: self-healing elasticity tests (backlog-driven "
+        "autoscaling, graceful drain, crash-loop quarantine, "
+        "allocation-exact restore; ISSUE 13)",
+    )
+    config.addinivalue_line(
+        "markers",
         "multichip: sharded multi-device solver tests; run on the virtual "
         "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
         "count=8, set above) so tier-1 exercises the 8-device path on "

@@ -1,0 +1,365 @@
+"""Self-healing elasticity tests (ISSUE 13).
+
+The local allocation handler spawns real worker processes, so the whole
+autoscaling loop — demand query, submit, register, drain, cancel — runs as
+a true e2e without a batch scheduler, and the FaultPlan harness can fail
+each phase deterministically (see utils/chaos.py autoalloc sites).
+
+Kept lean on purpose: the suite sits near the tier-1 time budget, so each
+e2e covers several assertions of its scenario in one server lifetime.
+"""
+
+import asyncio
+import json
+import os
+import stat
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from utils_e2e import HqEnv, wait_until
+
+pytestmark = pytest.mark.autoalloc
+
+FAST_TICK = {"HQ_AUTOALLOC_INTERVAL": "0.4"}
+
+
+@pytest.fixture
+def env(tmp_path):
+    with HqEnv(tmp_path) as e:
+        yield e
+
+
+def _allocs(env):
+    qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+    return qs[0]["allocations"]
+
+
+def _queue_state(env):
+    qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+    return qs[0]["state"]
+
+
+def _job(env, index=0):
+    out = json.loads(
+        env.command(["job", "list", "--all", "--output-mode", "json"])
+    )
+    return out[index] if len(out) > index else None
+
+
+# ----------------------------------------------------------------- units
+def test_crash_loop_quarantine_state():
+    """K consecutive fast deaths quarantine; backoff doubles per offense;
+    a slow/clean death resets the streak (state.py policy, no server)."""
+    from hyperqueue_tpu.autoalloc import state as state_mod
+    from hyperqueue_tpu.autoalloc.state import AllocationQueue, QueueParams
+
+    queue = AllocationQueue(1, QueueParams(manager="local"))
+    k = state_mod.CRASH_LOOP_K
+    for _ in range(k - 1):
+        assert not queue.on_worker_death(fast=True)
+    # a healthy (slow) death resets the streak
+    assert not queue.on_worker_death(fast=False)
+    assert queue.crash_streak == 0
+    for _ in range(k - 1):
+        assert not queue.on_worker_death(fast=True)
+    assert queue.on_worker_death(fast=True)
+    assert queue.state == "quarantined"
+    first_backoff = queue.quarantine_until - time.time()
+    assert first_backoff > 0
+    # geometric: the next offense backs off twice as long
+    queue.state = "running"
+    queue.quarantine()
+    second_backoff = queue.quarantine_until - time.time()
+    assert second_backoff > first_backoff * 1.5
+    # wire round-trip keeps the quarantine
+    queue.state = "quarantined"
+    clone = AllocationQueue.from_wire(queue.to_wire())
+    assert clone.state == "quarantined"
+    assert clone.quarantines == queue.quarantines
+    assert clone.quarantine_until == queue.quarantine_until
+    # operator resume forgets the history
+    clone.clear_quarantine()
+    assert clone.quarantines == 0
+
+
+def test_manager_timeout_kills_hung_sbatch(tmp_path, monkeypatch):
+    """A hung sbatch is killed at the hard timeout and surfaces as a
+    submit failure — never a wedged autoalloc tick loop (satellite)."""
+    from hyperqueue_tpu.autoalloc import handlers
+    from hyperqueue_tpu.autoalloc.state import QueueParams
+
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    sbatch = bin_dir / "sbatch"
+    sbatch.write_text("#!/bin/bash\nsleep 60\n")
+    sbatch.chmod(sbatch.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setattr(handlers, "MANAGER_TIMEOUT_SECS", 0.5)
+    handler = handlers.SlurmHandler("/srv", tmp_path / "work")
+    before = handlers._MANAGER_TIMEOUTS.labels().value
+    t0 = time.monotonic()
+    with pytest.raises(handlers.ManagerTimeout):
+        asyncio.run(
+            handler.submit_allocation(1, QueueParams(manager="slurm"))
+        )
+    assert time.monotonic() - t0 < 10.0  # killed, not waited out
+    assert handlers._MANAGER_TIMEOUTS.labels().value == before + 1
+
+
+def test_allocation_restore_round_trip():
+    """AutoAllocState capture/restore keeps queues, allocations, their
+    lifecycle fields and the id counter (the snapshot-table contract)."""
+    from hyperqueue_tpu.autoalloc.state import (
+        Allocation,
+        AutoAllocState,
+        QueueParams,
+    )
+
+    state = AutoAllocState()
+    queue = state.add_queue(QueueParams(manager="local", backlog=2))
+    queue.allocations["local-42"] = Allocation(
+        allocation_id="local-42", queue_id=queue.queue_id, worker_count=2,
+        status="running", started_at=123.0, workdir="/tmp/x",
+        ever_bound=True,
+    )
+    queue.allocations["local-43"] = Allocation(
+        allocation_id="local-43", queue_id=queue.queue_id, worker_count=1,
+        status="cancelled", reason="scale-down", ended_at=124.0,
+    )
+    restored = AutoAllocState()
+    restored.restore(state.capture())
+    q2 = restored.queues[queue.queue_id]
+    assert q2.params.backlog == 2
+    a42 = q2.allocations["local-42"]
+    assert (a42.status, a42.started_at, a42.ever_bound) == (
+        "running", 123.0, True
+    )
+    assert q2.allocations["local-43"].reason == "scale-down"
+    # ids continue past the restored queue
+    assert restored.add_queue(
+        QueueParams(manager="local")
+    ).queue_id == queue.queue_id + 1
+
+
+# ------------------------------------------------------------------- e2e
+def test_local_elasticity_loop(env):
+    """The tentpole loop: scale-up from demand, task completion, drain-
+    based scale-down to the floor, decision records for every verdict."""
+    env.start_server(env_extra=FAST_TICK)
+    env.command(["alloc", "add", "local", "--backlog", "2",
+                 "--idle-timeout", "1", "--no-dry-run"])
+    env.command(["submit", "--array", "1-4", "--", "sleep", "0.2"])
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=60, message="job finished via scaled-up worker")
+    # scale-down: the idle worker is drained, the allocation released
+    wait_until(
+        lambda: not [a for a in _allocs(env) if a["status"] in
+                     ("queued", "running")],
+        timeout=60, message="scale-down to floor",
+    )
+    decisions = json.loads(
+        env.command(["alloc", "events", "--output-mode", "json"])
+    )
+    verdicts = {d["verdict"] for d in decisions}
+    assert "scale-up" in verdicts and "scale-down" in verdicts
+    up = next(d for d in decisions if d["verdict"] == "scale-up")
+    assert "demand" in up["detail"]
+
+
+def test_worker_stop_drain_and_escalation(env):
+    """Manual graceful drain: the running task finishes (exactly one
+    start) before the worker stops; with a short --drain-timeout the
+    drain escalates to a clean stop and the task requeues with no crash
+    charge (zero task loss either way)."""
+    marker = env.work_dir / "starts.txt"
+    env.start_server()
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--", "bash", "-c",
+                 f'echo "s:$HQ_INSTANCE_ID" >> {marker}; sleep 2'])
+    wait_until(lambda: (_job(env) or {})["counters"]["running"] >= 1,
+               timeout=30, message="task running")
+    env.command(["worker", "stop", "1", "--drain"])
+    # on a loaded box the task may finish (and the worker stop) before
+    # this list lands; while the worker IS listed it must show draining
+    workers = json.loads(
+        env.command(["worker", "list", "--output-mode", "json"])
+    )
+    assert all(w["status"] == "draining" for w in workers)
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=30, message="drained task finished")
+    wait_until(
+        lambda: not json.loads(
+            env.command(["worker", "list", "--output-mode", "json"])
+        ),
+        timeout=20, message="worker stopped after drain",
+    )
+    assert marker.read_text().splitlines() == ["s:0"]
+
+    # escalation: deadline shorter than the task
+    env.start_worker(cpus=2)
+    env.wait_workers(1)
+    env.command(["submit", "--", "bash", "-c",
+                 f'echo "e:$HQ_INSTANCE_ID" >> {marker}; sleep 30'])
+    wait_until(lambda: _job(env, 1)["counters"]["running"] >= 1,
+               timeout=30, message="second task running")
+    env.command(["worker", "stop", "2", "--drain", "--drain-timeout", "1"])
+    wait_until(
+        lambda: not json.loads(
+            env.command(["worker", "list", "--output-mode", "json"])
+        ),
+        timeout=30, message="escalated stop",
+    )
+    env.start_worker(cpus=2)
+    wait_until(lambda: _job(env, 1)["counters"]["running"] >= 1,
+               timeout=30, message="task rerunning after escalation")
+    # restarted once (new instance), never failed: no crash charge
+    lines = [l for l in marker.read_text().splitlines()
+             if l.startswith("e:")]
+    assert len(lines) == 2 and lines[0] != lines[1], lines
+    assert _job(env, 1)["counters"]["failed"] == 0
+
+
+@pytest.mark.chaos
+def test_zombie_allocation_reaped(env):
+    """An allocation that runs but never registers a worker is cancelled
+    at the zombie timeout, and the pool converges afterwards."""
+    plan = json.dumps({"rules": [
+        {"site": "autoalloc.spawn", "action": "hang", "at": 1},
+    ]})
+    env.start_server(env_extra={
+        **FAST_TICK,
+        "HQ_AUTOALLOC_ZOMBIE_TIMEOUT": "3",
+        "HQ_FAULT_PLAN": plan,
+    })
+    env.command(["alloc", "add", "local", "--backlog", "1",
+                 "--idle-timeout", "2", "--no-dry-run"])
+    env.command(["submit", "--array", "1-2", "--", "true"])
+    wait_until(
+        lambda: any(a["status"] == "failed" and a.get("reason") == "zombie"
+                    for a in _allocs(env)),
+        timeout=40, message="zombie reaped",
+    )
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=60, message="job finished after reap")
+
+
+@pytest.mark.chaos
+def test_crash_loop_quarantine_and_release(env):
+    """Three boot-crashing workers quarantine the queue (geometric
+    backoff); the release re-enables submits and the healthy fourth
+    allocation finishes the job — with the whole story in the decision
+    records."""
+    plan = json.dumps({"rules": [
+        {"site": "autoalloc.spawn", "action": "raise", "times": 3},
+    ]})
+    env.start_server(env_extra={
+        **FAST_TICK,
+        "HQ_AUTOALLOC_CRASH_LOOP_K": "3",
+        "HQ_AUTOALLOC_CRASH_LOOP_WINDOW": "10",
+        "HQ_AUTOALLOC_QUARANTINE_BASE": "2",
+        "HQ_FAULT_PLAN": plan,
+    })
+    env.command(["alloc", "add", "local", "--backlog", "1",
+                 "--idle-timeout", "30", "--no-dry-run"])
+    env.command(["submit", "--array", "1-2", "--", "sleep", "2"])
+    wait_until(lambda: _queue_state(env) == "quarantined",
+               timeout=60, message="queue quarantined")
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=90, message="converged after release")
+    decisions = json.loads(
+        env.command(["alloc", "events", "--output-mode", "json"])
+    )
+    verdicts = [d["verdict"] for d in decisions]
+    assert "quarantined" in verdicts
+    assert "quarantine-released" in verdicts
+    # quarantine count survives into the queue record (backoff doubles on
+    # the next offense)
+    qs = json.loads(env.command(["alloc", "list", "--output-mode", "json"]))
+    assert qs[0]["quarantines"] == 1
+
+
+@pytest.mark.chaos
+def test_kill9_at_alloc_queued_restore_reconciles(env, tmp_path):
+    """kill -9 right after the alloc-queued journal record: restore
+    rebuilds the allocation table, the already-spawned worker reconnects
+    into the SAME allocation, no second submit happens, and scale-down
+    still converges afterwards."""
+    journal = tmp_path / "journal.bin"
+    plan = json.dumps({"rules": [
+        {"site": "server.event", "event": "alloc-queued", "at": 1,
+         "action": "kill"},
+    ]})
+    env.start_server("--journal", str(journal),
+                     env_extra={**FAST_TICK, "HQ_FAULT_PLAN": plan})
+    env.command(["alloc", "add", "local", "--backlog", "1",
+                 "--idle-timeout", "3", "--on-server-lost", "reconnect",
+                 "--no-dry-run"])
+    env.command(["submit", "--array", "1-2", "--", "sleep", "1"])
+    wait_until(lambda: env.processes[0][1].poll() is not None,
+               timeout=30, message="server killed at alloc-queued")
+    env.start_server("--journal", str(journal), env_extra=FAST_TICK)
+    env.command(["server", "wait", "--timeout", "20"])
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=60, message="job finished after restore")
+    allocs = _allocs(env)
+    assert len(allocs) == 1, f"double submit or lost allocation: {allocs}"
+    # exactly one allocation workdir ever created across both lives
+    workdirs = list(
+        (env.server_dir).glob("*/autoalloc/queue-1/1/*")
+    )
+    assert len(workdirs) == 1, workdirs
+    wait_until(
+        lambda: not [a for a in _allocs(env) if a["status"] in
+                     ("queued", "running")],
+        timeout=60, message="post-restore scale-down",
+    )
+
+
+@pytest.mark.chaos
+def test_kill9_in_adoption_window(env, tmp_path):
+    """kill -9 BETWEEN the spawn and its alloc-queued record (the classic
+    leak window): the journaled submit-attempt + the script's pidfile let
+    restore adopt the orphan — one allocation, one spawn, no leak."""
+    journal = tmp_path / "journal.bin"
+    plan = json.dumps({"rules": [
+        {"site": "autoalloc.post-spawn", "at": 1, "action": "kill"},
+    ]})
+    env.start_server("--journal", str(journal),
+                     env_extra={**FAST_TICK, "HQ_FAULT_PLAN": plan})
+    env.command(["alloc", "add", "local", "--backlog", "1",
+                 "--idle-timeout", "3", "--on-server-lost", "reconnect",
+                 "--no-dry-run"])
+    env.command(["submit", "--array", "1-2", "--", "sleep", "1"])
+    wait_until(lambda: env.processes[0][1].poll() is not None,
+               timeout=30, message="server killed post-spawn")
+    env.start_server("--journal", str(journal), env_extra=FAST_TICK)
+    env.command(["server", "wait", "--timeout", "20"])
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=60, message="job finished after adoption")
+    allocs = _allocs(env)
+    workdirs = list((env.server_dir).glob("*/autoalloc/queue-1/1/*"))
+    assert len(allocs) == 1 and len(workdirs) == 1, (allocs, workdirs)
+    assert "adopted orphan local allocation" in env.read_log("server1")
+
+
+@pytest.mark.chaos
+def test_submit_failure_backoff_with_chaos(env):
+    """An injected first-submit failure backs off and the queue still
+    converges on the retry — the --elasticity-smoke FaultPlan contract."""
+    plan = json.dumps({"rules": [
+        {"site": "autoalloc.submit", "action": "raise", "at": 1},
+    ]})
+    env.start_server(env_extra={**FAST_TICK, "HQ_FAULT_PLAN": plan})
+    env.command(["alloc", "add", "local", "--backlog", "1",
+                 "--idle-timeout", "2", "--no-dry-run"])
+    env.command(["submit", "--array", "1-2", "--", "true"])
+    wait_until(lambda: (_job(env) or {}).get("status") == "finished",
+               timeout=60, message="converged despite submit failure")
+    decisions = json.loads(
+        env.command(["alloc", "events", "--output-mode", "json"])
+    )
+    assert any(d["verdict"] == "scale-up-failed" for d in decisions)
