@@ -2265,6 +2265,132 @@ def run_saturation_smoke(args) -> None:
     sys.exit(1 if failures else 0)
 
 
+
+def run_sim_smoke(args) -> None:
+    """Deterministic-simulator gate (ISSUE 14).
+
+    Three parts, all on the virtual clock in THIS process (no spawns):
+    a determinism pair (same seed twice -> bit-identical decision-record
+    and journal digests), a scenario sweep over the synthetic workload
+    shapes under seeded fault schedules, and the acceptance-scale soak —
+    >= 100k virtual tasks on >= 1k simulated workers with a server
+    kill -9 + restore and worker churn in the schedule, required to
+    quiesce with every invariant green inside the 5-wall-minute budget.
+    Records virtual-tasks-per-wall-second and per-scenario rows."""
+    import os
+    from pathlib import Path as _Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(_Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+
+    from hyperqueue_tpu.sim import FaultEvent, FaultSchedule, build
+    from hyperqueue_tpu.sim.harness import run_scenario
+
+    failures = []
+    t_wall = time.perf_counter()
+
+    # --- determinism pair -------------------------------------------
+    def det_run():
+        wl = build("bursty", seed=42, n_tenants=3, bursts_per_tenant=2,
+                   tasks_per_burst=80, window=25)
+        faults = FaultSchedule(seed=42, events=[
+            FaultEvent(at=5.0, kind="server_kill", delay=1.0),
+            FaultEvent(at=11.0, kind="worker_kill", target="w3", delay=1.0),
+        ])
+        return run_scenario(wl, seed=42, n_workers=12, faults=faults)
+
+    d1, d2 = det_run(), det_run()
+    det_ok = (d1.decision_digest == d2.decision_digest
+              and d1.journal_digest == d2.journal_digest)
+    if not det_ok:
+        failures.append("same-seed runs diverged (decision/journal digest)")
+
+    # --- scenario sweep ---------------------------------------------
+    scenarios = []
+    for name, kwargs, workers in (
+        ("dag", dict(layers=8, width=16), 8),
+        ("gang", dict(n_gangs=6, gang_size=3, filler_tasks=300), 12),
+        ("tail", dict(n_tasks=800), 12),
+    ):
+        wl = build(name, seed=7, **kwargs)
+        names = [f"w{i}" for i in range(workers)]
+        faults = FaultSchedule.generate(
+            7, horizon=40.0, worker_names=names, rate=0.03, server_kills=1,
+        )
+        try:
+            res = run_scenario(wl, seed=7, n_workers=workers, faults=faults)
+            scenarios.append({
+                "workload": res.workload, "n_tasks": res.n_tasks,
+                "makespan_virtual_s": round(res.makespan, 2),
+                "wall_s": round(res.wall_s, 2),
+                "server_boots": res.server_boots,
+                "finished": res.audit["finished"],
+            })
+            if res.audit["finished"] != wl.n_tasks:
+                failures.append(f"{name}: lost tasks")
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+
+    # --- acceptance soak: 100k tasks / 1k workers / kill -9 + churn --
+    n_tasks = args.sim_tasks
+    n_workers = args.sim_workers
+    wl = build("uniform", seed=1, n_tasks=n_tasks, dur_ms=20_000)
+    events = [FaultEvent(at=30.0, kind="server_kill", delay=2.0)]
+    for i, t in ((1, 12.0), (7, 18.0), (13, 44.0), (200, 51.0),
+                 (400, 60.0), (650, 70.0)):
+        events.append(FaultEvent(
+            at=t, kind="worker_kill", target=f"w{i % n_workers}", delay=2.0,
+        ))
+    soak_row = {}
+    try:
+        res = run_scenario(
+            wl, seed=1, n_workers=n_workers, worker_cpus=4,
+            faults=FaultSchedule(seed=1, events=events),
+            horizon=4 * 3600.0, schedule_min_delay=0.5,
+        )
+        soak_row = {
+            "n_tasks": res.n_tasks, "n_workers": n_workers,
+            "makespan_virtual_s": round(res.makespan, 1),
+            "wall_s": round(res.wall_s, 1),
+            "virtual_tasks_per_wall_s": round(
+                res.virtual_tasks_per_wall_s, 1
+            ),
+            "server_boots": res.server_boots,
+            "executions": res.audit["executions"],
+            "finished": res.audit["finished"],
+        }
+        if res.audit["finished"] != n_tasks:
+            failures.append("soak lost tasks")
+        if res.server_boots < 2:
+            failures.append("soak never exercised kill -9 + restore")
+        if res.wall_s > 300.0:
+            failures.append(
+                f"soak took {res.wall_s:.0f}s wall (> 300s budget)"
+            )
+    except Exception as e:  # noqa: BLE001 - recorded as a failure
+        failures.append(f"soak: {type(e).__name__}: {e}")
+
+    emit({
+        "experiment": "sim_smoke",
+        "metric": "virtual_tasks_per_wall_s",
+        "value": soak_row.get("virtual_tasks_per_wall_s", 0.0),
+        "unit": "tasks/s",
+        "params": {
+            "tasks": n_tasks, "workers": n_workers,
+            "fault_schedule": "kill9+churn", "wall_budget_s": 300,
+        },
+        "determinism_ok": det_ok,
+        "soak": soak_row,
+        "scenarios": scenarios,
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    print("sim-smoke:", "OK" if not failures else failures)
+    sys.exit(1 if failures else 0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
@@ -2342,6 +2468,15 @@ def main() -> None:
                              "standby, SIGKILL shard 1 mid-job, measure "
                              "kill -> first successor-side completion, "
                              "assert the bound + exactly-once starts")
+    parser.add_argument("--sim-smoke", action="store_true",
+                        help="deterministic-simulator gate: determinism "
+                             "pair, scenario sweep, and the 100k-task/"
+                             "1k-worker kill -9 + churn soak on the "
+                             "virtual clock (ISSUE 14)")
+    parser.add_argument("--sim-tasks", type=int, default=100_000,
+                        help="soak task count for --sim-smoke")
+    parser.add_argument("--sim-workers", type=int, default=1000,
+                        help="soak worker count for --sim-smoke")
     parser.add_argument("--restore-smoke", action="store_true",
                         help="bounded-restore gate: restore under 2 s from "
                              "a snapshot after --tasks (default 1M) "
@@ -2397,6 +2532,10 @@ def main() -> None:
 
     if args.restore_smoke:
         run_restore_smoke(args)
+        return
+
+    if args.sim_smoke:
+        run_sim_smoke(args)
         return
 
     if args.multichip_smoke:

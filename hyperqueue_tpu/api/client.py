@@ -12,6 +12,7 @@ import os
 import sys
 import time
 from pathlib import Path
+from hyperqueue_tpu.utils import clock
 
 
 class FailedJobsException(Exception):
@@ -272,8 +273,8 @@ class LocalCluster:
                 stderr=subprocess.STDOUT,
             )
         ]
-        deadline = time.time() + 30
-        while time.time() < deadline:
+        deadline = clock.now() + 30
+        while clock.now() < deadline:
             if (self._dir / "hq-current" / "access.json").exists():
                 break
             if self._procs[0].poll() is not None:

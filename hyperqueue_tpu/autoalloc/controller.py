@@ -32,6 +32,7 @@ import time
 from collections import deque
 
 from hyperqueue_tpu.utils.metrics import REGISTRY
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.autoalloc")
 
@@ -91,7 +92,7 @@ class ElasticityController:
     def record(self, queue_id: int, verdict: str, reason: str,
                detail: str = "") -> None:
         """Append one scale verdict; consecutive repeats collapse."""
-        now = time.time()
+        now = clock.now()
         if self.decisions:
             last = self.decisions[-1]
             if (
@@ -113,7 +114,7 @@ class ElasticityController:
         """One tick's worth of the same signals the subscribe plane
         streams: backlog, its slope, and insufficient-capacity counts."""
         core = self.server.core
-        now = time.monotonic()
+        now = clock.monotonic()
         ready = core.queues.total_ready() + len(core.mn_queue)
         self._backlog.append((now, ready))
         slope = 0.0
@@ -152,7 +153,7 @@ class ElasticityController:
 
     def idle_for(self, worker_id: int) -> float:
         stamp = self._idle_since.get(worker_id)
-        return 0.0 if stamp is None else time.monotonic() - stamp
+        return 0.0 if stamp is None else clock.monotonic() - stamp
 
     # --- per-tick policy -------------------------------------------------
     def tick(self, signals: dict) -> None:
@@ -229,7 +230,7 @@ class ElasticityController:
                 )
 
     def _reap_zombies(self, queue) -> None:
-        now = time.time()
+        now = clock.now()
         for alloc in queue.active_allocations():
             if (
                 alloc.status == "running"

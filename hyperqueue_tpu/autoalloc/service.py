@@ -37,6 +37,7 @@ from hyperqueue_tpu.autoalloc.state import (
 from hyperqueue_tpu.resources.worker_resources import WorkerResources
 from hyperqueue_tpu.utils import chaos
 from hyperqueue_tpu.worker.hwdetect import detect_resources
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.autoalloc")
 
@@ -179,7 +180,7 @@ class AutoAllocService:
                     queue_id=queue.queue_id,
                     worker_count=queue.params.workers_per_alloc,
                     status="running",
-                    started_at=time.time(),
+                    started_at=clock.now(),
                     workdir=workdir,
                 )
                 logger.warning(
@@ -258,7 +259,7 @@ class AutoAllocService:
 
     def _transition(self, queue, alloc: Allocation, status: str) -> None:
         alloc.status = status
-        now = time.time()
+        now = clock.now()
         if status == "running" and not alloc.started_at:
             alloc.started_at = now
             self.emit(
@@ -511,7 +512,7 @@ class AutoAllocService:
                     "submits disabled while the queue is "
                     f"{queue.state}",
                 )
-            elif queue.next_submit_at > time.time():
+            elif queue.next_submit_at > clock.now():
                 self.controller.record(
                     queue.queue_id, "hold", "submit-backoff",
                     f"{queue.consecutive_failures} consecutive submit "
@@ -546,7 +547,7 @@ class AutoAllocService:
             # running allocation's missing workers are presumed dead and
             # must not suppress scale-up for the allocation's lifetime.
             workers = self.server.core.workers
-            now = time.time()
+            now = clock.now()
             queued = queue.queued_allocations()
             for alloc in queue.active_allocations():
                 if alloc.status == "running" and (
@@ -677,14 +678,14 @@ class AutoAllocService:
             return
         alloc.connected_workers.add(worker_id)
         self._worker_alloc[worker_id] = (
-            queue.queue_id, alloc_id, time.monotonic()
+            queue.queue_id, alloc_id, clock.monotonic()
         )
         if not alloc.ever_bound:
             alloc.ever_bound = True
             # scale-up latency: submit accepted -> first usable capacity
             if alloc.queued_at:
                 SCALE_UP_SECONDS.observe(
-                    max(time.time() - alloc.queued_at, 0.0)
+                    max(clock.now() - alloc.queued_at, 0.0)
                 )
             self.emit(
                 "alloc-worker-bound",
@@ -714,12 +715,12 @@ class AutoAllocService:
         alloc = queue.allocations.get(alloc_id)
         if alloc is not None:
             alloc.connected_workers.discard(worker_id)
-        lifetime = time.monotonic() - registered_at
+        lifetime = clock.monotonic() - registered_at
         clean = reason == "stopped" or reason.startswith("lent")
         fast = not clean and lifetime < CRASH_LOOP_WINDOW_SECS
         if queue.on_worker_death(fast):
             QUARANTINES_TOTAL.inc()
-            backoff = queue.quarantine_until - time.time()
+            backoff = queue.quarantine_until - clock.now()
             logger.warning(
                 "queue %d quarantined: workers keep dying within %.0fs of "
                 "registration (%.0fs backoff, offense #%d)",

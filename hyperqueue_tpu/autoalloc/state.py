@@ -16,10 +16,10 @@ and snapshots like every other durable table.
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass, field
 
 from hyperqueue_tpu.ids import IdCounter
+from hyperqueue_tpu.utils import clock
 
 MAX_SUBMIT_FAILS_BEFORE_PAUSE = 3
 BACKOFF_BASE_SECS = 2.0
@@ -73,7 +73,7 @@ class Allocation:
     queue_id: int
     worker_count: int
     status: str = "queued"      # queued | running | finished | failed | cancelled
-    queued_at: float = field(default_factory=time.time)
+    queued_at: float = field(default_factory=clock.now)
     started_at: float = 0.0
     ended_at: float = 0.0
     connected_workers: set[int] = field(default_factory=set)
@@ -157,7 +157,7 @@ class AllocationQueue:
             BACKOFF_BASE_SECS * (2 ** (self.consecutive_failures - 1)),
             BACKOFF_MAX_SECS,
         )
-        self.next_submit_at = time.time() + backoff
+        self.next_submit_at = clock.now() + backoff
         return self.consecutive_failures >= MAX_SUBMIT_FAILS_BEFORE_PAUSE
 
     # --- crash-loop quarantine ------------------------------------------
@@ -181,7 +181,7 @@ class AllocationQueue:
             QUARANTINE_BASE_SECS * (2 ** (self.quarantines - 1)),
             QUARANTINE_MAX_SECS,
         )
-        self.quarantine_until = time.time() + backoff
+        self.quarantine_until = clock.now() + backoff
         self.state = "quarantined"
         self.crash_streak = 0
         return backoff
@@ -189,7 +189,7 @@ class AllocationQueue:
     def maybe_release_quarantine(self) -> bool:
         """Release an expired quarantine (keeps `quarantines` so a repeat
         offender backs off twice as long next time)."""
-        if self.state == "quarantined" and time.time() >= self.quarantine_until:
+        if self.state == "quarantined" and clock.now() >= self.quarantine_until:
             self.state = "running"
             self.quarantine_until = 0.0
             return True
@@ -202,7 +202,7 @@ class AllocationQueue:
         self.crash_streak = 0
 
     def can_submit_now(self) -> bool:
-        return self.state == "running" and time.time() >= self.next_submit_at
+        return self.state == "running" and clock.now() >= self.next_submit_at
 
     def to_wire(self) -> dict:
         return {

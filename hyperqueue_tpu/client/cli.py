@@ -26,6 +26,7 @@ from hyperqueue_tpu.client.output import fail, make_output
 from hyperqueue_tpu.resources.amount import amount_from_str
 from hyperqueue_tpu.utils import serverdir
 from hyperqueue_tpu.utils.placeholders import fill_placeholders
+from hyperqueue_tpu.utils import clock
 
 
 def _add_common(parser: argparse.ArgumentParser) -> None:
@@ -795,7 +796,7 @@ def cmd_worker_address(args) -> None:
 
 def cmd_worker_wait(args) -> None:
     """Block until N workers are connected (reference `hq worker wait`)."""
-    deadline = time.time() + args.timeout
+    deadline = clock.now() + args.timeout
     with _session(args) as session:
         while True:
             workers = session.request({"op": "worker_list"})["workers"]
@@ -804,7 +805,7 @@ def cmd_worker_wait(args) -> None:
                     f"{len(workers)} worker(s) connected"
                 )
                 return
-            if time.time() > deadline:
+            if clock.now() > deadline:
                 fail(
                     f"timed out: {len(workers)}/{args.count} workers connected"
                 )
@@ -813,7 +814,7 @@ def cmd_worker_wait(args) -> None:
 
 def cmd_server_wait(args) -> None:
     """Block until a server is reachable in the server dir."""
-    deadline = time.time() + args.timeout
+    deadline = clock.now() + args.timeout
     while True:
         try:
             # retry_window=0: this loop IS the retry policy
@@ -822,7 +823,7 @@ def cmd_server_wait(args) -> None:
             make_output(args.output_mode).message("server is running")
             return
         except (FileNotFoundError, ClientError, ConnectionError, OSError):
-            if time.time() > deadline:
+            if clock.now() > deadline:
                 fail("timed out waiting for the server")
             time.sleep(0.25)
 
@@ -1325,7 +1326,7 @@ def cmd_submit(args) -> None:
         else:
             response = session.request(attach_trace(
                 {"op": "submit", "job": job_desc},
-                new_trace_id(), sent_at=time.time(),
+                new_trace_id(), sent_at=clock.now(),
             ))
         job_id = response["job_id"]
         if notify_runner is not None:
@@ -1447,7 +1448,7 @@ def cmd_job_info(args) -> None:
 def cmd_job_wait(args) -> None:
     with _session(args) as session:
         ids = _resolve_job_selector(session, args.selector)
-        t0 = time.time()
+        t0 = clock.now()
         jobs = session.request({"op": "job_wait", "job_ids": ids})["jobs"]
     out = make_output(args.output_mode)
     bad = [
@@ -1455,7 +1456,7 @@ def cmd_job_wait(args) -> None:
         if j["counters"]["failed"] or j["counters"]["canceled"]
     ]
     out.message(
-        f"waited {time.time() - t0:.1f}s; "
+        f"waited {clock.now() - t0:.1f}s; "
         f"{len(jobs) - len(bad)} succeeded, {len(bad)} with failures"
     )
     if bad:
@@ -3052,7 +3053,7 @@ def cmd_job_submit_file(args) -> None:
         else:
             response = session.request(attach_trace(
                 {"op": "submit", "job": job_desc},
-                new_trace_id(), sent_at=time.time(),
+                new_trace_id(), sent_at=clock.now(),
             ))
         job_id = response["job_id"]
         out = make_output(args.output_mode)

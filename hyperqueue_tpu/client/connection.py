@@ -31,6 +31,7 @@ from hyperqueue_tpu.transport.auth import (
 )
 from hyperqueue_tpu.utils import serverdir
 from hyperqueue_tpu.utils.retry import jittered_backoff
+from hyperqueue_tpu.utils import clock
 
 def _env_retry_secs() -> float:
     raw = os.environ.get("HQ_CLIENT_RETRY_SECS", "15")
@@ -126,14 +127,14 @@ class ClientSession:
             raise
 
     def _retries_exhausted(self, deadline: float) -> bool:
-        return self.retry_window <= 0 or time.monotonic() >= deadline
+        return self.retry_window <= 0 or clock.monotonic() >= deadline
 
     async def _connect_with_retry(self, deadline: float | None = None):
         # `deadline` lets request() span ONE retry window across its
         # send/reconnect cycles instead of granting each reconnect a fresh
         # window (which would stack to a multiple of HQ_CLIENT_RETRY_SECS)
         if deadline is None:
-            deadline = time.monotonic() + self.retry_window
+            deadline = clock.monotonic() + self.retry_window
         delay = _BACKOFF_BASE
         while True:
             try:
@@ -155,7 +156,7 @@ class ClientSession:
                     raise
             sleep_for, delay = jittered_backoff(
                 delay, _BACKOFF_CAP, self._rng,
-                remaining=deadline - time.monotonic(),
+                remaining=deadline - clock.monotonic(),
             )
             await asyncio.sleep(sleep_for)
 
@@ -164,7 +165,7 @@ class ClientSession:
             await self._conn.send(msg)
             return await self._conn.recv()
 
-        deadline = time.monotonic() + self.retry_window
+        deadline = clock.monotonic() + self.retry_window
         while True:
             coro = asyncio.wait_for(go(), timeout) if timeout else go()
             try:
@@ -521,7 +522,7 @@ class SubmitStream:
         replay already re-sends the failed frame, so retrying the send
         itself would put a duplicate on the wire whose extra ack desyncs
         the session's request/response protocol.)"""
-        deadline = time.monotonic() + self.session.retry_window
+        deadline = clock.monotonic() + self.session.retry_window
         while True:
             try:
                 return self.session._loop.run_until_complete(op())
@@ -563,7 +564,7 @@ class SubmitStream:
             # it exactly once on the new connection — do NOT also retry
             # the send (the extra duplicate would earn an extra ack that
             # finish() never drains, desyncing the session)
-            self._recover(time.monotonic() + self.session.retry_window)
+            self._recover(clock.monotonic() + self.session.retry_window)
 
     # --- public API -------------------------------------------------------
     def send_chunk(self, array: dict | None = None,
@@ -590,7 +591,7 @@ class SubmitStream:
         if last:
             frame["last"] = True
             self._sealed = True
-        attach_trace(frame, new_trace_id(), sent_at=time.time())
+        attach_trace(frame, new_trace_id(), sent_at=clock.now())
         self._next_index += 1
         self._send_frame(frame)
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 
 from hyperqueue_tpu.client.dashboard_data import DashboardData
+from hyperqueue_tpu.utils import clock
 
 SCREENS = ("cluster", "jobs", "autoalloc")
 
@@ -340,7 +341,7 @@ def _curses_loop(stdscr, data: DashboardData, lock, mode: str,
                         view_cache = (ui["now"], data.at(ui["now"]))
                     view = view_cache[1]
             else:
-                ui["now"] = data.last_time or time.time()
+                ui["now"] = data.last_time or clock.now()
                 view = data
             # clamp selection to the current screen's list
             if ui["screen"] == "jobs":

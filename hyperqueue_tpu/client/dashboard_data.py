@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
+from hyperqueue_tpu.utils import clock
 
 OVERVIEW_HISTORY = 512  # per-worker (t, cpu%) samples kept for the chart
 
@@ -393,9 +394,7 @@ def seed_from_server(data: DashboardData, session) -> None:
     job details, allocation queues) establishes current state and the live
     stream keeps it moving (the reference seeds the same way through its
     initial overview fetch, dashboard/data/fetch.rs)."""
-    import time as _time
-
-    now = _time.time()
+    now = clock.now()
     for w in session.request({"op": "worker_list"})["workers"]:
         ws = WorkerState(
             worker_id=w["id"],

@@ -240,6 +240,34 @@ class Journal:
             self._file.close()
             self._file = None
 
+    def kill(self) -> None:
+        """Abandon the appender with kill -9 semantics: whatever the flush
+        policy already pushed to the OS stays on disk, everything still in
+        the user-space buffer (an open group-commit batch, bytes the
+        BufferedWriter holds) is LOST — exactly what a SIGKILL of the
+        process would leave behind.  The deterministic simulator uses this
+        to model server death without ending the test process.
+
+        The buffered file object cannot simply be dropped (Python flushes
+        on finalize, which would resurrect the "lost" tail — possibly
+        AFTER a restored appender wrote past it) nor os.close()d (the fd
+        number could be reused before the finalizer runs and the flush
+        would land in an unrelated file).  Redirecting the fd to /dev/null
+        makes the eventual flush+close harmless and exact."""
+        if self._file is None:
+            return
+        self._batch = None
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        try:
+            os.dup2(devnull, self._file.fileno())
+        finally:
+            os.close(devnull)
+        try:
+            self._file.close()  # flushes the doomed buffer into /dev/null
+        except OSError:
+            pass
+        self._file = None
+
     @staticmethod
     def read_all(path: Path, salvage: bool = False):
         """Yield records, silently stopping at a torn tail (reference

@@ -31,6 +31,7 @@ from hyperqueue_tpu.server.protocol import (
 )
 from hyperqueue_tpu.server.task import Task
 from hyperqueue_tpu.utils.metrics import REGISTRY
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.restore")
 
@@ -729,7 +730,7 @@ def restore_from_journal(server) -> None:
     resubmitted = 0
     held = 0
     reattach_window = getattr(server, "reattach_timeout", 0.0)
-    reattach_deadline = time.monotonic() + reattach_window
+    reattach_deadline = clock.monotonic() + reattach_window
     for job_id, descs in acc.job_descs.items():
         job = server.jobs.jobs.get(job_id)
         if job is None:

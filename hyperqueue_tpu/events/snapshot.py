@@ -42,6 +42,7 @@ import msgpack
 from hyperqueue_tpu.events.journal import fsync_dir
 from hyperqueue_tpu.ids import make_task_id, task_id_task
 from hyperqueue_tpu.utils import chaos
+from hyperqueue_tpu.utils import clock
 
 MAGIC = b"hqtpusn1"
 VERSION = 1
@@ -250,7 +251,7 @@ def capture_state(server) -> dict:
     autoalloc = getattr(server, "autoalloc", None)
     return {
         "version": VERSION,
-        "time": time.time(),
+        "time": clock.now(),
         "autoalloc": autoalloc.capture() if autoalloc is not None else None,
         "traces": core.traces.snapshot_live(live_task_ids),
         # event-seq watermark: every event with seq < this is folded into
@@ -370,7 +371,7 @@ def snapshot_stats(journal_path: Path) -> dict:
         st = snap.stat()
         out.update(
             path=str(snap), bytes=st.st_size,
-            age_seconds=max(time.time() - st.st_mtime, 0.0),
+            age_seconds=max(clock.now() - st.st_mtime, 0.0),
         )
     except OSError:
         pass

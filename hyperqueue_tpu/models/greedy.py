@@ -36,6 +36,7 @@ from hyperqueue_tpu.ops.assign import (
     scarcity_weights,
 )
 from hyperqueue_tpu.utils.constants import INF_TIME
+from hyperqueue_tpu.utils import clock
 
 
 def _bucket(n: int, floor: int) -> int:
@@ -106,7 +107,7 @@ def _start_probe_locked() -> None:
             measured = float("inf")
         with _PROBE_LOCK:
             _DEVICE_SYNC_MS = measured
-            _PROBE_TS = time.monotonic()
+            _PROBE_TS = clock.monotonic()
             _PROBE_RUNNING = False
         done.set()
 
@@ -133,7 +134,7 @@ def device_sync_ms(wait_s: float = 0.0,
             max_age_s is not None
             and not _PROBE_RUNNING
             and _DEVICE_SYNC_MS is not None
-            and time.monotonic() - _PROBE_TS > max_age_s
+            and clock.monotonic() - _PROBE_TS > max_age_s
         ):
             _start_probe_locked()
         done = _PROBE_DONE

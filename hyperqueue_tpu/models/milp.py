@@ -36,6 +36,7 @@ from __future__ import annotations
 import logging
 
 import numpy as np
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger(__name__)
 
@@ -239,9 +240,7 @@ class MilpModel:
                     )
             level_rows.append(srow)
 
-        import time as _time
-
-        deadline = _time.monotonic() + self.time_limit_secs
+        deadline = clock.monotonic() + self.time_limit_secs
         integrality = np.ones(n_all)
         upper = np.array(
             var_upper + [1] * n_y, dtype=np.float64
@@ -251,7 +250,7 @@ class MilpModel:
         for li, srow in enumerate(level_rows):
             if not srow.any():
                 continue
-            budget = max(deadline - _time.monotonic(), 0.1) / (
+            budget = max(deadline - clock.monotonic(), 0.1) / (
                 len(level_rows) - li
             )
             result = milp(

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import time as _time
 from dataclasses import dataclass, field
+from hyperqueue_tpu.utils import clock
 
 
 @dataclass(slots=True)
@@ -48,7 +49,7 @@ class PendingSolve:
     # wall-clock dispatch stamp: the Perfetto export places the pipelined
     # solve's execution window by these recorded stamps instead of charging
     # it to the tick that happens to MAP it (PR 8 satellite)
-    dispatched_wall: float = field(default_factory=_time.time)
+    dispatched_wall: float = field(default_factory=clock.now)
     # (membership_epoch, queues.version, total_ready) at dispatch: the
     # reactor stamps it and, when this solve maps EMPTY and the signature
     # still matches (and no worker row moved), skips re-dispatching — an
@@ -129,7 +130,7 @@ class TickPipeline:
                 # recorded dispatch/readback wall stamps: the trace export
                 # renders the solve where it actually EXECUTED
                 "dispatched_at_wall": pending.dispatched_wall,
-                "mapped_at_wall": _time.time(),
+                "mapped_at_wall": clock.now(),
                 "objective": int(np.asarray(counts).sum()),
             }
         assignments = _map_counts(

@@ -52,6 +52,7 @@ from hyperqueue_tpu.transport.auth import (
     do_authentication,
 )
 from hyperqueue_tpu.utils import serverdir
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.server")
 
@@ -147,7 +148,7 @@ class CommSender:
         if q is not None:
             # the enqueue stamp feeds the fan-out plane's handoff-latency
             # probe (reactor enqueue -> frame on the wire)
-            q.put_nowait((time.monotonic(), message))
+            q.put_nowait((clock.monotonic(), message))
 
     # reactor.Comm protocol
     def send_compute(self, worker_id: int, tasks: list[dict]) -> None:
@@ -308,7 +309,7 @@ class EventBridge:
         if rec is None and task is None:
             return
         wt = wtrace or {}
-        now = time.time()
+        now = clock.now()
         # the reactor released resources (assigned_worker = 0) before this
         # sink fires: the worker identity lives in the earlier worker spans
         wid = task.assigned_worker if task else 0
@@ -506,6 +507,7 @@ class Server:
         lease_timeout: float = 15.0,
         promoted: bool = False,
         failover_watch: bool = False,
+        memory_transport: bool = False,
     ):
         # idle_timeout: default worker idle timeout, adopted at registration
         # by workers that set none (reference ServerStartOpts idle_timeout,
@@ -609,6 +611,22 @@ class Server:
         if client_plane not in ("thread", "reactor"):
             raise ValueError(f"unknown client plane {client_plane!r}")
         self.client_plane = client_plane
+        # in-memory transport (the deterministic simulator, sim/): no TCP
+        # listeners at all — connections are injected via accept_worker /
+        # accept_client over in-memory stream pairs.  Requires the in-loop
+        # client plane: the threaded ingest plane owns real sockets on its
+        # own thread, which is exactly what a single-threaded
+        # deterministic run must not have.
+        self.memory_transport = bool(memory_transport)
+        if self.memory_transport and client_plane != "reactor":
+            raise ValueError(
+                "memory_transport requires client_plane='reactor' "
+                "(the threaded ingest plane owns real sockets)"
+            )
+        # connection-handler tasks spawned by accept_worker/accept_client
+        # (memory transport only; TCP handlers belong to asyncio.Server).
+        # Tracked so a simulated kill -9 can cancel them abruptly.
+        self._conn_tasks: set = set()
         # journal plane (server/journal_plane.py): "thread" (default)
         # moves group commit + fsync onto a commit thread with
         # watermark-gated visibility; "reactor" keeps the inline
@@ -677,6 +695,13 @@ class Server:
             base_model = MilpModel()
         elif scheduler == "multichip":
             base_model = MultichipModel()
+        elif scheduler == "greedy-numpy":
+            # pinned host/numpy solve: no adaptive host/device selection,
+            # so the backend (and the decision records naming it) is
+            # identical run-to-run — the simulator's determinism
+            # regressions and any deployment that values reproducibility
+            # over device offload use this
+            base_model = GreedyCutScanModel(backend="numpy")
         else:
             base_model = GreedyCutScanModel()
         # --paranoid-tick also arms the device-resident solve's own
@@ -709,7 +734,7 @@ class Server:
         self._worker_conns: dict[int, Connection] = {}
         self._tasks: list[asyncio.Task] = []
         self._servers: list[asyncio.base_events.Server] = []
-        self.started_at = time.time()
+        self.started_at = clock.now()
         # Prometheus exposition endpoint (utils/metrics.py): None = off
         # (the default — recording still happens, it is just not served),
         # 0 = ephemeral port, resolved into self.metrics_port at start()
@@ -735,7 +760,12 @@ class Server:
         # journal's task graph) is frozen at the END of start().
         import gc
 
-        gc.set_threshold(100_000, 50, 25)
+        if not self.memory_transport:
+            # simulator runs boot many Server objects per process; the
+            # permanent-generation freeze at the end of start() would pin
+            # every dead incarnation's state in memory, so sim servers
+            # skip the GC tuning entirely
+            gc.set_threshold(100_000, 50, 25)
 
         if self.federation_root is not None:
             import secrets as _secrets
@@ -825,12 +855,20 @@ class Server:
             self.client_port = preshared.client_port
             self.worker_port = preshared.worker_port
 
-        worker_srv = await asyncio.start_server(
-            self._handle_worker_conn, "0.0.0.0", self.worker_port
-        )
-        self._servers = [worker_srv]
-        self.worker_port = worker_srv.sockets[0].getsockname()[1]
-        if self.client_plane == "thread":
+        if self.memory_transport:
+            # no listeners: the simulator injects connections directly
+            # (accept_worker/accept_client); port 0 marks "not reachable
+            # over TCP" in the access record
+            self._servers = []
+        else:
+            worker_srv = await asyncio.start_server(
+                self._handle_worker_conn, "0.0.0.0", self.worker_port
+            )
+            self._servers = [worker_srv]
+            self.worker_port = worker_srv.sockets[0].getsockname()[1]
+        if self.memory_transport:
+            pass
+        elif self.client_plane == "thread":
             # decoupled connection plane (server/ingest.py): client
             # sockets live on their own thread; decoded messages cross
             # into this loop through the batched handoff drained by
@@ -938,9 +976,29 @@ class Server:
         # freeze everything allocated so far (including a restored journal's
         # task graph) out of the GC generations: old-gen collections then
         # never re-traverse startup state mid-tick
-        gc.collect()
-        gc.freeze()
+        if not self.memory_transport:
+            gc.collect()
+            gc.freeze()
         return self.access
+
+    # --- memory transport (deterministic simulator) ---------------------
+    def accept_worker(self, reader, writer) -> "asyncio.Task":
+        """Inject a worker connection over an in-memory stream pair —
+        the memory-transport equivalent of a TCP accept on the worker
+        port.  Runs the REAL connection handler (auth handshake,
+        register/reattach, sender + recv loops)."""
+        return self._track_conn(self._handle_worker_conn(reader, writer))
+
+    def accept_client(self, reader, writer) -> "asyncio.Task":
+        """Inject a client connection (memory-transport equivalent of a
+        TCP accept on the client port; in-loop plane)."""
+        return self._track_conn(self._handle_client_conn(reader, writer))
+
+    def _track_conn(self, coro) -> "asyncio.Task":
+        task = asyncio.get_running_loop().create_task(coro)
+        self._conn_tasks.add(task)
+        task.add_done_callback(self._conn_tasks.discard)
+        return task
 
     async def run_until_stopped(self) -> None:
         await self._stop_event.wait()
@@ -971,6 +1029,8 @@ class Server:
         for t in self._tasks:
             t.cancel()
         for t in list(self._client_tasks):
+            t.cancel()
+        for t in list(self._conn_tasks):
             t.cancel()
         for srv in self._servers:
             srv.close()
@@ -1436,7 +1496,7 @@ class Server:
             and not self._subscribers
         ):
             return  # nobody consumes events; skip record construction
-        record = {"time": time.time(), "seq": self._event_seq,
+        record = {"time": clock.now(), "seq": self._event_seq,
                   "event": kind, **payload}
         self._event_seq += 1
         if self.jplane is not None:
@@ -1577,7 +1637,7 @@ class Server:
         submits but never schedules. Log the crash loudly, restart the loop
         up to LOOP_CRASH_RESTARTS consecutive times, then stop the
         server."""
-        started = time.time()
+        started = clock.now()
         task = asyncio.create_task(factory())
         name = getattr(factory, "__name__", repr(factory))
 
@@ -1595,7 +1655,7 @@ class Server:
                 # shutdown() is already closing
                 return
             restarts = (
-                0 if time.time() - started >= self.LOOP_HEALTHY_SECS
+                0 if clock.now() - started >= self.LOOP_HEALTHY_SECS
                 else _restarts
             )
             if restarts < self.LOOP_CRASH_RESTARTS:
@@ -1789,12 +1849,12 @@ class Server:
         poll = 5.0
         if self.journal_compact_interval > 0:
             poll = min(poll, self.journal_compact_interval)
-        last = time.monotonic()
+        last = clock.monotonic()
         while True:
             await asyncio.sleep(poll)
             due = (
                 self.journal_compact_interval > 0
-                and time.monotonic() - last >= self.journal_compact_interval
+                and clock.monotonic() - last >= self.journal_compact_interval
             )
             if not due and self.journal_compact_threshold > 0:
                 # a journal whose LIVE-work floor exceeds the threshold
@@ -1820,7 +1880,7 @@ class Server:
                 await self.compact_journal(reason="auto")
             except Exception:
                 logger.exception("journal compaction failed")
-            last = time.monotonic()
+            last = clock.monotonic()
 
     async def compact_journal(self, reason: str = "manual") -> dict:
         """One snapshot + journal-GC cycle.
@@ -1935,7 +1995,7 @@ class Server:
                 chaos.fire("server.compact", event="post-swap")
             stats = {
                 "reason": reason,
-                "time": time.time(),
+                "time": clock.now(),
                 "duration_ms": round((time.perf_counter() - t0) * 1e3, 2),
                 "watermark": watermark,
                 "gc_floor": gc_floor,
@@ -1974,7 +2034,7 @@ class Server:
             await asyncio.sleep(0.5)
             if not self.reattach_pending:
                 continue
-            now = time.monotonic()
+            now = clock.monotonic()
             expired = [
                 tid for tid, deadline in self.reattach_pending.items()
                 if deadline <= now
@@ -2010,7 +2070,7 @@ class Server:
         a crash charge (zero task loss either way)."""
         window = float(timeout) if timeout and timeout > 0 \
             else DRAIN_TIMEOUT_DEFAULT
-        now = time.monotonic()
+        now = clock.monotonic()
         started: list[int] = []
         for wid in worker_ids:
             worker = self.core.workers.get(wid)
@@ -2049,7 +2109,7 @@ class Server:
             await asyncio.sleep(0.2)
             if not self._draining:
                 continue
-            now = time.monotonic()
+            now = clock.monotonic()
             for wid, rec in list(self._draining.items()):
                 worker = self.core.workers.get(wid)
                 if worker is None:
@@ -2090,9 +2150,9 @@ class Server:
         timeout is heartbeat_secs x --heartbeat-timeout-factor (floored at
         2 s so one delayed frame never reaps a fast-heartbeat worker)."""
         while True:
-            before = time.monotonic()
+            before = clock.monotonic()
             await asyncio.sleep(0.5)
-            now = time.monotonic()
+            now = clock.monotonic()
             if now - before > 2.0:
                 # the event loop itself stalled (e.g. a solve held at the
                 # watchdog deadline): heartbeats are sitting unprocessed in
@@ -2348,14 +2408,14 @@ class Server:
             # re-pointed `fanout` lag probe (ISSUE 12): handoff latency —
             # reactor enqueue to frame-on-the-wire — not loop hold time
             # (the encode no longer holds the loop at all)
-            self.lag.observe("fanout", time.monotonic() - enq_ts)
+            self.lag.observe("fanout", clock.monotonic() - enq_ts)
             if self.stall_budget > 0 and dt >= self.stall_budget:
                 self._capture_stall("fanout", dt)
 
     async def _worker_recv_loop(self, conn: Connection, worker: Worker) -> None:
         while True:
             msg = await conn.recv()
-            worker.last_heartbeat = time.monotonic()
+            worker.last_heartbeat = clock.monotonic()
             subs = msg["msgs"] if msg.get("op") == "batch" else [msg]
             if chaos.ACTIVE:
                 # conservative path: chaos actions await between messages,
@@ -2809,7 +2869,7 @@ class Server:
         return {"op": "ok"}
 
     async def _client_submit(self, msg: dict) -> dict:
-        recv_at = time.time()
+        recv_at = clock.now()
         job_desc = msg["job"]
         job_id = job_desc.get("job_id")
         if job_id is not None and job_id in self.jobs.jobs:
@@ -2834,7 +2894,7 @@ class Server:
         trace_id = tctx.get("id") or new_trace_id()
         sent_at = float(tctx.get("sent_at") or 0.0)
         trace = {"id": trace_id, "sent_at": sent_at, "recv_at": recv_at,
-                 "commit_at": time.time()}
+                 "commit_at": clock.now()}
         array = job_desc.get("array")
         if array:
             n_new = self._ingest_array_desc(
@@ -2961,7 +3021,7 @@ class Server:
                 ids=ids,
                 entries=list(entries) if entries is not None else None,
                 submitted_at=submitted_at,
-                ready_at=time.time(),
+                ready_at=clock.now(),
                 trace=dict(trace) if trace else None,
             )
             held = job.job_id in self.core.paused_jobs
@@ -3008,7 +3068,7 @@ class Server:
         from hyperqueue_tpu.transport.framing import read_trace
         from hyperqueue_tpu.utils.trace import new_trace_id
 
-        recv_at = time.time()
+        recv_at = clock.now()
         uid = msg.get("uid")
         rid = msg.get("rid")
         if not isinstance(uid, str) or not uid:
@@ -3054,7 +3114,7 @@ class Server:
             "id": tctx.get("id") or new_trace_id(),
             "sent_at": float(tctx.get("sent_at") or 0.0),
             "recv_at": recv_at,
-            "commit_at": time.time(),
+            "commit_at": clock.now(),
         }
         desc: dict = {
             "name": job.name, "submit_dir": job.submit_dir,
@@ -3662,7 +3722,7 @@ class Server:
         carrying its task spans (lifecycle stamps), loadable in Perfetto
         (`hq server trace export out.json`)."""
         events: list[dict] = []
-        now = time.time()
+        now = clock.now()
         events.append({
             "ph": "M", "pid": 0, "tid": 0, "name": "process_name",
             "args": {"name": f"hq-server {self.host}"},
@@ -3825,11 +3885,11 @@ class Server:
             "n_running": 0,
             "resources": {},
             "overview": None,
-            "lost_at": time.time(),
+            "lost_at": clock.now(),
             "reason": reason,
             # age of the last heartbeat at loss time — for a heartbeat
             # timeout this is how long the worker was silent
-            "heartbeat_age": round(time.monotonic() - w.last_heartbeat, 3),
+            "heartbeat_age": round(clock.monotonic() - w.last_heartbeat, 3),
         }
         while len(self.past_workers) > 1000:  # bound server memory
             self.past_workers.pop(next(iter(self.past_workers)))
@@ -4061,8 +4121,8 @@ class Server:
             job_counts[status] = job_counts.get(status, 0) + 1
         return {
             "op": "sample",
-            "time": time.time(),
-            "uptime": round(time.time() - self.started_at, 1),
+            "time": clock.now(),
+            "uptime": round(clock.now() - self.started_at, 1),
             "event_seq": self._event_seq,
             "workers": workers,
             "n_workers": len(core.workers),
@@ -4116,14 +4176,14 @@ class Server:
             if sub.sample_interval:
                 await send(self._build_sample())
             next_sample = (
-                time.monotonic() + sub.sample_interval
+                clock.monotonic() + sub.sample_interval
                 if sub.sample_interval else None
             )
             eof = asyncio.ensure_future(gone.wait())
             try:
                 while not sub.dead:
                     timeout = (
-                        max(next_sample - time.monotonic(), 0.0)
+                        max(next_sample - clock.monotonic(), 0.0)
                         if next_sample is not None else None
                     )
                     getter = asyncio.ensure_future(sub.queue.get())
@@ -4151,10 +4211,10 @@ class Server:
                         getter.cancel()
                     if (
                         next_sample is not None
-                        and time.monotonic() >= next_sample
+                        and clock.monotonic() >= next_sample
                     ):
                         await send(self._build_sample())
-                        next_sample = time.monotonic() + sub.sample_interval
+                        next_sample = clock.monotonic() + sub.sample_interval
                 # fell behind: say so, then hang up
                 await send(
                     {"op": "sub_dropped", "dropped": sub.dropped}
@@ -4229,7 +4289,7 @@ class Server:
             self._capture_stall(plane, dt)
 
     def _capture_stall(self, plane: str, duration_s: float) -> None:
-        now = time.monotonic()
+        now = clock.monotonic()
         _REACTOR_STALLS.labels(plane).inc()
         self.core.flight.record_event(
             "reactor-stall",
@@ -4242,7 +4302,7 @@ class Server:
         self._last_stall_capture = now
         self.stalls_captured += 1
         dump = {
-            "time": time.time(),
+            "time": clock.now(),
             "plane": plane,
             "duration_s": round(duration_s, 4),
             "budget_s": self.stall_budget,
@@ -4302,9 +4362,9 @@ class Server:
         class was never instrumented)."""
         interval = 0.1
         while True:
-            before = time.monotonic()
+            before = clock.monotonic()
             await asyncio.sleep(interval)
-            overshoot = time.monotonic() - before - interval
+            overshoot = clock.monotonic() - before - interval
             self.note_plane("loop", max(overshoot, 0.0))
 
     async def _client_journal_flush(self, msg: dict) -> dict:

@@ -43,6 +43,7 @@ from hyperqueue_tpu.utils.lease import (
     ShardLease,
 )
 from hyperqueue_tpu.utils.metrics import REGISTRY
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.federation")
 
@@ -109,7 +110,7 @@ def plan_lending(samples: dict[int, dict | None],
     re-pick the same doomed worker every round and starve the borrower
     even though a lendable sibling idles right next to it.
     """
-    now = time.time()
+    now = clock.now()
     fresh = {
         k: s
         for k, s in samples.items()
@@ -192,7 +193,7 @@ class FederationCoordinator:
     def _control(self) -> None:
         while not self._stop.wait(self.sample_interval):
             try:
-                now = time.monotonic()
+                now = clock.monotonic()
                 self._refused = {
                     key: t for key, t in self._refused.items()
                     if now - t < self.refusal_ttl
@@ -232,7 +233,7 @@ class FederationCoordinator:
                 # a refused worker (policy/busy) must not be re-picked
                 # every pass while lendable siblings idle beside it
                 self._refused[(move["from"], move["worker_id"])] = (
-                    time.monotonic()
+                    clock.monotonic()
                 )
                 logger.info(
                     "shard %d refused to lend worker %d (%s)",

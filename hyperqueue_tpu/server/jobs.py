@@ -9,11 +9,11 @@ job ids into tako task ids (reference internal/common/ids.rs:5-60).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
 from hyperqueue_tpu.ids import IdCounter, make_task_id, task_id_task
 from hyperqueue_tpu.server.task import TaskState
+from hyperqueue_tpu.utils import clock
 
 # client-visible task status strings
 _STATUS = {
@@ -36,7 +36,7 @@ class JobTaskInfo:
     # lifecycle timeline endpoints (submitted_at defaults to creation time;
     # restore overwrites it with the journal's job-submitted time so a
     # restored timeline keeps the original clock)
-    submitted_at: float = field(default_factory=time.time)
+    submitted_at: float = field(default_factory=clock.now)
     started_at: float = 0.0
     finished_at: float = 0.0
 
@@ -49,7 +49,7 @@ class Job:
     max_fails: int | None = None
     is_open: bool = False
     cancel_reason: str = ""  # why tasks were canceled (user / max_fails)
-    submitted_at: float = field(default_factory=time.time)
+    submitted_at: float = field(default_factory=clock.now)
     # one record per submit: {"n_tasks": N, "request": wire request dict}
     # echoed in job detail (reference JobDetail.submit_descs)
     submits: list = field(default_factory=list)
@@ -221,7 +221,7 @@ class JobManager:
         # reattach after a server restart re-announces a task that never
         # stopped running, and the timeline must keep the ORIGINAL start
         # instead of restarting the clock (no duplicate spawn phase)
-        info.started_at = started_at or time.time()
+        info.started_at = started_at or clock.now()
 
     def on_task_restarted(self, job_id: int, task_id: int):
         found = self._task(job_id, task_id)
@@ -244,7 +244,7 @@ class JobManager:
             return None  # already terminal
         info.status = status
         info.error = error
-        info.finished_at = time.time()
+        info.finished_at = clock.now()
         job.counters[status] += 1
         return job
 

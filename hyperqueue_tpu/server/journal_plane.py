@@ -39,6 +39,7 @@ import time
 from collections import deque
 
 from hyperqueue_tpu.utils.metrics import REGISTRY
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.journal")
 
@@ -131,7 +132,7 @@ class JournalPlane:
                     lambda: self._durable >= target or self._dead
                 )
             self._enqueued += 1
-            self._pending.append((time.monotonic(), record))
+            self._pending.append((clock.monotonic(), record))
             self._cv.notify_all()
             return self._enqueued
 
@@ -296,7 +297,7 @@ class JournalPlane:
                 )
                 if want_sync or (batch and self.flush_each) or flush_req:
                     self.journal.flush(sync=want_sync)
-                now = time.monotonic()
+                now = clock.monotonic()
                 with self._cv:
                     self._durable = new_durable
                     if want_sync:

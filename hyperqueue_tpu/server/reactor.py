@@ -23,6 +23,7 @@ from hyperqueue_tpu.server.worker import Worker
 from hyperqueue_tpu.transport.framing import attach_trace_wire
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.trace import TRACER
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger(__name__)
 
@@ -113,7 +114,7 @@ def on_new_tasks(core: Core, comm: Comm, tasks: list[Task]) -> None:
 
 def _make_ready(core: Core, task: Task) -> None:
     task.state = TaskState.READY
-    task.t_ready = _time.time()
+    task.t_ready = clock.now()
     if core.paused_jobs:
         job_id = task_id_job(task.task_id)
         if job_id in core.paused_jobs:
@@ -357,7 +358,7 @@ def on_task_reattached(
         # restore pre-seeds t_started from the journal's task-started time;
         # a reattach must NOT restart the clock — the task kept running
         # through the outage and its timeline is one unbroken span
-        task.t_started = _time.time()
+        task.t_started = clock.now()
     worker.assign(
         task.task_id,
         core.variant_amounts(task.rq_id, task.assigned_variant, worker),
@@ -404,7 +405,7 @@ def on_task_running(
             task.prefilled = False
             task.retract_pending = False
         task.state = TaskState.RUNNING
-        task.t_started = _time.time()
+        task.t_started = clock.now()
         workers = list(task.mn_workers) or [task.assigned_worker]
         events.on_task_started(
             task_id, instance_id, workers, task.assigned_variant,
@@ -632,7 +633,7 @@ def schedule(
     _t_tick = _time.perf_counter()
     # one wall-clock stamp per tick: every task assigned this tick shares it
     # (the timeline's resolution is the tick itself)
-    now = _time.time()
+    now = clock.now()
     # DecisionRecord collection (scheduler/decision.py + utils/flight.py):
     # gang_unplaced gathers per-gang reasons during the gang phase,
     # decision_info receives the solver verdict from run_tick, and the

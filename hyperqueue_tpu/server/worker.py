@@ -8,13 +8,13 @@ the WorkerRow the tick snapshot copies out (scheduler/tick.py).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
 from hyperqueue_tpu.utils.constants import INF_TIME
 from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
 from hyperqueue_tpu.resources.map import ResourceIdMap
 from hyperqueue_tpu.resources.worker_resources import WorkerResources
+from hyperqueue_tpu.utils import clock
 
 
 @dataclass
@@ -100,7 +100,7 @@ class Worker:
     worker_id: int
     configuration: WorkerConfiguration
     resources: WorkerResources
-    started_at: float = field(default_factory=time.monotonic)
+    started_at: float = field(default_factory=clock.monotonic)
 
     # dense scheduling state (the tick snapshot reads these directly)
     free: list[int] = field(default_factory=list)
@@ -118,7 +118,7 @@ class Worker:
     # reference achieves this inside one MILP via per-group count variables
     # plus blocking variables, solver.rs:177-209,479-518).
     mn_reserved: int = 0
-    last_heartbeat: float = field(default_factory=time.monotonic)
+    last_heartbeat: float = field(default_factory=clock.monotonic)
     last_overview: dict = field(default_factory=dict)
     # gauge/counter samples piggybacked on the worker's last overview
     # message; fanned out (with a `worker` label) by the server's metrics
@@ -167,7 +167,7 @@ class Worker:
         limit = self.configuration.time_limit_secs
         if limit <= 0:
             return int(INF_TIME)
-        remaining = limit - (time.monotonic() - self.started_at)
+        remaining = limit - (clock.monotonic() - self.started_at)
         return max(int(remaining), 0)
 
     def cpu_floor(self) -> int:

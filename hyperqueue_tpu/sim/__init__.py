@@ -1,0 +1,35 @@
+"""Deterministic cluster simulation (ISSUE 14).
+
+Runs the REAL server — reactor, scheduler tick, journal + snapshot +
+restore, lazy store, autoalloc — on a virtual-clock event loop with
+simulated workers and clients over in-memory transports, under seeded
+fault schedules, with always-on invariant checking.  See
+``docs/simulation.md`` and ``python -m hyperqueue_tpu.sim --help``.
+"""
+
+from hyperqueue_tpu.sim.faults import FaultEvent, FaultSchedule
+from hyperqueue_tpu.sim.harness import (
+    SimResult,
+    Simulation,
+    bisect_failure,
+    run_scenario,
+)
+from hyperqueue_tpu.sim.invariants import InvariantViolation
+from hyperqueue_tpu.sim.loop import SimClock, SimDeadlockError, SimEventLoop
+from hyperqueue_tpu.sim.workloads import WORKLOADS, Workload, build
+
+__all__ = [
+    "FaultEvent",
+    "FaultSchedule",
+    "InvariantViolation",
+    "SimClock",
+    "SimDeadlockError",
+    "SimEventLoop",
+    "SimResult",
+    "Simulation",
+    "WORKLOADS",
+    "Workload",
+    "bisect_failure",
+    "build",
+    "run_scenario",
+]

@@ -1,0 +1,173 @@
+"""SimClient: the simulator's client, over the real client plane.
+
+Requests go through the in-loop client plane (``--client-plane reactor``)
+via an in-memory stream pair, so auth, framing, the durability-gated reply
+path and every ``_client_*`` handler run unchanged.  The client survives
+server death the way a retrying CLI does: a request that dies with the
+connection is retried against the next server incarnation.
+
+:class:`SimSubmitStream` mirrors ``client/connection.py``'s chunked
+submit contract at window 1: chunks are keyed (uid, index), the job id is
+pinned by the first ack, and after a reconnect every unacked chunk is
+replayed — the server's applied-index journaling turns the replay into
+idempotent duplicate acks.  This is what the kill -9 mid-chunked-submit
+re-enactment drives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hyperqueue_tpu.transport.auth import (
+    ROLE_CLIENT,
+    ROLE_SERVER,
+    AuthError,
+    do_authentication,
+)
+
+logger = logging.getLogger("hq.sim.client")
+
+
+class SimClientError(RuntimeError):
+    pass
+
+
+class SimClient:
+    def __init__(self, sim, name: str = "client"):
+        self.sim = sim
+        self.name = name
+        self._conn = None
+        self._link = None
+        self._lock = asyncio.Lock()
+
+    async def _ensure_conn(self):
+        if self._conn is not None and self._link is not None \
+                and self._link.alive:
+            return self._conn
+        endpoint = self.sim.connect_client(self.name)
+        self._link = endpoint.link
+        self._conn = await do_authentication(
+            endpoint.reader, endpoint.writer, ROLE_CLIENT, ROLE_SERVER, None
+        )
+        return self._conn
+
+    def drop_connection(self) -> None:
+        if self._link is not None:
+            self._link.close()
+        self._conn = None
+        self._link = None
+
+    async def request(self, msg: dict, retries: int = 50,
+                      retry_delay: float = 0.25) -> dict:
+        """One request/response exchange; a connection that dies
+        mid-exchange is retried against the (next) server.  NOT safe for
+        non-idempotent ops across a crash — chunked streams exist for
+        exactly-once submission."""
+        async with self._lock:
+            last: Exception | None = None
+            for _ in range(retries):
+                try:
+                    conn = await self._ensure_conn()
+                    await conn.send(msg)
+                    reply = await conn.recv()
+                    if reply.get("op") == "error":
+                        raise SimClientError(reply.get("message", "error"))
+                    return reply
+                except (ConnectionError, OSError, AuthError,
+                        asyncio.IncompleteReadError) as e:
+                    last = e
+                    self.drop_connection()
+                    await asyncio.sleep(retry_delay)
+            raise SimClientError(f"request failed after retries: {last}")
+
+    # --- convenience wrappers ------------------------------------------
+    async def submit(self, job_desc: dict) -> dict:
+        reply = await self.request({"op": "submit", "job": job_desc})
+        self.sim.monitor.on_submit_ack(
+            reply["job_id"], reply.get("n_tasks", 0)
+        )
+        return reply
+
+    async def job_wait(self, job_ids: list[int]) -> dict:
+        return await self.request({"op": "job_wait", "job_ids": job_ids},
+                                  retries=200)
+
+    async def job_info(self, job_ids: list[int]) -> dict:
+        return await self.request({"op": "job_info", "job_ids": job_ids})
+
+    async def job_list(self) -> dict:
+        return await self.request({"op": "job_list"})
+
+    async def worker_stop(self, worker_ids: list[int], drain: bool = False,
+                          timeout: float | None = None) -> dict:
+        msg: dict = {"op": "worker_stop", "worker_ids": worker_ids}
+        if drain:
+            msg["drain"] = True
+            if timeout is not None:
+                msg["timeout"] = timeout
+        return await self.request(msg)
+
+    def close(self) -> None:
+        self.drop_connection()
+
+
+class SimSubmitStream:
+    """Chunked exactly-once submit, window 1, with crash replay."""
+
+    def __init__(self, client: SimClient, uid: str, header: dict):
+        self.client = client
+        self.uid = uid
+        self.header = dict(header)
+        self.job_id: int | None = None
+        self.n_tasks = 0
+        self.acked: set[int] = set()
+        self._next_index = 0
+
+    async def send_chunk(self, array: dict | None = None,
+                         tasks: list | None = None,
+                         last: bool = False) -> dict:
+        index = self._next_index
+        self._next_index += 1
+        msg: dict = {"op": "submit_chunk", "uid": self.uid, "i": index,
+                     "job": dict(self.header)}
+        if self.job_id is not None:
+            msg["job"]["job_id"] = self.job_id
+        if array is not None:
+            msg["array"] = array
+        if tasks is not None:
+            msg["tasks"] = tasks
+        if last:
+            msg["last"] = True
+        reply = await self._send_until_acked(msg)
+        return reply
+
+    async def _send_until_acked(self, msg: dict) -> dict:
+        client = self.client
+        while True:
+            try:
+                async with client._lock:
+                    conn = await client._ensure_conn()
+                    # job id may have been pinned by a replayed chunk
+                    if self.job_id is not None:
+                        msg["job"]["job_id"] = self.job_id
+                    await conn.send(msg)
+                    reply = await conn.recv()
+            except (ConnectionError, OSError, AuthError,
+                    asyncio.IncompleteReadError):
+                client.drop_connection()
+                await asyncio.sleep(0.25)
+                continue  # replay the SAME (uid, index): idempotent
+            if reply.get("op") == "error":
+                raise SimClientError(reply.get("message", "chunk rejected"))
+            self.job_id = reply["job_id"]
+            index = reply["i"]
+            if index not in self.acked:
+                self.acked.add(index)
+                if not reply.get("dup"):
+                    self.n_tasks += reply.get("n_tasks", 0)
+                self.client.sim.monitor.on_chunk_ack(
+                    self.uid, self.job_id, index, reply.get("n_tasks", 0),
+                    dup=bool(reply.get("dup")),
+                )
+            return reply

@@ -1,0 +1,176 @@
+"""Seeded fault schedules: reproducible chaos for the simulator.
+
+A :class:`FaultSchedule` is an explicit, ordered list of
+:class:`FaultEvent`s pinned to virtual times — the unit the repro/bisect
+loop shrinks.  :meth:`FaultSchedule.generate` derives one from a seed
+(same seed, same schedule, always), mixing the sim-native faults:
+
+- ``worker_kill`` (+ optional revive delay) — unclean worker death; its
+  running executions are lost and the server requeues with crash
+  accounting;
+- ``server_kill`` (+ restore delay) — in-process kill -9: the server's
+  in-memory state is dropped, the unflushed journal tail is lost, and a
+  new incarnation restores from the journal (workers reattach, streams
+  replay);
+- ``partition`` — a worker's link drops everything for a duration while
+  both sides think it is up (heartbeat reaping territory);
+- ``straggler`` — a worker runs N× slower for a duration;
+- ``clock_skew`` — step the wall clock by delta seconds (monotonic time
+  is unaffected, like a stepped NTP correction);
+- ``chaos_rule`` — install a message-plane rule through the existing
+  ``utils/chaos.py`` FaultPlan surface (drop/dup/delay at
+  server.send/server.recv, raise at solve, kill at server.event — the
+  same sites the process-level chaos tests use).
+
+The driver applies events in time order on the virtual clock; everything
+is deterministic because the schedule is data, not dice rolled at fire
+time.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    at: float                 # virtual monotonic time
+    kind: str                 # see module docstring
+    target: str = ""          # worker name ("" = server / global)
+    duration: float = 0.0     # partition / straggler window
+    factor: float = 1.0       # straggler slowdown
+    delay: float = 1.0        # revive/restore delay for kills
+    delta: float = 0.0        # clock skew step
+    rule: dict | None = None  # chaos_rule payload
+
+    def describe(self) -> str:
+        bits = [f"t={self.at:g}", self.kind]
+        if self.target:
+            bits.append(self.target)
+        if self.kind in ("partition", "straggler"):
+            bits.append(f"for {self.duration:g}s")
+        if self.kind == "straggler":
+            bits.append(f"x{self.factor:g}")
+        if self.kind == "server_kill":
+            bits.append(f"restore after {self.delay:g}s")
+        if self.kind == "chaos_rule":
+            bits.append(repr(self.rule))
+        return " ".join(bits)
+
+
+@dataclass
+class FaultSchedule:
+    seed: int = 0
+    events: list[FaultEvent] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self.events.sort(key=lambda e: e.at)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def prefix(self, n: int) -> "FaultSchedule":
+        return FaultSchedule(seed=self.seed, events=self.events[:n])
+
+    def describe(self) -> list[str]:
+        return [e.describe() for e in self.events]
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        horizon: float,
+        worker_names: list[str],
+        *,
+        rate: float = 0.02,
+        server_kills: int = 1,
+        partitions: bool = True,
+        stragglers: bool = True,
+        clock_skew: bool = True,
+        message_faults: bool = True,
+    ) -> "FaultSchedule":
+        """A seeded schedule over ``[horizon*0.05, horizon*0.8)``.
+
+        ``rate`` is faults per worker-second (in expectation) for the
+        worker-scoped faults; server kills are scheduled explicitly so a
+        run always exercises restore when asked to."""
+        rng = random.Random(f"faultgen:{seed}")
+        lo, hi = horizon * 0.05, horizon * 0.8
+        events: list[FaultEvent] = []
+        n_worker_faults = max(int(rate * len(worker_names) * (hi - lo)), 1)
+        kinds = ["worker_kill"]
+        if partitions:
+            kinds.append("partition")
+        if stragglers:
+            kinds.append("straggler")
+        for _ in range(n_worker_faults):
+            kind = rng.choice(kinds)
+            target = rng.choice(worker_names)
+            at = rng.uniform(lo, hi)
+            if kind == "worker_kill":
+                events.append(FaultEvent(
+                    at=at, kind=kind, target=target,
+                    delay=rng.uniform(0.5, 5.0),
+                ))
+            elif kind == "partition":
+                events.append(FaultEvent(
+                    at=at, kind=kind, target=target,
+                    duration=rng.uniform(1.0, 30.0),
+                ))
+            else:
+                events.append(FaultEvent(
+                    at=at, kind=kind, target=target,
+                    duration=rng.uniform(5.0, 60.0),
+                    factor=rng.uniform(2.0, 16.0),
+                ))
+        for _ in range(server_kills):
+            events.append(FaultEvent(
+                at=rng.uniform(lo, hi), kind="server_kill",
+                delay=rng.uniform(0.5, 3.0),
+            ))
+        if clock_skew:
+            events.append(FaultEvent(
+                at=rng.uniform(lo, hi), kind="clock_skew",
+                delta=rng.uniform(-30.0, 30.0),
+            ))
+        if message_faults:
+            # schedule-driven chaos rules (utils/chaos.py at_t triggers):
+            # deterministic regardless of message arrival interleaving.
+            # Only RECOVERABLE actions (dup exercises dedup/idempotency,
+            # delay exercises reordering tolerance): a dropped message on
+            # a connection that stays up has no recovery path in the real
+            # system either — TCP does not lose frames mid-connection, so
+            # message loss is only ever modeled together with a
+            # connection loss (worker_kill/partition above).
+            for site, op in (("server.recv", "task_finished"),
+                             ("server.send", "compute")):
+                if rng.random() < 0.75:
+                    events.append(FaultEvent(
+                        at=rng.uniform(lo, hi), kind="chaos_rule",
+                        rule={
+                            "site": site, "op": op,
+                            "action": rng.choice(["dup", "delay"]),
+                            "times": rng.randint(1, 3),
+                        },
+                    ))
+        return cls(seed=seed, events=events)
+
+
+def bisect_minimal_prefix(run_prefix, n_events: int) -> int:
+    """Smallest k such that ``run_prefix(k)`` still fails.
+
+    ``run_prefix(k) -> bool`` replays the scenario with only the first k
+    fault events and returns True when the violation reproduces.  Assumes
+    prefix-monotonicity (the standard delta-debugging assumption: faults
+    after the triggering one are noise); the returned k is verified by
+    construction since the binary search only narrows on observed
+    failures."""
+    lo, hi = 0, n_events  # invariant: prefix(hi) fails, prefix(lo-1)… unknown
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if run_prefix(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    return hi

@@ -1,0 +1,242 @@
+"""Always-on invariant checking over the simulation's event streams.
+
+The monitor consumes three ground-truth streams and cross-checks them
+continuously — a violation raises at the moment the history first proves
+it, not at the end of the run:
+
+- the server's journaled event stream (every incarnation's live
+  ``emit_event`` deliveries, tapped via a listener queue);
+- worker-side execution truth (SimWorkers report every execution start,
+  finish, and loss — what actually "ran", independent of what the server
+  believes);
+- client-side acknowledgements (submit and chunk acks — what the client
+  was promised).
+
+Invariant catalog (docs/simulation.md has the prose version):
+
+``exactly-once execution``
+    No (task, instance) ever starts executing twice — the server never
+    double-spawns an incarnation and workers dedup replayed computes.
+    (Distinct instances of one task may overlap transiently under
+    partition — that is by design; instance fencing picks one winner.)
+``fence monotonicity``
+    Per task, the instance ids in started executions and task-started
+    events never decrease; a re-execution always carries a newer (or, for
+    a reattach, the same) instance.
+``drain-means-no-new-assignments``
+    After a drain begins for a worker id, no compute message reaches that
+    worker at any later virtual instant.
+``ack-implies-durable``
+    Every chunk acked to the client is present (stream uid + chunk index
+    applied) on every later server incarnation — checked at each restore.
+``no lost tasks`` / ``exactly-once acceptance`` (final)
+    At quiescence the journal contains exactly one terminal record per
+    submitted task, and every acked submit's tasks are accounted for.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("hq.sim.invariants")
+
+
+class InvariantViolation(AssertionError):
+    """A robustness property the simulated history disproves."""
+
+
+class InvariantMonitor:
+    def __init__(self, sim):
+        self.sim = sim
+        self.violations: list[str] = []
+        # (task_id, instance) -> (worker name, t) of the execution start
+        self.exec_started: dict[tuple[int, int], tuple[str, float]] = {}
+        self.exec_finished: dict[tuple[int, int], float] = {}
+        self.exec_lost: set[tuple[int, int]] = set()
+        # task_id -> highest instance ever seen starting
+        self.max_instance: dict[int, int] = {}
+        # worker id -> virtual time its drain began
+        self.drain_started: dict[int, float] = {}
+        # client promises
+        self.acked_jobs: dict[int, int] = {}          # job -> n_tasks acked
+        self.acked_chunks: dict[str, set[int]] = {}   # uid -> chunk indexes
+        self.chunk_jobs: dict[str, int] = {}
+        # journal-event observations (across incarnations)
+        self.started_events = 0
+        self.finished_events = 0
+        self.events_seen = 0
+
+    # --- plumbing -------------------------------------------------------
+    def _fail(self, message: str) -> None:
+        self.violations.append(message)
+        logger.error("INVARIANT VIOLATION: %s", message)
+        raise InvariantViolation(message)
+
+    # --- worker-side truth ---------------------------------------------
+    def on_worker_session(self, name: str, worker_id: int, t: float) -> None:
+        pass  # bookkeeping hook (kept for scenario assertions)
+
+    def on_compute_delivered(self, name: str, worker_id: int, task_id: int,
+                             instance: int, t: float) -> None:
+        started = self.drain_started.get(worker_id)
+        if started is not None and t > started:
+            self._fail(
+                f"drain violation: worker {worker_id} ({name}) received "
+                f"compute for task {task_id} at t={t:.3f}, "
+                f"{t - started:.3f}s after its drain began"
+            )
+
+    def on_exec_started(self, name: str, worker_id: int, task_id: int,
+                        instance: int, t: float) -> None:
+        key = (task_id, instance)
+        prior = self.exec_started.get(key)
+        if prior is not None:
+            self._fail(
+                f"double spawn: (task {task_id}, instance {instance}) "
+                f"started on {name} at t={t:.3f} but already started on "
+                f"{prior[0]} at t={prior[1]:.3f}"
+            )
+        last = self.max_instance.get(task_id)
+        if last is not None and instance < last:
+            self._fail(
+                f"fence regression: task {task_id} started instance "
+                f"{instance} after instance {last} had already started"
+            )
+        self.max_instance[task_id] = instance
+        self.exec_started[key] = (name, t)
+
+    def on_exec_finished(self, name: str, worker_id: int, task_id: int,
+                         instance: int, t: float, failed: bool) -> None:
+        key = (task_id, instance)
+        if key in self.exec_finished:
+            self._fail(
+                f"double completion: (task {task_id}, instance {instance}) "
+                f"finished twice on {name}"
+            )
+        self.exec_finished[key] = t
+
+    def on_exec_lost(self, name: str, worker_id: int, task_id: int,
+                     instance: int, t: float, reason: str) -> None:
+        self.exec_lost.add((task_id, instance))
+
+    def on_drain_started(self, worker_id: int, t: float) -> None:
+        self.drain_started[worker_id] = t
+
+    # --- client promises -------------------------------------------------
+    def on_submit_ack(self, job_id: int, n_tasks: int) -> None:
+        self.acked_jobs[job_id] = self.acked_jobs.get(job_id, 0) + n_tasks
+
+    def on_chunk_ack(self, uid: str, job_id: int, index: int, n_tasks: int,
+                     dup: bool) -> None:
+        self.acked_chunks.setdefault(uid, set()).add(index)
+        self.chunk_jobs[uid] = job_id
+
+    # --- journal events (live tap) ---------------------------------------
+    def on_event(self, record: dict) -> None:
+        self.events_seen += 1
+        kind = record.get("event")
+        if kind == "task-started":
+            self.started_events += 1
+            task = record.get("task")
+            job = record.get("job")
+            instance = record.get("instance", 0)
+            if task is not None and job is not None:
+                tid = (int(job) << 32) | int(task)
+                last = self.max_instance.get(tid)
+                if last is not None and instance < last:
+                    self._fail(
+                        f"fence regression in event stream: task "
+                        f"{job}@{task} announced instance {instance} after "
+                        f"{last}"
+                    )
+        elif kind == "task-finished":
+            self.finished_events += 1
+
+    # --- restore-time checks ---------------------------------------------
+    def check_restored_server(self, server) -> None:
+        """Every promise acked before the crash must hold on the restored
+        incarnation: acked chunk streams present with their applied
+        indexes, acked jobs known."""
+        for uid, indexes in self.acked_chunks.items():
+            job_id = self.chunk_jobs.get(uid)
+            job = server.jobs.jobs.get(job_id)
+            if job is None:
+                self._fail(
+                    f"ack-durability violation: job {job_id} (stream "
+                    f"{uid}) was acked but is unknown after restore"
+                )
+            stream = job.streams.get(uid)
+            applied = stream["applied"] if stream else set()
+            missing = indexes - set(applied)
+            # a sealed stream's applied set is released at job
+            # termination; a terminal job accounts for everything
+            if missing and not job.is_terminated():
+                self._fail(
+                    f"ack-durability violation: stream {uid} chunks "
+                    f"{sorted(missing)} were acked but not applied after "
+                    f"restore"
+                )
+        for job_id in self.acked_jobs:
+            if job_id not in server.jobs.jobs:
+                self._fail(
+                    f"ack-durability violation: job {job_id} was acked "
+                    f"but is unknown after restore"
+                )
+
+    # --- final audit ------------------------------------------------------
+    def final_check(self, journal_path, expected_tasks: dict[int, int],
+                    expect_failed: int = 0) -> dict:
+        """Quiescent-state audit straight from the journal file.
+
+        ``expected_tasks``: job id -> task count that must have reached a
+        terminal state exactly once.  Returns summary counts."""
+        from hyperqueue_tpu.events.journal import Journal
+
+        finished: dict[int, int] = {}
+        failed: dict[int, int] = {}
+        canceled: dict[int, int] = {}
+        submitted: dict[int, set] = {}
+        for record in Journal.read_all(journal_path):
+            kind = record.get("event")
+            job = record.get("job")
+            task = record.get("task")
+            if kind == "task-finished":
+                tid = (int(job) << 32) | int(task)
+                finished[tid] = finished.get(tid, 0) + 1
+            elif kind == "task-failed":
+                tid = (int(job) << 32) | int(task)
+                failed[tid] = failed.get(tid, 0) + 1
+            elif kind == "task-canceled":
+                tid = (int(job) << 32) | int(task)
+                canceled[tid] = canceled.get(tid, 0) + 1
+        dup_finished = {t: n for t, n in finished.items() if n > 1}
+        if dup_finished:
+            self._fail(
+                f"exactly-once violation: {len(dup_finished)} task(s) have "
+                f"multiple task-finished journal records, e.g. "
+                f"{sorted(dup_finished)[:5]}"
+            )
+        terminal = set(finished) | set(failed) | set(canceled)
+        missing_total = 0
+        for job_id, count in expected_tasks.items():
+            done = sum(1 for t in terminal if (t >> 32) == job_id)
+            if done < count:
+                missing_total += count - done
+        if missing_total:
+            self._fail(
+                f"lost tasks: {missing_total} submitted task(s) never "
+                f"reached a terminal state in the journal"
+            )
+        n_failed = len(failed)
+        if n_failed != expect_failed:
+            self._fail(
+                f"unexpected failures: {n_failed} task(s) failed "
+                f"(expected {expect_failed})"
+            )
+        return {
+            "finished": len(finished),
+            "failed": n_failed,
+            "canceled": len(canceled),
+            "events_seen": self.events_seen,
+            "executions": len(self.exec_started),
+        }

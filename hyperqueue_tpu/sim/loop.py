@@ -1,0 +1,119 @@
+"""Virtual-clock asyncio event loop: the simulator's time machine.
+
+The loop's ``time()`` is a virtual monotonic clock.  Whenever every ready
+callback has run and only timers remain, the clock JUMPS to the next timer
+deadline instead of sleeping — a ten-minute lease timeout costs the same
+wall time as a 10 ms scheduler delay, and a quiet night of heartbeats is
+free.  Within one instant, callback ordering is exactly asyncio's FIFO
+ready queue, so a run is a deterministic function of the code + the seed
+(no kernel scheduling, no socket buffering, no thread interleaving).
+
+Three deviations from a stock ``SelectorEventLoop``:
+
+- ``time()`` returns the virtual clock; timers scheduled with
+  ``call_later``/``call_at`` (and everything built on them —
+  ``asyncio.sleep``, timeouts, the server's min-delay throttle) run in
+  virtual time.
+- ``run_in_executor`` executes the function INLINE and returns a finished
+  future.  The server offloads journal restore and compaction snapshots to
+  an executor; in the simulator those run synchronously on the loop so no
+  real thread can interleave with simulated state.
+- A fully idle loop (no ready callbacks, no timers, not stopping) is a
+  deadlock by construction — nothing can ever wake it, because the
+  simulation owns every event source.  It raises :class:`SimDeadlockError`
+  instead of blocking forever, with the pending-task inventory in the
+  message.
+
+The per-process clock seam (``utils/clock.py``) is bridged by
+:class:`SimClock`: ``monotonic()`` is the loop's virtual time and
+``time()`` maps it onto a fixed epoch (plus an adjustable skew, the
+clock-skew fault's lever), so all ~117 swept call sites across the server
+tick with the simulation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import selectors
+
+# the virtual wall clock starts here: an arbitrary fixed epoch, so journal
+# record stamps are identical run-to-run (and obviously fake in dumps)
+SIM_EPOCH = 1_600_000_000.0
+
+
+class SimDeadlockError(RuntimeError):
+    """The virtual loop went fully idle with work still pending."""
+
+
+class SimEventLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop whose clock jumps to the next timer deadline."""
+
+    def __init__(self) -> None:
+        super().__init__(selectors.SelectSelector())
+        self._sim_time = 0.0
+
+    def time(self) -> float:
+        return self._sim_time
+
+    def _advance_clock(self) -> None:
+        # mirror of base _run_once's cancelled-timer cleanup, needed
+        # before peeking at the heap head for the true next deadline
+        while self._scheduled and self._scheduled[0]._cancelled:
+            handle = heapq.heappop(self._scheduled)
+            handle._scheduled = False
+        if self._scheduled:
+            when = self._scheduled[0]._when
+            if when > self._sim_time:
+                self._sim_time = when
+            return
+        # nothing ready, nothing scheduled, not stopping: no event source
+        # exists that could ever wake this loop again
+        pending = [
+            t for t in asyncio.all_tasks(self) if not t.done()
+        ]
+        names = ", ".join(sorted(
+            (t.get_coro().__qualname__ if t.get_coro() else repr(t))
+            for t in pending
+        )[:12])
+        raise SimDeadlockError(
+            f"virtual clock has nothing to advance to at t={self._sim_time:.6f}"
+            f" with {len(pending)} pending task(s): {names or 'none'}"
+        )
+
+    def _run_once(self) -> None:
+        if not self._ready and not self._stopping:
+            self._advance_clock()
+        super()._run_once()
+
+    def run_in_executor(self, executor, func, *args):
+        fut = self.create_future()
+        try:
+            fut.set_result(func(*args))
+        except BaseException as e:  # noqa: BLE001 - ferried to the caller
+            fut.set_exception(e)
+        return fut
+
+
+class SimClock:
+    """utils/clock provider backed by a :class:`SimEventLoop`.
+
+    ``monotonic()`` IS the loop's virtual time, so asyncio timers and the
+    server's monotonic bookkeeping (heartbeat ages, lease renewals,
+    reattach deadlines) can never disagree.  ``skew`` shifts only the wall
+    clock — the clock-skew fault: journal stamps and lease records jump
+    while monotonic durations stay truthful, exactly what a stepped NTP
+    correction does to a real host."""
+
+    __slots__ = ("_loop", "epoch", "skew")
+
+    def __init__(self, loop: SimEventLoop, epoch: float = SIM_EPOCH):
+        self._loop = loop
+        self.epoch = float(epoch)
+        self.skew = 0.0
+
+    def time(self) -> float:
+        return self.epoch + self._loop.time() + self.skew
+
+    def monotonic(self) -> float:
+        return self._loop.time()

@@ -1,0 +1,143 @@
+"""Journal-replay regression mode: same workload, new scheduler.
+
+A journal is a complete record of WHAT was asked (job-submitted events
+carry the verbatim job/array descriptions and their submit clocks).  This
+module re-derives a :class:`Workload` from a journal file and re-runs it
+in the simulator under any scheduler configuration — "same recorded
+workload, new scheduler — compare makespan and decision records" as a
+cheap bench row instead of a cluster run.
+
+Task run times: a sim-recorded journal carries them in the task bodies
+(``{"sim": ...}``); for journals from real runs the per-job observed mean
+run time (task-started → task-finished stamps) is injected instead, so
+the replay preserves each job's aggregate execution demand even when the
+original bodies were shell commands.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from hyperqueue_tpu.events.journal import Journal
+from hyperqueue_tpu.sim.workloads import SubmitSpec, Workload
+
+logger = logging.getLogger("hq.sim.replay")
+
+
+def _has_sim_duration(desc: dict) -> bool:
+    array = desc.get("array") or {}
+    body = array.get("body") or {}
+    if isinstance(body, dict) and "sim" in body:
+        return True
+    for t in desc.get("tasks") or ():
+        b = t.get("body") or {}
+        if isinstance(b, dict) and "sim" in b:
+            return True
+    return False
+
+
+def workload_from_journal(path) -> Workload:
+    """Rebuild the submitted workload (arrival times relative to the
+    first submit) from a journal's job-submitted events."""
+    submits: list[SubmitSpec] = []
+    t0: float | None = None
+    # per-job observed run times, for journals without sim bodies
+    started: dict[tuple[int, int], float] = {}
+    durations: dict[int, list[float]] = {}
+    per_job: dict[int, list[SubmitSpec]] = {}
+    for record in Journal.read_all(path):
+        kind = record.get("event")
+        if kind == "job-submitted":
+            t = float(record.get("time", 0.0))
+            if t0 is None:
+                t0 = t
+            desc = dict(record.get("desc") or {})
+            n = int(record.get("n_tasks", 0))
+            if n <= 0:
+                continue
+            desc.setdefault("name", f"job{record.get('job')}")
+            desc.setdefault("submit_dir", "/sim")
+            spec = SubmitSpec(at=t - t0, job_desc=desc, n_tasks=n)
+            submits.append(spec)
+            per_job.setdefault(int(record.get("job", 0)), []).append(spec)
+        elif kind == "task-started":
+            key = (record.get("job"), record.get("task"))
+            started[key] = float(record.get("time", 0.0))
+        elif kind == "task-finished":
+            key = (record.get("job"), record.get("task"))
+            t_start = started.pop(key, None)
+            if t_start is not None:
+                durations.setdefault(int(record.get("job", 0)), []).append(
+                    max(float(record.get("time", 0.0)) - t_start, 1e-3)
+                )
+    for job_id, specs in per_job.items():
+        samples = durations.get(job_id)
+        for spec in specs:
+            if _has_sim_duration(spec.job_desc):
+                continue
+            mean_ms = (
+                sum(samples) / len(samples) * 1e3 if samples else 100.0
+            )
+            array = spec.job_desc.get("array")
+            if array is not None:
+                body = dict(array.get("body") or {})
+                body["sim"] = {"dur_ms": mean_ms}
+                array["body"] = body
+            else:
+                for t in spec.job_desc.get("tasks") or ():
+                    body = dict(t.get("body") or {})
+                    body["sim"] = {"dur_ms": mean_ms}
+                    t["body"] = body
+    return Workload(f"replay:{path}", submits)
+
+
+@dataclass
+class ReplayComparison:
+    makespan_a: float
+    makespan_b: float
+    ticks_a: int
+    ticks_b: int
+    assigned_a: int
+    assigned_b: int
+
+    def summary(self) -> str:
+        ratio = (
+            self.makespan_b / self.makespan_a if self.makespan_a else 0.0
+        )
+        return (
+            f"makespan {self.makespan_a:.1f}s -> {self.makespan_b:.1f}s "
+            f"({ratio:.3f}x), ticks {self.ticks_a} -> {self.ticks_b}, "
+            f"assignments {self.assigned_a} -> {self.assigned_b}"
+        )
+
+
+def _decision_totals(decisions: list[dict]) -> tuple[int, int]:
+    assigned = 0
+    for d in decisions:
+        counts = d.get("counts") or {}
+        assigned += (counts.get("assigned", 0)
+                     + counts.get("gang_assigned", 0)
+                     + counts.get("prefilled", 0))
+    return len(decisions), assigned
+
+
+def replay_compare(journal_path, scheduler_a: str, scheduler_b: str,
+                   seed: int = 0, n_workers: int = 16,
+                   **sim_kwargs) -> ReplayComparison:
+    """Run the journal's workload under two scheduler configs and compare
+    makespan + decision-record totals."""
+    from hyperqueue_tpu.sim.harness import run_scenario
+
+    workload = workload_from_journal(journal_path)
+    res_a = run_scenario(workload, seed=seed, n_workers=n_workers,
+                         scheduler=scheduler_a, **sim_kwargs)
+    res_b = run_scenario(workload, seed=seed, n_workers=n_workers,
+                         scheduler=scheduler_b, **sim_kwargs)
+    ticks_a, assigned_a = _decision_totals(res_a.decisions)
+    ticks_b, assigned_b = _decision_totals(res_b.decisions)
+    return ReplayComparison(
+        makespan_a=res_a.makespan, makespan_b=res_b.makespan,
+        ticks_a=ticks_a, ticks_b=ticks_b,
+        assigned_a=assigned_a, assigned_b=assigned_b,
+    )

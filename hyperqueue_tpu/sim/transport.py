@@ -1,0 +1,243 @@
+"""In-memory duplex streams: the simulator's wire.
+
+A :func:`duplex` pair behaves like two ends of a TCP connection — real
+``asyncio.StreamReader``/``StreamWriter`` objects, so the server's
+connection handlers, the auth handshake, and the length-delimited msgpack
+framing all run UNCHANGED — but bytes move by feeding the peer's protocol
+inside the same event loop.  Ordering per direction is FIFO by
+construction; no kernel buffering, no partial reads at nondeterministic
+boundaries.
+
+Each end owns a :class:`SimLink` with the sim-native network fault levers:
+
+- ``cut`` — a partition: writes BUFFER (TCP would retransmit, not lose
+  them) while both ends believe the connection is up; healing flushes the
+  backlog in order, and a partition that outlasts the heartbeat timeout
+  gets the connection reaped server-side like a real one;
+- ``latency`` — per-byte-stream one-way delay, delivered through virtual
+  timers with FIFO preserved (a latency drop mid-stream cannot reorder
+  frames);
+- ``close()`` — orderly teardown: the peer reads EOF, like a FIN;
+- ``abort()`` — teardown that also drops queued-but-undelivered bytes,
+  like a process dying with unflushed socket buffers.
+
+Late deliveries into a closed end are dropped (a real kernel drops
+packets for a closed socket), so an abrupt kill never feeds a dead
+reader.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class _SimTransport(asyncio.Transport):
+    """Write-side of one direction; delivery goes to the peer protocol."""
+
+    def __init__(self, loop, link: "SimLink", label: str):
+        super().__init__()
+        self._loop = loop
+        self._link = link
+        self.label = label
+        self._protocol = None          # OWN side's protocol (for close)
+        self.peer: "_SimTransport | None" = None
+        self.closed = False
+        self._eof_sent = False
+        # FIFO delivery under latency: (deliver_at, data) queue + the
+        # timestamp of the newest scheduled delivery, so a latency change
+        # mid-stream can never reorder two writes
+        self._last_deliver_at = 0.0
+
+    # --- asyncio.Transport surface the streams layer touches ----------
+    def set_protocol(self, protocol) -> None:
+        self._protocol = protocol
+
+    def get_protocol(self):
+        return self._protocol
+
+    def get_extra_info(self, name, default=None):
+        if name == "peername":
+            return ("sim", self.label)
+        return default
+
+    def is_closing(self) -> bool:
+        return self.closed
+
+    def pause_reading(self) -> None:  # flow control: a no-op in memory
+        pass
+
+    def resume_reading(self) -> None:
+        pass
+
+    def write(self, data) -> None:
+        if self.closed or self.peer is None:
+            return
+        link = self._link
+        data = bytes(data)
+        if link.cut:
+            # partitioned: the bytes are in flight, not lost — TCP would
+            # retransmit them until the window heals or the peer resets
+            link.buffer.append((self, data))
+            link.buffered_bytes += len(data)
+            return
+        if link.latency <= 0.0 and self._last_deliver_at <= self._loop.time():
+            self._deliver(data)
+            return
+        deliver_at = max(
+            self._loop.time() + link.latency, self._last_deliver_at
+        )
+        self._last_deliver_at = deliver_at
+        self._loop.call_at(deliver_at, self._deliver, data)
+
+    def _deliver(self, data: "bytes | None") -> None:
+        """Deliver one chunk to the peer; None is the EOF marker (EOF
+        rides the same ordered channel as data, so a close can never
+        outrun bytes still queued behind a partition or latency)."""
+        peer = self.peer
+        if peer is None or peer.closed:
+            return  # packets to a closed socket are dropped
+        if data is None:
+            peer._protocol.eof_received()
+            return
+        try:
+            peer._protocol.data_received(data)
+        except Exception:  # noqa: BLE001 - a reader torn down mid-flight
+            pass           # behaves like a closed socket: drop
+
+    def write_eof(self) -> None:
+        if self._eof_sent or self.peer is None:
+            return
+        self._eof_sent = True
+        link = self._link
+        if link.cut:
+            # the FIN queues behind the partitioned backlog
+            link.buffer.append((self, None))
+            return
+        if link.latency > 0.0 or self._last_deliver_at > self._loop.time():
+            deliver_at = max(
+                self._loop.time() + link.latency, self._last_deliver_at
+            )
+            self._last_deliver_at = deliver_at
+            self._loop.call_at(deliver_at, self._deliver, None)
+            return
+        self._loop.call_soon(self._deliver, None)
+
+    def can_write_eof(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        """Orderly close of this end: own protocol sees connection_lost,
+        the peer reads EOF after anything already in flight."""
+        if self.closed:
+            return
+        self.closed = True
+        self.write_eof()
+        self._loop.call_soon(self._connection_lost)
+
+    def abort(self) -> None:
+        """Abrupt close: undelivered bytes are lost (scheduled deliveries
+        find this end closed and drop), peer sees EOF immediately."""
+        if self.closed:
+            return
+        self.closed = True
+        if self.peer is not None:
+            self.peer.closed = True
+            # the peer's reader gets EOF so its recv loop unblocks
+            self._loop.call_soon(self._peer_eof_abort)
+        self._loop.call_soon(self._connection_lost)
+
+    def _peer_eof_abort(self) -> None:
+        peer = self.peer
+        if peer is not None:
+            try:
+                peer._protocol.eof_received()
+            except Exception:  # noqa: BLE001 - peer may be torn down
+                pass
+
+    def _connection_lost(self) -> None:
+        if self._protocol is not None:
+            try:
+                self._protocol.connection_lost(None)
+            except Exception:  # noqa: BLE001 - teardown must not raise
+                pass
+
+
+class SimLink:
+    """Shared fault state of one duplex connection (both directions)."""
+
+    __slots__ = ("name", "cut", "latency", "buffer", "buffered_bytes",
+                 "ends")
+
+    def __init__(self, name: str, latency: float = 0.0):
+        self.name = name
+        self.cut = False
+        self.latency = float(latency)
+        self.buffer: list = []      # (transport, data) held by a partition
+        self.buffered_bytes = 0
+        self.ends: tuple = ()
+
+    def partition(self, on: bool = True) -> None:
+        self.cut = bool(on)
+        if not self.cut and self.buffer:
+            # heal: the retransmit backlog lands in order
+            backlog, self.buffer = self.buffer, []
+            self.buffered_bytes = 0
+            for transport, data in backlog:
+                transport._deliver(data)
+
+    def close(self) -> None:
+        for end in self.ends:
+            end.transport.close()
+
+    def abort(self) -> None:
+        # an abort mid-partition loses the in-flight backlog, like a
+        # connection reset while the window was dark
+        self.buffer.clear()
+        self.buffered_bytes = 0
+        for end in self.ends:
+            end.transport.abort()
+
+    @property
+    def alive(self) -> bool:
+        return bool(self.ends) and not any(e.transport.closed
+                                           for e in self.ends)
+
+
+class SimEndpoint:
+    """One end: (reader, writer) plus the transport underneath."""
+
+    __slots__ = ("reader", "writer", "transport", "link")
+
+    def __init__(self, reader, writer, transport, link):
+        self.reader = reader
+        self.writer = writer
+        self.transport = transport
+        self.link = link
+
+
+# big limit: compute batches for 512-task prefills are single frames; the
+# default 64 KiB StreamReader limit only gates readuntil, but keep the
+# flow-control ceiling far away regardless
+_READER_LIMIT = 1 << 30
+
+
+def duplex(loop, name: str = "link",
+           latency: float = 0.0) -> tuple[SimEndpoint, SimEndpoint]:
+    """A connected in-memory stream pair (a-end, b-end)."""
+    link = SimLink(name, latency=latency)
+
+    def make_end(label: str) -> SimEndpoint:
+        reader = asyncio.StreamReader(limit=_READER_LIMIT, loop=loop)
+        protocol = asyncio.StreamReaderProtocol(reader, loop=loop)
+        transport = _SimTransport(loop, link, label)
+        transport.set_protocol(protocol)
+        protocol.connection_made(transport)
+        writer = asyncio.StreamWriter(transport, protocol, reader, loop)
+        return SimEndpoint(reader, writer, transport, link)
+
+    a = make_end(f"{name}:a")
+    b = make_end(f"{name}:b")
+    a.transport.peer = b.transport
+    b.transport.peer = a.transport
+    link.ends = (a, b)
+    return a, b

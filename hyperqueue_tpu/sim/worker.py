@@ -1,0 +1,448 @@
+"""SimWorker: a simulated worker speaking the REAL worker-plane protocol.
+
+One SimWorker is the message-plane shadow of ``worker/runtime.py``: it
+authenticates, registers a real ``WorkerConfiguration``, receives the
+server's compute/cancel/retract/stop/batch downlink ops, and answers with
+the same uplinks (``task_running`` / ``task_finished`` / ``task_failed`` /
+``retract_response`` / ``heartbeat`` / ``goodbye``) — but instead of
+fork/exec'ing processes it models execution as a virtual-time timer whose
+duration comes from the task body.  Everything the SERVER does is
+therefore the production code path; only the leaf that would burn CPU is
+simulated.
+
+Crash/reconnect semantics mirror the real runtime's
+``--on-server-lost reconnect`` contract:
+
+- on connection loss, RUNNING tasks keep executing (their timers keep
+  firing) and terminal uplinks accumulate in a bounded done-log;
+- queued-but-never-started tasks are parked; the next registration
+  reports them as ``blocked`` and the server orders them discarded
+  (it re-issues them — the worker must not run a silently-kept copy);
+- reconnection re-registers with the ``reattach`` claim (old worker id,
+  last known server uid, the (task, instance) set still running), then
+  replays the done-log; the server's instance fencing discards stale
+  entries;
+- ``kill()`` is the unclean death: the link aborts and every running
+  execution is lost.
+
+Execution events (start/finish/loss, compute receipt) are reported to the
+simulation's invariant monitor — the ground truth the exactly-once checks
+run against.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import zlib
+
+from hyperqueue_tpu.transport.framing import _LEN
+
+from hyperqueue_tpu.resources.descriptor import ResourceDescriptor
+from hyperqueue_tpu.server.worker import WorkerConfiguration
+from hyperqueue_tpu.transport.auth import (
+    ROLE_SERVER,
+    ROLE_WORKER,
+    AuthError,
+    do_authentication,
+)
+from hyperqueue_tpu.utils import clock
+
+logger = logging.getLogger("hq.sim.worker")
+
+# bound on the replayed-on-reconnect terminal-uplink log, mirroring the
+# real runtime's bounded done-log
+DONE_LOG_CAP = 4096
+
+
+def task_duration_s(body, entry, task_id: int) -> float:
+    """Deterministic virtual run time of one task.
+
+    Priority: per-task entry ``{"dur_ms": X}`` > shared body
+    ``{"sim": {"dur_ms": X}}`` > shared body
+    ``{"sim": {"dur_range_ms": [lo, hi], "seed": s}}`` hashed per task id
+    (CRC32 — stable across processes, unlike ``hash()``) > 100 ms."""
+    if isinstance(entry, dict) and "dur_ms" in entry:
+        return float(entry["dur_ms"]) / 1e3
+    sim = body.get("sim") if isinstance(body, dict) else None
+    if isinstance(sim, dict):
+        if "dur_ms" in sim:
+            return float(sim["dur_ms"]) / 1e3
+        rng = sim.get("dur_range_ms")
+        if rng:
+            lo, hi = float(rng[0]), float(rng[1])
+            seed = int(sim.get("seed", 0))
+            frac = zlib.crc32(struct.pack("<QQ", task_id, seed)) / 2**32
+            return (lo + (hi - lo) * frac) / 1e3
+    return 0.1
+
+
+class _Exec:
+    """One running simulated execution."""
+
+    __slots__ = ("task_id", "instance", "cpus", "timer", "msg")
+
+    def __init__(self, task_id, instance, cpus, timer, msg):
+        self.task_id = task_id
+        self.instance = instance
+        self.cpus = cpus
+        self.timer = timer
+        self.msg = msg
+
+
+class SimWorker:
+    """One simulated worker node (possibly many connection incarnations)."""
+
+    def __init__(
+        self,
+        sim,
+        name: str,
+        n_cpus: int = 4,
+        group: str = "default",
+        heartbeat_secs: float = 8.0,
+        reconnect: bool = True,
+        reconnect_backoff: float = 0.5,
+    ):
+        self.sim = sim
+        self.name = name
+        self.n_cpus = n_cpus
+        self.group = group
+        self.heartbeat_secs = heartbeat_secs
+        self.reconnect = reconnect
+        self.reconnect_backoff = reconnect_backoff
+        # deterministic per-worker jitter source (seed, worker name)
+        import random
+
+        self._rng = random.Random(f"{sim.seed}:{name}")
+
+        self.worker_id = 0          # current server-side id (0 = none)
+        self.server_uid = ""
+        self.dead = False           # killed / stopped for good
+        self.stopping = False       # server ordered a stop
+        self.partitioned = False    # network-partitioned from the server
+        self.speed = 1.0            # straggler factor (>1 = slower)
+        self._conn = None
+        self._link = None
+        self._task: asyncio.Task | None = None
+        self._hb_timer = None
+
+        self.free_cpus = n_cpus * 10_000   # fixed-point, like the wire
+        self.running: dict[int, _Exec] = {}
+        self.pending: list[dict] = []      # queued compute msgs (FIFO)
+        self._done_log: list[dict] = []    # terminal uplinks for replay
+        # every (task, instance) this worker ever RECEIVED: the real
+        # runtime dedups duplicate (task, instance) computes at receive
+        # time — a duplicated delivery must not queue a second copy (a
+        # retract would remove one and leave the ghost to run a fenced
+        # incarnation later), nor re-run a finished one
+        self._seen: set[tuple[int, int]] = set()
+        # counters the harness reads
+        self.n_started = 0
+        self.n_finished = 0
+        self.connections = 0
+
+    # --- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        self._task = self.sim.loop.create_task(self._run())
+
+    def _config(self) -> WorkerConfiguration:
+        return WorkerConfiguration(
+            descriptor=ResourceDescriptor.simple_cpus(self.n_cpus),
+            hostname=f"sim-{self.name}",
+            group=self.group,
+            heartbeat_secs=self.heartbeat_secs,
+            on_server_lost="reconnect" if self.reconnect else "stop",
+        )
+
+    async def _run(self) -> None:
+        while not self.dead:
+            try:
+                await self._session()
+            except asyncio.CancelledError:
+                raise
+            except (AuthError, ConnectionError, OSError,
+                    asyncio.IncompleteReadError) as e:
+                logger.debug("sim worker %s session ended: %s", self.name, e)
+            finally:
+                self._teardown_session()
+            if self.dead or self.stopping or not self.reconnect:
+                break
+            # park never-started backlog: the server re-issues those tasks,
+            # and the next register reports them as blocked for discard
+            await asyncio.sleep(
+                self.reconnect_backoff * (0.5 + self._rng.random())
+            )
+
+    async def _session(self) -> None:
+        if self.partitioned:
+            raise ConnectionError("worker is partitioned from the server")
+        endpoint = self.sim.connect_worker(self.name)
+        self._link = endpoint.link
+        conn = await do_authentication(
+            endpoint.reader, endpoint.writer, ROLE_WORKER, ROLE_SERVER, None
+        )
+        register: dict = {"op": "register", "config": self._config().to_wire()}
+        if self.worker_id or self.running or self.pending:
+            register["reattach"] = {
+                "server_uid": self.server_uid,
+                "worker_id": self.worker_id,
+                "running": [
+                    {"id": e.task_id, "instance": e.instance,
+                     "variant": e.msg.get("variant", 0)}
+                    for e in self.running.values()
+                ],
+                "blocked": [{"id": m["id"]} for m in self.pending],
+            }
+        await conn.send(register)
+        registered = await conn.recv()
+        if registered.get("op") != "registered":
+            raise ConnectionError(f"unexpected reply {registered.get('op')!r}")
+        self._conn = conn
+        self.connections += 1
+        self.worker_id = registered["worker_id"]
+        self.server_uid = registered.get("server_uid", "")
+        discard = set(registered.get("discard") or ())
+        # parked backlog is never kept (the server re-issues those ids)
+        self.pending.clear()
+        for task_id in list(self.running):
+            if task_id in discard:
+                self._kill_exec(task_id, "discarded at reattach")
+        # the dedup memory is CONNECTION-scoped (dup deliveries can only
+        # happen within one connection): discarded/parked incarnations
+        # may be legitimately re-issued under the same instance by a
+        # restored server (lazy tasks re-materialize at instance 0), so
+        # only live executions stay fenced
+        self._seen = {
+            (e.task_id, e.instance) for e in self.running.values()
+        }
+        # replay the done-log: completions the old server may never have
+        # processed; the new server fences stale instances
+        for uplink in self._done_log:
+            await conn.send(uplink)
+        self._arm_heartbeat()
+        self.sim.monitor.on_worker_session(
+            self.name, self.worker_id, clock.monotonic()
+        )
+        try:
+            while True:
+                msg = await conn.recv()
+                for sub in (msg["msgs"] if msg.get("op") == "batch"
+                            else (msg,)):
+                    self._handle(sub)
+                if self.stopping:
+                    return
+        finally:
+            self._conn = None
+            if self._hb_timer is not None:
+                self._hb_timer.cancel()
+                self._hb_timer = None
+
+    def _teardown_session(self) -> None:
+        if self._link is not None:
+            self._link.close()
+            self._link = None
+
+    # --- downlink ops -------------------------------------------------
+    def _handle(self, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "compute":
+            shared = msg.get("shared_bodies") or []
+            now = clock.monotonic()
+            for t in msg.get("tasks", ()):
+                task = dict(t)
+                b = task.pop("b", None)
+                task["body"] = shared[b] if b is not None else {}
+                key = (task["id"], task.get("instance", 0))
+                if key in self._seen:
+                    continue  # duplicate delivery: dedup at receive
+                self._seen.add(key)
+                self.sim.monitor.on_compute_delivered(
+                    self.name, self.worker_id, task["id"],
+                    task.get("instance", 0), now,
+                )
+                self.pending.append(task)
+            self._fill()
+        elif op == "cancel":
+            ids = set(msg.get("task_ids", ()))
+            self.pending = [m for m in self.pending if m["id"] not in ids]
+            for task_id in list(self.running):
+                if task_id in ids:
+                    self._kill_exec(task_id, "canceled")
+            self._fill()
+        elif op == "retract":
+            for task_id, instance in msg.get("tasks", ()):
+                ok = False
+                for i, m in enumerate(self.pending):
+                    if m["id"] == task_id and m.get("instance") == instance:
+                        del self.pending[i]
+                        ok = True
+                        break
+                self._send({"op": "retract_response", "id": task_id,
+                            "instance": instance, "ok": ok})
+        elif op == "stop":
+            self.stopping = True
+            self._send({"op": "goodbye"})
+            # close after the goodbye drains (same loop turn ordering)
+            if self._link is not None:
+                self._link.close()
+        elif op in ("set_overview_override", "redirect"):
+            pass  # no hardware overviews / federation in the simulator
+        else:
+            logger.warning("sim worker %s: unknown op %r", self.name, op)
+
+    # --- execution model ----------------------------------------------
+    def _fill(self) -> None:
+        """Start queued tasks while resources fit (FIFO, like the real
+        runtime's resource-gated launch queue).  While disconnected the
+        backlog stays PARKED (never started): the next registration
+        reports it as blocked and the server re-issues those tasks."""
+        if self._conn is None:
+            return
+        while self.pending and not self.stopping:
+            msg = self.pending[0]
+            cpus = self._cpus_of(msg)
+            if msg.get("n_nodes", 0) == 0 and cpus > self.free_cpus:
+                break
+            self.pending.pop(0)
+            self._start_exec(msg, cpus)
+
+    def _cpus_of(self, msg: dict) -> int:
+        for entry in msg.get("entries") or ():
+            if entry.get("name") == "cpus":
+                amount = int(entry.get("amount", 10_000))
+                # ALL policy ships amount 0: take the whole pool
+                return amount if amount > 0 else self.n_cpus * 10_000
+        return 10_000
+
+    def _start_exec(self, msg: dict, cpus: int) -> None:
+        task_id = msg["id"]
+        instance = msg.get("instance", 0)
+        prior = self.running.get(task_id)
+        if prior is not None:
+            # a NEWER instance supersedes a local incarnation the server
+            # already fenced out (its completion would be discarded anyway)
+            if prior.instance >= instance:
+                return
+            self._kill_exec(task_id, "superseded by newer instance")
+        if msg.get("n_nodes", 0) == 0:
+            self.free_cpus -= cpus
+        else:
+            cpus = 0  # gang root: the server reserved whole workers
+        dur = task_duration_s(msg.get("body"), msg.get("entry"), task_id)
+        dur *= self.speed
+        timer = self.sim.loop.call_later(dur, self._finish_exec, task_id)
+        self.running[task_id] = _Exec(task_id, instance, cpus, timer, msg)
+        self.n_started += 1
+        self.sim.monitor.on_exec_started(
+            self.name, self.worker_id, task_id, instance, clock.monotonic()
+        )
+        self._send({"op": "task_running", "id": task_id,
+                    "instance": instance})
+
+    def _finish_exec(self, task_id: int) -> None:
+        ex = self.running.pop(task_id, None)
+        if ex is None:
+            return
+        self.free_cpus += ex.cpus
+        body = ex.msg.get("body") or {}
+        sim = body.get("sim") if isinstance(body, dict) else None
+        fail_ids = (sim or {}).get("fail_ids") or ()
+        failed = (task_id & 0xFFFFFFFF) in fail_ids
+        self.n_finished += 1
+        self.sim.monitor.on_exec_finished(
+            self.name, self.worker_id, task_id, ex.instance,
+            clock.monotonic(), failed=failed,
+        )
+        if failed:
+            uplink = {"op": "task_failed", "id": task_id,
+                      "instance": ex.instance, "error": "sim-injected failure"}
+        else:
+            uplink = {"op": "task_finished", "id": task_id,
+                      "instance": ex.instance}
+        self._log_done(uplink)
+        self._send(uplink)
+        self._fill()
+
+    def _kill_exec(self, task_id: int, reason: str) -> None:
+        ex = self.running.pop(task_id, None)
+        if ex is None:
+            return
+        ex.timer.cancel()
+        self.free_cpus += ex.cpus
+        self.sim.monitor.on_exec_lost(
+            self.name, self.worker_id, task_id, ex.instance,
+            clock.monotonic(), reason,
+        )
+
+    def _log_done(self, uplink: dict) -> None:
+        self._done_log.append(uplink)
+        if len(self._done_log) > DONE_LOG_CAP:
+            del self._done_log[: len(self._done_log) - DONE_LOG_CAP]
+
+    # --- uplink -------------------------------------------------------
+    def _send(self, msg: dict) -> None:
+        """Synchronous uplink: the in-memory transport's write never
+        blocks, so frames go out inline (encode + two writes) in exactly
+        the order the model produced them — no per-message task churn."""
+        conn = self._conn
+        if conn is None:
+            return  # disconnected: terminal ops live in the done-log
+        try:
+            data = conn.encode(msg)
+            conn.writer.write(_LEN.pack(len(data)))
+            conn.writer.write(data)
+        except (ConnectionError, OSError):
+            pass  # the recv loop notices the dead link
+
+    def _arm_heartbeat(self) -> None:
+        if self._hb_timer is not None:
+            self._hb_timer.cancel()
+        loop = self.sim.loop
+
+        def beat() -> None:
+            if self._conn is not None and not self.dead:
+                self._send({"op": "heartbeat"})
+                self._hb_timer = loop.call_later(self.heartbeat_secs, beat)
+
+        self._hb_timer = loop.call_later(self.heartbeat_secs, beat)
+
+    # --- fault levers ---------------------------------------------------
+    def kill(self) -> None:
+        """Unclean death: the link aborts, every execution is lost."""
+        self.dead = True
+        for task_id in list(self.running):
+            self._kill_exec(task_id, "worker killed")
+        self.pending.clear()
+        self._done_log.clear()
+        self._seen.clear()
+        if self._hb_timer is not None:
+            self._hb_timer.cancel()
+            self._hb_timer = None
+        if self._link is not None:
+            self._link.abort()
+            self._link = None
+        if self._task is not None:
+            self._task.cancel()
+
+    def revive(self) -> "SimWorker":
+        """A fresh worker process on the same simulated node (same name
+        suffix convention, new registration)."""
+        return self.sim.add_worker(
+            name=f"{self.name}+", n_cpus=self.n_cpus, group=self.group,
+            heartbeat_secs=self.heartbeat_secs, reconnect=self.reconnect,
+        )
+
+    def partition(self, on: bool = True) -> None:
+        """Partition (or heal) this worker: the current link buffers all
+        traffic and reconnect attempts fail until healed."""
+        self.partitioned = bool(on)
+        if self._link is not None:
+            self._link.partition(on)
+
+    async def wait_stopped(self) -> None:
+        if self._task is not None:
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass

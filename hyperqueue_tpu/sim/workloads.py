@@ -1,0 +1,205 @@
+"""Synthetic workload generators for the simulator.
+
+A workload is a list of :class:`SubmitSpec`s — (virtual arrival time, job
+description, expected task count) — that the harness submits through the
+real client plane at the right virtual instants.  Task run times live in
+the shared body (``{"sim": {...}}``, see ``sim/worker.py
+task_duration_s``) so a million-task array still ships one body.
+
+Shapes mirror the scenario suite the roadmap asks for:
+
+- :func:`uniform_array` — one big array job, the saturation baseline;
+- :func:`bursty_multi_tenant` — N tenants submitting bursts at seeded
+  arrival times with mixed priorities and sizes;
+- :func:`deep_dag` — layered diamond graphs (the stress-dag shape):
+  critical-path-bound completion, exercises dependency propagation;
+- :func:`gang_heavy` — a mix of multi-node gangs and single-node filler,
+  exercising reservation/drain interplay;
+- :func:`straggler_tailed` — wide short tasks with a heavy duration tail,
+  the shape retract/rebalance exists for.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SubmitSpec:
+    at: float                  # virtual submit time
+    job_desc: dict             # wire job description ({"op": "submit"} body)
+    n_tasks: int
+    expect_failed: int = 0
+
+
+@dataclass
+class Workload:
+    name: str
+    submits: list[SubmitSpec] = field(default_factory=list)
+
+    @property
+    def n_tasks(self) -> int:
+        return sum(s.n_tasks for s in self.submits)
+
+    @property
+    def expect_failed(self) -> int:
+        return sum(s.expect_failed for s in self.submits)
+
+    @property
+    def horizon_hint(self) -> float:
+        return max((s.at for s in self.submits), default=0.0)
+
+
+def _array_desc(name: str, n: int, body: dict, cpus: int = 1,
+                priority: int = 0) -> dict:
+    return {
+        "name": name,
+        "submit_dir": "/sim",
+        "array": {
+            "id_range": [0, n],
+            "body": body,
+            "request": {"variants": [{"entries": [
+                {"name": "cpus", "amount": cpus * 10_000},
+            ]}]},
+            "priority": priority,
+        },
+    }
+
+
+def uniform_array(n_tasks: int = 1000, dur_ms: float = 500.0,
+                  seed: int = 0) -> Workload:
+    body = {"sim": {"dur_range_ms": [dur_ms * 0.5, dur_ms * 1.5],
+                    "seed": seed}}
+    return Workload("uniform-array", [
+        SubmitSpec(at=0.0, job_desc=_array_desc("uniform", n_tasks, body),
+                   n_tasks=n_tasks),
+    ])
+
+
+def bursty_multi_tenant(n_tenants: int = 4, bursts_per_tenant: int = 3,
+                        tasks_per_burst: int = 200, window: float = 120.0,
+                        seed: int = 0) -> Workload:
+    rng = random.Random(f"bursty:{seed}")
+    submits = []
+    for tenant in range(n_tenants):
+        priority = rng.choice([-1, 0, 0, 1])
+        for burst in range(bursts_per_tenant):
+            at = rng.uniform(0.0, window)
+            n = max(int(tasks_per_burst * rng.uniform(0.3, 1.7)), 1)
+            body = {"sim": {"dur_range_ms": [100, 2000],
+                            "seed": seed * 1000 + tenant}}
+            submits.append(SubmitSpec(
+                at=at,
+                job_desc=_array_desc(
+                    f"tenant{tenant}-burst{burst}", n, body,
+                    priority=priority,
+                ),
+                n_tasks=n,
+            ))
+    return Workload("bursty-multi-tenant", submits)
+
+
+def deep_dag(layers: int = 12, width: int = 24, seed: int = 0) -> Workload:
+    """Layered diamond DAG (the stress-dag shape): layer k+1 tasks depend
+    on two tasks of layer k."""
+    rng = random.Random(f"dag:{seed}")
+    tasks = []
+    tid = 0
+    prev_layer: list[int] = []
+    for layer in range(layers):
+        this_layer = []
+        for i in range(width):
+            deps = []
+            if prev_layer:
+                deps = sorted(rng.sample(
+                    prev_layer, k=min(2, len(prev_layer))
+                ))
+            tasks.append({
+                "id": tid,
+                "deps": deps,
+                "body": {"sim": {"dur_range_ms": [50, 400],
+                                 "seed": seed}},
+                "request": {"variants": [{"entries": [
+                    {"name": "cpus", "amount": 10_000},
+                ]}]},
+            })
+            this_layer.append(tid)
+            tid += 1
+        prev_layer = this_layer
+    desc = {"name": "deep-dag", "submit_dir": "/sim", "tasks": tasks}
+    return Workload("deep-dag", [
+        SubmitSpec(at=0.0, job_desc=desc, n_tasks=len(tasks)),
+    ])
+
+
+def gang_heavy(n_gangs: int = 8, gang_size: int = 4,
+               filler_tasks: int = 400, seed: int = 0) -> Workload:
+    rng = random.Random(f"gang:{seed}")
+    submits = []
+    for g in range(n_gangs):
+        desc = {
+            "name": f"gang{g}",
+            "submit_dir": "/sim",
+            "tasks": [{
+                "id": 0,
+                "body": {"sim": {"dur_ms": rng.uniform(2000, 8000)}},
+                "request": {"variants": [{"n_nodes": gang_size}]},
+            }],
+        }
+        submits.append(SubmitSpec(
+            at=rng.uniform(0.0, 30.0), job_desc=desc, n_tasks=1,
+        ))
+    body = {"sim": {"dur_range_ms": [100, 1500], "seed": seed}}
+    submits.append(SubmitSpec(
+        at=0.0,
+        job_desc=_array_desc("filler", filler_tasks, body),
+        n_tasks=filler_tasks,
+    ))
+    return Workload("gang-heavy", submits)
+
+
+def straggler_tailed(n_tasks: int = 1500, seed: int = 0) -> Workload:
+    """Wide and short with a heavy tail: ~2% of tasks run 20-60x the
+    median (encoded per-task via the entries channel)."""
+    rng = random.Random(f"tail:{seed}")
+    entries = []
+    for i in range(n_tasks):
+        if rng.random() < 0.02:
+            entries.append({"dur_ms": rng.uniform(4000, 12000)})
+        else:
+            entries.append({"dur_ms": rng.uniform(50, 300)})
+    desc = {
+        "name": "straggler-tail",
+        "submit_dir": "/sim",
+        "array": {
+            "id_range": [0, n_tasks],
+            "body": {},
+            "entries": entries,
+            "request": {"variants": [{"entries": [
+                {"name": "cpus", "amount": 10_000},
+            ]}]},
+        },
+    }
+    return Workload("straggler-tailed", [
+        SubmitSpec(at=0.0, job_desc=desc, n_tasks=n_tasks),
+    ])
+
+
+WORKLOADS = {
+    "uniform": uniform_array,
+    "bursty": bursty_multi_tenant,
+    "dag": deep_dag,
+    "gang": gang_heavy,
+    "tail": straggler_tailed,
+}
+
+
+def build(name: str, seed: int = 0, **kwargs) -> Workload:
+    try:
+        factory = WORKLOADS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r} (have: {', '.join(sorted(WORKLOADS))})"
+        ) from None
+    return factory(seed=seed, **kwargs)

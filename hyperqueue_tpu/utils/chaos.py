@@ -16,10 +16,29 @@ Rule schema (all keys except site/action optional)::
      "event": "task-finished",         # match only this event kind (server.event)
      "action": "drop",                 # drop | dup | delay | kill | raise | hang
      "at": 3,                          # fire on the 3rd match only
+     "at_t": 42.5,                     # gate: match only at/after this clock time
      "times": 2,                       # fire at most twice
      "prob": 0.25,                     # else fire per-match with this probability
      "delay_ms": 50,                   # for action=delay
      "hang_s": 30}                     # for action=hang
+
+Schedule-driven mode (ISSUE 14): ``at``/``at_t`` triggers make a plan a
+deterministic SCHEDULE rather than a sieve — the same plan object fires
+the same faults regardless of what else raced through the site counters.
+``at_t`` reads :mod:`hyperqueue_tpu.utils.clock`, so under the simulator's
+virtual clock a rule pinned to t=600 fires at 600 virtual seconds even
+when the whole run takes milliseconds of wall time; ``at`` counts matches
+only once the ``at_t`` gate has opened, so "the 3rd journal event after
+t=42" composes the two.  Prefer these over ``prob`` rules whenever the
+run must be seed-reproducible: a ``prob`` draw consumes the per-rule RNG
+in ARRIVAL order, so two runs that interleave messages differently
+diverge, while an occurrence/time schedule does not.
+
+In-process harnesses (the simulator, tests) install plans directly with
+:func:`install_plan` / :func:`clear_plan` instead of the environment
+variable, and may replace the ``kill`` action's process-SIGKILL with
+:func:`set_kill_handler` (the simulator maps "kill" onto dropping the
+in-process server's state and restoring from the journal).
 
 Sites threaded through the control plane:
 
@@ -62,6 +81,8 @@ import signal
 import threading
 import time
 
+from hyperqueue_tpu.utils import clock
+
 logger = logging.getLogger("hq.chaos")
 
 
@@ -71,7 +92,7 @@ class ChaosInjectedError(RuntimeError):
 
 class _Rule:
     __slots__ = (
-        "site", "op", "event", "action", "prob", "at", "times",
+        "site", "op", "event", "action", "prob", "at", "at_t", "times",
         "delay_ms", "hang_s", "_matches", "_fired", "_rng",
     )
 
@@ -82,6 +103,10 @@ class _Rule:
         self.action = spec["action"]
         self.prob = spec.get("prob")
         self.at = spec.get("at")
+        # time gate (wall clock under the active utils/clock provider —
+        # virtual time in the simulator): the rule matches nothing before
+        # this instant, and `at` counts occurrences only after it
+        self.at_t = spec.get("at_t")
         self.times = spec.get("times")
         self.delay_ms = float(spec.get("delay_ms", 25.0))
         self.hang_s = float(spec.get("hang_s", 30.0))
@@ -95,6 +120,8 @@ class _Rule:
         if self.op is not None and op != self.op:
             return False
         if self.event is not None and event != self.event:
+            return False
+        if self.at_t is not None and clock.now() < self.at_t:
             return False
         self._matches += 1
         if self.times is not None and self._fired >= self.times:
@@ -153,9 +180,43 @@ def _load() -> None:
 _load()
 
 
+def install_plan(plan: "FaultPlan | dict | None") -> None:
+    """Install a plan programmatically (simulator / in-process tests).
+
+    Replaces whatever HQ_FAULT_PLAN loaded at import.  Passing a dict
+    builds a fresh FaultPlan (fresh rule counters); passing None is
+    equivalent to :func:`clear_plan`."""
+    global _PLAN, ACTIVE
+    if isinstance(plan, dict):
+        plan = FaultPlan(plan)
+    _PLAN = plan
+    ACTIVE = plan is not None
+
+
+def clear_plan() -> None:
+    """Remove the active plan (and with it all rule state)."""
+    install_plan(None)
+
+
 def _kill_self() -> None:
     logging.shutdown()
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+# action="kill" handler: SIGKILL of the process by default.  The simulator
+# replaces it with an in-process equivalent (drop the server's in-memory
+# state, lose the unflushed journal tail, restore from the journal) so
+# kill-at-site rules exercise the same crash choreography without taking
+# the test process down.  The handler must not return normally: a real
+# kill -9 never does, and code after the injection point must not run.
+_KILL_HANDLER = _kill_self
+
+
+def set_kill_handler(handler) -> None:
+    """Replace the action="kill" behavior; None restores SIGKILL-self.
+    The handler must unwind the caller (raise) or end the process."""
+    global _KILL_HANDLER
+    _KILL_HANDLER = handler if handler is not None else _kill_self
 
 
 def fire(site: str, op=None, event=None) -> None:
@@ -172,7 +233,7 @@ def fire(site: str, op=None, event=None) -> None:
     if rule is None:
         return
     if rule.action == "kill":
-        _kill_self()
+        _KILL_HANDLER()
     if rule.action == "raise":
         raise ChaosInjectedError(f"injected failure at {site}")
     if rule.action == "hang":
@@ -200,7 +261,7 @@ def decide(site: str, op=None, event=None) -> str | None:
     if rule is None:
         return None
     if rule.action == "kill":
-        _kill_self()
+        _KILL_HANDLER()
     return rule.action
 
 
@@ -216,7 +277,7 @@ async def on_message(site: str, op=None) -> str | None:
     if rule is None:
         return None
     if rule.action == "kill":
-        _kill_self()
+        _KILL_HANDLER()
     if rule.action == "raise":
         raise ChaosInjectedError(f"injected failure at {site}")
     if rule.action == "delay":

@@ -1,0 +1,82 @@
+"""Process-wide clock provider: every wall/monotonic read behind one seam.
+
+The server, scheduler, events, and autoalloc layers used to call
+``time.time()`` / ``time.monotonic()`` directly at ~117 sites.  That was
+fine for production but made the deterministic cluster simulator
+(``hyperqueue_tpu/sim``) impossible: the simulator runs the REAL server
+on a virtual-clock event loop where ten minutes of lease timeouts pass in
+microseconds of wall time, so every timestamp the server records and every
+staleness comparison it makes must come from the virtual clock — one code
+path for sim and production, switched here.
+
+Production pays one extra function call per read (the provider defaults to
+the stdlib clocks); ``perf_counter`` is deliberately NOT routed — it
+measures real CPU work for telemetry (tick phase latencies, fsync
+histograms) and virtualizing it would make the simulator lie about its own
+overhead.
+
+Usage::
+
+    from hyperqueue_tpu.utils import clock
+    stamp = clock.now()        # wall clock (time.time)
+    t0 = clock.monotonic()     # monotonic clock (time.monotonic)
+
+A simulation installs its provider for the duration of a run::
+
+    previous = clock.install(sim_clock)   # needs .time() and .monotonic()
+    try: ...
+    finally: clock.install(previous)
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+
+class SystemClock:
+    """The default provider: the stdlib clocks, no indirection beyond the
+    method lookup."""
+
+    __slots__ = ()
+
+    time = staticmethod(_time.time)
+    monotonic = staticmethod(_time.monotonic)
+
+
+SYSTEM = SystemClock()
+_provider = SYSTEM
+
+
+def now() -> float:
+    """Wall-clock seconds (``time.time`` under the active provider)."""
+    return _provider.time()
+
+
+def monotonic() -> float:
+    """Monotonic seconds (``time.monotonic`` under the active provider)."""
+    return _provider.monotonic()
+
+
+def get() -> object:
+    """The active provider."""
+    return _provider
+
+
+def install(provider) -> object:
+    """Swap the process-wide provider; returns the previous one so the
+    caller can restore it.  ``provider`` needs ``time()`` and
+    ``monotonic()`` methods."""
+    global _provider
+    previous = _provider
+    _provider = provider
+    return previous
+
+
+def reset() -> None:
+    """Back to the stdlib clocks."""
+    install(SYSTEM)
+
+
+def is_simulated() -> bool:
+    """True while a non-system provider is installed (the simulator)."""
+    return _provider is not SYSTEM

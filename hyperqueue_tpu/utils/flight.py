@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
+from hyperqueue_tpu.utils import clock
 
 DEFAULT_TICKS = 512
 DEFAULT_EVENTS = 1024
@@ -62,7 +63,7 @@ class FlightRecorder:
         if not self.enabled:
             return
         self._events.append(
-            {"time": time.time(), "event": kind, **(payload or {})}
+            {"time": clock.now(), "event": kind, **(payload or {})}
         )
 
     # --- queries ------------------------------------------------------

@@ -44,6 +44,7 @@ import time
 from pathlib import Path
 
 from hyperqueue_tpu.events.journal import fsync_dir
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.lease")
 
@@ -96,14 +97,14 @@ class ShardLease:
         record = self.read()
         if record is None:
             return "absent"
-        age = time.time() - float(record.get("renewed_at") or 0.0)
+        age = clock.now() - float(record.get("renewed_at") or 0.0)
         return "stale" if age > self.timeout else "held"
 
     def age_seconds(self) -> float | None:
         record = self.read()
         if record is None:
             return None
-        return max(time.time() - float(record.get("renewed_at") or 0.0), 0.0)
+        return max(clock.now() - float(record.get("renewed_at") or 0.0), 0.0)
 
     # --- writes (flock-serialized) --------------------------------------
     @contextlib.contextmanager
@@ -154,7 +155,7 @@ class ShardLease:
             record = {
                 "owner": owner,
                 "epoch": int((current or {}).get("epoch") or 0) + 1,
-                "renewed_at": time.time(),
+                "renewed_at": clock.now(),
                 "pid": os.getpid(),
             }
             self._write(record)
@@ -181,7 +182,7 @@ class ShardLease:
                 self._write({
                     "owner": self.owner,
                     "epoch": self.epoch,
-                    "renewed_at": time.time(),
+                    "renewed_at": clock.now(),
                     "pid": os.getpid(),
                 })
             return True

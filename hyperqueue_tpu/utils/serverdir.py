@@ -23,6 +23,7 @@ import secrets
 import time
 from dataclasses import dataclass
 from pathlib import Path
+from hyperqueue_tpu.utils import clock
 
 ACCESS_FILE = "access.json"
 CURRENT_LINK = "hq-current"
@@ -98,6 +99,23 @@ def default_server_dir() -> Path:
     return Path.home() / ".hq-tpu-server"
 
 
+# server uids land in the journal (server-uid lineage records), so a
+# deterministic simulation must be able to derive them from a seed; the
+# default stays the OS entropy pool. Key material also flows through this
+# source — a simulation that wants encryption must accept that a seeded
+# source makes those keys predictable (the sim runs auth-disabled).
+_token_source = secrets.token_hex
+
+
+def set_token_source(source) -> object:
+    """Swap the uid/key entropy source (``fn(nbytes) -> hex str``);
+    returns the previous source.  None restores ``secrets.token_hex``."""
+    global _token_source
+    previous = _token_source
+    _token_source = source if source is not None else secrets.token_hex
+    return previous
+
+
 def generate_access(
     host: str,
     client_port: int,
@@ -107,12 +125,12 @@ def generate_access(
     worker_host: str | None = None,
 ) -> AccessRecord:
     return AccessRecord(
-        server_uid=secrets.token_hex(8),
+        server_uid=_token_source(8),
         host=host,
         client_port=client_port,
         worker_port=worker_port,
-        client_key=None if disable_client_auth else secrets.token_hex(32),
-        worker_key=None if disable_worker_auth else secrets.token_hex(32),
+        client_key=None if disable_client_auth else _token_source(32),
+        worker_key=None if disable_worker_auth else _token_source(32),
         worker_host=worker_host,
     )
 
@@ -175,7 +193,7 @@ def load_access(
     retried for a short window before it propagates.
     """
     window = LOAD_ACCESS_RETRY_SECS if retry_secs is None else retry_secs
-    deadline = time.monotonic() + window
+    deadline = clock.monotonic() + window
     while True:
         direct = server_dir / ACCESS_FILE
         try:
@@ -195,12 +213,12 @@ def load_access(
             # hq-current symlink at all, fail fast with the clear message
             if not (server_dir / CURRENT_LINK).is_symlink():
                 raise
-            if time.monotonic() >= deadline:
+            if clock.monotonic() >= deadline:
                 raise
         except (ValueError, KeyError, TypeError):
             # torn/mid-rewrite record (json decode errors are ValueError);
             # retry briefly, then let the real error out
-            if time.monotonic() >= deadline:
+            if clock.monotonic() >= deadline:
                 raise
         time.sleep(_LOAD_ACCESS_POLL)
 

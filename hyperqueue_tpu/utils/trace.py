@@ -118,8 +118,23 @@ span = TRACER.span
 # of dict writes, never an extra message.
 # ----------------------------------------------------------------------
 
+# trace ids ride journal events, so a deterministic run (the simulator's
+# bit-identical-journal regression) must be able to derive them from a
+# seed instead of the OS entropy pool; production keeps secrets.token_hex
+_token_source = secrets.token_hex
+
+
+def set_token_source(source) -> object:
+    """Swap the trace-id entropy source (``fn(nbytes) -> hex str``);
+    returns the previous source.  None restores ``secrets.token_hex``."""
+    global _token_source
+    previous = _token_source
+    _token_source = source if source is not None else secrets.token_hex
+    return previous
+
+
 def new_trace_id() -> str:
-    return secrets.token_hex(8)
+    return _token_source(8)
 
 
 # span names, in causal order, for a task launched on a real worker; the

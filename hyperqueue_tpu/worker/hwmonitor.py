@@ -13,6 +13,7 @@ import os
 import shutil
 import subprocess
 import time
+from hyperqueue_tpu.utils import clock
 
 
 def parse_nvidia_smi_csv(text: str) -> list[dict]:
@@ -132,7 +133,7 @@ class HwSampler:
     def __init__(self):
         self._last_cpu = self._read_cpu_times()
         self._last_per_cpu = self._read_per_cpu_times()
-        self._last_time = time.monotonic()
+        self._last_time = clock.monotonic()
         self._gpu = GpuMonitor()
 
     @staticmethod
@@ -197,7 +198,7 @@ class HwSampler:
 
         load = os.getloadavg() if hasattr(os, "getloadavg") else (0, 0, 0)
         out = {
-            "timestamp": time.time(),
+            "timestamp": clock.now(),
             "cpu_usage_percent": round(cpu_usage, 1),
             "cpu_per_core_percent": per_core,
             "mem_total_bytes": mem_total,

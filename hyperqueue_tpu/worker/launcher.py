@@ -26,13 +26,13 @@ import asyncio
 import os
 import shutil
 import signal
-import time
 from dataclasses import dataclass
 from pathlib import Path
 
 from hyperqueue_tpu.ids import task_id_job, task_id_task
 from hyperqueue_tpu.utils.placeholders import fill_placeholders, task_placeholder_map
 from hyperqueue_tpu.worker.allocator import Allocation
+from hyperqueue_tpu.utils import clock
 
 
 def stderr_tail(stderr_path: str | None, nbytes: int = 2048) -> str:
@@ -285,7 +285,7 @@ async def launch_task(
         pumps=pumps,
         rm_if_finished=tuple(rm_paths),
         cleanup_dirs=tuple(cleanup_dirs),
-        spawned_wall=time.time(),
+        spawned_wall=clock.now(),
     )
 
 

@@ -27,6 +27,7 @@ import msgpack
 
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.worker.launcher import cleanup_task_files
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.worker.pool")
 
@@ -197,7 +198,7 @@ class _Runner:
             return
         if op == "spawned":
             task.pid = msg.get("pid", 0)
-            task.spawned_wall = time.time()
+            task.spawned_wall = clock.now()
             if task.spawned is not None and not task.spawned.done():
                 task.spawned.set_result(task.pid)
         elif op == "spawn_error":
@@ -293,7 +294,7 @@ class RunnerPool:
     async def _on_runner_exit(self, runner: _Runner) -> None:
         if self._closing or self.broken:
             return
-        now = time.monotonic()
+        now = clock.monotonic()
         self._restarts = [
             t for t in self._restarts if now - t < self.RESTART_WINDOW
         ]

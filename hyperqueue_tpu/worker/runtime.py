@@ -56,6 +56,7 @@ from hyperqueue_tpu.worker.launcher import (
     poolable,
 )
 from hyperqueue_tpu.worker.runner_pool import RunnerCrashed, RunnerPool
+from hyperqueue_tpu.utils import clock
 
 logger = logging.getLogger("hq.worker")
 
@@ -180,8 +181,8 @@ class WorkerRuntime:
         # writer: eviction may only close zero-refcount writers (closing an
         # in-use one fails its task's next write_chunk/close_task)
         self._streamer_users: dict[str, int] = {}
-        self.last_task_time = time.monotonic()
-        self.started_at = time.monotonic()
+        self.last_task_time = clock.monotonic()
+        self.started_at = clock.monotonic()
         self._conn: Connection | None = None
         self._send_lock = asyncio.Lock()
         self._sendq: asyncio.Queue = asyncio.Queue()
@@ -482,7 +483,7 @@ class WorkerRuntime:
             await self._connect(reattach=False)
             return
         window = self.configuration.reconnect_timeout_secs
-        deadline = time.monotonic() + window if window > 0 else None
+        deadline = clock.monotonic() + window if window > 0 else None
         delay = self.RECONNECT_BACKOFF_BASE
         while True:
             try:
@@ -509,7 +510,7 @@ class WorkerRuntime:
                 asyncio.IncompleteReadError,
                 asyncio.TimeoutError,
             ) as e:
-                now = time.monotonic()
+                now = clock.monotonic()
                 limit = self.configuration.time_limit_secs
                 if limit > 0 and now - self.started_at >= limit:
                     raise  # same contract as _reconnect_with_backoff
@@ -643,7 +644,7 @@ class WorkerRuntime:
         False once the reconnect window (`--reconnect-timeout`, 0 = keep
         trying forever) or the worker time limit is exhausted."""
         window = self.configuration.reconnect_timeout_secs
-        deadline = time.monotonic() + window if window > 0 else None
+        deadline = clock.monotonic() + window if window > 0 else None
         delay = self.RECONNECT_BACKOFF_BASE
         attempt = 0
         while True:
@@ -667,7 +668,7 @@ class WorkerRuntime:
                 asyncio.IncompleteReadError,
                 asyncio.TimeoutError,
             ) as e:
-                now = time.monotonic()
+                now = clock.monotonic()
                 limit = self.configuration.time_limit_secs
                 if limit > 0 and now - self.started_at >= limit:
                     logger.warning("time limit reached while reconnecting")
@@ -825,7 +826,7 @@ class WorkerRuntime:
                     # the compact wire header, stamp the accept clock;
                     # launch/spawn clocks follow in _run_task and
                     # everything is echoed on the task_running uplink
-                    tctx["accepted_at"] = time.time()
+                    tctx["accepted_at"] = clock.now()
                     task_msg["trace"] = tctx
                 self._try_start(task_msg)
         elif op == "cancel":
@@ -954,7 +955,7 @@ class WorkerRuntime:
                 {"op": "task_finished", "id": task_id, "instance": instance}
             )
             _TASKS_DONE.labels("finished").inc()
-            self.last_task_time = time.monotonic()
+            self.last_task_time = clock.monotonic()
             if allocation is not None:
                 self.allocator.release(allocation)
                 if self.blocked:
@@ -1002,7 +1003,7 @@ class WorkerRuntime:
                 extra_env["HQ_TOKEN"] = self.localcomm.register_task(task_id)
             tctx = task_msg.get("trace")
             if tctx is not None:
-                tctx["launch_at"] = time.time()
+                tctx["launch_at"] = clock.now()
             _t_spawn = time.perf_counter()
             launched = await self._launch(
                 task_msg, allocation, streamer, extra_env
@@ -1012,7 +1013,7 @@ class WorkerRuntime:
                 # the true spawn clock when the handle recorded one (runner
                 # ack / in-loop subprocess); dispatch-complete otherwise
                 tctx["spawned_at"] = (
-                    getattr(launched, "spawned_wall", 0.0) or time.time()
+                    getattr(launched, "spawned_wall", 0.0) or clock.now()
                 )
             rt = self.running.get(task_id)
             if rt is not None:
@@ -1050,7 +1051,7 @@ class WorkerRuntime:
             else:
                 code, detail = await launched.wait()
             if tctx is not None:
-                tctx["exited_at"] = time.time()
+                tctx["exited_at"] = clock.now()
             if task_id in self._discarded:
                 # killed as a stale incarnation at reconnect: exit silently
                 # (a report could pass the fence against a re-issued copy
@@ -1111,7 +1112,7 @@ class WorkerRuntime:
                     pass
         finally:
             self._discarded.discard(task_id)
-            self.last_task_time = time.monotonic()
+            self.last_task_time = clock.monotonic()
             if held_stream_dir is not None:
                 self._release_streamer(held_stream_dir)
             if self.localcomm is not None:
@@ -1132,7 +1133,7 @@ class WorkerRuntime:
         of the uplink span the server closes at receive time."""
         if tctx is None:
             return
-        now = time.time()
+        now = clock.now()
         msg["trace"] = {
             "id": tctx.get("id"),
             "parent": tctx.get("parent"),
@@ -1429,7 +1430,7 @@ class WorkerRuntime:
     async def _limits_loop(self) -> None:
         while True:
             await asyncio.sleep(0.5)
-            now = time.monotonic()
+            now = clock.monotonic()
             limit = self.configuration.time_limit_secs
             if limit > 0 and now - self.started_at >= limit:
                 logger.info("time limit reached; stopping")

@@ -71,6 +71,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "sim: deterministic cluster-simulator tests (virtual-clock loop, "
+        "seeded fault schedules, invariant checking; ISSUE 14)",
+    )
+    config.addinivalue_line(
+        "markers",
         "multichip: sharded multi-device solver tests; run on the virtual "
         "8-device CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_"
         "count=8, set above) so tier-1 exercises the 8-device path on "
