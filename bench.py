@@ -1141,6 +1141,180 @@ def run_federation_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_fleet_smoke() -> None:
+    """Fleet observability gate (ISSUE 15): 2 shards + a standby running
+    the lending coordinator.
+
+    A reconnect-mode worker registers with shard 0; an array job lands
+    on each shard (shard 1's requires the coordinator to LEND the
+    worker over, so completion itself proves the lending path). A
+    FleetFeed attached to the federation root must observe every
+    shard's task-finished events EXACTLY once under the right shard
+    label plus the structured lend departure, and one scrape of the
+    fleet metrics proxy must cover both shards under the latency bound.
+    Records a row in benchmarks/results/db.jsonl."""
+    import os
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv, start_fleet_proxy, wait_until
+
+    from hyperqueue_tpu.client.fleet import FleetFeed
+    from hyperqueue_tpu.utils.metrics import parse_exposition, scrape
+
+    n_tasks = 10
+    scrape_bound_s = 0.250
+    failures: list[str] = []
+    t_wall = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        with HqEnv(tmp) as env:
+            env.start_shard(0, 2, "--lease-timeout", "2")
+            env.start_shard(1, 2, "--lease-timeout", "2")
+            env.start_standby("--lease-timeout", "2",
+                              "--coordinator-interval", "0.25")
+            env.start_worker("--shard", "0", "--on-server-lost",
+                             "reconnect", cpus=2)
+            env.wait_workers(1)
+
+            feed = FleetFeed(env.server_dir, sample_interval=0.3,
+                             retry_delay=0.3)
+            feed.start()
+            frames: list[dict] = []
+
+            def collect() -> None:
+                for frame in feed.frames(timeout=2.0):
+                    frames.append(frame)
+
+            threading.Thread(target=collect, daemon=True).start()
+            wait_until(
+                lambda: all(s == "up" for s in feed.states.values()),
+                message="fleet feed live",
+            )
+
+            job_ids: dict[int, int] = {}
+            for shard in (0, 1):
+                os.environ["HQ_SHARD"] = str(shard)
+                try:
+                    out = env.command([
+                        "submit", "--array", f"0-{n_tasks - 1}", "--",
+                        "true",
+                    ])
+                finally:
+                    os.environ.pop("HQ_SHARD", None)
+                job_ids[shard] = int(out.split("job ID: ")[1].split()[0])
+            # shard 1's job can only finish if the coordinator lends the
+            # worker over — completion is the lending assert
+            env.command(["job", "wait", "all"], timeout=120)
+
+            def finished_events() -> dict:
+                seen: dict = {}
+                for frame in list(frames):
+                    if frame.get("op") != "events":
+                        continue
+                    for rec in frame["records"]:
+                        if rec.get("event") != "task-finished":
+                            continue
+                        key = (rec["shard"], rec["job"], rec["task"])
+                        seen[key] = seen.get(key, 0) + 1
+                return seen
+
+            try:
+                wait_until(
+                    lambda: len(finished_events()) >= 2 * n_tasks,
+                    timeout=30, message="fleet feed completeness",
+                )
+            except TimeoutError:
+                failures.append(
+                    f"feed saw {len(finished_events())} of "
+                    f"{2 * n_tasks} task-finished events"
+                )
+            seen = finished_events()
+            dups = {k: n for k, n in seen.items() if n != 1}
+            if dups:
+                failures.append(f"events not exactly-once: {dups}")
+            for shard, job_id in job_ids.items():
+                rows = [k for k in seen if k[0] == shard and k[1] == job_id]
+                if len(rows) != n_tasks:
+                    failures.append(
+                        f"shard {shard} job {job_id}: {len(rows)} of "
+                        f"{n_tasks} finishes observed under its label"
+                    )
+            lends = [
+                rec
+                for frame in list(frames) if frame.get("op") == "events"
+                for rec in frame["records"]
+                if rec.get("event") == "worker-lost"
+                and rec.get("lent_to") is not None
+            ]
+            if not lends:
+                failures.append("no structured lend event in the feed")
+
+            # --- metrics proxy: parallel fan-out scrape ----------------
+            scrape_s = float("inf")
+            text = ""
+            try:
+                proxy_port = start_fleet_proxy(env.server_dir)
+            except RuntimeError as e:
+                failures.append(str(e))
+            else:
+                for _ in range(3):  # best-of-3 dampens box noise
+                    t0 = time.perf_counter()
+                    text = scrape("127.0.0.1", proxy_port)
+                    scrape_s = min(scrape_s, time.perf_counter() - t0)
+            if text:
+                parsed = parse_exposition(text)
+                up = parsed.get("hq_federation_shard_up", {}).get(
+                    "samples", {}
+                )
+                for k in ("0", "1"):
+                    if up.get((
+                        "hq_federation_shard_up",
+                        frozenset({("shard", k)}),
+                    )) != 1.0:
+                        failures.append(
+                            f"proxy scrape missing shard {k} up"
+                        )
+                ticks = parsed.get("hq_scheduler_ticks_total", {}).get(
+                    "samples", {}
+                )
+                shard_labels = {
+                    dict(labels).get("shard") for _, labels in ticks
+                }
+                if not {"0", "1"} <= shard_labels:
+                    failures.append(
+                        f"proxy exposition lacks per-shard series: "
+                        f"{shard_labels}"
+                    )
+                if scrape_s > scrape_bound_s:
+                    failures.append(
+                        f"proxy scrape {scrape_s * 1e3:.1f}ms over the "
+                        f"{scrape_bound_s * 1e3:.0f}ms bound"
+                    )
+            feed.stop()
+    emit({
+        "experiment": "fleet_smoke",
+        "metric": "proxy_scrape_seconds",
+        "value": round(scrape_s, 4) if scrape_s != float("inf") else None,
+        "unit": "s",
+        "params": {
+            "shards": 2, "tasks_per_shard": n_tasks,
+            "scrape_bound_s": scrape_bound_s, "successor": "standby",
+        },
+        "events_observed": len(seen),
+        "lend_events": len(lends),
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    sys.exit(1 if failures else 0)
+
+
 def run_elasticity_smoke() -> None:
     """Self-healing elasticity gate (ISSUE 13): burst submit against an
     EMPTY local-handler pool.
@@ -2468,6 +2642,13 @@ def main() -> None:
                              "standby, SIGKILL shard 1 mid-job, measure "
                              "kill -> first successor-side completion, "
                              "assert the bound + exactly-once starts")
+    parser.add_argument("--fleet-smoke", action="store_true",
+                        help="fleet observability gate (ISSUE 15): 2 "
+                             "shards + standby w/ lending coordinator, "
+                             "assert fleet-feed completeness (every "
+                             "shard's task events exactly once) + a "
+                             "metrics-proxy scrape covering both shards "
+                             "under the latency bound")
     parser.add_argument("--sim-smoke", action="store_true",
                         help="deterministic-simulator gate: determinism "
                              "pair, scenario sweep, and the 100k-task/"
@@ -2524,6 +2705,10 @@ def main() -> None:
 
     if args.federation_smoke:
         run_federation_smoke()
+        return
+
+    if args.fleet_smoke:
+        run_fleet_smoke()
         return
 
     if args.elasticity_smoke:

@@ -20,6 +20,7 @@ from hyperqueue_tpu import __version__
 from hyperqueue_tpu.client.connection import (
     ClientError,
     ClientSession,
+    FederatedSession,
     open_session,
 )
 from hyperqueue_tpu.client.output import fail, make_output
@@ -268,6 +269,10 @@ def _run_standby(args, shards: int) -> None:
         lease_timeout=args.lease_timeout,
         coordinate=not getattr(args, "no_coordinator", False),
         sample_interval=args.coordinator_interval,
+        # the standby's endpoint keeps hq_federation_shard_up and
+        # failovers_total scrapeable through shard deaths (ISSUE 15)
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
     ))
 
 
@@ -1524,10 +1529,27 @@ def cmd_job_timeline(args) -> None:
 
 def cmd_server_reset_metrics(args) -> None:
     """Zero the server's metrics plane (registry, tracer spans, tick-phase
-    aggregates) so a benchmark can measure a steady-state window."""
+    aggregates) so a benchmark can measure a steady-state window. Under a
+    federation root, `--shard K|all` selects the shard(s) — `all` fans
+    out so one reset opens a fleet-wide window (ISSUE 15)."""
+    out = make_output(args.output_mode)
+    shard = getattr(args, "shard", None)
     with _session(args) as session:
-        session.request({"op": "reset_metrics"})
-    make_output(args.output_mode).message("metrics reset")
+        if shard is not None and not isinstance(session, FederatedSession):
+            # selector convention (cf. `hq top --shard`): a classic dir
+            # must not silently ignore the flag — the user would believe
+            # a shard-targeted window was opened when it was not
+            fail(f"--shard needs a federation root; "
+                 f"{_server_dir(args)} is a classic server dir")
+        result = session.request({"op": "reset_metrics", "shard": shard})
+    if "shards" in result:
+        for k, rec in enumerate(result["shards"]):
+            if rec.get("error"):
+                out.message(f"shard {k}: DOWN ({rec['error']})")
+            else:
+                out.message(f"shard {k}: metrics reset")
+        return
+    out.message("metrics reset")
 
 
 def cmd_job_cancel(args) -> None:
@@ -2434,6 +2456,9 @@ def build_parser() -> argparse.ArgumentParser:
              "for steady-state benchmark windows",
     )
     _add_common(p)
+    p.add_argument("--shard", default=None, metavar="K|all",
+                   help="federation: which shard to reset (default 0; "
+                        "'all' fans out for a fleet-wide window)")
     p.set_defaults(fn=cmd_server_reset_metrics)
     p = ssub.add_parser("wait", help="wait until the server is reachable")
     _add_common(p)
@@ -2876,14 +2901,46 @@ def build_parser() -> argparse.ArgumentParser:
 
     # top: push-fed live cluster view (subscribe RPC — no polling)
     p = sub.add_parser(
-        "top", help="live cluster view streamed from the subscribe RPC"
+        "top", help="live cluster view streamed from the subscribe RPC; "
+                    "against a federation root: the whole fleet"
     )
     _add_common(p)
     p.add_argument("--interval", type=float, default=1.0,
                    help="metric-sample refresh interval (seconds)")
     p.add_argument("--once", action="store_true",
                    help="print one sample and exit (scriptable)")
+    p.add_argument("--shard", type=int, default=None, metavar="K",
+                   help="federation: focus one shard with the classic "
+                        "single-server view (default: fleet view)")
     p.set_defaults(fn=cmd_top)
+
+    # fleet: cross-shard observability over a federation root (ISSUE 15)
+    fleet = sub.add_parser(
+        "fleet",
+        help="fleet observability over a federation root: metrics "
+             "federation + stitched trace export",
+    )
+    fsub = fleet.add_subparsers(dest="fleet_cmd", required=True)
+    p = fsub.add_parser(
+        "metrics-proxy",
+        help="serve one /metrics endpoint re-exporting every shard's "
+             "exposition under a shard label (dead shards appear as "
+             "hq_federation_shard_up 0)",
+    )
+    _add_common(p)
+    p.add_argument("--port", type=int, default=9090,
+                   help="port to serve on (0 = ephemeral, printed)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.set_defaults(fn=cmd_fleet_metrics_proxy)
+    p = fsub.add_parser(
+        "trace-export",
+        help="one Perfetto timeline for the whole fleet: a row group "
+             "per shard (ticks, boots/promotions, lease epochs, lending "
+             "moves, elasticity verdicts)",
+    )
+    _add_common(p)
+    p.add_argument("output", help="output path (e.g. fleet-trace.json)")
+    p.set_defaults(fn=cmd_fleet_trace_export)
 
     # doc + completion
     p = sub.add_parser("doc", help="show documentation topics")
@@ -2993,6 +3050,22 @@ def cmd_task_trace(args) -> None:
         out.message(
             "  missing hops: " + ", ".join(result["missing_hops"])
         )
+    for note in result.get("annotations") or ():
+        kind = note.get("kind")
+        if kind == "lend":
+            out.message(
+                f"  fleet: ran on worker {note.get('worker')} borrowed "
+                f"from shard {note.get('home_shard')} "
+                f"(host shard {note.get('host_shard')})"
+            )
+        elif kind == "failover":
+            out.message(
+                f"  fleet: survived failover of shard "
+                f"{note.get('shard')} (lease epoch "
+                f"{note.get('lease_epoch')})"
+            )
+        else:
+            out.message(f"  fleet: {note}")
     if not spans:
         return
     t_base = min(s["t0"] for s in spans)
@@ -3009,7 +3082,8 @@ def cmd_task_trace(args) -> None:
 
 
 def cmd_top(args) -> None:
-    """Live cluster view fed by the subscribe RPC (push, not polling)."""
+    """Live cluster view fed by the subscribe RPC (push, not polling);
+    a federation root renders the fleet view unless --shard focuses."""
     from hyperqueue_tpu.client.top import run_top
 
     rc = run_top(
@@ -3017,9 +3091,46 @@ def cmd_top(args) -> None:
         interval=args.interval,
         once=args.once,
         output_mode=args.output_mode,
+        shard=getattr(args, "shard", None),
     )
     if rc:
         raise SystemExit(rc)
+
+
+def cmd_fleet_metrics_proxy(args) -> None:
+    """`hq fleet metrics-proxy`: one scrape covers the fleet — every
+    shard's exposition under a `shard` label, dead shards visible as
+    hq_federation_shard_up 0 (ISSUE 15)."""
+    from hyperqueue_tpu.client.fleet import run_metrics_proxy
+
+    try:
+        run_metrics_proxy(_server_dir(args), args.port, host=args.host)
+    except ValueError as e:
+        fail(str(e))
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_fleet_trace_export(args) -> None:
+    """`hq fleet trace-export <out.json>`: the whole fleet as one
+    Perfetto timeline, a row group per shard."""
+    from hyperqueue_tpu.client.fleet import export_fleet_trace
+
+    try:
+        trace = export_fleet_trace(_server_dir(args))
+    except ValueError as e:
+        fail(str(e))
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    meta = trace.get("metadata") or {}
+    down = meta.get("down") or []
+    make_output(args.output_mode).message(
+        f"fleet trace written to {args.output} "
+        f"({meta.get('shards', 0)} shard(s), "
+        f"{len(trace.get('traceEvents') or ())} event(s)"
+        + (f", DOWN: {down}" if down else "")
+        + "); load at ui.perfetto.dev"
+    )
 
 
 def cmd_job_submit_file(args) -> None:

@@ -319,10 +319,11 @@ class FederatedSession:
             return self.submit_session().request(msg, timeout)
         shard = msg.pop("shard", None)
         if shard in ("all", -1, "-1") and op in (
-            "server_info", "server_stats"
+            "server_info", "server_stats", "reset_metrics"
         ):
             # per-shard fan-out: one record per shard (tick latencies and
-            # lease states are per-shard facts — never summed)
+            # lease states are per-shard facts — never summed; a
+            # reset_metrics window must cover every shard's registry)
             records = [
                 resp if resp is not None
                 else {"op": op, "shard_id": k, "error": str(err)}
@@ -631,13 +632,16 @@ def _resolve_stream_dir(server_dir: Path, shard: int = 0) -> Path:
 
 
 def _streaming_request(server_dir: Path, request: dict, on_subscribed=None,
-                       shard: int = 0):
+                       shard: int = 0, on_connected=None):
     """One authenticated client connection turned into a frame generator:
     send `request`, yield every received frame until the server closes or
     the consumer breaks out. Blocking-recv based (read_frame is not
     cancellation-safe, so no wait_for timeouts may wrap it).
     on_subscribed, when given, is called once the request is on the wire —
-    before the first frame is read."""
+    before the first frame is read. on_connected, when given, receives a
+    zero-arg CANCELLER safe to call from another thread: it schedules a
+    connection close on this generator's loop, waking the blocked recv
+    (how FleetFeed.stop() unwedges its feed threads)."""
     server_dir = _resolve_stream_dir(server_dir, shard)
 
     async def _connect():
@@ -660,6 +664,8 @@ def _streaming_request(server_dir: Path, request: dict, on_subscribed=None,
         conn = loop.run_until_complete(
             asyncio.wait_for(_connect(), _HANDSHAKE_TIMEOUT)
         )
+        if on_connected is not None:
+            on_connected(lambda: loop.call_soon_threadsafe(conn.close))
         if on_subscribed is not None:
             on_subscribed()
         while True:
@@ -679,7 +685,7 @@ def _streaming_request(server_dir: Path, request: dict, on_subscribed=None,
 
 def subscribe(server_dir: Path, filters=(), sample_interval: float = 0.0,
               buffer: int = 4096, overviews: bool = False,
-              on_subscribed=None, shard: int = 0):
+              on_subscribed=None, shard: int = 0, on_connected=None):
     """Generator of frames from the server's `subscribe` RPC: coalesced
     lifecycle-event frames ({"op": "events", "records": [...]}) plus
     periodic metric samples ({"op": "sample", ...}) when sample_interval
@@ -694,7 +700,7 @@ def subscribe(server_dir: Path, filters=(), sample_interval: float = 0.0,
         "overviews": overviews,
     }
     for msg in _streaming_request(server_dir, request, on_subscribed,
-                                  shard=shard):
+                                  shard=shard, on_connected=on_connected):
         yield msg
         if msg.get("op") == "sub_dropped":
             return

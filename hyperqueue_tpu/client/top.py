@@ -105,10 +105,166 @@ def _render(sample: dict, ticker: deque, dropped: int) -> str:
     return "\n".join(lines)
 
 
+def _fleet_row(shard: int, state: str, sample: dict | None) -> str:
+    """One shard's line in the fleet table (DOWN shards render a row —
+    that is the whole point; the client never crashes on a dead shard)."""
+    if sample is None:
+        return f"{shard:>5} {state.upper():<9} {'-':>5} {'-':>7} " \
+               f"{'-':>7} {'-':>7} {'-':>7} {'-':>6} {'-':>8} {'-':>5}"
+    fed = sample.get("federation") or {}
+    lag = (sample.get("lag") or {}).get("loop") or {}
+    label = "UP"
+    if fed.get("promoted"):
+        label = "UP*"  # promoted successor
+    borrowed = fed.get("workers_borrowed", 0)
+    return (
+        f"{shard:>5} {label:<9} "
+        f"{fed.get('lease_epoch', '-'):>5} "
+        f"{sample.get('n_workers', 0):>7} "
+        f"{borrowed:>7} "
+        f"{sample.get('running', 0):>7} "
+        f"{sample.get('ready', 0) + sample.get('mn_queued', 0):>7} "
+        f"{len(sample.get('pending_reasons') or {}):>6} "
+        + (f"{lag['last_ms']:>8.1f} " if lag.get("last_ms") is not None
+           else f"{'-':>8} ")
+        + f"{sample.get('alloc_quarantined', 0):>5}"
+    )
+
+
+def _render_fleet(states: dict, samples: dict, ticker: deque,
+                  lend_flows: dict) -> str:
+    """The fleet view: per-shard health rows + lending flows + merged
+    event ticker. Everything here comes off the FleetFeed — no polling."""
+    up = sum(1 for s in states.values() if s == "up")
+    lines = [
+        f"hq fleet — {len(states)} shard(s), {up} up",
+        f"{'shard':>5} {'state':<9} {'epoch':>5} {'workers':>7} "
+        f"{'borrow':>7} {'running':>7} {'backlog':>7} {'wait':>6} "
+        f"{'lag ms':>8} {'quar':>5}",
+    ]
+    for shard in sorted(states):
+        state = "up" if states[shard] == "up" else "down"
+        lines.append(_fleet_row(shard, state, samples.get(shard)))
+    if lend_flows:
+        lines.append(
+            "lend flows: " + "  ".join(
+                f"{a}→{b} ×{n}"
+                for (a, b), n in sorted(lend_flows.items())
+            )
+        )
+    if ticker:
+        lines.append("")
+        lines.append("recent events:")
+        for rec in list(ticker)[-10:]:
+            t = time.strftime("%H:%M:%S", time.localtime(rec.get("time", 0)))
+            rest = {
+                k: v for k, v in rec.items()
+                if k not in ("time", "seq", "event", "desc", "metrics",
+                             "hw", "shard")
+            }
+            lines.append(
+                f"  {t} [shard {rec.get('shard')}] "
+                f"{rec.get('event')} {rest}"
+            )
+    return "\n".join(lines)
+
+
+def _note_lend_flow(rec: dict, lend_flows: dict) -> None:
+    """Fold one structured lend event into the flow counters: the
+    lender's worker-lost carries `lent_to`, the borrower's
+    worker-connected carries `lent_from` (no string parsing — ISSUE 15).
+    Counted from the lender side only, so one move is one increment."""
+    if rec.get("event") == "worker-lost" and rec.get("lent_to") is not None:
+        key = (rec.get("shard"), rec["lent_to"])
+        lend_flows[key] = lend_flows.get(key, 0) + 1
+
+
+def run_fleet_top(server_dir: Path, interval: float = 1.0,
+                  once: bool = False, output_mode: str = "cli") -> int:
+    """`hq top` against a federation root: the whole fleet as one view,
+    fed by one FleetFeed (a subscribe stream per shard, merged). A
+    killed shard flips to DOWN and back to UP after its successor
+    promotes — the view rides failovers, it never crashes on them."""
+    from hyperqueue_tpu.client.fleet import FleetFeed, fleet_snapshot
+
+    if once:
+        samples = fleet_snapshot(server_dir, sample_interval=min(
+            max(interval, 0.2), 1.0
+        ))
+        states = {
+            k: ("up" if s is not None else "down")
+            for k, s in samples.items()
+        }
+        if output_mode == "json":
+            out = {
+                str(k): (
+                    {kk: vv for kk, vv in s.items() if kk != "op"}
+                    if s is not None else None
+                )
+                for k, s in samples.items()
+            }
+            print(json.dumps({"shards": out}))
+        else:
+            print(_render_fleet(states, samples, deque(), {}))
+        return 0
+
+    ticker: deque = deque(maxlen=64)
+    lend_flows: dict = {}
+    is_tty = sys.stdout.isatty()
+    feed = FleetFeed(server_dir, sample_interval=max(interval, 0.2))
+    try:
+        with feed:
+            for frame in feed.frames():
+                op = frame.get("op")
+                if op == "events":
+                    for rec in frame.get("records") or ():
+                        _note_lend_flow(rec, lend_flows)
+                        if not str(rec.get("event", "")).startswith(
+                            _TICKER_SKIP
+                        ):
+                            ticker.append(rec)
+                elif op not in ("sample", "shard-down", "shard-up"):
+                    continue
+                view = _render_fleet(
+                    dict(feed.states), dict(feed.last_sample), ticker,
+                    lend_flows,
+                )
+                if is_tty:
+                    sys.stdout.write("\x1b[H\x1b[J" + view + "\n")
+                else:
+                    sys.stdout.write(view + "\n---\n")
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+    return 0
+
+
 def run_top(server_dir: Path, interval: float = 1.0, once: bool = False,
-            output_mode: str = "cli") -> int:
-    """Drive the live view until interrupted (or one sample with --once)."""
+            output_mode: str = "cli", shard: int | None = None) -> int:
+    """Drive the live view until interrupted (or one sample with --once).
+
+    Against a federation root this is the FLEET view (all shards, DOWN
+    rows included) unless ``--shard K`` focuses one shard — which uses
+    the classic single-server view over that shard's subscribe feed."""
     from hyperqueue_tpu.client.connection import subscribe
+    from hyperqueue_tpu.utils import serverdir
+
+    fed = serverdir.load_federation(Path(server_dir))
+    if shard is None and fed is not None:
+        return run_fleet_top(server_dir, interval=interval, once=once,
+                             output_mode=output_mode)
+    if shard is not None:
+        # the info|stats --shard convention: a typo'd selector fails
+        # loudly instead of hanging on a nonexistent shard dir, and a
+        # classic dir must not silently ignore the flag
+        if fed is None:
+            print(f"--shard needs a federation root; {server_dir} is a "
+                  "classic server dir", file=sys.stderr)
+            return 1
+        count = int(fed["shard_count"])
+        if not (0 <= shard < count):
+            print(f"shard {shard} outside 0..{count - 1}", file=sys.stderr)
+            return 1
 
     ticker: deque = deque(maxlen=64)
     last_sample: dict | None = None
@@ -119,6 +275,7 @@ def run_top(server_dir: Path, interval: float = 1.0, once: bool = False,
             server_dir,
             sample_interval=max(interval, 0.2),
             overviews=not once,
+            shard=shard or 0,
         ):
             op = msg.get("op")
             if op == "events":

@@ -75,6 +75,10 @@ class _RestoreAcc:
         # trace the crashed one was assembling
         self.task_submit_trace: dict[tuple[int, int], dict] = {}
         self.task_wtrace: dict[tuple[int, int], dict] = {}
+        # lend annotations accumulate across task-started events — a
+        # later home-shard restart must not erase an earlier
+        # borrowed-worker start's note (the live store keeps every note)
+        self.task_lends: dict[tuple[int, int], list] = {}
         self.task_finish_wtrace: dict[tuple[int, int], dict] = {}
         self.task_trace_seed: dict[int, dict] = {}
         # unmaterialized lazy array chunks from a snapshot (ISSUE 10):
@@ -444,6 +448,20 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
             wt["_worker"] = (record.get("workers") or [0])[0]
             wt["_instance"] = record.get("instance", 0)
             acc.task_wtrace[key] = wt
+            lends = wt.get("lends")
+            if lends is None:
+                # legacy journals: scalar lent_from for the first worker
+                lf = wt.get("lent_from")
+                lends = ([[wt["_worker"], int(lf)]]
+                         if lf is not None and int(lf) >= 0 else [])
+            for lend_wid, home in lends:
+                acc.task_lends.setdefault(key, []).append({
+                    "worker": int(lend_wid),
+                    "home_shard": int(home),
+                    "instance": record.get("instance", 0),
+                    "time": float(record.get("started_at", 0.0))
+                    or float(record.get("time", 0.0)),
+                })
     elif kind == "task-restarted":
         key = (job_id, record["task"])
         acc.task_crashes[key] = record.get(
@@ -572,6 +590,16 @@ def _rebuild_traces(server, acc: _RestoreAcc) -> None:
             traces.begin(task_id, trace_id)
         instance = wt.get("_instance", acc.task_instances.get(key, 0))
         wid = wt.get("_worker", 0)
+        # fleet trace stitching (ISSUE 15): a start on a borrowed worker
+        # journaled its lend context — rebuild the same annotation the
+        # live EventBridge stamped (annotate() dedups against a snapshot-
+        # seeded copy)
+        for note in acc.task_lends.get(key, ()):
+            traces.annotate(task_id, {
+                "kind": "lend",
+                "host_shard": getattr(server, "shard_id", 0),
+                **note,
+            })
         parent = None
         sent = float(sub.get("sent_at") or 0.0)
         recv = float(sub.get("recv_at") or 0.0)

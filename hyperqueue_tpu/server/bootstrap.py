@@ -354,6 +354,26 @@ class EventBridge:
         )
         self._record_start_spans(task, task_id, instance_id, worker_ids,
                                  wtrace)
+        # fleet trace stitching (ISSUE 15): a task started on a BORROWED
+        # worker notes the lend — home shard, host shard — on its trace;
+        # the fact also rides the journal event so a restored successor
+        # rebuilds the same annotation
+        lends = []
+        for wid_ in worker_ids:
+            w = self.server.core.workers.get(wid_)
+            lf = (getattr(w.configuration, "lent_from", -1)
+                  if w is not None else -1)
+            if lf >= 0:
+                lends.append((wid_, lf))
+        for wid_, lf in lends:
+            self.server.core.traces.annotate(task_id, {
+                "kind": "lend",
+                "worker": wid_,
+                "home_shard": lf,
+                "host_shard": self.server.shard_id,
+                "instance": instance_id,
+                "time": started_at or clock.now(),
+            })
         # instance + chosen variant ride along (reference task-started
         # events carry instance/worker/variant, tests/test_events.py
         # test_event_running_variant)
@@ -370,7 +390,13 @@ class EventBridge:
         # through events/restore.py)
         trace_id = self.server.core.traces.trace_id(task_id)
         if trace_id is not None:
-            payload["trace"] = {"id": trace_id, **(wtrace or {})}
+            tctx = {"id": trace_id, **(wtrace or {})}
+            if lends:
+                # all (worker, home_shard) lend pairs ride the journal so
+                # restore rebuilds every gang member's annotation, not just
+                # the first worker's
+                tctx["lends"] = [[wid_, lf] for wid_, lf in lends]
+            payload["trace"] = tctx
         self.server.emit_event("task-started", payload)
 
     def on_task_restarted(self, task_id):
@@ -437,12 +463,18 @@ class EventBridge:
             for rid, amount in enumerate(worker.resources.amounts)
             if amount > 0 and rid < len(names)
         }
-        self.server.emit_event(
-            "worker-connected",
-            {"id": worker.worker_id, "hostname": worker.configuration.hostname,
-             "group": worker.group, "resources": resources,
-             "alloc_id": worker.configuration.alloc_id},
-        )
+        payload = {
+            "id": worker.worker_id,
+            "hostname": worker.configuration.hostname,
+            "group": worker.group, "resources": resources,
+            "alloc_id": worker.configuration.alloc_id,
+        }
+        lent_from = getattr(worker.configuration, "lent_from", -1)
+        if lent_from >= 0:
+            # the borrow side of a lend: the fleet feed pairs this with
+            # the lender's worker-lost `lent_to` to draw the flow
+            payload["lent_from"] = lent_from
+        self.server.emit_event("worker-connected", payload)
 
     def on_worker_lost(self, worker_id, reason):
         # structured loss record: how stale the last heartbeat was, and
@@ -450,12 +482,14 @@ class EventBridge:
         # won't; a heartbeat timeout / connection loss might — it would
         # re-register under a new id, its stale tasks fenced by instance)
         past = self.server.past_workers.get(worker_id) or {}
-        self.server.emit_event(
-            "worker-lost",
-            {"id": worker_id, "reason": reason,
-             "heartbeat_age": past.get("heartbeat_age"),
-             "reattach_eligible": reason != "stopped"},
-        )
+        payload = {"id": worker_id, "reason": reason,
+                   "heartbeat_age": past.get("heartbeat_age"),
+                   "reattach_eligible": reason != "stopped"}
+        if past.get("lent_to") is not None:
+            # structured lend target: consumers render lending flows
+            # without parsing the human reason string (ISSUE 15)
+            payload["lent_to"] = past["lent_to"]
+        self.server.emit_event("worker-lost", payload)
         self.server._draining.pop(worker_id, None)
         # crash-loop containment: the autoalloc service tracks how long
         # allocation-spawned workers survived after registration
@@ -814,6 +848,24 @@ class Server:
                 await asyncio.get_running_loop().run_in_executor(
                     None, restore_from_journal, self
                 )
+                if self.promoted and self.core.traces.enabled:
+                    # fleet trace stitching (ISSUE 15): every trace still
+                    # open at promotion lived through the shard death —
+                    # stamp the failover (lease epoch) so `hq task trace`
+                    # and the fleet export show the seam
+                    stamped = self.core.traces.annotate_open({
+                        "kind": "failover",
+                        "shard": self.shard_id,
+                        "lease_epoch": (
+                            self.lease.epoch if self.lease else 0
+                        ),
+                        "time": clock.now(),
+                    })
+                    if stamped:
+                        logger.info(
+                            "stamped failover annotation on %d open "
+                            "trace(s)", stamped,
+                        )
             self.journal.open_for_append()
             if self.journal_plane == "thread":
                 self.jplane = JournalPlane(
@@ -2266,15 +2318,21 @@ class Server:
                     lent_to = self._lent_workers.pop(worker_id, None)
                     if worker.clean_stop:
                         reason = "stopped"
+                        lent_to = None
                     elif lent_to is not None and not worker.assigned_tasks:
                         # only an IDLE departure is the lend completing; a
                         # worker that picked up work in the lend window
                         # aborts the redirect, so a busy disconnect here
-                        # is a genuine loss (its tasks requeue/reattach)
+                        # is a genuine loss (its tasks requeue/reattach).
+                        # The human string stays for logs; `lent_to` is
+                        # the structured field the fleet feed renders
+                        # lending flows from (ISSUE 15)
                         reason = f"lent to shard {lent_to}"
                     else:
                         reason = "connection lost"
-                    self._record_past_worker(worker_id, reason)
+                        lent_to = None
+                    self._record_past_worker(worker_id, reason,
+                                             lent_to=lent_to)
                     reactor.on_remove_worker(
                         self.core, self.comm, self.events, worker_id, reason
                     )
@@ -2764,6 +2822,12 @@ class Server:
         self.core.tick_cache.full_rebuilds = 0
         self.core.tick_cache.incremental_syncs = 0
         return {"op": "ok"}
+
+    async def _client_metrics_render(self, msg: dict) -> dict:
+        """The full Prometheus exposition over the client plane — the
+        fleet metrics proxy (ISSUE 15) scrapes shards through this RPC so
+        one federated scrape needs no per-shard --metrics-port wiring."""
+        return {"op": "metrics", "text": REGISTRY.render()}
 
     async def _client_job_timeline(self, msg: dict) -> dict:
         """Per-task lifecycle timeline of one job, aggregated server-side:
@@ -3873,7 +3937,8 @@ class Server:
                     })
         return {"op": "trace_export", "traceEvents": events}
 
-    def _record_past_worker(self, worker_id: int, reason: str) -> None:
+    def _record_past_worker(self, worker_id: int, reason: str,
+                            lent_to: int | None = None) -> None:
         w = self.core.workers.get(worker_id)
         if w is None:
             return
@@ -3887,6 +3952,10 @@ class Server:
             "overview": None,
             "lost_at": clock.now(),
             "reason": reason,
+            # structured lend target (None for a genuine loss): the fleet
+            # feed and `hq top` render lending flows from this field, the
+            # human `reason` string stays for logs (ISSUE 15)
+            "lent_to": lent_to,
             # age of the last heartbeat at loss time — for a heartbeat
             # timeout this is how long the worker was silent
             "heartbeat_age": round(clock.monotonic() - w.last_heartbeat, 3),
@@ -4095,17 +4164,23 @@ class Server:
         core = self.core
         workers = []
         running_total = 0
+        borrowed = 0
         for w in core.workers.values():
             running_total += len(w.assigned_tasks)
             hw = (w.last_overview or {}).get("hw") or {}
-            workers.append({
+            row = {
                 "id": w.worker_id,
                 "hostname": w.configuration.hostname,
                 "running": len(w.assigned_tasks),
                 "prefilled": len(w.prefilled_tasks),
                 "draining": w.draining,
                 "cpu": hw.get("cpu_usage_percent"),
-            })
+            }
+            lent_from = getattr(w.configuration, "lent_from", -1)
+            if lent_from >= 0:
+                row["lent_from"] = lent_from
+                borrowed += 1
+            workers.append(row)
         latest = core.flight.latest() or {}
         pending_reasons: dict[str, int] = {}
         for entry in latest.get("unplaced") or ():
@@ -4119,7 +4194,7 @@ class Server:
         for job in jobs.values():
             status = job.status()
             job_counts[status] = job_counts.get(status, 0) + 1
-        return {
+        sample = {
             "op": "sample",
             "time": clock.now(),
             "uptime": round(clock.now() - self.started_at, 1),
@@ -4140,6 +4215,25 @@ class Server:
             "stalls": self.stalls_captured,
             "subscribers": len(self._subscribers),
         }
+        if self.federation_root is not None:
+            # fleet view context (ISSUE 15) — all in-memory reads, no
+            # lease-file I/O on the sample path (self.lease.epoch is the
+            # holder's authoritative copy)
+            sample["federation"] = {
+                "shard_id": self.shard_id,
+                "shard_count": self.shard_count,
+                "lease_epoch": self.lease.epoch if self.lease else 0,
+                "promoted": self.promoted,
+                "workers_lent": self.workers_lent_total,
+                "workers_borrowed": borrowed,
+            }
+        autoalloc = getattr(self, "autoalloc", None)
+        if autoalloc is not None and autoalloc.state.queues:
+            sample["alloc_quarantined"] = sum(
+                1 for q in autoalloc.state.queues.values()
+                if q.state == "quarantined"
+            )
+        return sample
 
     async def _subscribe(self, send, gone: asyncio.Event,
                          msg: dict) -> None:
@@ -4276,6 +4370,8 @@ class Server:
                 sum(s["t1"] - s["t0"] for s in spans), 6
             ),
             "spans": spans,
+            # fleet annotations (ISSUE 15): lend / failover stamps
+            "annotations": list(rec.get("notes") or ()),
         }
 
     # --- reactor lag + stall watchdog (ISSUE 8c) ----------------------

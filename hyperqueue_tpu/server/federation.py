@@ -52,6 +52,17 @@ _FAILOVERS = REGISTRY.counter(
     "dead shards claimed and promoted by this process's failover watcher",
 )
 
+# exported from the WATCHER/coordinator process (standby or
+# --failover-watch peer), not the shard itself: the per-shard
+# hq_federation_lease_age_seconds gauge vanishes exactly when the shard
+# dies — this one survives the death it reports (ISSUE 15)
+_SHARD_UP = REGISTRY.gauge(
+    "hq_federation_shard_up",
+    "1 while the shard's lease is held (live owner), 0 while it is "
+    "stale or absent — set by the failover watcher's lease scan",
+    labels=("shard",),
+)
+
 JOURNAL_NAME = "journal.bin"
 
 
@@ -318,11 +329,16 @@ class FailoverWatcher:
                 self.promoted.pop(shard_id, None)
                 del self._promoted_tasks[shard_id]
         for shard_id in range(fed["shard_count"]):
-            if shard_id == self.own_shard or shard_id in self.promoted:
-                continue
             shard_dir = serverdir.shard_path(self.root, shard_id)
             lease = ShardLease(shard_dir, self.lease_timeout)
-            if lease.state() != "stale":
+            state = lease.state()
+            # liveness gauge for EVERY shard (own shard included): the
+            # scan is the one place that reads all leases anyway, and a
+            # scraper needs the dead shard's 0 from a surviving process
+            _SHARD_UP.labels(shard_id).set(1.0 if state == "held" else 0.0)
+            if shard_id == self.own_shard or shard_id in self.promoted:
+                continue
+            if state != "stale":
                 # "absent" = never started or cleanly stopped: an operator
                 # decision, not a death — nothing to fail over
                 continue
@@ -404,6 +420,8 @@ async def standby_main(
     poll: float | None = None,
     coordinate: bool = True,
     sample_interval: float = 1.0,
+    metrics_port: int | None = None,
+    metrics_host: str = "0.0.0.0",
 ) -> None:
     """`hq server start --standby`: a warm successor process.
 
@@ -426,6 +444,20 @@ async def standby_main(
             root, sample_interval=sample_interval
         )
         coordinator.start()
+    metrics_server = None
+    if metrics_port is not None:
+        # the standby is the process that SURVIVES shard deaths, so its
+        # endpoint is where hq_federation_shard_up / failovers_total stay
+        # scrapeable through a failover (ISSUE 15)
+        from hyperqueue_tpu.utils.metrics import start_metrics_server
+
+        metrics_server, bound = await start_metrics_server(
+            REGISTRY, metrics_port, host=metrics_host
+        )
+        print(
+            f"| standby metrics on http://{metrics_host}:{bound}/metrics",
+            flush=True,
+        )
     watcher = FailoverWatcher(
         root,
         server_kwargs=server_kwargs,
@@ -441,4 +473,6 @@ async def standby_main(
     finally:
         if coordinator is not None:
             coordinator.stop()
+        if metrics_server is not None:
+            metrics_server.close()
         await watcher.shutdown()

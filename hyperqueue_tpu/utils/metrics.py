@@ -388,15 +388,16 @@ REGISTRY = MetricsRegistry()
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-async def start_metrics_server(registry: MetricsRegistry, port: int,
-                               host: str = "0.0.0.0"):
-    """Serve GET /metrics on (host, port). Returns (asyncio server, bound
-    port) — pass port 0 for an ephemeral port (tests/CI).
+async def start_exposition_server(render, port: int, host: str = "0.0.0.0"):
+    """Serve GET /metrics on (host, port), answering with render()'s text
+    (render may be sync or async). Returns (asyncio server, bound port) —
+    pass port 0 for an ephemeral port (tests/CI).
 
     Deliberately minimal HTTP/1.0-style handling: read the request head,
     answer one response, close. A metrics endpoint needs no keep-alive, no
     TLS, no routing beyond /metrics."""
     import asyncio
+    import inspect
 
     async def handle(reader, writer):
         try:
@@ -408,7 +409,10 @@ async def start_metrics_server(registry: MetricsRegistry, port: int,
             parts = request.split()
             path = parts[1].decode("latin-1") if len(parts) > 1 else "/"
             if path.split("?")[0] in ("/", "/metrics"):
-                body = registry.render().encode("utf-8")
+                text = render()
+                if inspect.isawaitable(text):
+                    text = await text
+                body = text.encode("utf-8")
                 head = (
                     "HTTP/1.1 200 OK\r\n"
                     f"Content-Type: {CONTENT_TYPE}\r\n"
@@ -436,6 +440,13 @@ async def start_metrics_server(registry: MetricsRegistry, port: int,
     server = await asyncio.start_server(handle, host, port)
     bound = server.sockets[0].getsockname()[1]
     return server, bound
+
+
+async def start_metrics_server(registry: MetricsRegistry, port: int,
+                               host: str = "0.0.0.0"):
+    """Serve a registry's exposition on (host, port); see
+    start_exposition_server."""
+    return await start_exposition_server(registry.render, port, host)
 
 
 def scrape(host: str, port: int, timeout: float = 5.0) -> str:
@@ -553,6 +564,99 @@ def _split_labels(raw: str) -> list[str]:
     if buf:
         parts.append("".join(buf))
     return [p for p in (s.strip() for s in parts) if p]
+
+
+def relabel_exposition(text: str, label: str, value: str) -> list[tuple]:
+    """Parse one Prometheus text exposition into
+    ``[(metric_name, help_line, type_line, [sample_line, ...]), ...]``
+    with ``label="value"`` injected into every sample line.
+
+    This is the fleet metrics proxy's building block (ISSUE 15): each
+    shard's exposition is re-labelled with its shard id, then
+    ``merge_expositions`` regroups the per-shard fragments so every
+    metric's samples sit under ONE HELP/TYPE header (the text format
+    forbids a metric appearing twice). Text-level on purpose — values
+    round-trip byte-exact, no float re-formatting."""
+    groups: list[tuple] = []
+    current: list | None = None
+    types: dict[str, str] = {}
+    injected = f'{label}="{_escape_label_value(value)}"'
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(None, 3)[2]
+            current = [name, line, None, []]
+            groups.append(current)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            types[name] = mtype
+            if current is None or current[0] != name:
+                current = [name, None, line, []]
+                groups.append(current)
+            else:
+                current[2] = line
+            continue
+        if line.startswith("#"):
+            continue
+        if "{" in line:
+            head, rest = line.split("{", 1)
+            line = f"{head}{{{injected}," + rest
+        else:
+            sample_name, _, sample_value = line.rpartition(" ")
+            line = f"{sample_name}{{{injected}}} {sample_value}"
+        # _bucket/_sum/_count samples belong to their histogram's group
+        sample_base = line.split("{", 1)[0]
+        base = sample_base
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in types:
+                base = base[: -len(suffix)]
+                break
+        if current is None or current[0] != base:
+            current = next((g for g in groups if g[0] == base), None)
+            if current is None:
+                current = [base, None, None, []]
+                groups.append(current)
+        current[3].append(line)
+    return [tuple(g) for g in groups]
+
+
+def merge_expositions(shard_texts: dict[str, str],
+                      label: str = "shard",
+                      exclude: frozenset = frozenset()) -> str:
+    """One fleet-wide exposition from per-shard scrapes: every sample
+    gains ``label="<shard>"`` and same-named metrics across shards merge
+    under a single HELP/TYPE header (required by the text format). Shard
+    order in the dict decides whose HELP text wins ties (they are
+    identical across shards in practice). ``exclude`` drops metrics the
+    caller synthesizes itself (the proxy's shard_up rows — a shard
+    running a --failover-watch scan exports its OWN shard-labelled
+    copies, which would collide with the injected label)."""
+    merged: dict[str, list] = {}
+    for shard, text in shard_texts.items():
+        for name, help_line, type_line, samples in relabel_exposition(
+            text, label, str(shard)
+        ):
+            if name in exclude:
+                continue
+            entry = merged.get(name)
+            if entry is None:
+                entry = merged[name] = [help_line, type_line, []]
+            else:
+                entry[0] = entry[0] or help_line
+                entry[1] = entry[1] or type_line
+            entry[2].extend(samples)
+    out: list[str] = []
+    for name in sorted(merged):
+        help_line, type_line, samples = merged[name]
+        if help_line:
+            out.append(help_line)
+        if type_line:
+            out.append(type_line)
+        out.extend(samples)
+    return "\n".join(out) + "\n"
 
 
 def histogram_summary(parsed: dict, name: str) -> dict:

@@ -162,7 +162,18 @@ class TaskTraceStore:
     "parent"}`` deduplicated on (name, instance) — a reattach or a journal
     replay re-reporting a hop must not double it (the single-timeline
     contract from PR 3).  ``capacity=0`` disables the store entirely.
+
+    Records may also carry fleet ``notes`` (ISSUE 15): point annotations
+    stamped by cross-shard machinery — a worker lend (home/host shard), a
+    failover promotion (lease epoch) — deduplicated on their identity
+    keys so a journal replay or reattach re-reporting one keeps a single
+    annotation. They ride snapshots and restores with the spans.
     """
+
+    #: keys that identify an annotation for dedup (everything except the
+    #: wall stamp, which legitimately differs between live and replay)
+    _NOTE_IDENTITY = ("kind", "instance", "worker", "home_shard",
+                      "host_shard", "shard", "lease_epoch")
 
     def __init__(self, capacity: int = 16384):
         self.capacity = max(int(capacity), 0)
@@ -198,11 +209,14 @@ class TaskTraceStore:
         if not self.enabled or not isinstance(rec, dict):
             return
         done = bool(rec.get("done"))
-        self._traces[task_id] = {
+        adopted = {
             "trace_id": rec.get("trace_id") or new_trace_id(),
             "spans": list(rec.get("spans") or ()),
             "done": done,
         }
+        if rec.get("notes"):
+            adopted["notes"] = [dict(n) for n in rec["notes"]]
+        self._traces[task_id] = adopted
         self._traces.move_to_end(task_id)
         if done:
             self._closed.append(task_id)
@@ -239,6 +253,35 @@ class TaskTraceStore:
             "parent": parent,
         })
         return span_id
+
+    def annotate(self, task_id: int, note: dict) -> None:
+        """Attach one fleet annotation ({"kind", ...}) to a task's trace.
+        Idempotent on the note's identity keys — restore replay and
+        reattach re-report the same lend/failover fact."""
+        if not self.enabled:
+            return
+        rec = self._traces.get(task_id)
+        if rec is None:
+            return
+        notes = rec.setdefault("notes", [])
+        identity = tuple(note.get(k) for k in self._NOTE_IDENTITY)
+        for existing in notes:
+            if tuple(
+                existing.get(k) for k in self._NOTE_IDENTITY
+            ) == identity:
+                return
+        notes.append(dict(note))
+
+    def annotate_open(self, note: dict) -> int:
+        """Annotate every trace still open (not done) — the failover
+        promotion stamp: each task that lived through the shard death
+        carries the epoch it survived. Returns how many were stamped."""
+        stamped = 0
+        for task_id, rec in self._traces.items():
+            if not rec["done"]:
+                self.annotate(task_id, note)
+                stamped += 1
+        return stamped
 
     def get(self, task_id: int) -> dict | None:
         return self._traces.get(task_id)
@@ -282,11 +325,14 @@ class TaskTraceStore:
         for tid in task_ids:
             rec = self._traces.get(tid)
             if rec is not None:
-                out[tid] = {
+                copied = {
                     "trace_id": rec["trace_id"],
                     "spans": list(rec["spans"]),
                     "done": rec["done"],
                 }
+                if rec.get("notes"):
+                    copied["notes"] = [dict(n) for n in rec["notes"]]
+                out[tid] = copied
         return out
 
     def stats(self) -> dict:

@@ -136,6 +136,47 @@ def _env_base() -> dict:
     return env
 
 
+def start_fleet_proxy(root: Path, host: str = "127.0.0.1",
+                      timeout: float = 10.0) -> int:
+    """Run the fleet metrics proxy on an ephemeral port in a daemon
+    thread; returns the bound port (shared by tests/test_fleet.py and
+    bench.py --fleet-smoke). Raises RuntimeError — carrying the proxy's
+    own startup error when there is one — if it fails to bind."""
+    import asyncio
+    import threading
+
+    from hyperqueue_tpu.client.fleet import start_metrics_proxy
+
+    bound: dict = {}
+    ready = threading.Event()
+
+    def run() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            server, port = await start_metrics_proxy(root, 0, host=host)
+            bound["port"] = port
+            ready.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            loop.run_until_complete(go())
+        except Exception as e:  # noqa: BLE001
+            bound.setdefault("error", repr(e))
+            ready.set()  # unblock the waiter; teardown noise after the
+            # port is bound is harmless
+
+    threading.Thread(target=run, daemon=True, name="fleet-proxy").start()
+    if not ready.wait(timeout) or "port" not in bound:
+        raise RuntimeError(
+            "metrics proxy failed to start: "
+            + bound.get("error", "timed out")
+        )
+    return bound["port"]
+
+
 def wait_until(predicate, timeout=15.0, interval=0.05, message="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
