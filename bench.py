@@ -2506,6 +2506,88 @@ def run_sim_smoke(args) -> None:
         except Exception as e:  # noqa: BLE001 - recorded as a failure
             failures.append(f"{name}: {type(e).__name__}: {e}")
 
+    # --- fused-solve A/B (ISSUE 16): the same seeded workload under the
+    # host-greedy baseline and the fused gang/lookahead scheduler.  The
+    # gang-heavy and stress-dag rows are GATES: fused makespan must not
+    # exceed the host baseline, every gang must start atomically (the
+    # monitor's gang-atomicity invariant + the gang_starts count), and
+    # fused tick p95 must stay inside the north-star budget. ---
+    ab_rows = []
+    ab_specs = (
+        ("gang-heavy", "gang",
+         dict(n_gangs=8, gang_size=4, filler_tasks=600), 8, 11, True),
+        ("stress-dag", "dag", dict(layers=12, width=30), 8, 5, True),
+        ("tail", "tail", dict(n_tasks=800), 12, 7, False),
+    )
+    for label, name, kwargs, workers, seed, gated in ab_specs:
+        wl = build(name, seed=seed, **kwargs)
+        try:
+            base = run_scenario(wl, seed=seed, n_workers=workers,
+                                scheduler="greedy-numpy")
+            fused = run_scenario(wl, seed=seed, n_workers=workers,
+                                 scheduler="greedy-fused")
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"ab:{label}: {type(e).__name__}: {e}")
+            continue
+        ticks = sorted(fused.tick_ms)
+        p95 = ticks[min(int(len(ticks) * 0.95), len(ticks) - 1)] \
+            if ticks else 0.0
+        row = {
+            "workload": label, "n_tasks": wl.n_tasks,
+            "makespan_host_s": round(base.makespan, 2),
+            "makespan_fused_s": round(fused.makespan, 2),
+            "fused_vs_host": round(fused.makespan / base.makespan, 4)
+            if base.makespan else 0.0,
+            "gang_starts": fused.audit.get("gang_starts", 0),
+            "fused_tick_p95_ms": round(p95, 3),
+        }
+        ab_rows.append(row)
+        if gated and fused.makespan > base.makespan + 1e-6:
+            failures.append(
+                f"ab:{label}: fused makespan {fused.makespan:.2f}s > "
+                f"host baseline {base.makespan:.2f}s"
+            )
+        if gated and p95 > 50.0:
+            failures.append(
+                f"ab:{label}: fused tick p95 {p95:.1f}ms > 50ms budget"
+            )
+        if name == "gang" and \
+                fused.audit.get("gang_starts", 0) != kwargs["n_gangs"]:
+            failures.append(
+                f"ab:{label}: expected {kwargs['n_gangs']} atomic gang "
+                f"starts, saw {fused.audit.get('gang_starts', 0)}"
+            )
+
+    # --- journal replay --compare-scheduler row (sim/replay.py): record
+    # a gang run's journal, rebuild the workload from it, A/B the
+    # schedulers on the replay ---
+    replay_row = {}
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from hyperqueue_tpu.sim.replay import replay_compare
+
+    jdir = _Path(_tempfile.mkdtemp(prefix="hq-sim-replay-"))
+    try:
+        wl = build("gang", seed=3, n_gangs=4, gang_size=3,
+                   filler_tasks=150)
+        run_scenario(wl, seed=3, n_workers=9, server_dir=jdir)
+        cmp_res = replay_compare(
+            jdir / "journal.bin", "greedy-numpy", "greedy-fused",
+            seed=3, n_workers=9,
+        )
+        replay_row = {
+            "makespan_host_s": round(cmp_res.makespan_a, 2),
+            "makespan_fused_s": round(cmp_res.makespan_b, 2),
+            "assigned_host": cmp_res.assigned_a,
+            "assigned_fused": cmp_res.assigned_b,
+            "summary": cmp_res.summary(),
+        }
+    except Exception as e:  # noqa: BLE001 - recorded as a failure
+        failures.append(f"replay-compare: {type(e).__name__}: {e}")
+    finally:
+        _shutil.rmtree(jdir, ignore_errors=True)
+
     # --- acceptance soak: 100k tasks / 1k workers / kill -9 + churn --
     n_tasks = args.sim_tasks
     n_workers = args.sim_workers
@@ -2557,12 +2639,199 @@ def run_sim_smoke(args) -> None:
         "determinism_ok": det_ok,
         "soak": soak_row,
         "scenarios": scenarios,
+        "ab": ab_rows,
+        "replay_compare": replay_row,
         "ok": not failures,
         "failures": failures,
         "wall_s": round(time.perf_counter() - t_wall, 2),
     })
+    # --- regression gate: the row just stored vs its prior rows ------
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="sim_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} sim_smoke metric(s) "
+                      f"within 20% of baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
     print("sim-smoke:", "OK" if not failures else failures)
     sys.exit(1 if failures else 0)
+
+
+# --- result-db regression gate (ISSUE 16) ------------------------------
+# Metric direction heuristics: a regression is movement in the BAD
+# direction; metrics whose direction the name/unit doesn't reveal are
+# skipped rather than guessed.
+_HIGHER_BETTER = ("per_s", "per_wall", "tasks_per", "throughput",
+                  "vs_baseline", "speedup", "ratio_vs")
+_LOWER_BETTER = ("_ms", "_s", "latency", "makespan", "wall", "overhead",
+                 "p95", "p99", "restore")
+
+
+def _metric_direction(name: str, unit: str = "") -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (skipped)."""
+    n = str(name).lower()
+    for hint in _HIGHER_BETTER:
+        if hint in n:
+            return 1
+    u = str(unit or "").lower()
+    if "/s" in u or "per s" in u:
+        return 1
+    if u in ("ms", "us", "s", "seconds", "secs"):
+        return -1
+    for hint in _LOWER_BETTER:
+        if hint in n:
+            return -1
+    return 0
+
+
+def check_regressions(window: int = 5, threshold: float = 0.20,
+                      experiment: str | None = None, db_path=None):
+    """Compare the newest row of every (experiment, config) group in the
+    result db against the median of up to `window` prior rows.
+
+    Returns (n_metrics_checked, regressions): each regression names the
+    experiment, metric, baseline, current value, and relative change.
+    Groups with fewer than 2 rows or metrics of unknown direction are
+    skipped — the gate only fires on evidence."""
+    import statistics
+    from pathlib import Path as _Path
+
+    sys.path.insert(0, str(_Path(__file__).resolve().parent / "benchmarks"))
+    from database import Database, config_key
+
+    db = Database(db_path) if db_path is not None else Database()
+    groups: dict = {}
+    for r in db.records():
+        if experiment is not None and r.experiment != experiment:
+            continue
+        groups.setdefault((r.experiment, config_key(r.params)), []).append(r)
+
+    checked = 0
+    regressions = []
+    for (exp, _key), rows in sorted(groups.items()):
+        rows.sort(key=lambda r: r.timestamp)
+        if len(rows) < 2:
+            continue
+        cur, base = rows[-1], rows[-(window + 1):-1]
+        for name, value in sorted(cur.values.items()):
+            if not isinstance(value, (int, float)) or value <= 0:
+                continue
+            # rows emitted as {"metric": X, "value": v} carry the real
+            # metric name in params
+            metric_name = (str(cur.params.get("metric"))
+                           if name == "value" and cur.params.get("metric")
+                           else name)
+            direction = _metric_direction(
+                metric_name, str(cur.params.get("unit", "")))
+            if direction == 0:
+                continue
+            samples = [
+                r.values[name] for r in base
+                if isinstance(r.values.get(name), (int, float))
+                and r.values[name] > 0
+            ]
+            if not samples:
+                continue
+            baseline = statistics.median(samples)
+            checked += 1
+            # positive = worse, for either direction
+            regress = (baseline - value) / baseline * direction
+            if regress > threshold:
+                regressions.append({
+                    "experiment": exp,
+                    "metric": metric_name,
+                    "baseline": round(baseline, 4),
+                    "current": round(value, 4),
+                    "change_pct": round(regress * 100, 1),
+                    "n_baseline_rows": len(samples),
+                })
+    return checked, regressions
+
+
+def run_regress(args) -> None:
+    """`bench.py --regress`: fail (exit 1) when the newest result-db row
+    of any experiment regressed >20% against the median of its last N
+    prior rows.  `--regress-demo` proves the gate live: it times a small
+    compute path a few times into a THROWAWAY db, re-times it with a
+    deliberate slowdown injected, and asserts the gate trips on exactly
+    that row (the real db is never touched)."""
+    if args.regress_demo:
+        import shutil
+        import tempfile
+        from pathlib import Path as _Path
+
+        sys.path.insert(
+            0, str(_Path(__file__).resolve().parent / "benchmarks"))
+        from database import Database
+
+        tmp = _Path(tempfile.mkdtemp(prefix="hq-regress-demo-"))
+        try:
+            db = Database(tmp / "db.jsonl")
+
+            def timed_path(slow_ms: float = 0.0) -> float:
+                t0 = time.perf_counter()
+                acc = 0
+                for i in range(100_000):
+                    acc += i * i
+                if slow_ms:
+                    time.sleep(slow_ms / 1e3)  # the deliberate slowdown
+                return (time.perf_counter() - t0) * 1e3
+
+            for _ in range(3):
+                db.store_emit({
+                    "experiment": "regress_demo",
+                    "metric": "demo_path_ms", "unit": "ms",
+                    "value": round(timed_path(), 4),
+                })
+            db.store_emit({
+                "experiment": "regress_demo",
+                "metric": "demo_path_ms", "unit": "ms",
+                "value": round(timed_path(slow_ms=50.0), 4),
+            })
+            checked, regs = check_regressions(
+                window=args.regress_window, experiment="regress_demo",
+                db_path=db.path,
+            )
+            print(json.dumps({
+                "experiment": "regress_demo", "checked": checked,
+                "tripped": bool(regs), "regressions": regs,
+            }))
+            if not regs:
+                print("regress-demo: FAIL — slowed path did not trip "
+                      "the gate", file=sys.stderr)
+                sys.exit(1)
+            print("regress-demo: OK (deliberately slowed path tripped "
+                  "the >20% gate, as it must)")
+            sys.exit(0)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    checked, regs = check_regressions(
+        window=args.regress_window, experiment=args.regress_experiment,
+    )
+    print(json.dumps({
+        "checked_metrics": checked,
+        "regressions": regs,
+    }))
+    if regs:
+        for r in regs:
+            print(
+                f"REGRESSION {r['experiment']}/{r['metric']}: "
+                f"{r['baseline']} -> {r['current']} "
+                f"({r['change_pct']}% worse, vs median of "
+                f"{r['n_baseline_rows']} prior rows)",
+                file=sys.stderr,
+            )
+        sys.exit(1)
+    print(f"regress: OK ({checked} metric(s) within 20% of their "
+          f"baselines)")
+    sys.exit(0)
 
 
 def main() -> None:
@@ -2658,6 +2927,20 @@ def main() -> None:
                         help="soak task count for --sim-smoke")
     parser.add_argument("--sim-workers", type=int, default=1000,
                         help="soak worker count for --sim-smoke")
+    parser.add_argument("--regress", action="store_true",
+                        help="result-db regression gate: newest row per "
+                             "(experiment, config) vs the median of its "
+                             "last N prior rows; exit 1 on any metric "
+                             ">20% worse in its bad direction")
+    parser.add_argument("--regress-demo", action="store_true",
+                        help="prove the --regress gate live: time a "
+                             "path, re-time it deliberately slowed into "
+                             "a throwaway db, assert the gate trips")
+    parser.add_argument("--regress-window", type=int, default=5,
+                        help="prior rows per config the regression gate "
+                             "baselines against (median)")
+    parser.add_argument("--regress-experiment", default=None,
+                        help="limit --regress to one experiment name")
     parser.add_argument("--restore-smoke", action="store_true",
                         help="bounded-restore gate: restore under 2 s from "
                              "a snapshot after --tasks (default 1M) "
@@ -2717,6 +3000,10 @@ def main() -> None:
 
     if args.restore_smoke:
         run_restore_smoke(args)
+        return
+
+    if args.regress or args.regress_demo:
+        run_regress(args)
         return
 
     if args.sim_smoke:

@@ -2257,12 +2257,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-client-authentication", action="store_true")
     p.add_argument("--disable-worker-authentication", action="store_true")
     p.add_argument("--scheduler",
-                   choices=["auto", "cpu", "tpu", "milp", "multichip"],
+                   choices=["auto", "cpu", "tpu", "milp", "multichip",
+                            "greedy-numpy", "greedy-fused"],
                    default="auto",
                    help="auto/cpu/tpu pick the greedy cut-scan backend; "
                         "milp runs the exact host MILP (accuracy oracle); "
                         "multichip shards the cut-scan's worker axis over "
-                        "all visible devices (identical semantics)")
+                        "all visible devices (identical semantics); "
+                        "greedy-numpy pins the host numpy kernel; "
+                        "greedy-fused additionally folds gang rows and "
+                        "mask columns into the one dense solve "
+                        "(docs/scheduler.md)")
     p.add_argument("--journal", default=None)
     p.add_argument("--journal-fsync", choices=["never", "periodic", "always"],
                    default="never",

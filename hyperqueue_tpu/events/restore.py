@@ -22,6 +22,7 @@ import time
 from hyperqueue_tpu.events import snapshot as snapshot_mod
 from hyperqueue_tpu.events.journal import Journal
 from hyperqueue_tpu.ids import make_task_id
+from hyperqueue_tpu.scheduler.queues import encode_sched_priority
 from hyperqueue_tpu.server import reactor
 from hyperqueue_tpu.server.jobs import JobManager
 from hyperqueue_tpu.server.protocol import (
@@ -520,7 +521,8 @@ def _apply_lazy_chunks(server, acc: _RestoreAcc) -> None:
         chunk = ArrayChunk(
             job_id=job_id,
             rq_id=rq_id,
-            priority=(int(spec.get("priority", 0)), -job_id),
+            priority=(int(spec.get("priority", 0)),
+                      encode_sched_priority(job_id)),
             body=spec.get("body") or {},
             crash_limit=int(spec.get("crash_limit", 5)),
             id_range=id_range,
@@ -789,7 +791,8 @@ def restore_from_journal(server) -> None:
             task = Task(
                 task_id=make_task_id(job_id, job_task_id),
                 rq_id=rq_id,
-                priority=(int(t.get("priority", 0)), -job_id),
+                priority=(int(t.get("priority", 0)),
+                          encode_sched_priority(job_id)),
                 body=t.get("body", {}),
                 entry=t.get("entry"),
                 deps=deps,

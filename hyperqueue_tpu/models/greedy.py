@@ -416,17 +416,22 @@ class GreedyCutScanModel:
                                              # consumed on the host by
                                              # run_tick's batch ordering;
                                              # accepted for interface parity
+        gang_nodes: np.ndarray | None = None,    # (B,) int32 gang sizes
+        gang_ok: np.ndarray | None = None,       # (W,) int32 host idleness
+        group_onehot: np.ndarray | None = None,  # (W, G) int32 group map
     ) -> np.ndarray:
         """Returns counts (B, V, W) int32 (unpadded, C-contiguous)."""
         return self.solve_async(
             free, nt_free, lifetime, needs, sizes, min_time,
             priorities=priorities, total=total, all_mask=all_mask,
-            weights=weights,
+            weights=weights, gang_nodes=gang_nodes, gang_ok=gang_ok,
+            group_onehot=group_onehot,
         ).result()
 
     def solve_async(
         self, free, nt_free, lifetime, needs, sizes, min_time,
         priorities=None, total=None, all_mask=None, weights=None,
+        gang_nodes=None, gang_ok=None, group_onehot=None,
     ):
         """Dispatch one solve; returns a handle whose `.result()` yields the
         unpadded counts.  Host backends compute eagerly (the handle is just
@@ -435,7 +440,8 @@ class GreedyCutScanModel:
         pipelined tick (scheduler/pipeline.py) maps the previous solve
         during exactly this window."""
         prep = self._prepare(
-            free, nt_free, lifetime, needs, sizes, min_time, total, all_mask
+            free, nt_free, lifetime, needs, sizes, min_time, total, all_mask,
+            gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
         )
         backend, reason = self._backend_decision(prep["shape_key"])
         self.last_backend_reason = reason
@@ -457,7 +463,8 @@ class GreedyCutScanModel:
 
     # -- preparation (shared by every backend) ----------------------------
     def _prepare(self, free, nt_free, lifetime, needs, sizes, min_time,
-                 total, all_mask) -> dict:
+                 total, all_mask, gang_nodes=None, gang_ok=None,
+                 group_onehot=None) -> dict:
         _t0 = time.perf_counter()
         n_w, n_r = free.shape
         n_b, n_v, _ = needs.shape
@@ -470,6 +477,9 @@ class GreedyCutScanModel:
         if all_mask is not None and not np.any(all_mask):
             all_mask = None  # keep the common no-ALL compiled program
         has_all = all_mask is not None
+        if gang_nodes is not None and not np.any(np.asarray(gang_nodes) > 0):
+            gang_nodes = None  # keep the common no-gang compiled program
+        has_gang = gang_nodes is not None
 
         buf = self._get_buffers(pw, pb, pr, pv, has_all)
         free_p = buf["free"]
@@ -522,6 +532,23 @@ class GreedyCutScanModel:
                 amask_p[:n_b, n_v:lv] = 0
             total_p[:n_w, :n_r] = total if total is not None else free
             amask_p[:n_b, :n_v, :n_r] = all_mask
+        gang_p = gok_p = goh_p = None
+        pg = 0
+        if has_gang:
+            # gang inputs are FRESH per-solve allocations, not persistent
+            # buffers: gang rows appear on a minority of ticks and keying
+            # the donated-buffer cache on their presence would churn the
+            # steady-state shape; the arrays are tiny ((B,), (W,), (W, G))
+            n_g = group_onehot.shape[1] if group_onehot is not None else 1
+            pg = _bucket(max(n_g, 1), 4)
+            gang_p = np.zeros(pb, dtype=np.int32)
+            gang_p[:n_b] = gang_nodes
+            gok_p = np.zeros(pw, dtype=np.int32)
+            if gang_ok is not None:
+                gok_p[:n_w] = gang_ok
+            goh_p = np.zeros((pw, pg), dtype=np.int32)
+            if group_onehot is not None:
+                goh_p[:n_w, :n_g] = group_onehot
         _t1 = time.perf_counter()
 
         scarcity = np.asarray(
@@ -542,10 +569,11 @@ class GreedyCutScanModel:
             "free_p": free_p, "nt_p": nt_p, "life_p": life_p,
             "needs_p": needs_p, "sizes_p": sizes_p, "mt_p": mt_p,
             "total_p": total_p, "amask_p": amask_p,
+            "gang_p": gang_p, "gok_p": gok_p, "goh_p": goh_p,
             "class_m": class_m, "order_ids": order_ids,
             "extents": (n_b, n_v, n_w),
-            "shape_key": (pw, pb, pr, pv, pm, has_all),
-            "has_all": has_all,
+            "shape_key": (pw, pb, pr, pv, pm, has_all, has_gang, pg),
+            "has_all": has_all, "has_gang": has_gang,
             "pad_ms": (_t1 - _t0) * 1e3,
             "visit_ms": (_t2 - _t1) * 1e3,
             "dispatch_ms": 0.0,
@@ -573,9 +601,21 @@ class GreedyCutScanModel:
     def _host_counts(self, prep):
         """The host solve on fully padded inputs: the native C++ scan
         (identical semantics, with saturation early-exits) when the lib is
-        available, else numpy."""
+        available, else numpy.  Gang rows are numpy-only — the native scan
+        predates the all-or-nothing column groups, so a gang solve bypasses
+        it rather than silently dropping the constraint."""
         from hyperqueue_tpu.utils.native import native_cut_scan
 
+        if prep["has_gang"]:
+            self.last_backend = "host-numpy"
+            counts, _free_after, _nt_after = greedy_cut_scan_numpy(
+                prep["free_p"], prep["nt_p"], prep["life_p"],
+                prep["needs_p"], prep["sizes_p"], prep["mt_p"],
+                prep["class_m"], prep["order_ids"], total=prep["total_p"],
+                all_mask=prep["amask_p"], gang_nodes=prep["gang_p"],
+                gang_ok=prep["gok_p"], group_onehot=prep["goh_p"],
+            )
+            return counts
         counts = native_cut_scan(
             prep["free_p"], prep["nt_p"], prep["life_p"], prep["needs_p"],
             prep["sizes_p"], prep["mt_p"], prep["class_m"],
@@ -652,6 +692,9 @@ class GreedyCutScanModel:
             res.place_cached("order_ids", prep["order_ids"]),
             total=total_d,
             all_mask=res.place_cached("all_mask", prep["amask_p"]),
+            gang_nodes=res.place_cached("gang_nodes", prep["gang_p"]),
+            gang_ok=res.place_cached("gang_ok", prep["gok_p"]),
+            group_onehot=res.place_cached("group_onehot", prep["goh_p"]),
         )
 
     def _maybe_paranoid_check(self, prep, out: np.ndarray) -> None:
@@ -684,7 +727,8 @@ class GreedyCutScanModel:
             prep["needs_p"], prep["sizes_p"], prep["mt_p"],
             prep["class_m"], prep["order_ids"],
             total=None if prep["total_p"] is None else prep["total_p"].copy(),
-            all_mask=prep["amask_p"],
+            all_mask=prep["amask_p"], gang_nodes=prep["gang_p"],
+            gang_ok=prep["gok_p"], group_onehot=prep["goh_p"],
         )
         return counts
 

@@ -131,6 +131,11 @@ class MultichipModel(GreedyCutScanModel):
             res.place_cached("order_ids", prep["order_ids"]),
             total=total_d,
             all_mask=res.place_cached("all_mask", prep["amask_p"]),
+            gang_nodes=res.place_cached("gang_nodes", prep["gang_p"]),
+            gang_ok=res.place_cached("gang_ok", prep["gok_p"], kind=1),
+            group_onehot=res.place_cached(
+                "group_onehot", prep["goh_p"], kind=0
+            ),
         )
 
     def _fresh_device_counts(self, prep):
@@ -146,7 +151,8 @@ class MultichipModel(GreedyCutScanModel):
             mesh, prep["free_p"], prep["nt_p"], prep["life_p"],
             prep["needs_p"], prep["sizes_p"], prep["mt_p"],
             prep["class_m"], prep["order_ids"], total=prep["total_p"],
-            all_mask=prep["amask_p"],
+            all_mask=prep["amask_p"], gang_nodes=prep["gang_p"],
+            gang_ok=prep["gok_p"], group_onehot=prep["goh_p"],
         )
         counts, _f, _n = sharded_cut_scan(mesh, *placed)
         return counts

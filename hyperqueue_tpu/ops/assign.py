@@ -216,9 +216,38 @@ def expand_onehots(class_m, order_ids):
     return jax.lax.optimization_barrier(onehots)
 
 
+def _gang_select_local(elig, group_onehot, n):
+    """Pick the gang's worker set from one device's full worker view.
+
+    elig (W,) int32 0/1, group_onehot (W, G) int32, n scalar gang size.
+    Chooses the FIRST group with >= n eligible workers (else the group with
+    the most, for holdback), then the n lowest-index eligible members.
+    Returns (take (W,) int32 0/1, any_feasible bool). The sharded kernel
+    plugs in a collective variant (parallel/solve.py) with the same
+    contract.
+    """
+    per_group = jnp.sum(elig[:, None] * group_onehot, axis=0)  # (G,)
+    feasible = per_group >= n
+    any_feas = jnp.any(feasible)
+    chosen = jnp.where(
+        any_feas, jnp.argmax(feasible), jnp.argmax(per_group)
+    )
+    col = jnp.sum(
+        group_onehot
+        * (jnp.arange(group_onehot.shape[1], dtype=jnp.int32)
+           == chosen)[None, :],
+        axis=1,
+    )
+    sel = elig * col
+    prefix = jnp.cumsum(sel) - sel
+    take = sel * (prefix < n).astype(jnp.int32)
+    return take, any_feas
+
+
 def scan_batches(
     free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill,
     total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, gang_select=None,
 ):
     """Scan priority-ordered batches, water-filling each over the workers.
 
@@ -233,20 +262,57 @@ def scan_batches(
     assigned ALL task drains the worker's whole pool of the marked resources
     (reference solver.rs:120-124). Returns (counts, free_after,
     nt_free_after).
+
+    Gang rows (all-or-nothing column groups): gang_nodes (B,) int32 marks
+    batch rows that are one multi-node gang each (0 = ordinary row);
+    gang_ok (W,) int32 0/1 is host idleness (a gang member must be fully
+    idle — prefilled backlog does not show in `free`, so free==total is NOT
+    sufficient); group_onehot (W, G) int32 maps workers to worker groups.
+    The scan carries a gang-availability vector that starts at gang_ok and
+    is zeroed by ANY in-scan assignment, so a gang only sees workers still
+    untouched this solve. A feasible gang row emits n co-scheduled counts
+    in variant 0; feasible or not, the selected workers are HELD (free/nt
+    zeroed) for the rest of the scan — the in-solve equivalent of the host
+    `mn_reserved` reservation drain, so lower-priority work cannot steal
+    members while a gang accumulates.
     """
     _load_jax()
     n_variants = needs.shape[1]
     has_all = all_mask is not None
+    has_gang = gang_nodes is not None
+    if has_gang and gang_select is None:
+        gang_select = _gang_select_local
 
     def batch_body(carry, batch):
-        free, nt_free = carry
-        if has_all:
-            b_needs, b_size, b_min_time, b_onehot, b_all = batch
+        if has_gang:
+            free, nt_free, gang_avail = carry
         else:
-            b_needs, b_size, b_min_time, b_onehot = batch
-            b_all = None
+            free, nt_free = carry
+            gang_avail = None
+        batch = list(batch)
+        b_needs, b_size, b_min_time, b_onehot = batch[:4]
+        rest = batch[4:]
+        b_all = rest.pop(0) if has_all else None
+        b_gang = rest.pop(0) if has_gang else None
         remaining = b_size
         counts_v = []
+        emit = None
+        if has_gang:
+            is_gang = (b_gang > 0).astype(jnp.int32)
+            time_ok0 = (b_min_time[0] <= lifetime).astype(jnp.int32)
+            elig = (
+                gang_avail * time_ok0
+                * (nt_free >= 1).astype(jnp.int32)
+            )
+            take, any_feas = gang_select(elig, group_onehot, b_gang)
+            take = take * is_gang
+            emit = take * any_feas.astype(jnp.int32)
+            free = free * (1 - take)[:, None]
+            nt_free = nt_free * (1 - take)
+            gang_avail = gang_avail * (1 - take)
+            # a gang row is ONLY its all-or-nothing emit: the ordinary
+            # water-fill below must not also spend its size on stragglers
+            remaining = remaining * (1 - is_gang)
         for v in range(n_variants):  # V is tiny and static: unrolled
             need = b_needs[v]
             time_ok = b_min_time[v] <= lifetime
@@ -263,26 +329,40 @@ def scan_batches(
                 # the worker's pool of the marked resources
                 free = free * (1 - assign[:, None] * all_r[None, :])
             nt_free = nt_free - assign
+            if has_gang:
+                gang_avail = gang_avail * (assign == 0).astype(jnp.int32)
             counts_v.append(assign)
+        if has_gang:
+            counts_v[0] = counts_v[0] + emit
+            return (free, nt_free, gang_avail), jnp.stack(counts_v)
         return (free, nt_free), jnp.stack(counts_v)
 
     xs = (needs, sizes, min_time, onehots)
     if has_all:
         xs = xs + (all_mask,)
-    (free, nt_free), counts = jax.lax.scan(batch_body, (free, nt_free), xs)
+    if has_gang:
+        xs = xs + (gang_nodes,)
+        carry0 = (free, nt_free, gang_ok.astype(jnp.int32))
+        (free, nt_free, _), counts = jax.lax.scan(batch_body, carry0, xs)
+    else:
+        (free, nt_free), counts = jax.lax.scan(
+            batch_body, (free, nt_free), xs
+        )
     return counts, free, nt_free
 
 
 def greedy_cut_scan_impl(
     free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None,
 ):
     """Single-chip kernel: one-hot expansion + the shared batch scan.
 
     Un-jitted implementation (jit-wrapped below; also reused by the driver
     entry). class_m (M, W) int32 + order_ids (B, V) int32 come from
     host_visit_classes: per distinct request mask, each worker's visit class
-    (0 = visited first). total/all_mask enable ALL-policy requests (see
+    (0 = visited first). total/all_mask enable ALL-policy requests;
+    gang_nodes/gang_ok/group_onehot enable all-or-nothing gang rows (see
     scan_batches). See module docstring for shapes/semantics. Returns
     (counts, free_after, nt_free_after).
     """
@@ -290,6 +370,7 @@ def greedy_cut_scan_impl(
     return scan_batches(
         free, nt_free, lifetime, needs, sizes, min_time, onehots,
         _water_fill_classed, total=total, all_mask=all_mask,
+        gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
     )
 
 
@@ -313,6 +394,7 @@ def greedy_cut_scan(*args, **kwargs):
 def greedy_cut_scan_numpy(
     free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None,
 ):
     """Vectorized numpy implementation of the cut-scan (identical semantics).
 
@@ -332,9 +414,39 @@ def greedy_cut_scan_numpy(
     counts = np.zeros((n_b, n_v, n_w), dtype=np.int32)
     class_ids = np.asarray(class_m)[np.asarray(order_ids)]  # (B, V, W)
     idx = np.arange(n_w)
+    has_gang = gang_nodes is not None
+    if has_gang:
+        gang_nodes = np.asarray(gang_nodes)
+        gang_avail = np.asarray(gang_ok, dtype=bool).copy()
+        group_oh = np.asarray(group_onehot, dtype=bool)  # (W, G)
 
     for b in range(n_b):
         remaining = int(sizes[b])
+        if has_gang and gang_nodes[b] > 0:
+            # all-or-nothing gang row (see scan_batches): feasible -> emit
+            # n co-scheduled counts in variant 0; either way HOLD the
+            # selected workers for the rest of the scan
+            n = int(gang_nodes[b])
+            elig = (
+                gang_avail
+                & (min_time[b, 0] <= lifetime)
+                & (nt_free >= 1)
+            )
+            per_group = (elig[:, None] & group_oh).sum(axis=0)  # (G,)
+            feasible = per_group >= n
+            chosen = int(
+                np.argmax(feasible) if feasible.any()
+                else np.argmax(per_group)
+            )
+            sel = elig & group_oh[:, chosen]
+            prefix = np.cumsum(sel) - sel
+            take = sel & (prefix < n)
+            if feasible.any():
+                counts[b, 0, take] = 1
+            free[take] = 0
+            nt_free[take] = 0
+            gang_avail[take] = False
+            continue
         for v in range(n_v):
             if remaining <= 0:
                 break
@@ -379,6 +491,8 @@ def greedy_cut_scan_numpy(
             if all_r.any():
                 free[:, all_r] *= 1 - assign[:, None]
             nt_free -= assign
+            if has_gang:
+                gang_avail[assign > 0] = False
             counts[b, v] = assign
     return counts, free, nt_free
 

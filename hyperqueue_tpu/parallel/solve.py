@@ -97,9 +97,44 @@ def _sharded_water_fill_classed(cap, remaining, class_onehot, axis):
     )
 
 
+def _sharded_gang_select(elig, group_onehot, n, axis):
+    """Collective form of ops.assign._gang_select_local: elig/group_onehot
+    are LOCAL worker shards; the per-group eligible counts are gathered
+    across devices (one (G,)-vector all_gather), the chosen group is a
+    replicated argmax, and each local take-prefix is shifted by the chosen
+    group's eligible count on lower-index devices — shard_map splits the
+    worker axis contiguously, so this reproduces the single-chip "first n
+    eligible members in global index order" selection exactly."""
+    my_dev = jax.lax.axis_index(axis)
+    per_group_local = jnp.sum(elig[:, None] * group_onehot, axis=0)  # (G,)
+    all_per_group = jax.lax.all_gather(per_group_local, axis)  # (D, G)
+    per_group = jnp.sum(all_per_group, axis=0)  # (G,)
+    feasible = per_group >= n
+    any_feas = jnp.any(feasible)
+    chosen = jnp.where(
+        any_feas, jnp.argmax(feasible), jnp.argmax(per_group)
+    )
+    chosen_oh = (
+        jnp.arange(group_onehot.shape[1], dtype=jnp.int32) == chosen
+    )
+    col = jnp.sum(group_onehot * chosen_oh[None, :].astype(jnp.int32),
+                  axis=1)
+    sel = elig * col
+    n_dev = all_per_group.shape[0]
+    # sum(sel) on a device IS its per_group_local[chosen]
+    lower = jnp.sum(
+        jnp.where((jnp.arange(n_dev) < my_dev)[:, None], all_per_group, 0)
+        * chosen_oh[None, :].astype(jnp.int32)
+    )
+    prefix = jnp.cumsum(sel) - sel + lower
+    take = sel * (prefix < n).astype(jnp.int32)
+    return take, any_feas
+
+
 def _sharded_body(
     free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None,
 ):
     """shard_map body: free/nt_free/lifetime/class_m/total are local worker
     shards; needs/sizes/min_time/order_ids/all_mask are replicated. The
@@ -118,15 +153,21 @@ def _sharded_body(
     def water_fill(cap, remaining, class_onehot):
         return _sharded_water_fill_classed(cap, remaining, class_onehot, "w")
 
+    def gang_select(elig, goh, n):
+        return _sharded_gang_select(elig, goh, n, "w")
+
     return scan_batches(
         free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill,
         total=total, all_mask=all_mask,
+        gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
+        gang_select=gang_select if gang_nodes is not None else None,
     )
 
 
 def _sharded_cut_scan_impl(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None,
 ):
     in_specs = [
         P("w", None),              # free
@@ -140,27 +181,34 @@ def _sharded_cut_scan_impl(
     ]
     args = [free, nt_free, lifetime, needs, sizes, min_time, class_m,
             order_ids]
-    # optional ALL-policy inputs: None args are dropped from the pytree so
-    # the no-ALL compiled program is unchanged
+    # optional ALL-policy/gang inputs: None args are dropped from the pytree
+    # so the no-ALL/no-gang compiled program is unchanged
     if total is not None:
         in_specs.append(P("w", None))
         args.append(total)
     if all_mask is not None:
         in_specs.append(P())
         args.append(all_mask)
+    if gang_nodes is not None:
+        in_specs.extend([P(), P("w"), P("w", None)])
+        args.extend([gang_nodes, gang_ok, group_onehot])
 
     def body(free, nt_free, lifetime, needs, sizes, min_time, class_m,
              order_ids, *extra):
         i = 0
-        t = m = None
+        t = m = gn = go = goh = None
         if total is not None:
             t = extra[i]
             i += 1
         if all_mask is not None:
             m = extra[i]
+            i += 1
+        if gang_nodes is not None:
+            gn, go, goh = extra[i:i + 3]
         return _sharded_body(
             free, nt_free, lifetime, needs, sizes, min_time, class_m,
             order_ids, total=t, all_mask=m,
+            gang_nodes=gn, gang_ok=go, group_onehot=goh,
         )
 
     return _shard_map(
@@ -176,17 +224,20 @@ def _sharded_cut_scan_impl(
 def sharded_cut_scan(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None,
 ):
     """Worker-sharded variant of ops.assign.greedy_cut_scan — same inputs,
     same outputs, identical semantics.
 
-    free/total (W, R), nt_free/lifetime (W,), class_m (M, W) sharded on
-    axis "w"; needs/sizes/min_time/order_ids/all_mask replicated. Returns
-    counts (B, V, W) sharded on W, plus free/nt_free after.
+    free/total (W, R), nt_free/lifetime/gang_ok (W,), class_m (M, W) and
+    group_onehot (W, G) sharded on axis "w"; needs/sizes/min_time/
+    order_ids/all_mask/gang_nodes replicated. Returns counts (B, V, W)
+    sharded on W, plus free/nt_free after.
     """
     return _sharded_cut_scan_impl(
         mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
         order_ids, total=total, all_mask=all_mask,
+        gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
     )
 
 
@@ -196,6 +247,7 @@ def sharded_cut_scan(
 def sharded_cut_scan_donate(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None,
 ):
     """`sharded_cut_scan` with `free`/`nt_free` DONATED: the input buffers
     are consumed and their storage reused for `free_after`/`nt_after`.
@@ -208,6 +260,7 @@ def sharded_cut_scan_donate(
     return _sharded_cut_scan_impl(
         mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
         order_ids, total=total, all_mask=all_mask,
+        gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
     )
 
 
@@ -229,7 +282,8 @@ def _mesh_shardings(mesh: Mesh):
 
 def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
                       min_time, class_m, order_ids, total=None,
-                      all_mask=None):
+                      all_mask=None, gang_nodes=None, gang_ok=None,
+                      group_onehot=None):
     """Device-put the tick tensors with the proper shardings."""
     w2, w1, rep, cm = _mesh_shardings(mesh)
     out = (
@@ -242,9 +296,16 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
         jax.device_put(class_m, cm),
         jax.device_put(order_ids, rep),
     )
-    if total is not None or all_mask is not None:
+    has_gang = gang_nodes is not None
+    if total is not None or all_mask is not None or has_gang:
         out = out + (
             None if total is None else jax.device_put(total, w2),
             None if all_mask is None else jax.device_put(all_mask, rep),
+        )
+    if has_gang:
+        out = out + (
+            jax.device_put(gang_nodes, rep),
+            jax.device_put(gang_ok, w1),
+            jax.device_put(group_onehot, w2),
         )
     return out

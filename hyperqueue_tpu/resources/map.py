@@ -10,17 +10,33 @@ scheduler exactly once. rq-ids are the row space of the dense solver snapshot.
 from __future__ import annotations
 
 from hyperqueue_tpu.resources.request import ResourceRequestVariants
+from hyperqueue_tpu.utils.metrics import REGISTRY
 
 CPU_RESOURCE_NAME = "cpus"
 CPU_RESOURCE_ID = 0
 
+_SOLVE_MASK_ROWS = REGISTRY.counter(
+    "hq_solve_mask_rows",
+    "indexed-resource mask subcolumns interned into the dense solve "
+    "(one per distinct (resource, group) pair, e.g. gpus#0)",
+)
+
 
 class ResourceIdMap:
-    """name <-> dense resource id; CPU is always id 0."""
+    """name <-> dense resource id; CPU is always id 0.
+
+    Mask subcolumns: a non-fungible indexed constraint ("group k of gpus")
+    interns as its own dense column named ``gpus#k`` and is tracked in
+    ``masked_rids``. The solver sees one ordinary needs/free column (one
+    mask row in the batched solve, no variant expansion); the wire layer
+    strips these synthetic entries before messages reach workers, which
+    only know the physical resource names.
+    """
 
     def __init__(self):
         self._names: list[str] = [CPU_RESOURCE_NAME]
         self._ids: dict[str, int] = {CPU_RESOURCE_NAME: CPU_RESOURCE_ID}
+        self.masked_rids: set[int] = set()
 
     def get_or_create(self, name: str) -> int:
         rid = self._ids.get(name)
@@ -29,6 +45,16 @@ class ResourceIdMap:
             self._names.append(name)
             self._ids[name] = rid
         return rid
+
+    def get_or_create_masked(self, name: str, group: int) -> int:
+        rid = self.get_or_create(f"{name}#{group}")
+        if rid not in self.masked_rids:
+            self.masked_rids.add(rid)
+            _SOLVE_MASK_ROWS.inc()
+        return rid
+
+    def is_masked(self, resource_id: int) -> bool:
+        return resource_id in self.masked_rids
 
     def get(self, name: str) -> int | None:
         return self._ids.get(name)

@@ -32,6 +32,10 @@ class WorkerResources:
     amounts: list[int] = field(default_factory=list)
     # n_groups[resource_id] for multi-group (NUMA) resources, else 1.
     n_groups: list[int] = field(default_factory=list)
+    # rids of per-group mask subcolumns this worker registered; they alias
+    # capacity already counted under the parent resource, so capacity-derived
+    # bounds (task_max_count) must not double-count them.
+    masked: set = field(default_factory=set)
 
     @classmethod
     def from_descriptor(
@@ -43,6 +47,15 @@ class WorkerResources:
             wr._ensure_len(rid + 1)
             wr.amounts[rid] = item.total_amount()
             wr.n_groups[rid] = item.n_groups()
+            if item.n_groups() > 1:
+                # multi-group (NUMA) resource: register per-group mask
+                # subcolumns so "group k of <name>" requests are one dense
+                # constraint row in the batched solve (resources/map.py)
+                for k, group in enumerate(item.index_groups()):
+                    grid = resource_map.get_or_create_masked(item.name, k)
+                    wr._ensure_len(grid + 1)
+                    wr.amounts[grid] = len(group) * FRACTIONS_PER_UNIT
+                    wr.masked.add(grid)
         return wr
 
     def _ensure_len(self, n: int) -> None:
@@ -63,7 +76,11 @@ class WorkerResources:
         of some pool), capped (reference workerload.rs computes an analogous
         bound to limit solver variables).
         """
-        total = sum(a // FRACTIONS_PER_UNIT for a in self.amounts if a > 0)
+        total = sum(
+            a // FRACTIONS_PER_UNIT
+            for rid, a in enumerate(self.amounts)
+            if a > 0 and rid not in self.masked
+        )
         return min(TASK_MAX_COUNT_CAP, max(total, 1))
 
     def is_capable_of(self, request: ResourceRequest) -> bool:

@@ -17,6 +17,10 @@ tick-budget guard (ISSUE 4 acceptance; watched by ``bench.py --phases``).
 from __future__ import annotations
 
 from hyperqueue_tpu.resources.request import AllocationPolicy
+from hyperqueue_tpu.scheduler.queues import (
+    decode_sched_blevel,
+    decode_sched_job,
+)
 
 # --- reason codes (the registry; keep docs/observability.md in sync) ------
 # No connected worker could EVER run the task (resource totals too small,
@@ -42,6 +46,13 @@ REASON_WAITING_DEPS = "waiting-dependencies"
 # Marker entry: a pathological tick had more unplaced classes than a
 # DecisionRecord keeps (MAX_UNPLACED_ENTRIES); the count is the folded tail.
 REASON_TRUNCATED = "truncated"
+# A multi-node gang had enough capable workers overall, but the fused solve
+# found no single group with enough members free this tick (or in-solve
+# holdback kept members idle for it) — the gang retries next tick.
+REASON_GANG_GROUP_DEFERRED = "gang-group-deferred"
+# The solve placed deeper critical-path work of the SAME job first (b-level
+# lookahead); this class was deliberately held behind it this tick.
+REASON_LOOKAHEAD_HELD = "lookahead-held"
 
 ALL_REASONS = frozenset(
     value
@@ -192,18 +203,25 @@ FREE_SCAN_BUDGET = 20_000
 
 
 def build_unplaced_entries(
-    core, leftover_batches, rq_reasons, degraded: bool = False
+    core, leftover_batches, rq_reasons, degraded: bool = False,
+    placed_blevel: dict | None = None,
 ) -> list[dict]:
     """Fold leftover batches into per-(class, job) unplaced entries.
 
     `rq_reasons` memoizes classify_class per rq_id for this tick.  Job
     attribution uses the scheduler priority component: the jobs layer
-    submits every task with priority=(user, -job_id), so one batch always
-    belongs to exactly one job — EXCEPT the per-queue tail batch that
-    create_batches folds past MAX_CUTS_PER_QUEUE, whose merged tasks are
-    all charged to the tail batch's job (a known approximation at > 32
-    distinct priority levels per class; `hq task explain` still answers
-    correctly for the other jobs via live classification).
+    submits every task with priority=(user, encode_sched_priority(job_id,
+    blevel)) — see scheduler/queues.py — so one batch always belongs to
+    exactly one job — EXCEPT the per-queue tail batch that create_batches
+    folds past MAX_CUTS_PER_QUEUE, whose merged tasks are all charged to
+    the tail batch's job (a known approximation at > 32 distinct priority
+    levels per class; `hq task explain` still answers correctly for the
+    other jobs via live classification).
+
+    `placed_blevel` maps job_id -> max decoded b-level among batches that
+    DID receive assignments this tick; a solver-deferred class whose own
+    b-level is strictly below that mark was held behind deeper
+    critical-path work of its own job and reports lookahead-held instead.
     """
     entries: list[dict] = []
     truncated = 0
@@ -225,9 +243,17 @@ def build_unplaced_entries(
                 core, batch.rq_id, degraded=degraded,
                 check_free=check_free,
             )
+        job_id = decode_sched_job(batch.priority[1])
+        if placed_blevel and reason == REASON_SOLVER_DEFERRED:
+            placed = placed_blevel.get(job_id)
+            if (
+                placed is not None
+                and decode_sched_blevel(batch.priority[1]) < placed
+            ):
+                reason = REASON_LOOKAHEAD_HELD
         entries.append({
             "rq_id": batch.rq_id,
-            "job": -batch.priority[1],
+            "job": job_id,
             "priority": batch.priority[0],
             "count": batch.size,
             "reason": reason,

@@ -11,9 +11,20 @@ solver behavior.
 from __future__ import annotations
 
 
-def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
+def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity,
+                 gang_nodes=None, gang_ok=None, group_ids=None):
     """Same contract as ops.assign.greedy_cut_scan, lists/nested lists in,
-    counts[b][v][w] out. Mutates nothing."""
+    counts[b][v][w] out. Mutates nothing.
+
+    Gang rows (all-or-nothing column groups, ops/assign.py scan_batches):
+    gang_nodes[b] > 0 marks batch b as one multi-node gang; gang_ok[w] is
+    host idleness and group_ids[w] the worker's group index. A gang row
+    takes the first group with >= n still-untouched eligible members (the
+    n lowest-index ones) and emits n counts in variant 0; feasible or not,
+    the selected members are held (free/nt zeroed) for the rest of the
+    scan, and any single-node assignment makes a worker ineligible for
+    later gangs.
+    """
     n_w = len(free)
     n_r = len(free[0]) if n_w else 0
     free0 = [list(row) for row in free]  # visit order derives from this
@@ -22,9 +33,35 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
     n_b = len(needs)
     n_v = len(needs[0]) if n_b else 0
     counts = [[[0] * n_w for _ in range(n_v)] for _ in range(n_b)]
+    gang_avail = list(gang_ok) if gang_ok is not None else [0] * n_w
+    n_g = (max(group_ids) + 1) if group_ids else 1
 
     for b in range(n_b):
         remaining = sizes[b]
+        if gang_nodes is not None and gang_nodes[b] > 0:
+            n = gang_nodes[b]
+            per_group = [0] * n_g
+            members: list[list[int]] = [[] for _ in range(n_g)]
+            for w in range(n_w):
+                if (
+                    gang_avail[w]
+                    and min_time[b][0] <= lifetime[w]
+                    and nt_free[w] >= 1
+                ):
+                    per_group[group_ids[w]] += 1
+                    members[group_ids[w]].append(w)
+            feasible = [g for g in range(n_g) if per_group[g] >= n]
+            if feasible:
+                chosen = feasible[0]
+            else:
+                chosen = per_group.index(max(per_group))
+            for w in members[chosen][:n]:
+                if feasible:
+                    counts[b][0][w] = 1
+                free[w] = [0] * n_r
+                nt_free[w] = 0
+                gang_avail[w] = 0
+            continue
         for v in range(n_v):
             need = needs[b][v]
             if not any(x > 0 for x in need):
@@ -60,13 +97,15 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity):
                 counts[b][v][w] = take
                 remaining -= take
                 nt_free[w] -= take
+                gang_avail[w] = 0
                 for r in range(n_r):
                     free[w][r] -= take * need[r]
     return counts
 
 
 def explain_unplaced(
-    free, nt_free, lifetime, needs, sizes, min_time, counts, total=None
+    free, nt_free, lifetime, needs, sizes, min_time, counts, total=None,
+    gang_nodes=None, gang_ok=None, group_ids=None,
 ):
     """Reference classifier for WHY each batch's remainder stayed unplaced.
 
@@ -75,9 +114,14 @@ def explain_unplaced(
     inputs and the solver's counts, return one reason string per batch
     (None for fully placed batches). `total` is the worker TOTAL capacity
     matrix (defaults to the tick-start `free`, which equals totals on an
-    empty cluster snapshot). Mutates nothing.
+    empty cluster snapshot). Gang rows (gang_nodes[b] > 0) classify as
+    gang-incomplete when NO group could ever muster n lifetime-capable
+    members, else gang-group-deferred (members exist but were busy or held
+    this tick). Mutates nothing.
     """
     from hyperqueue_tpu.scheduler.decision import (
+        REASON_GANG_GROUP_DEFERRED,
+        REASON_GANG_INCOMPLETE,
         REASON_INSUFFICIENT_CAPACITY,
         REASON_NO_MATCHING_WORKER,
         REASON_SOLVER_DEFERRED,
@@ -104,10 +148,27 @@ def explain_unplaced(
                         post_free[w][r] -= take * needs[b][v][r]
 
     reasons = []
+    n_g = (max(group_ids) + 1) if group_ids else 1
     for b in range(n_b):
         placed = sum(
             counts[b][v][w] for v in range(n_v) for w in range(n_w)
         )
+        if gang_nodes is not None and gang_nodes[b] > 0:
+            # all-or-nothing: the kernel emits either n counts or none
+            if placed > 0:
+                reasons.append(None)
+                continue
+            n = gang_nodes[b]
+            per_group = [0] * n_g
+            for w in range(n_w):
+                if min_time[b][0] <= lifetime[w]:
+                    per_group[group_ids[w]] += 1
+            reasons.append(
+                REASON_GANG_GROUP_DEFERRED
+                if max(per_group, default=0) >= n
+                else REASON_GANG_INCOMPLETE
+            )
+            continue
         if placed >= sizes[b]:
             reasons.append(None)
             continue

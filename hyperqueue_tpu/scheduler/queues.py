@@ -17,6 +17,43 @@ from collections import deque
 
 Priority = tuple[int, int]  # (user_priority, scheduler_priority), higher first
 
+# --- scheduler-priority encoding -------------------------------------------
+#
+# The scheduler component packs (job_id, b-level) into one int so the
+# existing lexicographic (user, sched) comparison yields: older job first
+# (reference -job_id FIFO), and WITHIN a job deeper critical path first
+# (b-level lookahead — a task with more dependent work below it outranks its
+# siblings). Encoding: sched = -(job_id * BLEVEL_STRIDE + BLEVEL_MAX - blevel)
+# so cross-job ordering stays strict (any blevel of job J beats every blevel
+# of job J+1) while higher blevel yields a higher (less negative) value
+# within the job. Values with magnitude < BLEVEL_STRIDE are legacy raw
+# literals (tests pass -job_id directly) and decode as (job=-sched, blevel=0).
+
+BLEVEL_STRIDE = 1 << 20
+BLEVEL_MAX = 1 << 16
+
+
+def encode_sched_priority(job_id: int, blevel: int = 0) -> int:
+    if blevel > BLEVEL_MAX:
+        blevel = BLEVEL_MAX
+    elif blevel < 0:
+        blevel = 0
+    return -(job_id * BLEVEL_STRIDE + BLEVEL_MAX - blevel)
+
+
+def decode_sched_job(sched: int) -> int:
+    p = -sched
+    if p < BLEVEL_STRIDE:
+        return p  # legacy raw -job_id literal
+    return p // BLEVEL_STRIDE
+
+
+def decode_sched_blevel(sched: int) -> int:
+    p = -sched
+    if p < BLEVEL_STRIDE:
+        return 0
+    return BLEVEL_MAX - (p % BLEVEL_STRIDE)
+
 
 class TaskQueue:
     __slots__ = ("_levels", "_keys", "_tombstones", "_len")

@@ -37,6 +37,13 @@ class Batch:
     rq_id: int
     priority: Priority
     size: int
+    # fused gang rows (reactor fused mode): gang_task is the multi-node
+    # task this row represents, gang_nodes its node count.  The solve
+    # co-schedules the gang atomically (ops/assign.py gang rows); the
+    # mapping emits (gang_task, worker, rq, -1) sentinels — gang tasks
+    # live in core.mn_queue, never in the per-rq TaskQueues.
+    gang_task: int = 0
+    gang_nodes: int = 0
 
 
 @dataclass(slots=True)
@@ -157,6 +164,8 @@ def run_tick(
     key_cache=None,
     decision: dict | None = None,
     pipeline=None,
+    gang_ok=None,
+    group_ids=None,
 ) -> list[Assignment]:
     """Solve one tick and pop assigned tasks from the queues.
 
@@ -195,6 +204,7 @@ def run_tick(
             queues, None, rq_map, resource_map, model, batches,
             dense=dense, phases=phases, key_cache=key_cache,
             decision=decision, pipeline=pipeline,
+            gang_ok=gang_ok, group_ids=group_ids,
         )
     if not batches or not workers:
         return []
@@ -237,7 +247,8 @@ def run_tick(
 
 
 def assemble_solve_inputs(workers, batches, rq_map, resource_map,
-                          cpu_floor=None, dense=None, key_cache=None):
+                          cpu_floor=None, dense=None, key_cache=None,
+                          gang_ok=None, group_ids=None):
     """Build the dense model.solve inputs for `batches` over `workers`.
 
     Sorts `batches` IN PLACE into the production solve order (priority,
@@ -423,7 +434,14 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
             cand = (value * (size if size < fit else fit), -value)
             if cand > best:
                 best = cand
-        return (b.priority, scarcity, best)
+        # gang rows sort ahead of same-user-priority single-node work (the
+        # in-solve mirror of the host gang phase running before the dense
+        # solve); without the boost a deep filler backlog would touch every
+        # idle worker before any gang row scans, starving gangs forever
+        return (
+            (b.priority[0], 1 if b.gang_nodes else 0, b.priority[1]),
+            scarcity, best,
+        )
 
     batches.sort(key=_sort_key, reverse=True)
 
@@ -536,6 +554,24 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
         extra = {"total": total.astype(np.int32), "all_mask": all_mask}
     if w_arr is not None:
         extra["weights"] = w_arr
+    if any(b.gang_nodes for b in batches):
+        # fused gang rows: per-batch gang sizes plus the worker-side
+        # idleness/group inputs the kernel's all-or-nothing selection needs
+        extra["gang_nodes"] = np.fromiter(
+            (b.gang_nodes for b in batches), dtype=np.int32, count=n_b
+        )
+        extra["gang_ok"] = (
+            np.zeros(n_w, dtype=np.int32) if gang_ok is None
+            else np.asarray(gang_ok, dtype=np.int32)
+        )
+        gids = (
+            np.zeros(n_w, dtype=np.int32) if group_ids is None
+            else np.asarray(group_ids, dtype=np.int32)
+        )
+        n_g = int(gids.max(initial=0)) + 1
+        extra["group_onehot"] = (
+            gids[:, None] == np.arange(n_g, dtype=np.int32)[None, :]
+        ).astype(np.int32)
     if cpu_floor is not None:
         # joint mu path (run_tick): if _range_compress shifted the cpu
         # column, ceil-shift the floors the same way (a floor must never
@@ -558,11 +594,13 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 
 def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
                     cpu_floor=None, dense=None, phases=None, key_cache=None,
-                    decision=None, pipeline=None):
+                    decision=None, pipeline=None, gang_ok=None,
+                    group_ids=None):
     _t0 = _time.perf_counter()
     kwargs = assemble_solve_inputs(
         workers, batches, rq_map, resource_map, cpu_floor=cpu_floor,
-        dense=dense, key_cache=key_cache,
+        dense=dense, key_cache=key_cache, gang_ok=gang_ok,
+        group_ids=group_ids,
     )
     _t1 = _time.perf_counter()
     if pipeline is not None and hasattr(model, "solve_async"):
@@ -684,6 +722,35 @@ def _map_counts(queues, batches, worker_ids, counts,
             if bs.size == 0:
                 return assignments
             vals = counts[bs, vs, ws]
+
+        if any(b.gang_nodes for b in batches):
+            # gang cells never touch the queues — the gang task lives in
+            # the reactor's mn_queue until the assignment is applied.  Emit
+            # one (gang_task, worker, rq, -1) sentinel per selected worker;
+            # ordinary cells pop from queues fetched LAZILY (the eager
+            # queues.queue() sweep below would auto-create empty queues for
+            # the gang rq ids, silently registering them as single-node).
+            extend = assignments.extend
+            queue_by_bi: dict = {}
+            for bi, vi, wi, n in zip(
+                bs.tolist(), vs.tolist(), ws.tolist(), vals.tolist()
+            ):
+                batch = batches[bi]
+                if batch.gang_nodes:
+                    assignments.append(
+                        (batch.gang_task, worker_ids[wi], batch.rq_id, -1)
+                    )
+                    continue
+                queue = queue_by_bi.get(bi)
+                if queue is None:
+                    queue = queue_by_bi[bi] = queues.queue(batch.rq_id)
+                task_ids = queue.take(batch.priority, n)
+                worker_id = worker_ids[wi]
+                extend(
+                    [(task_id, worker_id, batch.rq_id, vi)
+                     for task_id in task_ids]
+                )
+            return assignments
 
         batch_queues = [queues.queue(b.rq_id) for b in batches]
         native = _native_map_take(batch_queues, batches, bs, vals)

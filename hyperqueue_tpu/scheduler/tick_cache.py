@@ -290,30 +290,34 @@ class TickStateCache:
 
 
 def paranoid_check(core, snapshot: DenseSnapshot, batches, rq_map,
-                   resource_map) -> None:
+                   resource_map, gang_ok=None, group_ids=None) -> None:
     """Assert the incremental assembly is bit-identical to from-scratch.
 
     Runs BOTH assemble paths on copies of the batch list (assemble sorts
-    in place but pops nothing), and compares every kwargs array exactly.
-    Raises AssertionError naming the first differing array.  Debug tool:
-    `hq server start --paranoid-tick N` runs this every N ticks.
+    in place but pops nothing), and compares every kwargs array exactly —
+    including the fused-gang inputs (gang_nodes/gang_ok/group_onehot)
+    when the tick carries gang rows.  Raises AssertionError naming the
+    first differing array.  Debug tool: `hq server start
+    --paranoid-tick N` runs this every N ticks.
     """
     from hyperqueue_tpu.scheduler.tick import Batch, assemble_solve_inputs
 
     def copy_batches(src):
-        return [Batch(rq_id=b.rq_id, priority=b.priority, size=b.size)
+        return [Batch(rq_id=b.rq_id, priority=b.priority, size=b.size,
+                      gang_task=b.gang_task, gang_nodes=b.gang_nodes)
                 for b in src]
 
     scratch_rows = [r for r in core.worker_rows() if r.cpu_floor <= 0]
     k_scratch = assemble_solve_inputs(
-        scratch_rows, copy_batches(batches), rq_map, resource_map
+        scratch_rows, copy_batches(batches), rq_map, resource_map,
+        gang_ok=gang_ok, group_ids=group_ids,
     )
     # key_cache=core.tick_cache: the check must exercise the SAME memoized
     # sort-key/batch-layout/needs32 path the production assemble uses, or
     # a corrupted memo would pass paranoid while feeding every real solve
     k_incr = assemble_solve_inputs(
         None, copy_batches(batches), rq_map, resource_map, dense=snapshot,
-        key_cache=core.tick_cache,
+        key_cache=core.tick_cache, gang_ok=gang_ok, group_ids=group_ids,
     )
     scratch_ids = [r.worker_id for r in scratch_rows]
     assert scratch_ids == snapshot.worker_ids, (

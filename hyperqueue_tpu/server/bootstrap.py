@@ -36,6 +36,7 @@ from hyperqueue_tpu.server.jobs import JobManager, JobTaskInfo
 from hyperqueue_tpu.server.journal_plane import JournalPlane
 from hyperqueue_tpu.server.lazy import ArrayChunk
 from hyperqueue_tpu.server.protocol import rqv_from_wire, submit_record
+from hyperqueue_tpu.scheduler.queues import encode_sched_priority
 from hyperqueue_tpu.scheduler.watchdog import SolverWatchdog
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
@@ -736,6 +737,14 @@ class Server:
             # regressions and any deployment that values reproducibility
             # over device offload use this
             base_model = GreedyCutScanModel(backend="numpy")
+        elif scheduler == "greedy-fused":
+            # fused constraint solve: multi-node gangs become all-or-
+            # nothing column groups INSIDE the batched solve
+            # (ops/assign.py gang rows) instead of the host-side
+            # reservation drain; deterministic like greedy-numpy so the
+            # simulator can A/B it against the host gang phase
+            base_model = GreedyCutScanModel(backend="numpy")
+            self.core.fused_solve = True
         else:
             base_model = GreedyCutScanModel()
         # --paranoid-tick also arms the device-resident solve's own
@@ -3072,7 +3081,8 @@ class Server:
         rq_id = self.core.intern_rqv(rqv)
         shared_body = array.get("body", {})
         entries = array.get("entries")
-        priority = (int(array.get("priority", 0)), -job.job_id)
+        priority = (int(array.get("priority", 0)),
+                    encode_sched_priority(job.job_id))
         crash_limit = int(array.get("crash_limit", 5))
         if not rqv.is_multi_node and n >= self.lazy_array_threshold:
             chunk = ArrayChunk(
@@ -3277,7 +3287,8 @@ class Server:
                 Task(
                     task_id=task_id,
                     rq_id=rq_id,
-                    priority=(int(t.get("priority", 0)), -job.job_id),
+                    priority=(int(t.get("priority", 0)),
+                              encode_sched_priority(job.job_id)),
                     body=t.get("body", {}),
                     deps=deps,
                     crash_limit=int(t.get("crash_limit", 5)),

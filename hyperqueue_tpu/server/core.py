@@ -56,6 +56,10 @@ class Core:
     # debug: every N ticks, assert the incremental assembly is
     # bit-identical to a from-scratch one (0 = off; --paranoid-tick N)
     paranoid_tick: int = 0
+    # fused-solve mode (--scheduler greedy-fused): multi-node gangs become
+    # all-or-nothing column groups inside the dense solve (scheduler/tick.py
+    # gang rows) instead of the host-side reservation drain
+    fused_solve: bool = False
     # two-stage async tick pipeline (scheduler/pipeline.TickPipeline) when
     # the server started with --tick-pipeline; None = synchronous ticks
     tick_pipeline: object = None

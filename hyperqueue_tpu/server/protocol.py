@@ -98,14 +98,29 @@ def rqv_to_wire(rqv: ResourceRequestVariants, resource_map: ResourceIdMap) -> di
 def rqv_from_wire(data: dict, resource_map: ResourceIdMap) -> ResourceRequestVariants:
     variants = []
     for v in data.get("variants") or [{}]:
-        entries = tuple(
-            ResourceRequestEntry(
-                resource_id=resource_map.get_or_create(e["name"]),
-                amount=int(e["amount"]),
-                policy=AllocationPolicy.parse(e.get("policy", "compact")),
+        entries_list = []
+        for e in v.get("entries", []):
+            entries_list.append(
+                ResourceRequestEntry(
+                    resource_id=resource_map.get_or_create(e["name"]),
+                    amount=int(e["amount"]),
+                    policy=AllocationPolicy.parse(e.get("policy", "compact")),
+                )
             )
-            for e in v.get("entries", [])
-        )
+            if e.get("group") is not None:
+                # non-fungible indexed constraint ("group k of gpus"):
+                # one extra dense mask entry against the per-group
+                # subcolumn, NOT a materialized per-group variant — the
+                # batched solve sees it as one more needs row
+                entries_list.append(
+                    ResourceRequestEntry(
+                        resource_id=resource_map.get_or_create_masked(
+                            e["name"], int(e["group"])
+                        ),
+                        amount=int(e["amount"]),
+                    )
+                )
+        entries = tuple(entries_list)
         if not entries and not v.get("n_nodes"):
             # default: 1 cpu
             entries = (

@@ -15,8 +15,14 @@ from typing import Protocol
 
 from hyperqueue_tpu.ids import task_id_job, task_id_task
 from hyperqueue_tpu.scheduler import decision as decision_mod
-from hyperqueue_tpu.scheduler.queues import Priority as Priority_t
-from hyperqueue_tpu.scheduler.tick import create_batches, run_tick
+from hyperqueue_tpu.scheduler.queues import (
+    BLEVEL_STRIDE,
+    Priority as Priority_t,
+    decode_sched_blevel,
+    decode_sched_job,
+    encode_sched_priority,
+)
+from hyperqueue_tpu.scheduler.tick import Batch, create_batches, run_tick
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker
@@ -52,6 +58,22 @@ _RETRACTED_TOTAL = REGISTRY.counter(
     "prefilled tasks asked back from workers",
     labels=("reason",),
 )
+_SOLVE_GANG_GROUPS = REGISTRY.counter(
+    "hq_solve_gang_groups",
+    "multi-node gangs co-scheduled atomically by the fused dense solve "
+    "(all-or-nothing column groups, --scheduler greedy-fused)",
+)
+_SOLVE_LOOKAHEAD_DEPTH = REGISTRY.gauge(
+    "hq_solve_lookahead_depth",
+    "critical-path depth (b-level) of the deepest task in the last "
+    "dependency-carrying submit batch",
+)
+
+# at most this many gang rows ride one fused solve: gangs are rare and a
+# deep mn backlog must not grow the padded batch axis (each row holds its
+# selected workers for the whole scan, so later rows see a drained pool
+# anyway — exactly like the host phase's one-reservation-at-a-time drain)
+MAX_FUSED_GANG_ROWS = 16
 
 # max tasks queued on a worker beyond its current capacity. The reference
 # uses 40 (scheduler/state.rs:4-21) with its own tick cadence; ours is sized
@@ -98,6 +120,7 @@ def on_new_tasks(core: Core, comm: Comm, tasks: list[Task]) -> None:
     """
     for task in tasks:
         core.tasks[task.task_id] = task
+    _apply_blevel_lookahead(core, tasks)
     for task in tasks:
         unfinished = 0
         for dep_id in task.deps:
@@ -110,6 +133,54 @@ def on_new_tasks(core: Core, comm: Comm, tasks: list[Task]) -> None:
         if unfinished == 0:
             _make_ready(core, task)
     comm.ask_for_scheduling()
+
+
+def _apply_blevel_lookahead(core: Core, tasks: list[Task]) -> None:
+    """Critical-path (b-level) lookahead over one submitted batch.
+
+    Re-encodes the scheduler-priority component (scheduler/queues.py
+    encoding) so that within a job, a task with more dependent work below
+    it outranks its siblings: blevel = 1 + max over in-batch consumers,
+    0 for sinks. Tasks carrying raw test-literal priorities are left
+    untouched, so explicit priority assertions stay bit-exact; production
+    submits always carry the encoding.
+    """
+    if not any(t.deps for t in tasks):
+        return
+    batch = {t.task_id: t for t in tasks}
+    n_children: dict[int, int] = {}
+    for t in tasks:
+        for dep_id in t.deps:
+            if dep_id in batch:
+                n_children[dep_id] = n_children.get(dep_id, 0) + 1
+    blevel = dict.fromkeys(batch, 0)
+    stack = [t for t in tasks if n_children.get(t.task_id, 0) == 0]
+    while stack:
+        t = stack.pop()
+        lvl = blevel[t.task_id] + 1
+        for dep_id in t.deps:
+            if dep_id not in batch:
+                continue
+            if lvl > blevel[dep_id]:
+                blevel[dep_id] = lvl
+            n_children[dep_id] -= 1
+            if n_children[dep_id] == 0:
+                stack.append(batch[dep_id])
+    depth = 0
+    for tid, lvl in blevel.items():
+        if lvl <= 0:
+            continue
+        t = batch[tid]
+        user, sched = t.priority
+        if sched > -BLEVEL_STRIDE:
+            continue  # raw literal scheduler priority: no blevel channel
+        t.priority = (
+            user, encode_sched_priority(decode_sched_job(sched), lvl)
+        )
+        if lvl > depth:
+            depth = lvl
+    if depth:
+        _SOLVE_LOOKAHEAD_DEPTH.set(depth)
 
 
 def _make_ready(core: Core, task: Task) -> None:
@@ -613,6 +684,57 @@ def _clear_mn_reservations(core: Core, task_id: int) -> None:
             core.bump_membership()
 
 
+def _apply_fused_gangs(
+    core: Core, mapped, per_worker_msgs: dict, now: float
+) -> tuple[list, int]:
+    """Apply the gang sentinel assignments (variant == -1) a fused solve
+    emitted, validating against CURRENT state — a pipelined solve maps one
+    tick late, so a member may have been claimed, drained or disconnected
+    while the solve was in flight; the whole gang is then dropped and
+    retried next tick (it is still in core.mn_queue).
+
+    Returns (the non-gang assignments, gangs applied)."""
+    gang_cells: dict[int, list[int]] = {}
+    sn = []
+    for a in mapped:
+        if a[3] == -1:
+            gang_cells.setdefault(a[0], []).append(a[1])
+        else:
+            sn.append(a)
+    n_gangs = 0
+    for task_id, member_ids in gang_cells.items():
+        task = core.tasks.get(task_id)
+        if task is None or task.is_done or task_id not in core.mn_queue:
+            continue
+        rqv = core.rq_map.get_variants(task.rq_id)
+        n_nodes = rqv.variants[0].n_nodes
+        members = [core.workers.get(wid) for wid in member_ids]
+        if len(members) != n_nodes or any(
+            w is None or w.mn_task or w.draining or not w.is_idle()
+            for w in members
+        ):
+            continue  # stale solve: the gang retries next tick
+        core.mn_queue.remove(task_id)
+        core.bump_membership()
+        for w in members:
+            w.mn_task = task_id
+        task.mn_workers = tuple(w.worker_id for w in members)
+        task.state = TaskState.ASSIGNED
+        task.t_assigned = now
+        root = members[0]
+        msg = _compute_message(core, task, variant=0)
+        msg["node_ids"] = list(task.mn_workers)
+        msg["node_hostnames"] = [
+            core.workers[wid].configuration.hostname
+            for wid in task.mn_workers
+        ]
+        per_worker_msgs.setdefault(root.worker_id, []).append(msg)
+        n_gangs += 1
+    if n_gangs:
+        _SOLVE_GANG_GROUPS.inc(n_gangs)
+    return sn, n_gangs
+
+
 def schedule(
     core: Core, comm: Comm, events: EventSink, model, prefill: bool = True
 ) -> int:
@@ -652,7 +774,17 @@ def schedule(
     # the reference's priority interleaving (the MILP schedules higher
     # classes first and only blocks lower ones, solver.rs:479-518). ---
     _t_phase = _time.perf_counter()
-    if core.mn_queue:
+    # fused mode (--scheduler greedy-fused): gangs become all-or-nothing
+    # column groups INSIDE the dense solve instead of this host phase —
+    # but only when the dense snapshot can serve the tick (tick_cache
+    # refuses min-utilization workers; the scratch/mu path keeps the host
+    # gang semantics)
+    fused_tick = core.fused_solve and not any(
+        w.configuration.min_utilization > 0.001
+        for w in core.workers.values()
+        if not (w.mn_task or w.mn_reserved or w.draining)
+    )
+    if core.mn_queue and not fused_tick:
         top_sn = _top_sn_priority(core)
         remaining_mn = []
         for task_id in core.mn_queue:
@@ -801,6 +933,69 @@ def schedule(
         phases["gangs"] = (_time.perf_counter() - _t_phase) * 1e3
         TRACER.record("scheduler/gangs", _time.perf_counter() - _t_phase)
 
+    # --- fused gangs: the head of the mn queue rides the dense solve as
+    # all-or-nothing gang rows (scheduler/tick.py Batch.gang_nodes; kernel
+    # semantics in ops/assign.py scan_batches).  Tasks STAY in mn_queue
+    # until their sentinel assignments come back and validate — a stale
+    # pipelined solve simply drops its gang and the next tick retries. ---
+    fused_gang_batches: list[Batch] = []
+    if fused_tick and core.mn_queue:
+        remaining_mn = []
+        for task_id in core.mn_queue:
+            task = core.tasks.get(task_id)
+            if task is None or task.is_done:
+                _clear_mn_reservations(core, task_id)
+                continue
+            remaining_mn.append(task_id)
+            if len(fused_gang_batches) < MAX_FUSED_GANG_ROWS:
+                # fused mode never reserves: lift any reservation left
+                # over from a host-phase tick so the workers rejoin the
+                # dense row set
+                _clear_mn_reservations(core, task_id)
+                rqv = core.rq_map.get_variants(task.rq_id)
+                fused_gang_batches.append(Batch(
+                    rq_id=task.rq_id, priority=task.priority, size=1,
+                    gang_task=task_id,
+                    gang_nodes=rqv.variants[0].n_nodes,
+                ))
+        core.mn_queue = remaining_mn
+        phases["gangs"] = (_time.perf_counter() - _t_phase) * 1e3
+
+    # Soft drain for fused gangs: the kernel holds members WITHIN one
+    # solve, but between ticks the prefill phase would keep piling backlog
+    # onto the busy members a waiting gang needs (the host phase used the
+    # mn_reserved drain for this).  Mark each pending gang's best-group
+    # candidate set prefill-exempt instead — no membership change, so the
+    # rows stay in the dense solve for the gang row to take.  Mirrors the
+    # host interleave: a gang outranked by strictly-higher-priority ready
+    # single-node work holds nothing yet.
+    fused_gang_hold: set[int] = set()
+    if fused_gang_batches:
+        top_sn = _top_sn_priority(core)
+        for gb in fused_gang_batches:
+            if top_sn is not None and top_sn[0] > gb.priority[0]:
+                continue
+            req = core.rq_map.get_variants(gb.rq_id).variants[0]
+            groups: dict[str, list[Worker]] = {}
+            for w in core.workers.values():
+                if (
+                    w.mn_task
+                    or w.draining
+                    or w.worker_id in fused_gang_hold
+                    or not _mn_member_eligible(w, req)
+                ):
+                    continue
+                groups.setdefault(w.group, []).append(w)
+            best = max(groups.values(), key=len, default=None)
+            if best is None or len(best) < gb.gang_nodes:
+                continue
+            best.sort(key=lambda w: (
+                not w.is_idle(),
+                len(w.assigned_tasks) + len(w.prefilled_tasks),
+                w.worker_id,
+            ))
+            fused_gang_hold.update(w.worker_id for w in best[:gb.gang_nodes])
+
     # --- single-node: dense solve ---
     # Batches are built ONCE per schedule(): run_tick consumes this list,
     # and the prefill phase below reuses it with per-batch taken counts
@@ -837,6 +1032,9 @@ def schedule(
             else pipeline.take_result(model=model, phases=phases,
                                       decision=decision_target)
         )
+        mapped, n_gangs = _apply_fused_gangs(core, mapped, per_worker_msgs, now)
+        assigned += n_gangs
+        gang_assigned += n_gangs
         for task_id, worker_id, rq_id, variant in mapped:
             task = core.tasks.get(task_id)
             if task is None:
@@ -865,15 +1063,31 @@ def schedule(
     have_workers = (
         bool(snapshot.worker_ids) if snapshot is not None else bool(rows)
     )
-    if have_workers and core.queues.total_ready():
+    run_gangs_fused = bool(fused_gang_batches) and snapshot is not None
+    placed_blevel: dict[int, int] | None = None
+    if have_workers and (core.queues.total_ready() or run_gangs_fused):
         _t_batches = _time.perf_counter()
         batches = create_batches(core.queues)
+        gang_ok = group_ids = None
+        if run_gangs_fused:
+            batches = batches + fused_gang_batches
+            # worker-side gang inputs, aligned to the snapshot rows: host
+            # idleness (prefilled backlog does not show in `free`, so the
+            # kernel cannot derive it) and the worker-group index map
+            gmap: dict[str, int] = {}
+            gang_ok = []
+            group_ids = []
+            for wid in snapshot.worker_ids:
+                w = core.workers[wid]
+                gang_ok.append(1 if w.is_idle() else 0)
+                group_ids.append(gmap.setdefault(w.group, len(gmap)))
         phases["batches"] = (_time.perf_counter() - _t_batches) * 1e3
         if snapshot is not None and paranoid_now:
             from hyperqueue_tpu.scheduler.tick_cache import paranoid_check
 
             paranoid_check(
-                core, snapshot, batches, core.rq_map, core.resource_map
+                core, snapshot, batches, core.rq_map, core.resource_map,
+                gang_ok=gang_ok, group_ids=group_ids,
             )
         pipeline_this_tick = (
             pipeline
@@ -905,6 +1119,7 @@ def schedule(
                 key_cache=core.tick_cache,
                 decision=decision_info if record_decision else None,
                 pipeline=pipeline_this_tick,
+                gang_ok=gang_ok, group_ids=group_ids,
             )
             if (
                 pipeline_this_tick is not None
@@ -916,6 +1131,12 @@ def schedule(
                     core.membership_epoch, core.queues.version,
                     core.queues.total_ready(),
                 )
+        if run_gangs_fused:
+            assignments, n_gangs = _apply_fused_gangs(
+                core, assignments, per_worker_msgs, now
+            )
+            assigned += n_gangs
+            gang_assigned += n_gangs
         taken_by_batch: dict[tuple[int, Priority_t], int] = {}
         for task_id, worker_id, rq_id, variant in assignments:
             task = core.tasks[task_id]
@@ -935,11 +1156,57 @@ def schedule(
             taken_by_batch[key] = taken_by_batch.get(key, 0) + 1
         leftover_batches = []
         for batch in batches:
+            if batch.gang_nodes:
+                continue  # gang rows never feed prefill/displacement
             batch.size -= taken_by_batch.get(
                 (batch.rq_id, batch.priority), 0
             )
             if batch.size > 0:
                 leftover_batches.append(batch)
+        if record_decision:
+            # per-job max b-level among the batches that PLACED work this
+            # tick: a same-job leftover with a shallower critical path was
+            # deliberately held behind deeper work (lookahead-held)
+            placed_blevel = {}
+            for (_rq, prio), _n in taken_by_batch.items():
+                if prio[1] <= -BLEVEL_STRIDE:
+                    j = decode_sched_job(prio[1])
+                    bl = decode_sched_blevel(prio[1])
+                    if bl > placed_blevel.get(j, -1):
+                        placed_blevel[j] = bl
+            if run_gangs_fused:
+                still_waiting = set(core.mn_queue)
+                for gb in fused_gang_batches:
+                    if gb.gang_task not in still_waiting:
+                        continue
+                    per_group: dict[str, int] = {}
+                    for w in core.workers.values():
+                        if w.mn_task or w.draining:
+                            continue
+                        per_group[w.group] = per_group.get(w.group, 0) + 1
+                    feasible = (
+                        max(per_group.values(), default=0) >= gb.gang_nodes
+                    )
+                    reason = (
+                        decision_mod.REASON_GANG_GROUP_DEFERRED
+                        if feasible
+                        else decision_mod.REASON_GANG_INCOMPLETE
+                    )
+                    gang_unplaced.append({
+                        "rq_id": gb.rq_id,
+                        "job": task_id_job(gb.gang_task),
+                        "task": task_id_task(gb.gang_task),
+                        "priority": gb.priority[0],
+                        "count": 1,
+                        "reason": reason,
+                        "detail": (
+                            f"fused solve held {gb.gang_nodes} group "
+                            "members this tick (busy or taken by the "
+                            "scan)" if feasible else
+                            f"no group musters {gb.gang_nodes} eligible "
+                            "members"
+                        ),
+                    })
         TRACER.record("scheduler/solve", _time.perf_counter() - _t_phase)
 
     # --- proactive prefilling: push extra top-priority tasks to busy
@@ -953,6 +1220,7 @@ def schedule(
             if not w.mn_task
             and not w.mn_reserved
             and not w.draining
+            and w.worker_id not in fused_gang_hold
             and (w.assigned_tasks or w.prefilled_tasks)
             and len(w.prefilled_tasks) < PREFILL_MAX
         }
@@ -1206,6 +1474,7 @@ def schedule(
                     leftover_batches = create_batches(core.queues)
                 unplaced.extend(decision_mod.build_unplaced_entries(
                     core, leftover_batches, {}, degraded=degraded,
+                    placed_blevel=placed_blevel,
                 ))
             n_paused = 0
             for job_id, held in core.paused_held.items():
@@ -1314,6 +1583,9 @@ def _compute_message(core: Core, task: Task, variant: int) -> dict:
                 "policy": e.policy.value,
             }
             for e in request.entries
+            # mask subcolumns (gpus#k) are server-side placement
+            # constraints; workers only know physical resource names
+            if not core.resource_map.is_masked(e.resource_id)
         ]
         cached = (entries, request.n_nodes)
         core.entries_cache[key] = cached

@@ -76,6 +76,9 @@ class SimResult:
     journal_digest: str
     decisions: list = field(repr=False, default_factory=list)
     violations: list = field(default_factory=list)
+    # real per-tick scheduler latencies (ms), collected BEFORE decision
+    # records are normalized (normalization strips wall timings)
+    tick_ms: list = field(repr=False, default_factory=list)
 
     @property
     def virtual_tasks_per_wall_s(self) -> float:
@@ -162,6 +165,7 @@ class Simulation:
         self._next_restore_delay = self.restore_delay
         self._stopping = False
         self._decisions: list[dict] = []
+        self._tick_ms: list[float] = []
         self._event_tap_task = None
         self._fault_tasks: list = []
         self.wall_s = 0.0
@@ -223,9 +227,11 @@ class Simulation:
             self.monitor.on_event(record)
 
     def _collect_decisions(self, server: Server) -> None:
-        self._decisions.extend(
-            _normalize_decision(r) for r in server.core.flight.ticks()
-        )
+        for r in server.core.flight.ticks():
+            dur = r.get("duration_ms")
+            if isinstance(dur, (int, float)):
+                self._tick_ms.append(float(dur))
+            self._decisions.append(_normalize_decision(r))
 
     def _kill_server_now(self) -> None:
         """kill -9 the current incarnation, synchronously: everything
@@ -537,6 +543,7 @@ class Simulation:
             journal_digest=journal_digest,
             decisions=self._decisions,
             violations=list(self.monitor.violations),
+            tick_ms=self._tick_ms,
         )
 
 

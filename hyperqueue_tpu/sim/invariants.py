@@ -32,6 +32,13 @@ Invariant catalog (docs/simulation.md has the prose version):
 ``no lost tasks`` / ``exactly-once acceptance`` (final)
     At quiescence the journal contains exactly one terminal record per
     submitted task, and every acked submit's tasks are accounted for.
+``gang atomicity``
+    A multi-node task never starts with fewer workers than its requested
+    ``n_nodes`` (no gang member ever starts without its siblings), and no
+    worker is a member of two concurrently-running gangs.  Checked on
+    every task-started event against the live server's request map; holds
+    identically for the host reservation drain and the fused in-solve
+    gang rows (``--scheduler greedy-fused``).
 """
 
 from __future__ import annotations
@@ -65,6 +72,9 @@ class InvariantMonitor:
         self.started_events = 0
         self.finished_events = 0
         self.events_seen = 0
+        # task_id -> worker-id member set of each currently-running gang
+        self.gang_active: dict[int, set[int]] = {}
+        self.gang_starts = 0
 
     # --- plumbing -------------------------------------------------------
     def _fail(self, message: str) -> None:
@@ -149,8 +159,51 @@ class InvariantMonitor:
                         f"{job}@{task} announced instance {instance} after "
                         f"{last}"
                     )
-        elif kind == "task-finished":
-            self.finished_events += 1
+                self._check_gang_start(tid, record)
+        elif kind in ("task-finished", "task-failed", "task-canceled"):
+            if kind == "task-finished":
+                self.finished_events += 1
+            task = record.get("task")
+            job = record.get("job")
+            if task is not None and job is not None:
+                self.gang_active.pop((int(job) << 32) | int(task), None)
+
+    def _check_gang_start(self, tid: int, record: dict) -> None:
+        """Gang atomicity: a multi-node start must carry exactly n_nodes
+        workers, none of which belongs to another running gang."""
+        server = self.sim.server
+        if server is None:
+            return  # event from a just-killed incarnation; nothing to read
+        task = server.core.tasks.get(tid)
+        if task is None:
+            return
+        rqv = server.core.rq_map.get_variants(task.rq_id)
+        variant = int(record.get("variant", 0) or 0)
+        if variant >= len(rqv.variants):
+            return
+        n_nodes = rqv.variants[variant].n_nodes
+        if not n_nodes:
+            return
+        members = set(record.get("workers") or ())
+        t = record.get("time", 0.0)
+        if len(members) != n_nodes:
+            self._fail(
+                f"gang atomicity violation: task {tid} (n_nodes={n_nodes}) "
+                f"started with {len(members)} worker(s) "
+                f"{sorted(members)} at t={t}"
+            )
+        for other_tid, other_members in self.gang_active.items():
+            if other_tid == tid:
+                continue  # a restart supersedes the prior instance
+            overlap = members & other_members
+            if overlap:
+                self._fail(
+                    f"gang overlap violation: workers {sorted(overlap)} "
+                    f"belong to running gang {other_tid} but gang {tid} "
+                    f"started on them at t={t}"
+                )
+        self.gang_active[tid] = members
+        self.gang_starts += 1
 
     # --- restore-time checks ---------------------------------------------
     def check_restored_server(self, server) -> None:
@@ -239,4 +292,5 @@ class InvariantMonitor:
             "canceled": len(canceled),
             "events_seen": self.events_seen,
             "executions": len(self.exec_started),
+            "gang_starts": self.gang_starts,
         }

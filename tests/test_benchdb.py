@@ -164,3 +164,80 @@ def test_published_baseline_is_regenerated_and_nonempty():
     assert pub.get("per_task_overhead_ms"), "published section is empty"
     db = Database()
     assert report.build_published(db).keys() == pub.keys()
+
+
+# --- bench.py --regress: result-db regression gate -------------------------
+
+def _load_bench():
+    import importlib.util
+
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("_bench_gate", root / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_direction_heuristics():
+    bench = _load_bench()
+    # higher-better name hints win even when a lower-better hint also matches
+    assert bench._metric_direction("virtual_tasks_per_wall_s") == 1
+    assert bench._metric_direction("throughput") == 1
+    assert bench._metric_direction("fused_vs_baseline") == 1
+    assert bench._metric_direction("tick_p95_ms") == -1
+    assert bench._metric_direction("makespan_s") == -1
+    assert bench._metric_direction("widgets", unit="/s") == 1
+    assert bench._metric_direction("widgets", unit="ms") == -1
+    # unknown direction is skipped, never guessed
+    assert bench._metric_direction("blob") == 0
+
+
+def test_regression_gate_fires_on_slowdown(tmp_path):
+    bench = _load_bench()
+    dbp = tmp_path / "db.jsonl"
+    db = Database(dbp)
+    for v in (100.0, 102.0, 98.0):
+        db.store_emit({"experiment": "gate", "mode": "x", "path_ms": v})
+    db.store_emit({"experiment": "gate", "mode": "x", "path_ms": 200.0})
+    checked, regs = bench.check_regressions(db_path=dbp)
+    assert checked == 1
+    (reg,) = regs
+    assert reg["experiment"] == "gate"
+    assert reg["metric"] == "path_ms"
+    assert reg["baseline"] == 100.0
+    assert reg["current"] == 200.0
+    assert reg["change_pct"] > 20
+    assert reg["n_baseline_rows"] == 3
+
+
+def test_regression_gate_quiet_on_healthy_unknown_and_sparse(tmp_path):
+    bench = _load_bench()
+    dbp = tmp_path / "db.jsonl"
+    db = Database(dbp)
+    # healthy: newest within noise of the median
+    for v in (100.0, 101.0, 99.0, 100.5):
+        db.store_emit({"experiment": "ok", "mode": "x", "path_ms": v})
+    # unknown-direction metric: never counted, never flagged
+    for v in (1.0, 50.0):
+        db.store_emit({"experiment": "mystery", "mode": "x", "blob": v})
+    # single row: no baseline, skipped
+    db.store_emit({"experiment": "sparse", "mode": "x", "path_ms": 5.0})
+    checked, regs = bench.check_regressions(db_path=dbp)
+    assert checked == 1  # only the healthy path_ms group has evidence
+    assert regs == []
+    # experiment filter scopes the gate
+    checked, regs = bench.check_regressions(db_path=dbp, experiment="mystery")
+    assert (checked, regs) == (0, [])
+
+
+def test_regression_gate_reads_metric_name_from_value_rows(tmp_path):
+    bench = _load_bench()
+    dbp = tmp_path / "db.jsonl"
+    db = Database(dbp)
+    # {"metric": ..., "value": ...} rows take their direction from params
+    for v in (10.0, 10.0, 30.0):
+        db.store_emit({"experiment": "e", "metric": "tick_p99_ms", "value": v})
+    checked, regs = bench.check_regressions(db_path=dbp)
+    assert checked == 1
+    (reg,) = regs
+    assert reg["metric"] == "tick_p99_ms"

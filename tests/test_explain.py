@@ -384,6 +384,173 @@ def test_oracle_and_production_classifier_agree():
         assert oracle_reason == [expected]
 
 
+def test_oracle_and_production_agree_on_fused_gang_reasons():
+    """The fused-solve gang classification (reactor) and the oracle's gang
+    branch must agree: members exist but are busy -> gang-group-deferred;
+    no group could ever muster n members -> gang-incomplete."""
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+    from hyperqueue_tpu.scheduler.oracle import explain_unplaced, solve_oracle
+
+    U = 10_000
+    INF = 10**9
+
+    # deferred: 3 lifetime-capable same-group workers, one busy
+    env = TestEnv(model=GreedyCutScanModel(backend="numpy"))
+    env.core.fused_solve = True
+    for _ in range(3):
+        env.worker(cpus=4)
+    (blocker,) = env.submit(rqv=env.rqv(cpus=4), job=9, priority=(0, -1))
+    env.schedule()
+    env.start_all_assigned()
+    env.submit(rqv=env.rqv(n_nodes=3), job=1, priority=(0, -2))
+    env.schedule()
+    rec = env.core.flight.latest()
+    (entry,) = [e for e in rec["unplaced"] if e["job"] == 1]
+    assert entry["reason"] == decision.REASON_GANG_GROUP_DEFERRED
+    # dense oracle mirror: same cluster, gang_ok=0 on the busy worker
+    dense = ([[0], [4 * U], [4 * U]], [0, 4, 4], [INF, INF, INF],
+             [[[U]]], [1], [[0]])
+    counts = solve_oracle(*dense, [1.0], gang_nodes=[3],
+                          gang_ok=[0, 1, 1], group_ids=[0, 0, 0])
+    assert sum(counts[0][0]) == 0  # all-or-nothing: no partial emit
+    assert explain_unplaced(*dense, counts, gang_nodes=[3],
+                            gang_ok=[0, 1, 1], group_ids=[0, 0, 0]) == \
+        [decision.REASON_GANG_GROUP_DEFERRED]
+
+    # incomplete: only 2 workers exist at all
+    env2 = TestEnv(model=GreedyCutScanModel(backend="numpy"))
+    env2.core.fused_solve = True
+    env2.worker(cpus=2)
+    env2.worker(cpus=2)
+    env2.submit(rqv=env2.rqv(n_nodes=3), job=1, priority=(0, -1))
+    env2.schedule()
+    rec = env2.core.flight.latest()
+    (entry,) = rec["unplaced"]
+    assert entry["reason"] == decision.REASON_GANG_INCOMPLETE
+    dense2 = ([[2 * U], [2 * U]], [2, 2], [INF, INF], [[[U]]], [1], [[0]])
+    counts2 = solve_oracle(*dense2, [1.0], gang_nodes=[3],
+                           gang_ok=[1, 1], group_ids=[0, 0])
+    assert explain_unplaced(*dense2, counts2, gang_nodes=[3],
+                            gang_ok=[1, 1], group_ids=[0, 0]) == \
+        [decision.REASON_GANG_INCOMPLETE]
+
+
+def test_oracle_and_production_agree_on_fractional_and_masked():
+    """Fractional amounts (0.5 gpu) and non-fungible indexed groups
+    (gpus#1 mask subcolumn) classify identically in production and in the
+    dense oracle mirror."""
+    from hyperqueue_tpu.resources.descriptor import (
+        ResourceDescriptor,
+        ResourceDescriptorItem,
+    )
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.scheduler.oracle import explain_unplaced, solve_oracle
+    from hyperqueue_tpu.server import reactor
+    from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
+
+    U = 10_000
+    INF = 10**9
+
+    # fractional: 3 x 0.5-gpu tasks on one 1-gpu worker -> exactly 2 run
+    env = TestEnv()
+    env.worker(cpus=4, gpus=1)
+    env.submit(n=3, rqv=env.rqv(cpus=1, gpus=0.5), priority=(0, -1))
+    assert env.schedule() == 2
+    assert _reasons(env) == {decision.REASON_INSUFFICIENT_CAPACITY: 1}
+    dense = ([[4 * U, U]], [4], [INF], [[[U, U // 2]]], [3], [[0]])
+    counts = solve_oracle(*dense, [1.0, 1.0])
+    assert sum(counts[0][0]) == 2
+    assert explain_unplaced(*dense, counts) == \
+        [decision.REASON_INSUFFICIENT_CAPACITY]
+
+    # masked: request pinned to gpus group 1 (2 indices) -> third task
+    # can't fit even though group 0 still has free gpus
+    env = TestEnv()
+    items = [
+        ResourceDescriptorItem.range("cpus", 0, 7),
+        ResourceDescriptorItem.group_list(
+            "gpus", [["0", "1"], ["2", "3"]]
+        ),
+    ]
+    config = WorkerConfiguration(
+        descriptor=ResourceDescriptor(items=tuple(items)), group="default"
+    )
+    w = Worker.create(
+        env.core.worker_id_counter.next(), config, env.core.resource_map
+    )
+    reactor.on_new_worker(env.core, env.comm, env.events, w)
+    rm = env.core.resource_map
+    gpus = rm.get_or_create("gpus")
+    g1 = rm.get_or_create_masked("gpus", 1)
+    assert rm.is_masked(g1) and not rm.is_masked(gpus)
+    rq = ResourceRequest(entries=(
+        ResourceRequestEntry(rm.get_or_create("cpus"), U),
+        ResourceRequestEntry(gpus, U),
+        ResourceRequestEntry(g1, U),
+    ))
+    env.submit(
+        n=3, rqv=ResourceRequestVariants.single(rq), priority=(0, -1)
+    )
+    assert env.schedule() == 2
+    assert _reasons(env) == {decision.REASON_INSUFFICIENT_CAPACITY: 1}
+    # dense mirror: columns [cpus, gpus, gpus#0, gpus#1]
+    dense = ([[8 * U, 4 * U, 2 * U, 2 * U]], [8], [INF],
+             [[[U, U, 0, U]]], [3], [[0]])
+    counts = solve_oracle(*dense, [1.0] * 4)
+    assert sum(counts[0][0]) == 2
+    assert explain_unplaced(*dense, counts) == \
+        [decision.REASON_INSUFFICIENT_CAPACITY]
+
+
+def test_reason_lookahead_held_for_shallow_same_job_work():
+    """With critical-path lookahead, shallow same-job work left behind
+    while deeper work placed reports lookahead-held, not a bare
+    solver-deferred."""
+    from hyperqueue_tpu.ids import make_task_id
+    from hyperqueue_tpu.scheduler.queues import encode_sched_priority
+    from hyperqueue_tpu.server import reactor
+    from hyperqueue_tpu.server.task import Task
+
+    class _HeadOnlyModel:
+        # places exactly one task from the top-priority batch: capacity
+        # remains free, so the leftover classifies solver-deferred
+        def solve(self, free, nt_free, lifetime, needs, sizes, min_time,
+                  priorities, **kw):
+            out = np.zeros(
+                (needs.shape[0], needs.shape[1], free.shape[0]),
+                dtype=np.int32,
+            )
+            out[0, 0, 0] = 1
+            return out
+
+    env = TestEnv(model=_HeadOnlyModel())
+    env.worker(cpus=2)
+    rq_id = env.core.intern_rqv(env.rqv())
+    p = (0, encode_sched_priority(1))
+    ids = [make_task_id(1, i + 1) for i in range(4)]
+    # chain a -> b -> c (a has b-level 2) plus shallow d (b-level 0);
+    # only a and d are ready, forming two batches of one job
+    tasks = [
+        Task(task_id=ids[0], rq_id=rq_id, priority=p, body={}),
+        Task(task_id=ids[1], rq_id=rq_id, priority=p, deps=(ids[0],),
+             body={}),
+        Task(task_id=ids[2], rq_id=rq_id, priority=p, deps=(ids[1],),
+             body={}),
+        Task(task_id=ids[3], rq_id=rq_id, priority=p, body={}),
+    ]
+    reactor.on_new_tasks(env.core, env.comm, tasks)
+    assert env.schedule() == 1
+    # the chain head (deepest b-level) wins the single granted slot
+    assert env.core.tasks[ids[0]].assigned_worker
+    rec = env.core.flight.latest()
+    (entry,) = rec["unplaced"]
+    assert entry["reason"] == decision.REASON_LOOKAHEAD_HELD
+
+
 # --------------------------------------------------------------------------
 # docs catalog checker: no reason code ships undocumented
 # --------------------------------------------------------------------------

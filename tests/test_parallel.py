@@ -206,18 +206,26 @@ def test_multichip_model_single_device_fallback():
 # bitwise identical to a fresh full-upload solve EVERY tick
 # ---------------------------------------------------------------------------
 
-def _random_tick_batches(rng, n_r, with_all=False):
+def _random_tick_batches(rng, n_r, with_all=False, with_gangs=False):
     n_b = int(rng.integers(1, 9))
     n_v = int(rng.integers(1, 3))
     needs = (rng.integers(0, 3, size=(n_b, n_v, n_r)) * (U // 2)).astype(
         np.int32
     )
     # every batch requests something in its first variant so no batch is
-    # accidentally absent
+    # accidentally absent (U//2 amounts double as fractional requests)
     needs[:, 0, 0] = np.maximum(needs[:, 0, 0], U)
     sizes = rng.integers(0, 25, size=n_b).astype(np.int32)
     min_time = rng.choice([0, 0, 120, 3600], size=(n_b, n_v)).astype(np.int32)
     kwargs = dict(needs=needs, sizes=sizes, min_time=min_time)
+    if with_gangs and rng.random() < 0.5:
+        # one fused gang row: all-or-nothing over a worker group; the
+        # resident path caches gang_ok/group_onehot placements too
+        gang_nodes = np.zeros(n_b, dtype=np.int32)
+        g = int(rng.integers(0, n_b))
+        gang_nodes[g] = int(rng.integers(2, 4))
+        sizes[g] = 1
+        kwargs["gang_nodes"] = gang_nodes
     if with_all and rng.random() < 0.3:
         # ALL-policy on resource 1 for one batch: the kernel drains the
         # whole pool; the resident mirror must track the zeroing exactly
@@ -260,8 +268,11 @@ def test_resident_multi_tick_soak_bitwise(seed):
     # full sharded solve — the half cadence keeps the soak inside the
     # tier-1 budget while still covering every shape the soak produces)
     resident.paranoid_resident = 2
+    gang_ticks = 0
     for tick in range(12):
-        batch_kwargs = _random_tick_batches(rng, n_r, with_all=True)
+        batch_kwargs = _random_tick_batches(
+            rng, n_r, with_all=True, with_gangs=True
+        )
         kwargs = dict(
             free=free.copy(), nt_free=nt_free.copy(),
             lifetime=lifetime.copy(),
@@ -269,6 +280,17 @@ def test_resident_multi_tick_soak_bitwise(seed):
         )
         if "all_mask" in batch_kwargs:
             kwargs["total"] = total.copy()
+        if "gang_nodes" in batch_kwargs:
+            # worker-side gang inputs track the current (churned) W
+            gang_ticks += 1
+            w_now = free.shape[0]
+            kwargs["gang_ok"] = rng.integers(
+                0, 2, size=w_now
+            ).astype(np.int32)
+            gids = rng.integers(0, 2, size=w_now).astype(np.int32)
+            kwargs["group_onehot"] = (
+                gids[:, None] == np.arange(2, dtype=np.int32)[None, :]
+            ).astype(np.int32)
         out_res = resident.solve(**{k: v.copy() for k, v in kwargs.items()})
         fresh = MultichipModel()  # no residency: full upload by definition
         out_fresh = fresh.solve(**kwargs)
@@ -324,6 +346,7 @@ def test_resident_multi_tick_soak_bitwise(seed):
         "the soak never exercised the dirty-row delta path"
     )
     assert resident.paranoid_checks > 0
+    assert gang_ticks > 0, "the soak never exercised a fused gang row"
 
 
 def test_resident_steady_state_uploads_only_dirty_rows():

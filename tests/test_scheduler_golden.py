@@ -1122,3 +1122,35 @@ def test_resource_time_balance1():
     assert owner[1] == 2          # 170s fits only the 200s worker
     assert owner[3] in (2, 3)     # 99s cannot land on the 50s worker
     assert len(set(owner.values())) == 3  # one task per 1-cpu worker
+
+
+def test_blevel_priority_encoding_roundtrip_and_order():
+    """Critical-path lookahead rides the scheduler priority encoding:
+    decode(encode(job, blevel)) must round-trip, deeper b-levels must sort
+    first within a job, lower job ids must dominate across jobs, and raw
+    legacy priorities (magnitude < BLEVEL_STRIDE) must pass through
+    untouched."""
+    from hyperqueue_tpu.scheduler.queues import (
+        BLEVEL_MAX,
+        BLEVEL_STRIDE,
+        decode_sched_blevel,
+        decode_sched_job,
+        encode_sched_priority,
+    )
+
+    for job in (1, 2, 77, 4096):
+        for bl in (0, 1, 2, 63, BLEVEL_MAX):
+            sched = encode_sched_priority(job, bl)
+            assert decode_sched_job(sched) == job
+            assert decode_sched_blevel(sched) == bl
+    # clamped above BLEVEL_MAX rather than bleeding into the job field
+    assert decode_sched_job(
+        encode_sched_priority(3, BLEVEL_MAX + 500)
+    ) == 3
+    # deeper critical path -> higher scheduling priority within one job
+    assert encode_sched_priority(1, 5) > encode_sched_priority(1, 0)
+    # job order dominates any blevel difference
+    assert encode_sched_priority(1, 0) > encode_sched_priority(2, BLEVEL_MAX)
+    # legacy raw priorities are outside the encoded band
+    assert -5 > -BLEVEL_STRIDE
+    assert encode_sched_priority(1, 0) < -1
