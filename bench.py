@@ -1138,6 +1138,21 @@ def run_federation_smoke() -> None:
         "failures": failures,
         "wall_s": round(time.perf_counter() - t_wall, 2),
     })
+    # --- regression gate: the row just stored vs its prior rows ------
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="federation_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} federation_smoke "
+                      f"metric(s) within 20% of baseline)",
+                      file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
     sys.exit(1 if failures else 0)
 
 
@@ -1312,6 +1327,225 @@ def run_fleet_smoke() -> None:
         "failures": failures,
         "wall_s": round(time.perf_counter() - t_wall, 2),
     })
+    # --- regression gate: the row just stored vs its prior rows ------
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="fleet_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} fleet_smoke metric(s) "
+                      f"within 20% of baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
+    sys.exit(1 if failures else 0)
+
+
+def run_reshard_smoke() -> None:
+    """Elastic-resharding gate (ISSUE 17): 2 shards, a hot/idle backlog
+    split, the rebalancer on, then an ONLINE third shard.
+
+    Phase 1 (convergence): every job lands pinned on shard 0 while
+    shard 1 idles behind a small pinned warmup; the standby runs
+    ``--rebalance`` and must drive live migrations until the fleet's
+    max/mean backlog ratio drops below the 1.5x hysteresis band.
+    Measures standby-start -> convergence.
+
+    Phase 2 (online add): ``--shards 3 --shard-id 2`` boots against the
+    2-way root — the descriptor grows in place, the shard-add lands in
+    the ownership log, no restart anywhere. Measures spawn -> shard 2
+    serving stats. A job is then explicitly migrated onto the new shard
+    and EVERY submitted task must still finish exactly once (zero loss
+    across both the rebalancer's moves and the manual one)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv, wait_until
+
+    from hyperqueue_tpu.client.fleet import fleet_snapshot
+    from hyperqueue_tpu.server.federation import _backlog
+    from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+    converge_bound_s = 90.0
+    add_bound_s = 45.0
+    failures = []
+    converge_s = float("inf")
+    add_s = float("inf")
+    t_wall = time.perf_counter()
+
+    def backlog_ratio(root) -> float | None:
+        samples = fleet_snapshot(root, timeout=5.0, sample_interval=0.25)
+        # the rebalancer's own backlog definition (server queues PLUS
+        # worker prefill queues) — measuring convergence with a narrower
+        # one would declare victory on an all-prefilled hot shard
+        backlogs = [
+            _backlog(s) for s in samples.values() if s is not None
+        ]
+        if len(backlogs) < 2:
+            return None
+        mean = sum(backlogs) / len(backlogs)
+        if mean <= 0:
+            return 1.0  # all quiet: trivially converged
+        return max(backlogs) / mean
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        with HqEnv(tmp) as env:
+            env.start_shard(0, 2, "--lease-timeout", "2.0")
+            env.start_shard(1, 2, "--lease-timeout", "2.0")
+            env.start_worker("--shard", "0", cpus=2)
+            env.start_worker("--shard", "1", cpus=2)
+            env.wait_workers(2)
+            # shard 1's worker stays busy on a small pinned warmup: the
+            # lending coordinator then has no idle donor, so backlog can
+            # only converge through the REBALANCER's job migrations
+            os.environ["HQ_SHARD"] = "1"
+            try:
+                env.command(["submit", "--array", "0-7", "--",
+                             "sleep", "1"])
+            finally:
+                os.environ.pop("HQ_SHARD", None)
+            os.environ["HQ_SHARD"] = "0"
+            try:
+                for _ in range(2):
+                    env.command(["submit", "--array", "0-19", "--",
+                                 "sleep", "2"])
+            finally:
+                os.environ.pop("HQ_SHARD", None)
+            t0 = time.perf_counter()
+            env.start_standby("--lease-timeout", "2.0",
+                              "--coordinator-interval", "0.25",
+                              "--rebalance")
+            store = OwnershipStore(env.server_dir)
+
+            def engaged() -> bool:
+                m = store.load()
+                return bool(m.assignments) or bool(m.verdicts)
+
+            try:
+                wait_until(engaged, timeout=converge_bound_s,
+                           message="rebalancer verdict/migration")
+                wait_until(
+                    lambda: (backlog_ratio(env.server_dir) or 9.9) < 1.5,
+                    timeout=converge_bound_s, interval=0.5,
+                    message="backlog convergence below 1.5x",
+                )
+                converge_s = time.perf_counter() - t0
+            except TimeoutError as e:
+                failures.append(f"no convergence: {e}")
+            moved = len(store.load().assignments)
+
+            # --- phase 2: online shard add (N=2 -> N=3) --------------
+            t1 = time.perf_counter()
+            env.start_shard(2, 3, "--lease-timeout", "2.0")
+
+            def shard2_up() -> bool:
+                try:
+                    stats = json.loads(env.command(
+                        ["server", "stats", "--shard", "2",
+                         "--output-mode", "json"], timeout=20,
+                    ))
+                except Exception:  # noqa: BLE001 - still booting
+                    return False
+                return (
+                    stats.get("federation") or {}
+                ).get("shard_id") == 2
+
+            try:
+                wait_until(shard2_up, timeout=add_bound_s,
+                           message="shard 2 serving")
+                add_s = time.perf_counter() - t1
+            except TimeoutError:
+                failures.append("online shard add never served")
+            env.start_worker("--shard", "2", cpus=2)
+            # move one job onto the shard that did not exist at submit
+            # time (retry once: the rebalancer may hold the job's claim)
+            migrated_to_new = False
+            for _ in range(3):
+                try:
+                    env.command(["fleet", "migrate", "1", "2"],
+                                timeout=60)
+                    migrated_to_new = True
+                    break
+                except AssertionError:
+                    time.sleep(2.0)
+            if not migrated_to_new:
+                failures.append("migration onto the added shard failed")
+            env.command(["job", "wait", "all"], timeout=180)
+            # zero task loss: every submitted task finished exactly once
+            jobs = json.loads(env.command(
+                ["job", "info", "all", "--output-mode", "json"],
+                timeout=30,
+            ))
+            expected = {1: 20, 2: 8, 3: 20}
+            got = {
+                j["id"]: (j.get("counters") or {}).get("finished", 0)
+                for j in jobs
+            }
+            if got != expected:
+                failures.append(
+                    f"task loss across resharding: finished {got}, "
+                    f"expected {expected}"
+                )
+            status = env.command(["fleet", "status"], timeout=30)
+            if "federation:" not in status:
+                failures.append(f"fleet status unusable: {status!r}")
+            if converge_s != float("inf") and converge_s > converge_bound_s:
+                failures.append(
+                    f"convergence {converge_s:.1f}s over the "
+                    f"{converge_bound_s}s bound"
+                )
+            if add_s != float("inf") and add_s > add_bound_s:
+                failures.append(
+                    f"shard add {add_s:.1f}s over the {add_bound_s}s bound"
+                )
+    emit({
+        "experiment": "reshard_smoke",
+        "metric": "converge_seconds",
+        "value": round(converge_s, 2) if converge_s != float("inf")
+        else None,
+        "unit": "s",
+        "params": {"shards": 2, "ratio_band": 1.5,
+                   "converge_bound_s": converge_bound_s},
+        "jobs_moved": moved,
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    emit({
+        "experiment": "reshard_smoke",
+        "metric": "shard_add_seconds",
+        "value": round(add_s, 2) if add_s != float("inf") else None,
+        "unit": "s",
+        "params": {"shards_before": 2, "shards_after": 3,
+                   "add_bound_s": add_bound_s},
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    # --- regression gate: the rows just stored vs their prior rows ---
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="reshard_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} reshard_smoke metric(s) "
+                      f"within 20% of baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
+    print("reshard-smoke:", "OK" if not failures else failures)
     sys.exit(1 if failures else 0)
 
 
@@ -2918,6 +3152,12 @@ def main() -> None:
                              "shard's task events exactly once) + a "
                              "metrics-proxy scrape covering both shards "
                              "under the latency bound")
+    parser.add_argument("--reshard-smoke", action="store_true",
+                        help="elastic-resharding gate (ISSUE 17): "
+                             "rebalancer-driven hot-shard backlog "
+                             "convergence below 1.5x + online N->N+1 "
+                             "shard add with zero task loss; one "
+                             "db.jsonl row per metric under --regress")
     parser.add_argument("--sim-smoke", action="store_true",
                         help="deterministic-simulator gate: determinism "
                              "pair, scenario sweep, and the 100k-task/"
@@ -2992,6 +3232,10 @@ def main() -> None:
 
     if args.fleet_smoke:
         run_fleet_smoke()
+        return
+
+    if args.reshard_smoke:
+        run_reshard_smoke()
         return
 
     if args.elasticity_smoke:

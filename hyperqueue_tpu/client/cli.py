@@ -241,8 +241,14 @@ def _run_standby(args, shards: int) -> None:
     root = _server_dir(args)
     if shards >= 1:
         # allow the standby to come up FIRST in a deployment: it can
-        # publish the federation descriptor the shards will join
-        serverdir.write_federation(root, shards)
+        # publish the federation descriptor the shards will join — and
+        # GROW an existing one when restarted with a larger --shards
+        # (online shard add; shrinking is rejected)
+        existing = serverdir.load_federation(root)
+        if existing is not None and shards != int(existing["shard_count"]):
+            serverdir.grow_federation(root, shards)
+        else:
+            serverdir.write_federation(root, shards)
     # keep in lockstep with Server.federation_server_kwargs() — the
     # peer-promotion path clones the same subset from a live Server, and
     # a knob present in one list but not the other makes standby- and
@@ -269,6 +275,7 @@ def _run_standby(args, shards: int) -> None:
         lease_timeout=args.lease_timeout,
         coordinate=not getattr(args, "no_coordinator", False),
         sample_interval=args.coordinator_interval,
+        rebalance=getattr(args, "rebalance", False),
         # the standby's endpoint keeps hq_federation_shard_up and
         # failovers_total scrapeable through shard deaths (ISSUE 15)
         metrics_port=args.metrics_port,
@@ -2415,6 +2422,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=1.0,
                    help="with --standby: subscribe-feed sample cadence "
                         "driving the lending decisions")
+    p.add_argument("--rebalance", action="store_true",
+                   help="with --standby: also drive live job migrations "
+                        "from backlogged shards toward idle ones "
+                        "(largest job first, hysteresis-bounded; every "
+                        "verdict lands in the ownership log)")
     p.set_defaults(fn=cmd_server_start)
     p = ssub.add_parser("stop")
     _add_common(p)
@@ -2946,6 +2958,30 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("output", help="output path (e.g. fleet-trace.json)")
     p.set_defaults(fn=cmd_fleet_trace_export)
+    p = fsub.add_parser(
+        "status",
+        help="ownership map: per-shard owned-job counts, in-flight "
+             "migrations with their protocol phase, and the last "
+             "rebalance verdict",
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_fleet_status)
+    p = fsub.add_parser(
+        "migrate",
+        help="live-migrate one job to another shard: the source seals "
+             "and drains it, the destination imports exactly-once, the "
+             "ownership log journals the handoff (crash-safe at every "
+             "phase; re-run with the same arguments to resume)",
+    )
+    _add_common(p)
+    p.add_argument("job_id", type=int, nargs="?", default=None)
+    p.add_argument("to_shard", type=int, nargs="?", default=None,
+                   metavar="SHARD")
+    p.add_argument("--recover", action="store_true",
+                   help="re-drive every in-flight migration intent left "
+                        "in the ownership log by a crashed driver, then "
+                        "exit (no job/shard arguments needed)")
+    p.set_defaults(fn=cmd_fleet_migrate)
 
     # doc + completion
     p = sub.add_parser("doc", help="show documentation topics")
@@ -3135,6 +3171,100 @@ def cmd_fleet_trace_export(args) -> None:
         f"{len(trace.get('traceEvents') or ())} event(s)"
         + (f", DOWN: {down}" if down else "")
         + "); load at ui.perfetto.dev"
+    )
+
+
+def cmd_fleet_status(args) -> None:
+    """`hq fleet status`: the ownership map as operators read it —
+    who owns what, what is mid-move, what the rebalancer last did."""
+    from hyperqueue_tpu.client.connection import ClientSession
+    from hyperqueue_tpu.client.fleet import shard_count_of
+    from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+    root = _server_dir(args)
+    try:
+        n = shard_count_of(root)
+    except ValueError as e:
+        fail(str(e))
+    omap = OwnershipStore(root).load()
+    moved_in = omap.owned_counts()
+    lines = [
+        f"federation: {max(n, omap.shard_count)} shard(s) "
+        f"(base {omap.base_shard_count}), "
+        f"ownership epoch {omap.epoch}"
+    ]
+    for k in range(max(n, omap.shard_count)):
+        shard_dir = serverdir.shard_path(root, k)
+        try:
+            with ClientSession(shard_dir, retry_window=2.0) as session:
+                jobs = session.request({"op": "job_list"}).get("jobs", [])
+            owned = len(jobs)
+            live = sum(
+                1 for j in jobs
+                if j.get("status") in ("running", "waiting", "opened")
+            )
+            detail = f"{owned} job(s) owned, {live} active"
+            if moved_in.get(k):
+                detail += f", {moved_in[k]} migrated in"
+        except (OSError, ClientError, FileNotFoundError) as e:
+            detail = f"DOWN ({e})"
+        lines.append(f"  shard {k}: {detail}")
+    in_flight = omap.in_flight()
+    if in_flight:
+        lines.append("in-flight migrations:")
+        for rec in in_flight:
+            lines.append(
+                f"  {rec['mig']}: job {rec['job']} shard {rec['from']} "
+                f"-> {rec['to']} ({rec['phase']})"
+            )
+    else:
+        lines.append("in-flight migrations: none")
+    if omap.verdicts:
+        v = omap.verdicts[-1]
+        moved = v.get("moved")
+        what = (f"moved job {moved}" if moved
+                else "no move" + (f" (job {v['job']})" if v.get("job")
+                                  else ""))
+        lines.append(
+            f"last rebalance: {what} shard {v.get('from')} -> "
+            f"{v.get('to')} — {v.get('reason', '')}"
+        )
+    make_output(args.output_mode).message("\n".join(lines))
+
+
+def cmd_fleet_migrate(args) -> None:
+    """`hq fleet migrate <job> <shard>` (or `--recover`): drive the
+    exactly-once live migration protocol from the CLI."""
+    from hyperqueue_tpu.server.federation import (
+        MigrationError,
+        drive_migration,
+        recover_migrations,
+    )
+
+    root = _server_dir(args)
+    out = make_output(args.output_mode)
+    if args.recover:
+        moves = recover_migrations(root)
+        if not moves:
+            out.message("no in-flight migrations to recover")
+        for move in moves:
+            out.message(
+                f"recovered {move['mig']}: job {move['job']} shard "
+                f"{move['from']} -> {move['to']} ({move['seconds']}s)"
+            )
+        return
+    if args.job_id is None or args.to_shard is None:
+        fail("usage: hq fleet migrate <job_id> <to_shard> "
+             "(or hq fleet migrate --recover)")
+    try:
+        move = drive_migration(root, args.job_id, args.to_shard)
+    except MigrationError as e:
+        fail(str(e))
+    except Exception as e:  # noqa: BLE001 - MigrationClaimed and friends
+        fail(str(e))
+    out.message(
+        f"migrated job {move['job']}: shard {move['from']} -> "
+        f"{move['to']} ({move['mig']}, {move['seconds']}s)"
     )
 
 

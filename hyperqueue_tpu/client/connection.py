@@ -64,7 +64,13 @@ _RETRIABLE = (ConnectionError, OSError, asyncio.IncompleteReadError,
 
 
 class ClientError(Exception):
-    pass
+    """Server-reported error. `code`/`owner` carry the machine-readable
+    half of coded errors (ISSUE 17): code="wrong-shard" + owner=<shard>
+    is the redirect hint a federated client retries on; code="migrating"
+    means the job is sealed mid-move — retry shortly."""
+
+    code: str | None = None
+    owner: int | None = None
 
 
 class ClientSession:
@@ -188,7 +194,10 @@ class ClientSession:
                     self._connect_with_retry(deadline=deadline)
                 )
         if isinstance(response, dict) and response.get("op") == "error":
-            raise ClientError(response.get("message", "server error"))
+            err = ClientError(response.get("message", "server error"))
+            err.code = response.get("code")
+            err.owner = response.get("owner")
+            raise err
         return response
 
     def close(self) -> None:
@@ -206,15 +215,19 @@ class ClientSession:
 class FederatedSession:
     """ClientSession-shaped facade over a federated server dir (ISSUE 11).
 
-    Routes each request to the shard that owns it — the job-id partition
-    is static ((job_id - 1) % shard_count), so any request naming a job
-    routes directly; cluster-wide reads (job_list, worker_list) fan out
-    to every live shard and merge; submits/open_job pick a shard
-    round-robin from a random start (pin with HQ_SHARD). Per-shard
-    ClientSessions open lazily and are reused, each with the full
-    reconnect/retry machinery — so a request that lands during a shard
-    failover rides it out exactly like against a restarting standalone
-    server.
+    Routes each request to the shard that owns it — through a cached
+    :class:`~hyperqueue_tpu.client.routing.Resolver` over the federation
+    root's ownership log (modulo partition until a job migrates), so any
+    request naming a job routes directly; a shard that answers
+    ``wrong-shard`` (the job migrated since the cache was read) triggers
+    one refresh-and-retry toward the owner it names, and ``migrating``
+    (the job is mid-move) a short wait-and-retry. Cluster-wide reads
+    (job_list, worker_list) fan out to every live shard and merge;
+    submits/open_job pick a shard round-robin from a random start (pin
+    with HQ_SHARD). Per-shard ClientSessions open lazily and are reused,
+    each with the full reconnect/retry machinery — so a request that
+    lands during a shard failover rides it out exactly like against a
+    restarting standalone server.
     """
 
     # ops fanned out to every shard, responses merged; a shard with no
@@ -224,6 +237,8 @@ class FederatedSession:
 
     def __init__(self, server_dir: Path, retry_window: float | None = None,
                  shard_count: int | None = None):
+        from hyperqueue_tpu.client.routing import Resolver
+
         self.server_dir = Path(server_dir)
         self.retry_window = retry_window
         if shard_count is None:
@@ -231,13 +246,18 @@ class FederatedSession:
             if fed is None:
                 raise ValueError(f"no federation at {server_dir}")
             shard_count = fed["shard_count"]
-        self.shard_count = shard_count
+        # ALL job routing goes through the resolver (ISSUE 17): ownership
+        # map when one exists, the boot-time modulo otherwise. Its shard
+        # count folds in shards added online, which the descriptor count
+        # the caller read may predate.
+        self.resolver = Resolver(self.server_dir, shard_count)
+        self.shard_count = self.resolver.shard_count
         self._sessions: dict[int, ClientSession] = {}
         env_shard = os.environ.get("HQ_SHARD")
         self._pin_submits = env_shard not in (None, "")
         if self._pin_submits:
             try:
-                self._submit_shard = int(env_shard) % shard_count
+                self._submit_shard = int(env_shard) % self.shard_count
             except ValueError:
                 import logging
 
@@ -247,7 +267,7 @@ class FederatedSession:
                 )
                 self._pin_submits = False
         if not self._pin_submits:
-            self._submit_shard = random.randrange(shard_count)
+            self._submit_shard = random.randrange(self.shard_count)
 
     # --- shard sessions -------------------------------------------------
     def shard_session(self, shard_id: int) -> ClientSession:
@@ -277,9 +297,32 @@ class FederatedSession:
                 pass
 
     def session_for_job(self, job_id: int) -> ClientSession:
-        return self.shard_session(
-            serverdir.shard_for_job(job_id, self.shard_count)
-        )
+        return self.shard_session(self.resolver.shard_for_job(job_id))
+
+    def _request_routed(self, job_id: int, msg: dict, timeout) -> dict:
+        """Job-routed request with ONE wrong-shard redirect: a stale
+        route (the job migrated after the resolver's read — or HQ_SHARD
+        pinned the old owner) answers code="wrong-shard" with the owner;
+        refresh the map and retry there. code="migrating" waits out the
+        move's seal window, then routes by the refreshed map."""
+        deadline = clock.monotonic() + 10.0
+        redirected = False
+        while True:
+            try:
+                return self.session_for_job(job_id).request(msg, timeout)
+            except ClientError as e:
+                if e.code == "migrating" and clock.monotonic() < deadline:
+                    time.sleep(0.2)
+                    self.resolver.refresh()
+                    continue
+                if e.code != "wrong-shard" or redirected:
+                    raise
+                redirected = True
+                self.resolver.refresh()
+                if e.owner is not None:
+                    return self.shard_session(int(e.owner)).request(
+                        msg, timeout
+                    )
 
     def submit_session(self) -> ClientSession:
         """The shard for a NEW job: round-robin from a random start so
@@ -311,11 +354,11 @@ class FederatedSession:
         if "job_ids" in msg:
             return self._by_job_ids(msg, timeout)
         if "job_id" in msg and msg["job_id"] is not None:
-            return self.session_for_job(msg["job_id"]).request(msg, timeout)
+            return self._request_routed(msg["job_id"], msg, timeout)
         if op in ("submit", "open_job"):
             job_id = (msg.get("job") or {}).get("job_id")
             if job_id:
-                return self.session_for_job(job_id).request(msg, timeout)
+                return self._request_routed(job_id, msg, timeout)
             return self.submit_session().request(msg, timeout)
         shard = msg.pop("shard", None)
         if shard in ("all", -1, "-1") and op in (
@@ -360,20 +403,42 @@ class FederatedSession:
                 yield shard, None, e
 
     def _by_job_ids(self, msg: dict, timeout) -> dict:
-        groups: dict[int, list[int]] = {}
-        for job_id in msg["job_ids"]:
-            groups.setdefault(
-                serverdir.shard_for_job(job_id, self.shard_count), []
-            ).append(job_id)
-        if not groups:
+        if not msg["job_ids"]:
             # empty selector: any shard answers the empty request
             return self.shard_session(0).request(msg, timeout)
-        responses = []
-        for shard, ids in sorted(groups.items()):
-            sub = dict(msg)
-            sub["job_ids"] = ids
-            responses.append(self.shard_session(shard).request(sub, timeout))
-        return _merge_responses(responses)
+        migrating_deadline = clock.monotonic() + 10.0
+        for attempt in range(32):
+            groups: dict[int, list[int]] = {}
+            for job_id in msg["job_ids"]:
+                groups.setdefault(
+                    self.resolver.shard_for_job(job_id), []
+                ).append(job_id)
+            responses = []
+            try:
+                for shard, ids in sorted(groups.items()):
+                    sub = dict(msg)
+                    sub["job_ids"] = ids
+                    responses.append(
+                        self.shard_session(shard).request(sub, timeout)
+                    )
+            except ClientError as e:
+                # a group routed to a shard that lost (or is losing)
+                # those jobs: refresh the map and re-group. wrong-shard
+                # answers each imply a REAL committed migration (a
+                # long-blocked `job wait` sees one per rebalancer move,
+                # so a single retry is not enough); migrating is a
+                # transient seal window and gets a bounded wait instead
+                if e.code == "wrong-shard" and attempt < 31:
+                    self.resolver.refresh()
+                    continue
+                if e.code == "migrating" and (
+                    clock.monotonic() < migrating_deadline
+                ):
+                    time.sleep(0.2)
+                    self.resolver.refresh()
+                    continue
+                raise
+            return _merge_responses(responses)
 
     def _fan_out(self, msg: dict, timeout) -> dict:
         responses = []
@@ -463,10 +528,16 @@ class SubmitStream:
                  window: int | None = None, uid: str | None = None):
         from hyperqueue_tpu.utils.trace import new_trace_id
 
+        self._fed: FederatedSession | None = None
         if isinstance(session, FederatedSession):
-            # a stream lives on ONE shard: the owning shard for a pinned
-            # job id, a submit shard otherwise (the job id acked by the
-            # first chunk then stays in that shard's partition)
+            # a stream lives on ONE shard at a time: the owning shard for
+            # a pinned job id, a submit shard otherwise. The federated
+            # session is KEPT (ISSUE 17): if the job migrates mid-stream
+            # the shard answers a coded error and the stream re-resolves,
+            # switches shards, and replays its unacked chunks — the
+            # destination imported the stream's applied-index set, so the
+            # replay dedups exactly-once.
+            self._fed = session
             job_id = header.get("job_id")
             session = (
                 session.session_for_job(job_id)
@@ -487,6 +558,7 @@ class SubmitStream:
         self._next_index = 0
         self._unacked: dict[int, dict] = {}
         self._sealed = False
+        self._redirects = 0
 
     # --- wire helpers (session-loop, with reconnect + replay) -----------
     def _replay_unacked(self) -> None:
@@ -536,8 +608,18 @@ class SubmitStream:
 
         ack = self._with_retry(step)
         if not isinstance(ack, dict) or ack.get("op") == "error":
+            code = ack.get("code") if isinstance(ack, dict) else None
+            if code in ("wrong-shard", "migrating") and (
+                self._fed is not None
+            ):
+                self._follow_migration(ack.get("owner"), code)
+                return self._recv_ack()
             msg = (ack or {}).get("message", "server error")
-            raise ClientError(msg)
+            err = ClientError(msg)
+            if isinstance(ack, dict):
+                err.code = ack.get("code")
+                err.owner = ack.get("owner")
+            raise err
         index = ack["i"]
         frame = self._unacked.pop(index, None)
         if self.job_id is None:
@@ -551,6 +633,38 @@ class SubmitStream:
         # total must still cover it
         if frame is not None:
             self.n_tasks += _frame_task_count(frame)
+
+    def _follow_migration(self, owner, code: str) -> None:
+        """The stream's job moved (or is moving) mid-stream: switch to
+        the owning shard's session and replay every unacked chunk there.
+        Bounded — a stream bouncing between shards means routing itself
+        is broken, and looping would mask that."""
+        self._redirects += 1
+        if self._redirects > 8:
+            raise ClientError(
+                "submit stream redirected too many times; "
+                "federation routing is inconsistent"
+            )
+        fed = self._fed
+        # the abandoned session's socket may hold unread error responses
+        # for chunks still in flight when the first error arrived; drop
+        # it from the cache so no later request reads a stale reply
+        for shard_id, cached in list(fed._sessions.items()):
+            if cached is self.session:
+                fed._drop_session(shard_id)
+        fed.resolver.refresh()
+        if code == "migrating" or owner is None:
+            # mid-move seal window: wait for the commit to land, then
+            # route by the refreshed ownership map
+            time.sleep(0.25)
+            fed.resolver.refresh()
+            owner = fed.resolver.shard_for_job(
+                self.job_id
+                if self.job_id is not None
+                else self.header.get("job_id")
+            )
+        self.session = fed.shard_session(int(owner))
+        self._replay_unacked()
 
     def _send_frame(self, frame: dict) -> None:
         while len(self._unacked) >= self.window:

@@ -131,10 +131,35 @@ def _fleet_row(shard: int, state: str, sample: dict | None) -> str:
     )
 
 
+def _backlog_convergence(samples: dict) -> str | None:
+    """One line tracking the rebalancer's target function: max/mean
+    backlog ratio across live shards (the hysteresis band is 1.5x — the
+    line makes a rebalance visibly converge under `hq top`)."""
+    backlogs = {
+        k: (s.get("ready", 0) + s.get("mn_queued", 0))
+        for k, s in samples.items() if s is not None
+    }
+    if len(backlogs) < 2:
+        return None
+    mean = sum(backlogs.values()) / len(backlogs)
+    hot = max(backlogs, key=backlogs.get)
+    if mean <= 0:
+        return "backlog: balanced (all empty)"
+    ratio = backlogs[hot] / mean
+    return (
+        f"backlog: max {backlogs[hot]} (shard {hot}) mean {mean:.1f} "
+        f"ratio {ratio:.2f}x"
+        + (" — imbalanced (rebalance target >1.50x)" if ratio > 1.5
+           else " — converged")
+    )
+
+
 def _render_fleet(states: dict, samples: dict, ticker: deque,
-                  lend_flows: dict) -> str:
-    """The fleet view: per-shard health rows + lending flows + merged
-    event ticker. Everything here comes off the FleetFeed — no polling."""
+                  lend_flows: dict, ownership: dict | None = None) -> str:
+    """The fleet view: per-shard health rows + backlog convergence +
+    in-flight migrations + lending flows + merged event ticker.
+    Everything but the ownership block comes off the FleetFeed — no
+    polling; the ownership block is one lock-free log read per frame."""
     up = sum(1 for s in states.values() if s == "up")
     lines = [
         f"hq fleet — {len(states)} shard(s), {up} up",
@@ -145,6 +170,20 @@ def _render_fleet(states: dict, samples: dict, ticker: deque,
     for shard in sorted(states):
         state = "up" if states[shard] == "up" else "down"
         lines.append(_fleet_row(shard, state, samples.get(shard)))
+    conv = _backlog_convergence(samples)
+    if conv:
+        lines.append(conv)
+    if ownership:
+        for rec in ownership.get("in_flight") or ():
+            lines.append(
+                f"migrating: job {rec['job']} shard {rec['from']} -> "
+                f"{rec['to']} ({rec['phase']}, {rec['mig']})"
+            )
+        if ownership.get("moved"):
+            lines.append(
+                f"ownership: epoch {ownership.get('epoch', 0)}, "
+                f"{ownership['moved']} job(s) on non-home shards"
+            )
     if lend_flows:
         lines.append(
             "lend flows: " + "  ".join(
@@ -187,6 +226,19 @@ def run_fleet_top(server_dir: Path, interval: float = 1.0,
     promotes — the view rides failovers, it never crashes on them."""
     from hyperqueue_tpu.client.fleet import FleetFeed, fleet_snapshot
 
+    def ownership_block() -> dict | None:
+        from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+        try:
+            omap = OwnershipStore(server_dir).load()
+        except OSError:
+            return None
+        return {
+            "epoch": omap.epoch,
+            "moved": len(omap.assignments),
+            "in_flight": omap.in_flight(),
+        }
+
     if once:
         samples = fleet_snapshot(server_dir, sample_interval=min(
             max(interval, 0.2), 1.0
@@ -205,7 +257,8 @@ def run_fleet_top(server_dir: Path, interval: float = 1.0,
             }
             print(json.dumps({"shards": out}))
         else:
-            print(_render_fleet(states, samples, deque(), {}))
+            print(_render_fleet(states, samples, deque(), {},
+                                ownership_block()))
         return 0
 
     ticker: deque = deque(maxlen=64)
@@ -227,7 +280,7 @@ def run_fleet_top(server_dir: Path, interval: float = 1.0,
                     continue
                 view = _render_fleet(
                     dict(feed.states), dict(feed.last_sample), ticker,
-                    lend_flows,
+                    lend_flows, ownership_block(),
                 )
                 if is_tty:
                     sys.stdout.write("\x1b[H\x1b[J" + view + "\n")

@@ -101,6 +101,14 @@ class _RestoreAcc:
         # alloc-submit-attempt records with no journaled outcome: possible
         # orphans the service pidfile-scans at start (_adopt_orphans)
         self.alloc_attempts: list[dict] = []
+        # elastic resharding (ISSUE 17): jobs sealed for export whose
+        # migration had not finalized at the crash (job_id -> out record);
+        # they restore held/paused until the coordinator re-drives or
+        # aborts the migration
+        self.migrating_out: dict[int, dict] = {}
+        # jobs this shard handed off (migration-out-done replayed):
+        # job_id -> destination shard, for wrong-shard redirects
+        self.migrated_out: dict[int, int] = {}
 
 
 def _seed_autoalloc(acc: _RestoreAcc, table: dict | None) -> None:
@@ -202,73 +210,7 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
     bodies = state["bodies"]
     requests = state["requests"]
     for jd in state["jobs"]:
-        job_id = jd["id"]
-        job = server.jobs.create_job(
-            name=jd["name"],
-            submit_dir=jd["submit_dir"],
-            max_fails=jd["max_fails"],
-            is_open=jd["open"],
-            job_id=job_id,
-        )
-        job.submitted_at = jd["submitted_at"]
-        job.cancel_reason = jd["cancel_reason"]
-        job.submits = list(jd["submits"])
-        for tid, status, error, finished_at, started_at, submitted_at in (
-            jd["done"]
-        ):
-            server.jobs.attach_task(job, tid)
-            info = job.tasks[tid]
-            info.submitted_at = submitted_at
-            key = (job_id, tid)
-            acc.task_status[key] = (status, error)
-            acc.task_finished_at[key] = finished_at
-            if started_at:
-                acc.task_started_at[key] = (0.0, 0.0, started_at)
-        for uid, s in (jd.get("streams") or {}).items():
-            job.streams[uid] = {
-                "applied": set(s["applied"]), "sealed": bool(s["sealed"]),
-            }
-            if not s["sealed"]:
-                job.open_streams += 1
-            server._stream_jobs[uid] = job_id
-        for spec in jd.get("lazy") or ():
-            resolved = dict(spec)
-            resolved["body"] = bodies[spec["b"]]
-            resolved["request"] = requests[spec["rq"]]
-            acc.lazy_chunks.append((job_id, resolved))
-        descs = acc.job_descs.setdefault(job_id, [])
-        for t in jd["pending"]:
-            tid = t["id"]
-            server.jobs.attach_task(job, tid)
-            job.tasks[tid].submitted_at = t["submitted_at"]
-            desc = {
-                "id": tid,
-                # index into the shared tables: tasks of one array get the
-                # SAME body object back, preserving the identity sharing
-                # the compute-message dedup relies on
-                "body": bodies[t["b"]],
-                "request": requests[t["rq"]],
-                "priority": t["priority"],
-                "crash_limit": t["crash_limit"],
-                "deps": t["deps"],
-            }
-            if "entry" in t:
-                desc["entry"] = t["entry"]
-            descs.append(desc)
-            key = (job_id, tid)
-            if t["crashes"]:
-                acc.task_crashes[key] = t["crashes"]
-            if t["running"]:
-                acc.task_instances[key] = t["instance"]
-                acc.task_variants[key] = t["variant"]
-                acc.task_maybe_running[key] = True
-                acc.task_started_at[key] = tuple(t["stamps"])
-            elif t["instance"]:
-                # not running, but the instance counter moved (crashes,
-                # assignment at capture): restore must fence past it
-                acc.task_instances[key] = t["instance"]
-                acc.task_variants[key] = t["variant"]
-                acc.task_maybe_running[key] = False
+        seed_job(server, acc, jd, bodies, requests)
     for task_id, rec in (state.get("traces") or {}).items():
         acc.task_trace_seed[int(task_id)] = rec
     _seed_autoalloc(acc, state.get("autoalloc"))
@@ -280,6 +222,83 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
     # reused — a reconnecting worker could still hold a forgotten job's
     # task under the same (job, task) id
     server.jobs.job_id_counter.ensure_above(state.get("next_job_id", 1) - 1)
+
+
+def seed_job(server, acc: _RestoreAcc, jd: dict,
+             bodies: list, requests: list) -> None:
+    """Seed ONE job (snapshot per-job shape) into server.jobs + the
+    accumulators. Shared by the snapshot seed and the migration-record
+    import replay (ISSUE 17): a migrated-in job flows through the exact
+    path a snapshot-restored one does, so every restore invariant —
+    reattach holds, fencing, original clocks — carries over to moves."""
+    job_id = jd["id"]
+    job = server.jobs.create_job(
+        name=jd["name"],
+        submit_dir=jd["submit_dir"],
+        max_fails=jd["max_fails"],
+        is_open=jd["open"],
+        job_id=job_id,
+    )
+    job.submitted_at = jd["submitted_at"]
+    job.cancel_reason = jd["cancel_reason"]
+    job.submits = list(jd["submits"])
+    for tid, status, error, finished_at, started_at, submitted_at in (
+        jd["done"]
+    ):
+        server.jobs.attach_task(job, tid)
+        info = job.tasks[tid]
+        info.submitted_at = submitted_at
+        key = (job_id, tid)
+        acc.task_status[key] = (status, error)
+        acc.task_finished_at[key] = finished_at
+        if started_at:
+            acc.task_started_at[key] = (0.0, 0.0, started_at)
+    for uid, s in (jd.get("streams") or {}).items():
+        job.streams[uid] = {
+            "applied": set(s["applied"]), "sealed": bool(s["sealed"]),
+        }
+        if not s["sealed"]:
+            job.open_streams += 1
+        server._stream_jobs[uid] = job_id
+    for spec in jd.get("lazy") or ():
+        resolved = dict(spec)
+        resolved["body"] = bodies[spec["b"]]
+        resolved["request"] = requests[spec["rq"]]
+        acc.lazy_chunks.append((job_id, resolved))
+    descs = acc.job_descs.setdefault(job_id, [])
+    for t in jd["pending"]:
+        tid = t["id"]
+        server.jobs.attach_task(job, tid)
+        job.tasks[tid].submitted_at = t["submitted_at"]
+        desc = {
+            "id": tid,
+            # index into the shared tables: tasks of one array get the
+            # SAME body object back, preserving the identity sharing
+            # the compute-message dedup relies on
+            "body": bodies[t["b"]],
+            "request": requests[t["rq"]],
+            "priority": t["priority"],
+            "crash_limit": t["crash_limit"],
+            "deps": t["deps"],
+        }
+        if "entry" in t:
+            desc["entry"] = t["entry"]
+        descs.append(desc)
+        key = (job_id, tid)
+        if t["crashes"]:
+            acc.task_crashes[key] = t["crashes"]
+        if t["running"]:
+            acc.task_instances[key] = t["instance"]
+            acc.task_variants[key] = t["variant"]
+            acc.task_maybe_running[key] = True
+            acc.task_started_at[key] = tuple(t["stamps"])
+        elif t["instance"]:
+            # not running, but the instance counter moved (crashes,
+            # assignment at capture): restore must fence past it
+            acc.task_instances[key] = t["instance"]
+            acc.task_variants[key] = t["variant"]
+            acc.task_maybe_running[key] = False
+
 
 
 def _array_replays_lazy(server, array: dict) -> bool:
@@ -298,6 +317,57 @@ def _array_replays_lazy(server, array: dict) -> bool:
         return False
     variants = (array.get("request") or {}).get("variants") or []
     return not any(v.get("n_nodes") for v in variants)
+
+
+def _seed_migration_record(server, acc: _RestoreAcc, rec: dict) -> None:
+    """migration-in replay: re-import the embedded migration record.
+
+    The record is self-contained (fresh bodies/requests tables captured
+    by snapshot.capture_job on the source), so replay needs nothing from
+    the source shard. Instances are floored at the source's fence
+    watermark BEFORE this boot's own fence bump, keeping instance ids
+    monotonic across the move — a SIGSTOP'd source resuming later can
+    never collide with an incarnation the destination issues."""
+    jd = rec.get("job_state") or {}
+    job_id = jd.get("id")
+    if job_id is None or job_id in server.jobs.jobs:
+        return  # duplicate import (a re-driven migration): first wins
+    # a returning job (migrated out earlier, now migrating back in)
+    # must clear its own wrong-shard tombstone, mirroring the live
+    # import path in bootstrap._apply_migration_record
+    acc.migrating_out.pop(job_id, None)
+    acc.migrated_out.pop(job_id, None)
+    seed_job(server, acc, jd, rec.get("bodies") or [],
+             rec.get("requests") or [])
+    src_fence = int(rec.get("fence", 0))
+    for t in jd.get("pending") or ():
+        key = (job_id, t["id"])
+        if src_fence:
+            acc.task_instances[key] = max(
+                acc.task_instances.get(key, 0), src_fence
+            )
+        # the source's workers never reattach here: requeue, don't hold
+        acc.task_maybe_running[key] = False
+
+
+def _drop_migrated_job(server, acc: _RestoreAcc, job_id: int,
+                       to_shard: int) -> None:
+    """migration-out-done replay: the handoff finalized before the crash.
+    Only a tombstone survives, for wrong-shard redirects."""
+    job = server.jobs.jobs.pop(job_id, None)
+    if job is not None:
+        for uid in job.streams:
+            server._stream_jobs.pop(uid, None)
+    acc.job_descs.pop(job_id, None)
+    acc.lazy_chunks = [(j, s) for j, s in acc.lazy_chunks if j != job_id]
+    for table in (acc.task_status, acc.task_finished_at,
+                  acc.task_started_at, acc.task_instances,
+                  acc.task_maybe_running, acc.task_variants,
+                  acc.task_crashes):
+        for key in [k for k in table if k[0] == job_id]:
+            del table[key]
+    acc.migrating_out.pop(job_id, None)
+    acc.migrated_out[job_id] = to_shard
 
 
 def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
@@ -475,6 +545,14 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
     elif kind == "server-uid":
         server.journal_uids.add(record.get("server_uid") or "")
         acc.n_boots += 1
+    elif kind == "migration-out":
+        # export sealed (ISSUE 17): the job stays here — held — until the
+        # coordinator's re-driven migration commits or aborts the move
+        acc.migrating_out[job_id] = dict(record)
+    elif kind == "migration-in":
+        _seed_migration_record(server, acc, record.get("record") or {})
+    elif kind == "migration-out-done":
+        _drop_migrated_job(server, acc, job_id, int(record.get("to", -1)))
     elif isinstance(kind, str) and kind.startswith("alloc-"):
         _replay_alloc_record(acc, kind, record)
 
@@ -846,6 +924,15 @@ def restore_from_journal(server) -> None:
         if new_tasks:
             reactor.on_new_tasks(server.core, server.comm, new_tasks)
             resubmitted += len(new_tasks)
+
+    # elastic resharding (ISSUE 17): restore the handoff tombstones and
+    # re-seal jobs whose export had no journaled finalize — they stay
+    # paused until the coordinator re-drives (or aborts) the migration
+    server.migrated_out.update(acc.migrated_out)
+    if acc.migrating_out:
+        server.migrating_out.update(acc.migrating_out)
+        reactor.pause_jobs(server.core, server.comm,
+                           list(acc.migrating_out))
     _rebuild_traces(server, acc)
 
     # hand the reconstructed allocation table to the autoalloc service

@@ -92,149 +92,14 @@ def capture_state(server) -> dict:
     from hyperqueue_tpu.server.protocol import rqv_to_wire
     from hyperqueue_tpu.server.task import TaskState
 
-    core = server.core
     bodies: list[dict] = []
     body_index: dict[int, int] = {}
     requests: list[dict] = []
     request_index: dict[int, int] = {}
-    jobs_out = []
-    for job in server.jobs.jobs.values():
-        done = []
-        pending = []
-        for info in job.tasks.values():
-            if info.status in _TERMINAL:
-                done.append([
-                    info.job_task_id, info.status, info.error,
-                    info.finished_at, info.started_at, info.submitted_at,
-                ])
-                continue
-            task_id = make_task_id(job.job_id, info.job_task_id)
-            task = core.tasks.get(task_id)
-            if task is None:
-                # jobs-layer entry with no core task: without the core
-                # record there is no body/request to rebuild it from, so
-                # it cannot ride the snapshot (should not happen outside
-                # forget/teardown races — scream if it ever does)
-                logger.error(
-                    "snapshot: non-terminal task %d.%d has no core "
-                    "record; it will be missing from the snapshot",
-                    job.job_id, info.job_task_id,
-                )
-                continue
-            body_key = id(task.body)
-            body_i = body_index.get(body_key)
-            if body_i is None:
-                body_i = len(bodies)
-                body_index[body_key] = body_i
-                bodies.append(task.body)
-            rq_i = request_index.get(task.rq_id)
-            if rq_i is None:
-                rq_i = len(requests)
-                request_index[task.rq_id] = rq_i
-                requests.append(
-                    rqv_to_wire(
-                        core.rq_map.get_variants(task.rq_id),
-                        core.resource_map,
-                    )
-                )
-            entry = {
-                "id": info.job_task_id,
-                "b": body_i,
-                "rq": rq_i,
-                "priority": task.priority[0],
-                "crash_limit": task.crash_limit,
-                "deps": [task_id_task(d) for d in task.deps],
-                "submitted_at": info.submitted_at,
-                "instance": task.instance_id,
-                "crashes": task.crash_counter,
-                "variant": task.assigned_variant,
-                # journal-replay parity: "the last lifecycle event was a
-                # start" == the incarnation may still run on a worker that
-                # will reconnect and reclaim it. ASSIGNED tasks (compute
-                # sent, start not yet reported) have no journaled start, so
-                # replay would fence + re-issue them — capture the same.
-                "running": (
-                    task.state is TaskState.RUNNING
-                    or task_id in server.reattach_pending
-                ),
-                "stamps": [task.t_ready, task.t_assigned, task.t_started],
-            }
-            if task.entry is not None:
-                entry["entry"] = task.entry
-            pending.append(entry)
-        jd = {
-            "id": job.job_id,
-            "name": job.name,
-            "submit_dir": job.submit_dir,
-            "max_fails": job.max_fails,
-            "open": job.is_open,
-            "cancel_reason": job.cancel_reason,
-            "submitted_at": job.submitted_at,
-            "submits": job.submits,
-            "done": done,
-            "pending": pending,
-        }
-        # chunked-submit streams (ISSUE 10): applied chunk indexes are the
-        # exactly-once fence for client retries; they must survive any
-        # restore the journal would have survived
-        if job.streams:
-            jd["streams"] = {
-                uid: {"applied": sorted(s["applied"]),
-                      "sealed": bool(s["sealed"])}
-                for uid, s in job.streams.items()
-            }
-        # unmaterialized lazy array chunks: O(chunks + tombstones) — the
-        # whole point is that a 1M-task lazy array snapshots (and
-        # restores) without expanding to per-task records
-        lazy_out = []
-        for seg in server.core.lazy.segments_of(job.job_id):
-            chunk = seg.chunk
-            body_key = id(chunk.body)
-            body_i = body_index.get(body_key)
-            if body_i is None:
-                body_i = len(bodies)
-                body_index[body_key] = body_i
-                bodies.append(chunk.body)
-            rq_i = request_index.get(chunk.rq_id)
-            if rq_i is None:
-                rq_i = len(requests)
-                request_index[chunk.rq_id] = rq_i
-                requests.append(
-                    rqv_to_wire(
-                        core.rq_map.get_variants(chunk.rq_id),
-                        core.resource_map,
-                    )
-                )
-            spec: dict = {
-                "b": body_i,
-                "rq": rq_i,
-                "priority": chunk.priority[0],
-                "crash_limit": chunk.crash_limit,
-                "submitted_at": chunk.submitted_at,
-                "ready_at": chunk.ready_at,
-            }
-            if chunk.trace:
-                spec["trace"] = chunk.trace
-            if chunk.id_range is not None and chunk.entries is None:
-                spec["id_range"] = [
-                    chunk.id_range[0] + seg.pos, chunk.id_range[1],
-                ]
-                dead = [
-                    chunk.id_at(i) for i in sorted(seg.dead) if i >= seg.pos
-                ]
-                if dead:
-                    spec["dead"] = dead
-            else:
-                remaining = list(seg.remaining_ids())
-                spec["ids"] = remaining
-                if chunk.entries is not None:
-                    spec["entries"] = [
-                        chunk.entries[chunk.index_of(t)] for t in remaining
-                    ]
-            lazy_out.append(spec)
-        if lazy_out:
-            jd["lazy"] = lazy_out
-        jobs_out.append(jd)
+    jobs_out = [
+        capture_job(server, job, bodies, body_index, requests, request_index)
+        for job in server.jobs.jobs.values()
+    ]
     # live tasks' distributed traces (utils/trace.py TaskTraceStore): the
     # GC'd journal prefix held their submit/start events, so the snapshot
     # must carry the assembled spans or a snapshot-seeded restore would
@@ -253,7 +118,7 @@ def capture_state(server) -> dict:
         "version": VERSION,
         "time": clock.now(),
         "autoalloc": autoalloc.capture() if autoalloc is not None else None,
-        "traces": core.traces.snapshot_live(live_task_ids),
+        "traces": server.core.traces.snapshot_live(live_task_ids),
         # event-seq watermark: every event with seq < this is folded into
         # the snapshot; restore replays only seq >= this from the journal
         "seq": server._event_seq,
@@ -266,6 +131,157 @@ def capture_state(server) -> dict:
         "requests": requests,
         "jobs": jobs_out,
     }
+
+
+def capture_job(server, job, bodies: list, body_index: dict,
+                requests: list, request_index: dict) -> dict:
+    """One job's restorable state, in the snapshot's per-job shape.
+
+    Shared by :func:`capture_state` (all jobs, shared dedup tables) and
+    the migration export RPC (ISSUE 17 — one job with fresh tables makes
+    a self-contained migration record). Lazy array chunks are captured in
+    CHUNK form (id ranges + tombstones), so capturing — and migrating — a
+    1M-task lazy array is O(chunks), never O(tasks)."""
+    from hyperqueue_tpu.server.protocol import rqv_to_wire
+    from hyperqueue_tpu.server.task import TaskState
+
+    core = server.core
+    done = []
+    pending = []
+    for info in job.tasks.values():
+        if info.status in _TERMINAL:
+            done.append([
+                info.job_task_id, info.status, info.error,
+                info.finished_at, info.started_at, info.submitted_at,
+            ])
+            continue
+        task_id = make_task_id(job.job_id, info.job_task_id)
+        task = core.tasks.get(task_id)
+        if task is None:
+            # jobs-layer entry with no core task: without the core
+            # record there is no body/request to rebuild it from, so
+            # it cannot ride the snapshot (should not happen outside
+            # forget/teardown races — scream if it ever does)
+            logger.error(
+                "snapshot: non-terminal task %d.%d has no core "
+                "record; it will be missing from the snapshot",
+                job.job_id, info.job_task_id,
+            )
+            continue
+        body_key = id(task.body)
+        body_i = body_index.get(body_key)
+        if body_i is None:
+            body_i = len(bodies)
+            body_index[body_key] = body_i
+            bodies.append(task.body)
+        rq_i = request_index.get(task.rq_id)
+        if rq_i is None:
+            rq_i = len(requests)
+            request_index[task.rq_id] = rq_i
+            requests.append(
+                rqv_to_wire(
+                    core.rq_map.get_variants(task.rq_id),
+                    core.resource_map,
+                )
+            )
+        entry = {
+            "id": info.job_task_id,
+            "b": body_i,
+            "rq": rq_i,
+            "priority": task.priority[0],
+            "crash_limit": task.crash_limit,
+            "deps": [task_id_task(d) for d in task.deps],
+            "submitted_at": info.submitted_at,
+            "instance": task.instance_id,
+            "crashes": task.crash_counter,
+            "variant": task.assigned_variant,
+            # journal-replay parity: "the last lifecycle event was a
+            # start" == the incarnation may still run on a worker that
+            # will reconnect and reclaim it. ASSIGNED tasks (compute
+            # sent, start not yet reported) have no journaled start, so
+            # replay would fence + re-issue them — capture the same.
+            "running": (
+                task.state is TaskState.RUNNING
+                or task_id in server.reattach_pending
+            ),
+            "stamps": [task.t_ready, task.t_assigned, task.t_started],
+        }
+        if task.entry is not None:
+            entry["entry"] = task.entry
+        pending.append(entry)
+    jd = {
+        "id": job.job_id,
+        "name": job.name,
+        "submit_dir": job.submit_dir,
+        "max_fails": job.max_fails,
+        "open": job.is_open,
+        "cancel_reason": job.cancel_reason,
+        "submitted_at": job.submitted_at,
+        "submits": job.submits,
+        "done": done,
+        "pending": pending,
+    }
+    # chunked-submit streams (ISSUE 10): applied chunk indexes are the
+    # exactly-once fence for client retries; they must survive any
+    # restore the journal would have survived
+    if job.streams:
+        jd["streams"] = {
+            uid: {"applied": sorted(s["applied"]),
+                  "sealed": bool(s["sealed"])}
+            for uid, s in job.streams.items()
+        }
+    # unmaterialized lazy array chunks: O(chunks + tombstones) — the
+    # whole point is that a 1M-task lazy array snapshots (and
+    # restores) without expanding to per-task records
+    lazy_out = []
+    for seg in server.core.lazy.segments_of(job.job_id):
+        chunk = seg.chunk
+        body_key = id(chunk.body)
+        body_i = body_index.get(body_key)
+        if body_i is None:
+            body_i = len(bodies)
+            body_index[body_key] = body_i
+            bodies.append(chunk.body)
+        rq_i = request_index.get(chunk.rq_id)
+        if rq_i is None:
+            rq_i = len(requests)
+            request_index[chunk.rq_id] = rq_i
+            requests.append(
+                rqv_to_wire(
+                    core.rq_map.get_variants(chunk.rq_id),
+                    core.resource_map,
+                )
+            )
+        spec: dict = {
+            "b": body_i,
+            "rq": rq_i,
+            "priority": chunk.priority[0],
+            "crash_limit": chunk.crash_limit,
+            "submitted_at": chunk.submitted_at,
+            "ready_at": chunk.ready_at,
+        }
+        if chunk.trace:
+            spec["trace"] = chunk.trace
+        if chunk.id_range is not None and chunk.entries is None:
+            spec["id_range"] = [
+                chunk.id_range[0] + seg.pos, chunk.id_range[1],
+            ]
+            dead = [
+                chunk.id_at(i) for i in sorted(seg.dead) if i >= seg.pos
+            ]
+            if dead:
+                spec["dead"] = dead
+        else:
+            remaining = list(seg.remaining_ids())
+            spec["ids"] = remaining
+            if chunk.entries is not None:
+                spec["entries"] = [
+                    chunk.entries[chunk.index_of(t)] for t in remaining
+                ]
+        lazy_out.append(spec)
+    if lazy_out:
+        jd["lazy"] = lazy_out
+    return jd
 
 
 # --------------------------------------------------------------------------

@@ -720,6 +720,15 @@ class Server:
         # shard ordered to re-register elsewhere (coordinator-driven)
         self._lent_workers: dict[int, int] = {}
         self.workers_lent_total = 0
+        # elastic resharding (ISSUE 17): jobs this shard exported live to a
+        # sibling. migrating_out: job -> {"mig", "to"} while sealed here and
+        # the protocol is in flight; migrated_out: job -> new owner once the
+        # tombstone is journaled (requests answer wrong-shard from then on);
+        # migrations_in: mig uid -> job for imports already applied, so a
+        # re-driven import acks dup instead of double-seeding.
+        self.migrating_out: dict[int, dict] = {}
+        self.migrated_out: dict[int, int] = {}
+        self.migrations_in: dict[str, int] = {}
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
@@ -815,7 +824,22 @@ class Server:
 
             from hyperqueue_tpu.utils.lease import ShardLease
 
-            serverdir.write_federation(self.federation_root, self.shard_count)
+            existing_fed = serverdir.load_federation(self.federation_root)
+            if (
+                existing_fed is not None
+                and self.shard_count > int(existing_fed["shard_count"])
+            ):
+                # online shard add (ISSUE 17): booting shard N of an N+1-way
+                # count against an N-way root GROWS the federation in place
+                # — descriptor rewritten, ownership log records the join,
+                # sibling shards keep running untouched
+                serverdir.grow_federation(
+                    self.federation_root, self.shard_count
+                )
+            else:
+                serverdir.write_federation(
+                    self.federation_root, self.shard_count
+                )
             # claim the shard BEFORE touching the journal: the lease is
             # what guarantees one journal appender per shard — a double
             # start (or a failover race) must fail here, not interleave
@@ -1173,8 +1197,25 @@ class Server:
         counter = self.jobs.job_id_counter
         from hyperqueue_tpu.ids import IdCounter
 
+        base_count = self.shard_count
+        if self.federation_root is not None:
+            fed = serverdir.load_federation(self.federation_root)
+            if fed:
+                base_count = int(fed.get("base_shard_count",
+                                         fed["shard_count"]))
+        if self.shard_id >= base_count:
+            # shard added online (ISSUE 17): the modulo classes are frozen
+            # at base_shard_count, so this shard allocates from its
+            # reserved high id block instead — the id alone still routes
+            from hyperqueue_tpu.utils.ownership import added_shard_block
+
+            lo, _hi = added_shard_block(self.shard_id, base_count)
+            blocked = IdCounter(start=lo + 1, stride=1)
+            blocked.ensure_above(counter.peek() - 1)
+            self.jobs.job_id_counter = blocked
+            return
         strided = IdCounter(
-            start=self.shard_id + 1, stride=self.shard_count
+            start=self.shard_id + 1, stride=base_count
         )
         strided.ensure_above(counter.peek() - 1)
         self.jobs.job_id_counter = strided
@@ -1233,6 +1274,9 @@ class Server:
             "fenced": self.fenced,
             "workers_lent": self.workers_lent_total,
             "workers_borrowed": borrowed,
+            "jobs_migrated_out": len(self.migrated_out),
+            "jobs_migrating_out": len(self.migrating_out),
+            "jobs_migrated_in": len(self.migrations_in),
         }
 
     async def _client_worker_lend(self, msg: dict) -> dict:
@@ -1265,6 +1309,297 @@ class Server:
             extra={"worker": wid},
         )
         return {"op": "worker_lend", "lent": True, "to_shard": target}
+
+    # --- live job migration (ISSUE 17) ----------------------------------
+    def _migration_barrier(self) -> None:
+        """Durability barrier for the migration protocol: the journaled
+        migration record must be ON DISK before the RPC reply leaves —
+        kill -9 right after the ack must replay to the same decision."""
+        if self.journal is None:
+            return
+        if self.jplane is not None:
+            self.jplane.barrier(sync=True)
+        else:
+            if self.journal.in_batch:
+                self.journal.commit_batch()
+            self.journal.flush(sync=True)
+
+    def _owned_elsewhere(self, job_id, rid=None) -> dict | None:
+        """wrong-shard / migrating guard: an error dict when this shard
+        no longer (or not currently) serves the job, else None. `code`
+        lets clients tell a redirect (wrong-shard, with the owner hint)
+        from a transient seal (migrating — retry here shortly)."""
+        if job_id is None:
+            return None
+        owner = self.migrated_out.get(job_id)
+        if owner is not None:
+            err = {"op": "error", "code": "wrong-shard", "owner": owner,
+                   "message": f"job {job_id} migrated to shard {owner}"}
+            if rid is not None:
+                err["rid"] = rid
+            return err
+        if job_id in self.migrating_out:
+            err = {"op": "error", "code": "migrating",
+                   "message": f"job {job_id} is migrating; retry shortly"}
+            if rid is not None:
+                err["rid"] = rid
+            return err
+        return None
+
+    def _guard_job_ids(self, job_ids) -> dict | None:
+        """Job-op guard: redirect only when EVERY requested job moved
+        (mixed batches fall through — absent jobs are simply omitted
+        from the reply, exactly like unknown ids always were)."""
+        guards = [self._owned_elsewhere(j) for j in job_ids]
+        if guards and all(g is not None for g in guards):
+            return guards[0]
+        return None
+
+    async def _client_migration_export(self, msg: dict) -> dict:
+        """Phase 1 of a live migration (driver RPC): seal + drain the job
+        and return a self-contained, versioned migration record.
+
+        Sealing = pause (READY held, lazy chunks detached in chunk form,
+        prefilled retracted) + RECALL of ASSIGNED/RUNNING tasks (resources
+        released, worker's incarnation canceled, instance bumped — the
+        fence). The `migration-out` journal record carries only {mig, to,
+        fence}, NOT the record: a source crash after the barrier restores
+        the job PAUSED, and a re-driven export rebuilds an equivalent
+        record from that state — safe because the sealed job made no
+        progress in between."""
+        from hyperqueue_tpu.events import snapshot as snapshot_mod
+
+        mig = str(msg.get("mig") or "")
+        job_id = int(msg.get("job", 0))
+        to_shard = int(msg.get("to", -1))
+        if not mig:
+            return {"op": "error", "message": "migration_export needs mig"}
+        guard = self._owned_elsewhere(job_id)
+        if guard is not None and guard.get("code") == "wrong-shard":
+            return guard
+        out = self.migrating_out.get(job_id)
+        if out is not None and out.get("mig") != mig:
+            return {"op": "error",
+                    "message": f"job {job_id} is sealed by migration "
+                               f"{out.get('mig')!r}, not {mig!r}"}
+        job = self.jobs.jobs.get(job_id)
+        if job is None:
+            return {"op": "error", "message": f"unknown job {job_id}"}
+        if out is None:
+            reactor.pause_jobs(self.core, self.comm, [job_id])
+            recall_ids = [
+                make_task_id(job_id, info.job_task_id)
+                for info in job.tasks.values()
+                if info.status in ("waiting", "running")
+            ]
+            reactor.recall_tasks(self.core, self.comm, recall_ids)
+            self.migrating_out[job_id] = {"mig": mig, "to": to_shard}
+            fence = self._job_fence(job_id, job)
+            self.emit_event(
+                "migration-out",
+                {"job": job_id, "mig": mig, "to": to_shard, "fence": fence},
+            )
+            self._migration_barrier()
+        bodies: list = []
+        body_index: dict = {}
+        requests: list = []
+        request_index: dict = {}
+        record = {
+            "version": 1,
+            "mig": mig,
+            "job": job_id,
+            "from": self.shard_id,
+            "to": to_shard,
+            "fence": self._job_fence(job_id, job),
+            "bodies": bodies,
+            "requests": requests,
+            "job_state": snapshot_mod.capture_job(
+                self, job, bodies, body_index, requests, request_index
+            ),
+        }
+        return {"op": "migration_export", "mig": mig, "record": record}
+
+    def _job_fence(self, job_id: int, job) -> int:
+        """Highest instance id this shard could have issued for the job:
+        the destination floors every imported task AT it, so any late
+        uplink from this (possibly SIGSTOP'd) shard's workers carries a
+        strictly smaller instance id and is discarded over there."""
+        fence = int(self.core.instance_fence_floor)
+        for info in job.tasks.values():
+            task = self.core.tasks.get(
+                make_task_id(job_id, info.job_task_id)
+            )
+            if task is not None:
+                fence = max(fence, task.instance_id)
+        return fence
+
+    async def _client_migration_import(self, msg: dict) -> dict:
+        """Phase 2: durably adopt a migration record. The `migration-in`
+        journal record embeds the WHOLE record before any in-memory state
+        changes — kill -9 after the barrier replays the import; kill
+        before it leaves nothing, and the driver re-sends. Duplicate
+        imports (re-driven migrations) ack dup instead of double-seeding
+        — same exactly-once discipline as SubmitStream chunk replay."""
+        rec = msg.get("record") or {}
+        mig = str(msg.get("mig") or rec.get("mig") or "")
+        job_id = rec.get("job_state", {}).get("id")
+        if not mig or job_id is None:
+            return {"op": "error", "message": "malformed migration record"}
+        if mig in self.migrations_in or job_id in self.jobs.jobs:
+            return {"op": "migration_import", "mig": mig, "dup": True}
+        self.emit_event(
+            "migration-in", {"job": job_id, "mig": mig, "record": rec}
+        )
+        self._apply_migration_record(rec)
+        self.migrations_in[mig] = job_id
+        self._migration_barrier()
+        return {"op": "migration_import", "mig": mig, "dup": False}
+
+    async def _client_migration_finalize(self, msg: dict) -> dict:
+        """Phase 3 (post-commit): drop the sealed source copy, leaving a
+        journaled tombstone for wrong-shard redirects. Idempotent — the
+        driver may re-send after a crash on either side."""
+        mig = str(msg.get("mig") or "")
+        job_id = int(msg.get("job", 0))
+        to_shard = int(msg.get("to", -1))
+        if job_id in self.migrated_out or job_id not in self.jobs.jobs:
+            return {"op": "migration_finalize", "mig": mig, "dup": True}
+        self.emit_event(
+            "migration-out-done",
+            {"job": job_id, "mig": mig, "to": to_shard},
+        )
+        job = self.jobs.jobs.pop(job_id)
+        for job_task_id in job.tasks:
+            self.core.tasks.pop(make_task_id(job_id, job_task_id), None)
+        self.core.paused_jobs.discard(job_id)
+        self.core.paused_held.pop(job_id, None)
+        self.core.lazy.forget_job(job_id)
+        for uid in job.streams:
+            self._stream_jobs.pop(uid, None)
+        # job_wait callers must not hang on a job that left: wake them —
+        # their follow-up job_info gets the wrong-shard redirect
+        for event in self._job_waiters.pop(job_id, ()):
+            event.set()
+        self.migrating_out.pop(job_id, None)
+        self.migrated_out[job_id] = to_shard
+        self._migration_barrier()
+        return {"op": "migration_finalize", "mig": mig, "dup": False}
+
+    def _apply_migration_record(self, rec: dict) -> None:
+        """Install an exported job into the LIVE server (the in-memory
+        twin of restore's migration-in replay — events/restore.py
+        _seed_migration_record covers the post-crash path). Lazy chunks
+        re-register in chunk form: importing a 1M-task lazy array is
+        O(chunks), never O(tasks)."""
+        jd = rec["job_state"]
+        bodies = rec.get("bodies") or []
+        requests = rec.get("requests") or []
+        job_id = jd["id"]
+        # a job can migrate BACK to a shard that once exported it: the
+        # old wrong-shard tombstone must die with the import, or this
+        # shard keeps redirecting requests for a job it owns again
+        self.migrating_out.pop(job_id, None)
+        self.migrated_out.pop(job_id, None)
+        job = self.jobs.create_job(
+            name=jd["name"],
+            submit_dir=jd["submit_dir"],
+            max_fails=jd["max_fails"],
+            is_open=jd["open"],
+            job_id=job_id,
+        )
+        job.submitted_at = jd["submitted_at"]
+        job.cancel_reason = jd["cancel_reason"]
+        job.submits = list(jd["submits"])
+        status_of: dict[int, str] = {}
+        for tid, status, error, finished_at, started_at, submitted_at in (
+            jd["done"]
+        ):
+            self.jobs.attach_task(job, tid)
+            info = job.tasks[tid]
+            info.submitted_at = submitted_at
+            info.status = status
+            info.error = error
+            info.finished_at = finished_at
+            if started_at:
+                info.started_at = started_at
+            job.counters[status] += 1
+            status_of[tid] = status
+        for uid, s in (jd.get("streams") or {}).items():
+            job.streams[uid] = {
+                "applied": set(s["applied"]), "sealed": bool(s["sealed"]),
+            }
+            if not s["sealed"]:
+                job.open_streams += 1
+            self._stream_jobs[uid] = job_id
+        fence = max(
+            int(rec.get("fence", 0)), int(self.core.instance_fence_floor)
+        )
+        new_tasks = []
+        for t in jd["pending"]:
+            tid = t["id"]
+            self.jobs.attach_task(job, tid)
+            job.tasks[tid].submitted_at = t["submitted_at"]
+            deps = tuple(
+                make_task_id(job_id, d)
+                for d in t.get("deps", ())
+                if status_of.get(d) != "finished"
+            )
+            if any(
+                status_of.get(d) in ("failed", "canceled")
+                for d in t.get("deps", ())
+            ):
+                job.tasks[tid].status = "canceled"
+                job.counters["canceled"] += 1
+                continue
+            task = Task(
+                task_id=make_task_id(job_id, tid),
+                rq_id=self.core.intern_rqv(rqv_from_wire(
+                    requests[t["rq"]], self.core.resource_map
+                )),
+                priority=(int(t.get("priority", 0)),
+                          encode_sched_priority(job_id)),
+                body=bodies[t["b"]],
+                entry=t.get("entry"),
+                deps=deps,
+                crash_limit=int(t.get("crash_limit", 5)),
+            )
+            task.crash_counter = int(t.get("crashes", 0))
+            # monotonic across the move: floor at the source's fence,
+            # then bump past it — the source's recalled incarnations
+            # (and a SIGSTOP'd source's late uplinks) are all stale here
+            task.instance_id = int(t.get("instance", 0))
+            task.fence_instance(fence)
+            new_tasks.append(task)
+        if new_tasks:
+            reactor.on_new_tasks(self.core, self.comm, new_tasks)
+        for spec in jd.get("lazy") or ():
+            rqv = rqv_from_wire(
+                requests[spec["rq"]], self.core.resource_map
+            )
+            chunk = ArrayChunk(
+                job_id=job_id,
+                rq_id=self.core.intern_rqv(rqv),
+                priority=(int(spec.get("priority", 0)),
+                          encode_sched_priority(job_id)),
+                body=bodies[spec["b"]],
+                crash_limit=int(spec.get("crash_limit", 5)),
+                id_range=(
+                    tuple(spec["id_range"]) if "id_range" in spec else None
+                ),
+                ids=(
+                    [int(i) for i in spec["ids"]]
+                    if "ids" in spec else None
+                ),
+                entries=spec.get("entries"),
+                submitted_at=float(spec.get("submitted_at") or 0.0),
+                ready_at=float(spec.get("ready_at") or 0.0),
+                trace=spec.get("trace"),
+            )
+            self.core.lazy.register(self.core, chunk)
+            for dead in spec.get("dead") or ():
+                self.core.lazy.drop_id(self.core, job_id, dead)
+        self.check_job_completion(job_id)
+        self.comm.ask_for_scheduling()
 
     # --- metrics --------------------------------------------------------
     def _collect_metrics(self) -> None:
@@ -1353,6 +1688,21 @@ class Server:
                 "currently-registered workers lent to this shard by a "
                 "sibling (register carried lent_from)",
             ).set(fed.get("workers_borrowed") or 0)
+            REGISTRY.counter(
+                "hq_federation_jobs_moved_total",
+                "jobs this shard finished migrating out (ownership "
+                "tombstone journaled; live migration, ISSUE 17)",
+            ).set_total(len(self.migrated_out))
+            try:
+                from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+                REGISTRY.gauge(
+                    "hq_federation_ownership_epoch",
+                    "last epoch in the federation ownership log (the "
+                    "fencing token of the migration protocol)",
+                ).set(OwnershipStore(self.federation_root).current_epoch())
+            except OSError:
+                pass
         trace_stats = core.traces.stats()
         REGISTRY.gauge(
             "hq_task_traces", "tasks with spans in the bounded trace store"
@@ -1591,7 +1941,9 @@ class Server:
                 self.jplane.barrier(sync=self.journal_fsync == "always")
             elif self.journal is not None and self.journal.in_batch:
                 self.journal.flush(sync=self.journal_fsync == "always")
-            chaos.fire("server.event", event=kind)
+            chaos.fire(
+                "server.event", event=kind, shard=self.shard_id, ctx=self
+            )
         if self.jplane is not None and (
             self._event_listeners or self._subscribers
         ):
@@ -3150,6 +3502,16 @@ class Server:
                     "message": "submit_chunk requires a stream uid"}
         index = int(msg.get("i", 0))
         header = msg.get("job") or {}
+        # elastic resharding (ISSUE 17): a stream whose job moved (or is
+        # mid-move) answers a coded error — the client re-resolves the
+        # owner and replays its unacked chunks there (the destination
+        # imported the stream's applied-index set, so the replay dedups)
+        probe_id = self._stream_jobs.get(uid)
+        if probe_id is None:
+            probe_id = header.get("job_id")
+        guard = self._owned_elsewhere(probe_id, rid=rid)
+        if guard is not None:
+            return guard
         job_id = self._stream_jobs.get(uid)
         if job_id is not None:
             job = self.jobs.jobs.get(job_id)
@@ -3348,6 +3710,9 @@ class Server:
         return detail
 
     async def _client_job_info(self, msg: dict) -> dict:
+        guard = self._guard_job_ids(msg["job_ids"])
+        if guard is not None:
+            return guard
         out = []
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)
@@ -3362,6 +3727,9 @@ class Server:
         return {"op": "job_info", "jobs": out}
 
     async def _client_job_wait(self, msg: dict) -> dict:
+        guard = self._guard_job_ids(msg["job_ids"])
+        if guard is not None:
+            return guard
         events = []
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)
@@ -3375,6 +3743,9 @@ class Server:
         return await self._client_job_info(msg)
 
     async def _client_job_cancel(self, msg: dict) -> dict:
+        guard = self._guard_job_ids(msg["job_ids"])
+        if guard is not None:
+            return guard
         canceled = []
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)
@@ -3404,6 +3775,9 @@ class Server:
         return {"op": "job_cancel", "result": canceled}
 
     async def _client_job_forget(self, msg: dict) -> dict:
+        guard = self._guard_job_ids(msg["job_ids"])
+        if guard is not None:
+            return guard
         forgotten = 0
         for job_id in msg["job_ids"]:
             job = self.jobs.jobs.get(job_id)

@@ -94,7 +94,16 @@ def _idle_workers(sample: dict) -> list[int]:
 
 
 def _backlog(sample: dict) -> int:
-    return int(sample.get("ready") or 0) + int(sample.get("mn_queued") or 0)
+    # ready counts only what still sits in SERVER queues — the solver
+    # prefills deep per-worker batches, so a hot shard's whole backlog
+    # can live in worker prefill queues while total_ready() reads 0.
+    # Waiting work is waiting work wherever it queues: count both, or
+    # the rebalancer sees a drowning shard as balanced.
+    queued_on_workers = sum(
+        int(w.get("prefilled") or 0) for w in sample.get("workers") or ()
+    )
+    return (int(sample.get("ready") or 0)
+            + int(sample.get("mn_queued") or 0) + queued_on_workers)
 
 
 def _wants_capacity(sample: dict) -> bool:
@@ -157,17 +166,254 @@ def plan_lending(samples: dict[int, dict | None],
     return moves
 
 
+# ------------------------------------------------------------- migration
+# ISSUE 17: exactly-once live job migration. The driver (coordinator
+# side) runs a 5-phase protocol; every phase is idempotent on both shards
+# AND in the ownership log, so a crashed driver re-runs the same mig uid
+# from the top and converges. The chaos site `federation.migration` fires
+# BETWEEN phases with shard=-1 ("the coordinator") so a kill matrix can
+# land a kill -9 at every protocol boundary.
+
+_MIGRATIONS = REGISTRY.counter(
+    "hq_federation_migrations_total",
+    "live job migrations driven to completion by this process",
+)
+_MIGRATION_SECONDS = REGISTRY.histogram(
+    "hq_federation_migration_seconds",
+    "end-to-end duration of one live job migration (claim to done)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0),
+)
+_JOBS_MOVED = REGISTRY.counter(
+    "hq_federation_jobs_moved_total",
+    "jobs whose ownership transferred to another shard (rebalancer and "
+    "manual `hq fleet migrate` moves both count)",
+)
+
+
+class MigrationError(RuntimeError):
+    """A migration RPC returned an error the driver cannot retry past."""
+
+
+def _shard_rpc(root: Path, shard_id: int, msg: dict,
+               retry_window: float = 5.0) -> dict:
+    from hyperqueue_tpu.client.connection import ClientSession
+
+    shard_dir = serverdir.shard_path(root, shard_id)
+    with ClientSession(shard_dir, retry_window=retry_window) as session:
+        return session.request(msg)
+
+
+async def drive_migration_async(root: Path, job_id: int, to_shard: int,
+                                *, mig: str | None = None, store=None,
+                                rpc=None, from_shard: int | None = None,
+                                ) -> dict:
+    """Run the migration protocol for one job; returns the move record.
+
+    Re-entrant: pass the same ``mig`` uid to resume a crashed driver's
+    protocol. Phases (ownership.log is the source of truth throughout):
+
+    1. ``claim``     append migration-intent (a double claim of the same
+                     job by a DIFFERENT mig raises MigrationClaimed);
+    2. ``export``    source seals + drains the job and hands back a
+                     self-contained record (journaled `migration-out`
+                     + barrier on the source first);
+    3. ``import``    destination journals `migration-in` (embedding the
+                     record) + barrier, then acks — or acks dup;
+    4. ``commit``    append migration-commit: THE linearization point of
+                     the ownership transfer;
+    5. ``finalize``  source drops its sealed copy behind a journaled
+                     tombstone (`migration-out-done`), then
+                     migration-done retires the log entry.
+
+    Kill -9 of source / destination / driver at ANY point leaves exactly
+    one durable owner: before commit the source still owns the job (an
+    un-finalized destination import is unreachable — routing still says
+    source — and a re-driven import acks dup); after commit the
+    destination owns it and finalize merely garbage-collects the sealed
+    source copy, which answers wrong-shard from its tombstone on."""
+    from hyperqueue_tpu.utils import chaos
+    from hyperqueue_tpu.utils.ownership import OwnershipStore
+    from hyperqueue_tpu.utils.trace import new_trace_id
+
+    store = store or OwnershipStore(root)
+    if rpc is None:
+        async def rpc(shard, msg):  # noqa: ANN001 - local default
+            return _shard_rpc(root, shard, msg)
+    if from_shard is None:
+        from_shard = store.load().shard_for_job(job_id)
+    mig = mig or f"mig-{new_trace_id()}"
+    t0 = time.perf_counter()
+    intent = store.begin_migration(job_id, from_shard, to_shard, mig)
+    from_shard, to_shard = int(intent["from"]), int(intent["to"])
+    chaos.fire("federation.migration", op="claim", shard=-1,
+               ctx="coordinator")
+    if mig not in store.load().committed:
+        resp = await rpc(from_shard, {
+            "op": "migration_export", "mig": mig, "job": int(job_id),
+            "to": to_shard,
+        })
+        if resp.get("op") == "error":
+            # the source says the job already lives elsewhere (a PRIOR
+            # finalized migration) — this claim is moot; abort it
+            store.abort_migration(mig, reason=resp.get("message", ""))
+            raise MigrationError(
+                f"export of job {job_id} failed: {resp.get('message')}"
+            )
+        chaos.fire("federation.migration", op="export", shard=-1,
+                   ctx="coordinator")
+        resp = await rpc(to_shard, {
+            "op": "migration_import", "mig": mig,
+            "record": resp["record"],
+        })
+        if resp.get("op") == "error":
+            raise MigrationError(
+                f"import of job {job_id} failed: {resp.get('message')}"
+            )
+        chaos.fire("federation.migration", op="import", shard=-1,
+                   ctx="coordinator")
+        store.commit_migration(mig)
+    chaos.fire("federation.migration", op="commit", shard=-1,
+               ctx="coordinator")
+    resp = await rpc(from_shard, {
+        "op": "migration_finalize", "mig": mig, "job": int(job_id),
+        "to": to_shard,
+    })
+    if resp.get("op") == "error":
+        raise MigrationError(
+            f"finalize of job {job_id} failed: {resp.get('message')}"
+        )
+    chaos.fire("federation.migration", op="finalize", shard=-1,
+               ctx="coordinator")
+    store.finish_migration(mig)
+    seconds = time.perf_counter() - t0
+    _MIGRATIONS.inc()
+    _JOBS_MOVED.inc()
+    _MIGRATION_SECONDS.observe(seconds)
+    logger.info(
+        "migrated job %d: shard %d -> shard %d (%s, %.3fs)",
+        job_id, from_shard, to_shard, mig, seconds,
+    )
+    return {"mig": mig, "job": int(job_id), "from": from_shard,
+            "to": to_shard, "seconds": round(seconds, 4)}
+
+
+def drive_migration(root: Path, job_id: int, to_shard: int, *,
+                    mig: str | None = None, store=None, rpc=None,
+                    from_shard: int | None = None) -> dict:
+    """Synchronous twin of :func:`drive_migration_async` (CLI and
+    coordinator threads; the simulator awaits the async form on its own
+    loop with a memory-transport rpc)."""
+    sync_rpc = rpc
+
+    async def arpc(shard, msg):
+        # ClientSession drives a PRIVATE event loop; calling it on the
+        # thread already running asyncio.run's loop would nest loops
+        # (RuntimeError) — hop to an executor thread for each sync RPC
+        loop = asyncio.get_running_loop()
+        if sync_rpc is not None:
+            return await loop.run_in_executor(None, sync_rpc, shard, msg)
+        return await loop.run_in_executor(
+            None, _shard_rpc, root, shard, msg
+        )
+
+    return asyncio.run(drive_migration_async(
+        root, job_id, to_shard, mig=mig, store=store, rpc=arpc,
+        from_shard=from_shard,
+    ))
+
+
+def recover_migrations(root: Path, store=None, rpc=None) -> list[dict]:
+    """Re-drive every in-flight migration intent in the ownership log
+    (coordinator start / `hq fleet migrate --recover`): a pre-commit
+    intent restarts from export (the sealed source re-exports, the
+    destination dedups), a committed one skips straight to finalize."""
+    from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+    store = store or OwnershipStore(root)
+    out = []
+    for rec in store.load().in_flight():
+        try:
+            out.append(drive_migration(
+                root, int(rec["job"]), int(rec["to"]), mig=rec["mig"],
+                store=store, rpc=rpc, from_shard=int(rec["from"]),
+            ))
+        except Exception as e:  # noqa: BLE001 - recover what can be
+            logger.warning("re-driving migration %s failed: %s",
+                           rec.get("mig"), e)
+    return out
+
+
+# ------------------------------------------------------------ rebalancer
+#: a rebalance fires only while max(backlog) exceeds mean(backlog) by
+#: this ratio — the hysteresis band that keeps near-balanced fleets still
+REBALANCE_RATIO = 1.5
+#: and only this often per donor shard (migrations are heavier than
+#: lends; give the moved job's backlog time to show up in the samples)
+REBALANCE_COOLDOWN_SECS = 10.0
+
+
+def plan_rebalance(samples: dict[int, dict | None],
+                   min_ratio: float = REBALANCE_RATIO) -> dict | None:
+    """Pick one hot->cold whole-job move from per-shard backlog samples,
+    or None while the fleet is balanced. Pure and deterministic.
+
+    Hysteresis: no move unless the hottest shard's backlog exceeds the
+    fleet mean by ``min_ratio`` AND beats the coldest by more than one
+    job's worth of slack (moving a job between near-equal shards would
+    just oscillate). The coldest shard receives — idle added shards have
+    backlog 0 and become immediate receivers, which is exactly how
+    `--shards N -> N+1` drains the hot shard onto the new one."""
+    now = clock.now()
+    fresh = {
+        k: s for k, s in samples.items()
+        if s is not None
+        and now - float(s.get("time") or 0.0) <= SAMPLE_FRESH_SECS
+    }
+    if len(fresh) < 2:
+        return None
+    backlogs = {k: _backlog(s) for k, s in fresh.items()}
+    mean = sum(backlogs.values()) / len(backlogs)
+    if mean <= 0:
+        return None
+    hot = max(sorted(backlogs), key=lambda k: backlogs[k])
+    cold = min(sorted(backlogs), key=lambda k: backlogs[k])
+    if hot == cold or backlogs[hot] < min_ratio * mean:
+        return None
+    if backlogs[hot] - backlogs[cold] < 2:
+        return None
+    return {
+        "from": hot, "to": cold,
+        "ratio": round(backlogs[hot] / mean, 3),
+        "backlogs": dict(sorted(backlogs.items())),
+    }
+
+
 class FederationCoordinator:
     """Thread-based lending loop: one subscribe feed per shard feeding
     ``plan_lending``; each move becomes a ``worker_lend`` RPC against the
     lender. Shard death is routine here — a dead feed clears its sample
-    and keeps retrying until the shard's successor comes up."""
+    and keeps retrying until the shard's successor comes up.
+
+    With ``rebalance=True`` a second control thread turns the same
+    samples into WHOLE-JOB moves (ISSUE 17): largest-pending job first,
+    hottest shard to coldest, each move one exactly-once
+    :func:`drive_migration` run, each verdict appended to the ownership
+    log for `hq fleet` to show."""
 
     def __init__(self, root: Path, sample_interval: float = 1.0,
-                 cooldown: float = LEND_COOLDOWN_SECS):
+                 cooldown: float = LEND_COOLDOWN_SECS,
+                 rebalance: bool = False,
+                 rebalance_ratio: float = REBALANCE_RATIO,
+                 rebalance_cooldown: float = REBALANCE_COOLDOWN_SECS):
         self.root = Path(root)
         self.sample_interval = sample_interval
         self.cooldown = cooldown
+        self.rebalance = rebalance
+        self.rebalance_ratio = rebalance_ratio
+        self.rebalance_cooldown = rebalance_cooldown
+        self.migrations_done = 0
+        self.last_verdict: dict | None = None
+        self._last_rebalance: dict[int, float] = {}
         self.samples: dict[int, dict | None] = {}
         self.moves_issued = 0
         self._last_lend: dict[int, float] = {}
@@ -223,6 +469,97 @@ class FederationCoordinator:
             except Exception:  # noqa: BLE001 - the loop must survive
                 logger.exception("lending pass failed")
 
+    # --- rebalancing (ISSUE 17) -----------------------------------------
+    def _rebalance_control(self) -> None:
+        from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+        store = OwnershipStore(self.root)
+        try:
+            # a coordinator that died mid-protocol left intents behind:
+            # converge them before planning anything new
+            recover_migrations(self.root, store=store)
+        except Exception:  # noqa: BLE001 - recovery must not kill the loop
+            logger.exception("migration recovery failed")
+        while not self._stop.wait(self.sample_interval):
+            try:
+                self._rebalance_pass(store)
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("rebalance pass failed")
+
+    def _rebalance_pass(self, store) -> None:
+        plan = plan_rebalance(
+            dict(self.samples), min_ratio=self.rebalance_ratio
+        )
+        if plan is None:
+            return
+        now = clock.monotonic()
+        if now - self._last_rebalance.get(plan["from"], 0.0) < (
+            self.rebalance_cooldown
+        ):
+            return
+        backlogs = plan["backlogs"]
+        job_id = self._pick_job(
+            plan["from"], cap=backlogs[plan["from"]] - backlogs[plan["to"]]
+        )
+        if job_id is None:
+            self.last_verdict = store.record_verdict({
+                "moved": None, "from": plan["from"], "to": plan["to"],
+                "reason": f"imbalance {plan['ratio']}x but no movable job",
+            })
+            self._last_rebalance[plan["from"]] = now
+            return
+        try:
+            move = drive_migration(
+                self.root, job_id, plan["to"], store=store,
+                from_shard=plan["from"],
+            )
+        except Exception as e:  # noqa: BLE001 - verdict either way
+            logger.warning("rebalance migration of job %d failed: %s",
+                           job_id, e)
+            self.last_verdict = store.record_verdict({
+                "moved": None, "from": plan["from"], "to": plan["to"],
+                "job": job_id, "reason": f"migration failed: {e}",
+            })
+        else:
+            self.migrations_done += 1
+            self.last_verdict = store.record_verdict({
+                "moved": job_id, "from": plan["from"], "to": plan["to"],
+                "mig": move["mig"], "seconds": move["seconds"],
+                "reason": f"backlog imbalance {plan['ratio']}x "
+                          f"{plan['backlogs']}",
+            })
+        self._last_rebalance[plan["from"]] = now
+
+    def _pick_job(self, shard_id: int,
+                  cap: float = float("inf")) -> int | None:
+        """Largest-pending-first: the job whose move shifts the most
+        backlog in one migration. Open jobs are skipped (a mid-stream
+        SubmitStream CAN follow a move, but the planner prefers moves
+        that cannot even need a redirect); so are terminated ones.
+
+        ``cap`` is the hot-cold backlog gap: moving a job with pending
+        >= the gap would leave the RECEIVER at least as hot as the donor
+        was — the next pass would just move it back. Requiring a strict
+        peak improvement is what makes the rebalancer convergent instead
+        of ping-ponging one indivisible job between two shards."""
+        try:
+            resp = _shard_rpc(self.root, shard_id, {"op": "job_list"})
+        except Exception as e:  # noqa: BLE001 - shard may just have died
+            logger.debug("job_list on shard %d failed: %s", shard_id, e)
+            return None
+        best, best_pending = None, 0
+        for info in resp.get("jobs", ()):
+            c = info.get("counters") or {}
+            pending = int(info.get("n_tasks", 0)) - (
+                int(c.get("finished", 0)) + int(c.get("failed", 0))
+                + int(c.get("canceled", 0))
+            )
+            if info.get("is_open"):
+                continue
+            if best_pending < pending < cap:
+                best, best_pending = int(info["id"]), pending
+        return best
+
     def _issue(self, move: dict) -> bool:
         from hyperqueue_tpu.client.connection import ClientSession
 
@@ -274,6 +611,13 @@ class FederationCoordinator:
         )
         ctl.start()
         self._threads.append(ctl)
+        if self.rebalance:
+            reb = threading.Thread(
+                target=self._rebalance_control, daemon=True,
+                name="hq-fed-rebalancer",
+            )
+            reb.start()
+            self._threads.append(reb)
 
     def stop(self) -> None:
         self._stop.set()
@@ -422,6 +766,7 @@ async def standby_main(
     sample_interval: float = 1.0,
     metrics_port: int | None = None,
     metrics_host: str = "0.0.0.0",
+    rebalance: bool = False,
 ) -> None:
     """`hq server start --standby`: a warm successor process.
 
@@ -441,7 +786,7 @@ async def standby_main(
     coordinator = None
     if coordinate:
         coordinator = FederationCoordinator(
-            root, sample_interval=sample_interval
+            root, sample_interval=sample_interval, rebalance=rebalance
         )
         coordinator.start()
     metrics_server = None

@@ -223,8 +223,28 @@ class LazyStore:
             seg.in_queue = False
 
     def forget_job(self, job_id: int) -> None:
-        """Drop every segment of a forgotten job (terminated jobs have
-        none live, but the records themselves must not linger)."""
+        """Drop every segment of a forgotten job. Terminated jobs have
+        none live, but a MIGRATED-OUT job leaves its sealed segments here
+        in chunk form — release their in-queue/held counts too, or the
+        unmaterialized gauge stays inflated for the server's lifetime."""
+        for seg in self.per_job.get(job_id, ()):
+            if not seg.remaining:
+                continue
+            if seg.in_queue:
+                self._adjust(seg.chunk.rq_id, seg.chunk.priority,
+                             -seg.remaining)
+                key = (seg.chunk.rq_id, seg.chunk.priority)
+                segs = self.levels.get(key)
+                if segs is not None:
+                    try:
+                        segs.remove(seg)
+                    except ValueError:
+                        pass
+                    if not segs:
+                        self.levels.pop(key, None)
+                seg.in_queue = False
+            else:
+                self.held -= seg.remaining
         self.per_job.pop(job_id, None)
 
     # --- queue-side interface (consumed by scheduler/queues.py) ---------

@@ -286,6 +286,36 @@ def resume_jobs(core: Core, comm: Comm, job_ids: list[int]) -> int:
     return released
 
 
+def recall_tasks(core: Core, comm: Comm, task_ids: list[int]) -> int:
+    """Recall ASSIGNED/RUNNING tasks from their workers (migration
+    export, ISSUE 17): release resources, cancel the incarnation on the
+    worker, bump the instance — a late uplink from the recalled
+    incarnation then carries a stale instance id and is discarded — and
+    requeue through _make_ready (the caller pauses the job first, so the
+    task lands in the pause ledger, not a queue).  Never charges the
+    crash counter: the recall is deliberate, not a worker failure."""
+    per_worker: dict[int, list[int]] = {}
+    recalled = 0
+    for tid in task_ids:
+        task = core.tasks.get(tid)
+        if task is None or task.is_done:
+            continue
+        if task.state not in (TaskState.ASSIGNED, TaskState.RUNNING):
+            continue
+        notify = list(task.mn_workers) or [task.assigned_worker]
+        _release_task_resources(core, task)
+        for wid in notify:
+            if wid:
+                per_worker.setdefault(wid, []).append(tid)
+        task.increment_instance()
+        task.state = TaskState.WAITING
+        _make_ready(core, task)
+        recalled += 1
+    for wid, tids in per_worker.items():
+        comm.send_cancel(wid, tids)
+    return recalled
+
+
 def on_new_worker(core: Core, comm: Comm, events: EventSink, worker: Worker) -> None:
     core.workers[worker.worker_id] = worker
     core.bump_membership()

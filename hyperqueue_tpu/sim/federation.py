@@ -1,0 +1,658 @@
+"""Federated simulation: M shard servers + the migration driver on ONE
+virtual clock.
+
+ISSUE 17's chaos gate needs to interleave a live job migration with
+kill -9 of the source, the destination, or the driver at every protocol
+phase — and prove exactly-once execution and single ownership each time.
+Process-level chaos tests can hit a handful of interleavings per second;
+this module runs the whole federation (every shard a real ``Server``,
+the real ``drive_migration_async`` driver, real journals and the real
+ownership log) inside one :class:`~hyperqueue_tpu.sim.loop.SimEventLoop`,
+so a scenario explores a kill site per virtual millisecond and the run
+is a deterministic function of (scenario, seed, rules).
+
+Kill model: ONE global chaos kill handler serves every shard. A chaos
+``action: "kill"`` rule fires inside whichever call stack reached the
+site; :func:`chaos.last_ctx` says whose — a ``Server`` instance means
+"this shard dies now" (its journal appender is abandoned mid-buffer, its
+links abort, a supervisor restores it after a delay), the string
+``"coordinator"`` means the migration driver dies (its coroutine unwinds
+with :class:`SimKilled`; a later :meth:`FederatedSimulation.recover`
+re-drives the intent from the ownership log, exactly like
+``hq fleet migrate --recover`` after a coordinator crash).
+
+Invariants are FLEET-SCOPED: one shared monitor sees every shard's
+journaled events and every simulated execution, so a task that slips
+through a migration twice — once on each side — is caught the moment the
+second ``(task, instance)`` starts, and the final audit counts terminal
+records across ALL shard journals plus exactly one live owner per job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import shutil
+import tempfile
+import time as _walltime
+from pathlib import Path
+
+from hyperqueue_tpu.server.bootstrap import Server
+from hyperqueue_tpu.server.federation import (
+    MigrationError,
+    drive_migration_async,
+)
+from hyperqueue_tpu.sim.client import SimClient, SimClientError, SimSubmitStream
+from hyperqueue_tpu.sim.harness import SimKilled
+from hyperqueue_tpu.sim.invariants import InvariantMonitor, InvariantViolation
+from hyperqueue_tpu.sim.loop import SimClock, SimEventLoop
+from hyperqueue_tpu.sim.transport import duplex
+from hyperqueue_tpu.sim.worker import SimWorker
+from hyperqueue_tpu.utils import chaos, clock, serverdir
+from hyperqueue_tpu.utils import trace as trace_mod
+from hyperqueue_tpu.utils.lease import LeaseHeldError
+from hyperqueue_tpu.utils.metrics import REGISTRY
+from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+logger = logging.getLogger("hq.sim.federation")
+
+
+class FederatedMonitor(InvariantMonitor):
+    """The single-server monitor, made ownership-aware.
+
+    Execution/fence/exactly-once tracking is already keyed by global
+    ``(job << 32) | task`` ids, so sharing one monitor across shards
+    needs no change there. Only the restore-time ack-durability check
+    must learn routing: an acked job is owed to its CURRENT owner shard,
+    not to whichever shard happens to be restoring."""
+
+    def _owned_here(self, server, job_id) -> bool:
+        if job_id is None:
+            return False
+        if job_id in server.migrated_out or job_id in server.migrating_out:
+            return False  # sealed/shipped away: the destination answers
+        try:
+            root = self.sim.root
+            owner = OwnershipStore(root).load().shard_for_job(int(job_id))
+        except OSError:
+            owner = (int(job_id) - 1) % max(self.sim.shard_count, 1)
+        return owner == server.shard_id
+
+    def check_restored_server(self, server) -> None:
+        for uid, indexes in self.acked_chunks.items():
+            job_id = self.chunk_jobs.get(uid)
+            if not self._owned_here(server, job_id):
+                continue
+            job = server.jobs.jobs.get(job_id)
+            if job is None:
+                self._fail(
+                    f"ack-durability violation: job {job_id} (stream "
+                    f"{uid}) was acked but is unknown on its owner shard "
+                    f"{server.shard_id} after restore"
+                )
+            stream = job.streams.get(uid)
+            applied = stream["applied"] if stream else set()
+            missing = indexes - set(applied)
+            if missing and not job.is_terminated():
+                self._fail(
+                    f"ack-durability violation: stream {uid} chunks "
+                    f"{sorted(missing)} were acked but not applied on "
+                    f"shard {server.shard_id} after restore"
+                )
+        for job_id in self.acked_jobs:
+            if not self._owned_here(server, job_id):
+                continue
+            if job_id not in server.jobs.jobs:
+                self._fail(
+                    f"ack-durability violation: job {job_id} was acked "
+                    f"but is unknown on its owner shard "
+                    f"{server.shard_id} after restore"
+                )
+
+
+class _ShardSim:
+    """One shard's harness surface: exactly the attribute set SimWorker
+    and SimClient read from a ``Simulation`` (loop / seed / monitor /
+    connect_* / add_worker), plus this shard's kill/restore lifecycle."""
+
+    def __init__(self, fed: "FederatedSimulation", shard_id: int):
+        self.fed = fed
+        self.shard_id = shard_id
+        self.seed = fed.seed
+        self.monitor = fed.monitor
+        self.server_dir = serverdir.shard_path(fed.root, shard_id)
+        self.journal_path = self.server_dir / "journal.bin"
+        self.server: Server | None = None
+        self.server_boots = 0
+        self.workers: dict[str, SimWorker] = {}
+        self.client = SimClient(self, name=f"driver-s{shard_id}")
+        self._links: list = []
+        self._event_tap_task = None
+        self._down: asyncio.Event | None = None
+        self._restore_delay = fed.restore_delay
+
+    @property
+    def loop(self):
+        return self.fed.loop
+
+    # --- connection points (SimWorker / SimClient call these) ----------
+    def connect_worker(self, name: str):
+        if self.server is None:
+            raise ConnectionError(f"shard {self.shard_id} is down")
+        a, b = duplex(self.loop, name=f"s{self.shard_id}-w-{name}")
+        self._links.append(a.link)
+        self.server.accept_worker(b.reader, b.writer)
+        return a
+
+    def connect_client(self, name: str):
+        if self.server is None:
+            raise ConnectionError(f"shard {self.shard_id} is down")
+        a, b = duplex(self.loop, name=f"s{self.shard_id}-c-{name}")
+        self._links.append(a.link)
+        self.server.accept_client(b.reader, b.writer)
+        return a
+
+    def add_worker(self, name: str | None = None, **kwargs) -> SimWorker:
+        name = name or f"s{self.shard_id}w{len(self.workers)}"
+        worker = SimWorker(
+            self, name,
+            n_cpus=kwargs.pop("n_cpus", self.fed.worker_cpus),
+            group=kwargs.pop("group", f"shard{self.shard_id}"),
+            heartbeat_secs=kwargs.pop(
+                "heartbeat_secs", self.fed.heartbeat_secs
+            ),
+            **kwargs,
+        )
+        self.workers[name] = worker
+        worker.start()
+        return worker
+
+    # --- lifecycle ------------------------------------------------------
+    async def start_server(self) -> Server:
+        kwargs = dict(
+            server_dir=self.server_dir,
+            host=f"sim-shard-{self.shard_id}",
+            disable_client_auth=True,
+            disable_worker_auth=True,
+            scheduler=self.fed.scheduler,
+            schedule_min_delay=self.fed.schedule_min_delay,
+            journal_path=self.journal_path,
+            reattach_timeout=self.fed.reattach_timeout,
+            solver_watchdog_timeout=0.0,
+            client_plane="reactor",
+            journal_plane="reactor",
+            fanout_senders=0,
+            memory_transport=True,
+            lease_timeout=self.fed.lease_timeout,
+            shard_id=self.shard_id,
+            shard_count=self.fed.shard_count,
+            federation_root=self.fed.root,
+            failover_watch=False,
+        )
+        kwargs.update(self.fed.server_kwargs)
+        server = Server(**kwargs)
+        await server.start()
+        self.server = server
+        self.server_boots += 1
+        self._links = []
+        tap: asyncio.Queue = asyncio.Queue()
+        server._event_listeners.append(tap)
+        self._event_tap_task = self.loop.create_task(self._drain_tap(tap))
+        if server.n_boots > 1:
+            self.monitor.check_restored_server(server)
+        return server
+
+    async def _drain_tap(self, tap: asyncio.Queue) -> None:
+        while True:
+            record = await tap.get()
+            self.monitor.on_event(record)
+
+    def kill_now(self) -> None:
+        """kill -9 this shard's incarnation (mirrors the single-server
+        harness: unflushed journal tail lost, links aborted)."""
+        server = self.server
+        if server is None:
+            return
+        self.server = None
+        server._event_listeners.clear()
+        server._subscribers.clear()
+        if self._event_tap_task is not None:
+            self._event_tap_task.cancel()
+            self._event_tap_task = None
+        if server.journal is not None:
+            server.journal.kill()
+            server.journal = None
+        server.jplane = None
+        for t in (list(server._tasks) + list(server._client_tasks)
+                  + list(server._conn_tasks)):
+            t.cancel()
+        if server.autoalloc is not None:
+            server.autoalloc.stop()
+        if server._metrics_hook is not None:
+            REGISTRY.remove_collect_hook(server._metrics_hook)
+            server._metrics_hook = None
+        for link in self._links:
+            link.abort()
+        self._links = []
+        if self._down is not None:
+            self._down.set()
+        logger.info("sim: shard %d killed at t=%.3f",
+                    self.shard_id, clock.monotonic())
+
+    async def supervisor(self) -> None:
+        self._down = asyncio.Event()
+        while True:
+            await self._down.wait()
+            self._down.clear()
+            if self.fed._stopping:
+                return
+            await asyncio.sleep(self._restore_delay)
+            self._restore_delay = self.fed.restore_delay
+            while not self.fed._stopping:
+                try:
+                    await self.start_server()
+                except LeaseHeldError:
+                    # the killed incarnation's lease is not yet stale —
+                    # a real restarted process waits it out the same way
+                    await asyncio.sleep(0.5)
+                    continue
+                logger.info("sim: shard %d restored at t=%.3f",
+                            self.shard_id, clock.monotonic())
+                break
+
+
+class FederatedSimulation:
+    """M shard servers + per-shard workers + the migration driver, on one
+    virtual clock, under one chaos plan and one fleet-wide monitor.
+
+    Usage::
+
+        fed = FederatedSimulation(shard_count=2, rules=[
+            {"site": "server.event", "event": "migration-out",
+             "shard": 0, "action": "kill", "times": 1},
+        ])
+        result = fed.run(scenario)   # async def scenario(fed): ...
+
+    The scenario drives submits (:meth:`submit` / :meth:`stream`),
+    migrations (:meth:`migrate` / :meth:`recover`), shard kills
+    (:meth:`kill_shard`) and arbitrary RPCs (:meth:`rpc`); ``run``
+    quiesces every submitted job, audits the fleet, and tears down."""
+
+    def __init__(
+        self,
+        shard_count: int = 2,
+        seed: int = 0,
+        n_workers_per_shard: int = 4,
+        worker_cpus: int = 4,
+        rules: list[dict] | None = None,
+        root: Path | None = None,
+        scheduler: str = "greedy-numpy",
+        schedule_min_delay: float = 0.01,
+        heartbeat_secs: float = 8.0,
+        reattach_timeout: float = 5.0,
+        restore_delay: float = 1.0,
+        lease_timeout: float = 3.0,
+        horizon: float = 1800.0,
+        server_kwargs: dict | None = None,
+    ):
+        self.shard_count = max(int(shard_count), 1)
+        self.seed = seed
+        self.n_workers_per_shard = n_workers_per_shard
+        self.worker_cpus = worker_cpus
+        self.rules = list(rules or [])
+        self.scheduler = scheduler
+        self.schedule_min_delay = schedule_min_delay
+        self.heartbeat_secs = heartbeat_secs
+        self.reattach_timeout = reattach_timeout
+        self.restore_delay = restore_delay
+        self.lease_timeout = lease_timeout
+        self.horizon = horizon
+        self.server_kwargs = dict(server_kwargs or {})
+        self._own_dir = root is None
+        self.root = Path(root or tempfile.mkdtemp(prefix="hq-fedsim-"))
+
+        self.loop: SimEventLoop | None = None
+        # gang checks read .server off the monitor's sim; fleet-scoped
+        # monitoring has no single server, so they no-op here
+        self.server = None
+        self.monitor = FederatedMonitor(self)
+        self.shards: list[_ShardSim] = []
+        self.expected_tasks: dict[int, int] = {}
+        self.driver_kills = 0
+        self._stopping = False
+        self._supervisors: list = []
+        self.wall_s = 0.0
+
+    # --- scenario surface ------------------------------------------------
+    def store(self) -> OwnershipStore:
+        return OwnershipStore(self.root)
+
+    async def rpc(self, shard_id: int, msg: dict, retries: int = 400,
+                  retry_delay: float = 0.25) -> dict:
+        """Raw request against one shard; a connection that dies with a
+        shard kill is retried against the restored incarnation. Error
+        replies are RETURNED (the migration driver reads them), not
+        raised."""
+        from hyperqueue_tpu.transport.auth import AuthError
+
+        client = self.shards[shard_id].client
+        last: Exception | None = None
+        async with client._lock:
+            for _ in range(retries):
+                try:
+                    conn = await client._ensure_conn()
+                    await conn.send(msg)
+                    return await conn.recv()
+                except (ConnectionError, OSError, AuthError,
+                        asyncio.IncompleteReadError) as e:
+                    last = e
+                    client.drop_connection()
+                    await asyncio.sleep(retry_delay)
+        raise SimClientError(f"shard {shard_id} rpc failed: {last}")
+
+    async def submit(self, shard_id: int, job_desc: dict) -> dict:
+        reply = await self.shards[shard_id].client.submit(job_desc)
+        self.expected_tasks[reply["job_id"]] = (
+            self.expected_tasks.get(reply["job_id"], 0)
+            + reply.get("n_tasks", 0)
+        )
+        return reply
+
+    def stream(self, shard_id: int, uid: str, header: dict) \
+            -> SimSubmitStream:
+        return SimSubmitStream(self.shards[shard_id].client, uid=uid,
+                               header=dict(header))
+
+    def track(self, job_id: int, n_tasks: int) -> None:
+        """Register chunk-streamed tasks with the quiescence audit."""
+        self.expected_tasks[job_id] = (
+            self.expected_tasks.get(job_id, 0) + n_tasks
+        )
+
+    async def migrate(self, job_id: int, to_shard: int,
+                      mig: str | None = None) -> dict | None:
+        """Drive one migration; ``None`` means the DRIVER was chaos-killed
+        mid-protocol (the intent stays in the ownership log for
+        :meth:`recover`)."""
+        try:
+            return await drive_migration_async(
+                self.root, job_id, to_shard, mig=mig, store=self.store(),
+                rpc=self.rpc,
+            )
+        except SimKilled:
+            self.driver_kills += 1
+            logger.info("sim: migration driver killed (job %d)", job_id)
+            return None
+
+    async def recover(self) -> list[dict]:
+        """Re-drive every in-flight intent in the ownership log — the
+        async twin of ``recover_migrations`` (which wraps asyncio.run and
+        cannot nest inside the sim loop)."""
+        out = []
+        store = self.store()
+        for rec in store.load().in_flight():
+            try:
+                out.append(await drive_migration_async(
+                    self.root, int(rec["job"]), int(rec["to"]),
+                    mig=rec["mig"], store=store, rpc=self.rpc,
+                    from_shard=int(rec["from"]),
+                ))
+            except (MigrationError, SimKilled) as e:
+                logger.warning("sim: re-drive of %s failed: %s",
+                               rec.get("mig"), e)
+        return out
+
+    async def kill_shard(self, shard_id: int,
+                         restore_after: float | None = None) -> None:
+        shard = self.shards[shard_id]
+        if restore_after is not None:
+            shard._restore_delay = restore_after
+        shard.kill_now()
+        await asyncio.sleep(0)
+
+    async def add_shard(self, n_workers: int | None = None) -> int:
+        """Online N -> N+1: boot a brand-new shard against the same
+        federation root (its start grows the descriptor and journals the
+        shard-add in the ownership log) and give it workers. The existing
+        shards keep running — no restart anywhere. Returns the new id."""
+        new_id = len(self.shards)
+        self.shard_count = new_id + 1
+        shard = _ShardSim(self, new_id)
+        self.shards.append(shard)
+        await shard.start_server()
+        self._supervisors.append(self.loop.create_task(shard.supervisor()))
+        for _ in range(self.n_workers_per_shard
+                       if n_workers is None else n_workers):
+            shard.add_worker()
+        return new_id
+
+    async def wait_job(self, job_id: int, retries: int = 400) -> dict:
+        """job_wait routed at the job's CURRENT owner — re-resolving
+        through the ownership log on every wrong-shard redirect, the way
+        a FederatedSession client does."""
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                owner = self.store().load().shard_for_job(job_id)
+            except OSError:
+                owner = (job_id - 1) % self.shard_count
+            try:
+                return await self.rpc(
+                    owner, {"op": "job_wait", "job_ids": [job_id]},
+                )
+            except SimClientError as e:
+                last = e
+            await asyncio.sleep(0.25)
+        raise SimClientError(f"job_wait({job_id}) failed: {last}")
+
+    async def wait_all(self) -> None:
+        for job_id in sorted(self.expected_tasks):
+            reply = await self.wait_job(job_id)
+            if reply.get("op") == "error":
+                raise SimClientError(
+                    f"job_wait({job_id}) errored: {reply.get('message')}"
+                )
+
+    # --- chaos ------------------------------------------------------------
+    def chaos_kill_handler(self) -> None:
+        ctx = chaos.last_ctx()
+        if isinstance(ctx, Server):
+            for shard in self.shards:
+                if shard.server is ctx:
+                    shard.kill_now()
+                    break
+        # ctx == "coordinator" (or unknown): only the injecting stack —
+        # the migration driver — dies; every shard keeps running
+        raise SimKilled("chaos kill")
+
+    # --- main --------------------------------------------------------------
+    def run(self, scenario) -> dict:
+        t_wall = _walltime.perf_counter()
+        self.loop = SimEventLoop()
+        asyncio.set_event_loop(self.loop)
+        sim_clock = SimClock(self.loop)
+        prev_clock = clock.install(sim_clock)
+        import random as _random
+        uid_rng = _random.Random(f"fed-uids:{self.seed}")
+        token = lambda n: "%0*x" % (n * 2, uid_rng.getrandbits(n * 8))  # noqa: E731
+        prev_sd_tokens = serverdir.set_token_source(token)
+        prev_tr_tokens = trace_mod.set_token_source(token)
+        prev_plan = chaos._PLAN
+        plan = chaos.FaultPlan({"seed": self.seed, "rules": self.rules}) \
+            if self.rules else None
+        chaos.install_plan(plan)
+        chaos.set_kill_handler(self.chaos_kill_handler)
+        try:
+            return self.loop.run_until_complete(
+                asyncio.wait_for(self._main(scenario), timeout=self.horizon)
+            )
+        finally:
+            chaos.set_kill_handler(None)
+            chaos.install_plan(prev_plan)
+            serverdir.set_token_source(prev_sd_tokens)
+            trace_mod.set_token_source(prev_tr_tokens)
+            clock.install(prev_clock)
+            try:
+                self._drain_loop()
+            finally:
+                try:
+                    self.loop.close()
+                finally:
+                    asyncio.set_event_loop(None)
+            self.wall_s = _walltime.perf_counter() - t_wall
+            if self._own_dir:
+                shutil.rmtree(self.root, ignore_errors=True)
+
+    def _drain_loop(self) -> None:
+        if self.loop is None or self.loop.is_closed():
+            return
+        self._stopping = True
+        for shard in self.shards:
+            if shard.server is not None:
+                shard.kill_now()
+        pending = [t for t in asyncio.all_tasks(self.loop) if not t.done()]
+        for t in pending:
+            t.cancel()
+        if pending:
+            try:
+                self.loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+
+    async def _main(self, scenario) -> dict:
+        self.shards = [
+            _ShardSim(self, k) for k in range(self.shard_count)
+        ]
+        for shard in self.shards:
+            await shard.start_server()
+            self._supervisors.append(
+                self.loop.create_task(shard.supervisor())
+            )
+            for _ in range(self.n_workers_per_shard):
+                shard.add_worker()
+        await scenario(self)
+        await self.wait_all()
+        await asyncio.sleep(max(self.heartbeat_secs, 2.0))
+        makespan = clock.monotonic()
+        audit = self._final_audit()
+        self._stopping = True
+        for t in self._supervisors:
+            t.cancel()
+        for shard in self.shards:
+            shard.client.close()
+            for worker in shard.workers.values():
+                if not worker.dead:
+                    worker.dead = True
+                    if worker._task is not None:
+                        worker._task.cancel()
+                    if worker._link is not None:
+                        worker._link.close()
+        await asyncio.sleep(0.05)
+        for shard in self.shards:
+            server = shard.server
+            if server is not None:
+                if shard._event_tap_task is not None:
+                    shard._event_tap_task.cancel()
+                server._event_listeners.clear()
+                await server.shutdown()
+                shard.server = None
+        if self.monitor.violations:
+            raise InvariantViolation(self.monitor.violations[0])
+        return {
+            "seed": self.seed,
+            "makespan": makespan,
+            "shard_boots": [s.server_boots for s in self.shards],
+            "driver_kills": self.driver_kills,
+            "audit": audit,
+            "violations": list(self.monitor.violations),
+        }
+
+    def _final_audit(self) -> dict:
+        """Fleet-scoped quiescence audit.
+
+        exactly-once: across ALL shard journals each (job, task) has at
+        most one task-finished record (a migrated job's pre-move
+        completions travel inside the migration-in record, never as
+        re-emitted events, so cross-journal counting is sound).
+        single ownership: each job is live on EXACTLY the shard the
+        ownership log routes it to."""
+        from hyperqueue_tpu.events.journal import Journal
+
+        finished: dict[int, int] = {}
+        terminal: set[int] = set()
+        for shard in self.shards:
+            if not shard.journal_path.exists():
+                continue
+            for record in Journal.read_all(shard.journal_path):
+                kind = record.get("event")
+                if kind not in ("task-finished", "task-failed",
+                                "task-canceled"):
+                    continue
+                tid = (int(record["job"]) << 32) | int(record["task"])
+                terminal.add(tid)
+                if kind == "task-finished":
+                    finished[tid] = finished.get(tid, 0) + 1
+        dup = {t: n for t, n in finished.items() if n > 1}
+        if dup:
+            self.monitor._fail(
+                f"cross-shard exactly-once violation: {len(dup)} task(s) "
+                f"finished on more than one shard/incarnation, e.g. "
+                f"{sorted(dup)[:5]}"
+            )
+        # migrated-in completions live inside migration-in records, not
+        # as task events: credit the live servers' terminal counters too
+        done_live: dict[int, int] = {}
+        for shard in self.shards:
+            server = shard.server
+            if server is None:
+                continue
+            for job_id, job in server.jobs.jobs.items():
+                c = job.counters
+                done_live[job_id] = (
+                    c["finished"] + c["failed"] + c["canceled"]
+                )
+        missing = 0
+        for job_id, count in self.expected_tasks.items():
+            done = sum(1 for t in terminal if (t >> 32) == job_id)
+            done = max(done, done_live.get(job_id, 0))
+            if done < count:
+                missing += count - done
+        if missing:
+            self.monitor._fail(
+                f"lost tasks: {missing} submitted task(s) never reached "
+                f"a terminal state anywhere in the fleet"
+            )
+        try:
+            omap = self.store().load()
+        except OSError:
+            omap = None
+        owners_ok = 0
+        for job_id in self.expected_tasks:
+            owner = (
+                omap.shard_for_job(job_id) if omap is not None
+                else (job_id - 1) % self.shard_count
+            )
+            holders = [
+                s.shard_id for s in self.shards
+                if s.server is not None and job_id in s.server.jobs.jobs
+            ]
+            if holders != [owner]:
+                self.monitor._fail(
+                    f"ownership violation: job {job_id} is routed to "
+                    f"shard {owner} but live on {holders}"
+                )
+            owners_ok += 1
+        return {
+            "tasks_terminal": len(terminal),
+            "jobs_owned": owners_ok,
+            "executions": len(self.monitor.exec_started),
+            "events_seen": self.monitor.events_seen,
+        }
+
+
+def run_federated_scenario(scenario, **kwargs) -> dict:
+    """One-call runner (tests use this)."""
+    fed = FederatedSimulation(**kwargs)
+    return fed.run(scenario)

@@ -92,14 +92,18 @@ class ChaosInjectedError(RuntimeError):
 
 class _Rule:
     __slots__ = (
-        "site", "op", "event", "action", "prob", "at", "at_t", "times",
-        "delay_ms", "hang_s", "_matches", "_fired", "_rng",
+        "site", "op", "event", "shard", "action", "prob", "at", "at_t",
+        "times", "delay_ms", "hang_s", "_matches", "_fired", "_rng",
     )
 
     def __init__(self, spec: dict, index: int, seed: int):
         self.site = spec["site"]
         self.op = spec.get("op")
         self.event = spec.get("event")
+        # scope to one federation shard (ISSUE 17): sites fired from a
+        # shard server pass shard=<id>, the migration driver passes
+        # shard=-1 ("the coordinator"); rules without the key match all
+        self.shard = spec.get("shard")
         self.action = spec["action"]
         self.prob = spec.get("prob")
         self.at = spec.get("at")
@@ -114,12 +118,14 @@ class _Rule:
         self._fired = 0
         self._rng = random.Random(f"{seed}:{index}")
 
-    def check(self, site: str, op, event) -> bool:
+    def check(self, site: str, op, event, shard=None) -> bool:
         if site != self.site:
             return False
         if self.op is not None and op != self.op:
             return False
         if self.event is not None and event != self.event:
+            return False
+        if self.shard is not None and shard != self.shard:
             return False
         if self.at_t is not None and clock.now() < self.at_t:
             return False
@@ -143,13 +149,13 @@ class FaultPlan:
         # counters are bumped from the event loop AND the solve thread
         self._lock = threading.Lock()
 
-    def match(self, site: str, op=None, event=None) -> _Rule | None:
+    def match(self, site: str, op=None, event=None, shard=None) -> _Rule | None:
         with self._lock:
             for rule in self.rules:
-                if rule.check(site, op, event):
+                if rule.check(site, op, event, shard):
                     logger.warning(
-                        "chaos: %s at site=%s op=%s event=%s",
-                        rule.action, site, op, event,
+                        "chaos: %s at site=%s op=%s event=%s shard=%s",
+                        rule.action, site, op, event, shard,
                     )
                     return rule
         return None
@@ -219,20 +225,36 @@ def set_kill_handler(handler) -> None:
     _KILL_HANDLER = handler if handler is not None else _kill_self
 
 
-def fire(site: str, op=None, event=None) -> None:
+# context of the most recent fire() that reached the kill handler: a
+# multi-server harness (the federated simulator) installs ONE global kill
+# handler but must know WHICH server (or "the coordinator") hit the rule.
+# Set just before the handler runs; the handler reads it synchronously.
+_LAST_CTX = None
+
+
+def last_ctx():
+    """Context object passed to the fire() that last triggered a kill."""
+    return _LAST_CTX
+
+
+def fire(site: str, op=None, event=None, shard=None, ctx=None) -> None:
     """Synchronous injection point (solve, server.event).
 
     Applies kill/raise/hang/delay inline (delay and hang are BLOCKING
     sleeps — at server.event that stalls the whole event loop, which is
     the point of injecting them there). drop/dup have no meaning at a
     sync site (there is no message to drop); such rules are rejected
-    loudly rather than silently matching and doing nothing."""
+    loudly rather than silently matching and doing nothing. `shard`
+    scopes rule matching; `ctx` is recorded for the kill handler (see
+    :func:`last_ctx`)."""
+    global _LAST_CTX
     if _PLAN is None:
         return
-    rule = _PLAN.match(site, op=op, event=event)
+    rule = _PLAN.match(site, op=op, event=event, shard=shard)
     if rule is None:
         return
     if rule.action == "kill":
+        _LAST_CTX = ctx
         _KILL_HANDLER()
     if rule.action == "raise":
         raise ChaosInjectedError(f"injected failure at {site}")

@@ -241,7 +241,12 @@ def shard_id_of(server_dir: Path) -> int | None:
 
 
 def shard_for_job(job_id: int, shard_count: int) -> int:
-    """The shard owning a job id (static partition; ids are 1-based)."""
+    """The modulo partition primitive (static; ids are 1-based).
+
+    This is only the PRE-MIGRATION fallback since ISSUE 17: live routing
+    must go through ``client/routing.py``'s resolver, which consults the
+    ownership map first (committed migrations and online-added shards
+    re-home job ids away from this arithmetic)."""
     return (int(job_id) - 1) % max(int(shard_count), 1)
 
 
@@ -265,24 +270,83 @@ def write_federation(root: Path, shard_count: int) -> dict:
             if existing["shard_count"] != shard_count:
                 raise ValueError(
                     f"federation at {root} has {existing['shard_count']} "
-                    f"shard(s); refusing to restart it with {shard_count}"
+                    f"shard(s); refusing to restart it with {shard_count} "
+                    f"(online growth goes through grow_federation / "
+                    f"`hq server start --shard-id {existing['shard_count']}"
+                    f" --shards {existing['shard_count'] + 1}`)"
                 )
             return existing
-        record = {"version": 1, "shard_count": int(shard_count)}
-        tmp = root / f".{FEDERATION_FILE}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(record, f, indent=2)
-            f.flush()
-            os.fsync(f.fileno())
-        tmp.replace(root / FEDERATION_FILE)
-        from hyperqueue_tpu.events.journal import fsync_dir
-
-        fsync_dir(root)
+        record = {
+            "version": 1,
+            "shard_count": int(shard_count),
+            # the MODULO partition width, frozen forever: online shard
+            # adds bump shard_count but never this — pre-existing job
+            # ids are baked into the original journal lineages
+            "base_shard_count": int(shard_count),
+        }
+        _publish_federation(root, record)
         for k in range(shard_count):
             shard_path(root, k).mkdir(exist_ok=True)
         return record
     finally:
         os.close(lock_fd)
+
+
+def _publish_federation(root: Path, record: dict) -> None:
+    tmp = root / f".{FEDERATION_FILE}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    tmp.replace(root / FEDERATION_FILE)
+    from hyperqueue_tpu.events.journal import fsync_dir
+
+    fsync_dir(root)
+
+
+def grow_federation(root: Path, shard_count: int) -> dict:
+    """Grow an existing federation to `shard_count` shards ONLINE.
+
+    The explicit growth path (ISSUE 17): rewrites the descriptor with the
+    larger count (base_shard_count unchanged — the modulo partition stays
+    frozen at the boot-time width), creates the new shard dirs, and
+    journals a shard-add record per new shard in the ownership log so
+    clients and the coordinator learn the new member without any restart
+    of the existing shards. Shrinking remains a hard error."""
+    import fcntl
+
+    root = Path(root)
+    lock_fd = os.open(root / ".federation.lock", os.O_CREAT | os.O_RDWR,
+                      0o600)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        existing = load_federation(root)
+        if existing is None:
+            raise ValueError(
+                f"no federation at {root} to grow; boot one with --shards"
+            )
+        old_count = int(existing["shard_count"])
+        if shard_count < old_count:
+            raise ValueError(
+                f"federation at {root} has {old_count} shard(s); shrinking "
+                f"to {shard_count} is not supported — drain instead"
+            )
+        if shard_count == old_count:
+            return existing
+        record = dict(existing)
+        record["shard_count"] = int(shard_count)
+        record["base_shard_count"] = int(existing["base_shard_count"])
+        _publish_federation(root, record)
+        for k in range(shard_count):
+            shard_path(root, k).mkdir(exist_ok=True)
+    finally:
+        os.close(lock_fd)
+    from hyperqueue_tpu.utils.ownership import OwnershipStore
+
+    store = OwnershipStore(root)
+    for k in range(old_count, shard_count):
+        store.record_shard_add(k, shard_count)
+    return record
 
 
 def load_federation(root: Path) -> dict | None:
@@ -296,4 +360,9 @@ def load_federation(root: Path) -> dict | None:
     if int(data.get("shard_count", 0)) < 1:
         raise ValueError(f"malformed federation descriptor {path}")
     data["shard_count"] = int(data["shard_count"])
+    # pre-ISSUE-17 descriptors had no base_shard_count: the federation
+    # never grew, so the modulo width IS the shard count
+    data["base_shard_count"] = int(
+        data.get("base_shard_count", data["shard_count"])
+    )
     return data

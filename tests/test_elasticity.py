@@ -216,7 +216,14 @@ def test_worker_stop_drain_and_escalation(env):
     env.start_worker(cpus=2)
     wait_until(lambda: _job(env, 1)["counters"]["running"] >= 1,
                timeout=30, message="task rerunning after escalation")
-    # restarted once (new instance), never failed: no crash charge
+    # restarted once (new instance), never failed: no crash charge.
+    # the running counter flips when the server ISSUES the task; the
+    # worker's bash appends its start marker a beat later — wait for it
+    wait_until(
+        lambda: len([l for l in marker.read_text().splitlines()
+                     if l.startswith("e:")]) >= 2,
+        timeout=15, message="restart marker written",
+    )
     lines = [l for l in marker.read_text().splitlines()
              if l.startswith("e:")]
     assert len(lines) == 2 and lines[0] != lines[1], lines
