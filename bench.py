@@ -1027,6 +1027,129 @@ def run_chaos_smoke() -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_slo_smoke() -> None:
+    """SLO alerting gate (ISSUE 18): a chaos solve-delay breaches the
+    tick-latency objective on a real server running with compressed
+    alert windows (HQ_SLO_WINDOW_SCALE), the page-severity burn-rate
+    alert fires (observed through `hq alerts`), the chaos plan exhausts,
+    and the alert resolves. Fire/resolve latencies are recorded into
+    benchmarks/results/db.jsonl (experiment slo_smoke)."""
+    import os
+    import tempfile
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv
+
+    # 1 h / 5 m page windows become 36 s / 3 s; evaluation every 0.3 s
+    scale = 0.01
+    delay_ms = 400.0      # > the 250 ms tick objective, < the 5 s watchdog
+    chaos_fires = 50      # the bad era ends by exhaustion, then resolves
+    plan = json.dumps({"rules": [
+        {"site": "solve", "action": "delay",
+         "delay_ms": delay_ms, "times": chaos_fires},
+    ]})
+    env_extra = {
+        "HQ_SLO_WINDOW_SCALE": str(scale),
+        "HQ_FAULT_PLAN": plan,
+    }
+    failures = []
+    fired = None
+    fire_s = resolve_s = None
+    t_wall = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        with HqEnv(tmp) as env:
+            env.start_server(env_extra=env_extra)
+            env.start_worker(cpus=4)
+            env.wait_workers(1)
+
+            def alerts():
+                out = json.loads(env.command(
+                    ["alerts", "--output-mode", "json"]
+                ))
+                # no-alerts renders as a {"message": ...} record, firing
+                # alerts as a list of table rows
+                return out if isinstance(out, list) else []
+
+            # bad era: every solve is delayed past the objective. Keep
+            # the scheduler ticking with small arrays, polling WITHOUT
+            # waiting for completion — the alert must be caught while
+            # the chaos plan still has fires left.
+            t0 = time.perf_counter()
+            deadline = t0 + 60
+            batch = 0
+            while time.perf_counter() < deadline and fired is None:
+                env.command([
+                    "submit", "--array", "0-3", "--", "true",
+                ])
+                batch += 1
+                hits = [a for a in alerts()
+                        if a["slo"] == "tick-latency"
+                        and a["state"] == "firing"]
+                if hits:
+                    fired = hits[0]
+                    fire_s = round(time.perf_counter() - t0, 2)
+            if fired is None:
+                failures.append(
+                    "tick-latency alert never fired under the chaos "
+                    "solve-delay"
+                )
+            elif fired["severity"] != "page":
+                failures.append(f"expected a page alert, got {fired}")
+
+            # good era: drain the backlog (exhausting the chaos fires),
+            # then the short window clears and the alert must resolve
+            env.command(["job", "wait", "all"], timeout=120)
+            t1 = time.perf_counter()
+            deadline = t1 + 90
+            while time.perf_counter() < deadline and resolve_s is None:
+                if not [a for a in alerts()
+                        if a["slo"] == "tick-latency"]:
+                    resolve_s = round(time.perf_counter() - t1, 2)
+                    break
+                time.sleep(0.5)
+            if fired is not None and resolve_s is None:
+                failures.append(
+                    "tick-latency alert never resolved after the chaos "
+                    "plan exhausted"
+                )
+
+    emit({
+        "experiment": "slo_smoke",
+        "metric": "alert_fire_seconds",
+        "value": fire_s if fire_s is not None else 0.0,
+        "unit": "s",
+        "params": {
+            "window_scale": scale, "delay_ms": delay_ms,
+            "chaos_fires": chaos_fires, "slo": "tick-latency",
+        },
+        "alert_resolve_seconds": resolve_s if resolve_s is not None else 0.0,
+        "submit_batches": batch,
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="slo_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} slo_smoke metric(s) "
+                      f"within 20% of baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
+    print("slo-smoke:", "OK" if not failures else failures)
+    sys.exit(1 if failures else 0)
+
+
 def run_federation_smoke() -> None:
     """Federated failover gate (ISSUE 11): 2 shards + a warm standby.
 
@@ -3092,6 +3215,12 @@ def main() -> None:
                         help="one seeded kill -9/restart cycle: workers "
                              "reconnect + reattach, job completes, zero "
                              "duplicate executions")
+    parser.add_argument("--slo-smoke", action="store_true",
+                        help="SLO alerting gate: a chaos solve-delay "
+                             "breaches the tick budget under compressed "
+                             "alert windows, the burn-rate page fires in "
+                             "`hq alerts` and resolves after the chaos "
+                             "lifts; latencies recorded into db.jsonl")
     parser.add_argument("--metrics", action="store_true",
                         help="end-to-end metrics gate: scrape the server's "
                              "Prometheus endpoint before/after a 1k-task "
@@ -3224,6 +3353,10 @@ def main() -> None:
 
     if args.saturation_smoke:
         run_saturation_smoke(args)
+        return
+
+    if args.slo_smoke:
+        run_slo_smoke()
         return
 
     if args.federation_smoke:

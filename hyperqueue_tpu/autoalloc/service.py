@@ -523,6 +523,23 @@ class AutoAllocService:
         ]
         if not eligible:
             return
+        # SLO gate (ISSUE 18): while a page-severity burn-rate alert is
+        # firing, the control plane is already failing its objectives —
+        # buying MORE workers would pile registration/dispatch load onto
+        # a struggling server (and spend allocation budget on capacity
+        # it cannot drive). Hold scale-up, with a verdict per queue so
+        # `hq alloc events` explains the pause; ticket-severity alerts
+        # do not gate (slow burn leaves time for capacity to help).
+        slo = getattr(self.server, "slo", None)
+        paging = slo.paging_alerts() if slo is not None else []
+        if paging:
+            names = ",".join(sorted(a["alert"] for a in paging))
+            for queue in eligible:
+                self.controller.record(
+                    queue.queue_id, "hold", "slo-page",
+                    f"scale-up held: page alert(s) firing ({names})",
+                )
+            return
         response = compute_new_worker_query(
             self.server.core,
             self.server.model,

@@ -1559,6 +1559,132 @@ def cmd_server_reset_metrics(args) -> None:
     out.message("metrics reset")
 
 
+_ACCOUNTING_HEADER = [
+    "job", "label", "task s", "cpu s", "gpu s", "wait s", "crash",
+    "runs", "done", "fail", "run",
+]
+
+
+def cmd_job_accounting(args) -> None:
+    """Per-job usage ledger rows (ISSUE 18): closed run-span charges
+    folded from the journal — stable under restore/replay/migration."""
+    out = make_output(args.output_mode)
+    with _session(args) as session:
+        ids = _resolve_job_selector(session, args.selector)
+        result = session.request({"op": "accounting", "job_ids": ids})
+    rows = result.get("jobs") or []
+    if not rows:
+        fail("no accounting rows for that selector")
+    out.table(
+        _ACCOUNTING_HEADER,
+        [
+            [
+                r["job"], r["label"],
+                f"{r['task_seconds']:.3f}",
+                f"{r['cpu_seconds']:.3f}",
+                f"{r['gpu_seconds']:.3f}",
+                f"{r['wait_seconds']:.3f}",
+                r["crash_retries"], r["runs"], r["finished"],
+                r["failed"], r["running"],
+            ]
+            for r in rows
+        ],
+    )
+
+
+def cmd_fleet_accounting(args) -> None:
+    """Per-label usage rollup across every shard (`hq fleet accounting`;
+    also answers on a classic dir as a single-shard rollup)."""
+    out = make_output(args.output_mode)
+    with _session(args) as session:
+        if isinstance(session, FederatedSession):
+            result = session.request({"op": "accounting", "shard": "all"})
+            records = [
+                rec for rec in result["shards"] if not rec.get("error")
+            ]
+        else:
+            records = [session.request({"op": "accounting"})]
+    header = ["shard", "label", "jobs", "task s", "cpu s", "gpu s",
+              "wait s", "crash", "run"]
+    rows = []
+    for rec in records:
+        rollup = rec.get("rollup") or {}
+        shard = rec.get("shard", 0)
+        for label, agg in (rollup.get("labels") or {}).items():
+            rows.append([
+                shard, label, agg["jobs"],
+                f"{agg['task_seconds']:.3f}",
+                f"{agg['cpu_seconds']:.3f}",
+                f"{agg['gpu_seconds']:.3f}",
+                f"{agg['wait_seconds']:.3f}",
+                agg["crash_retries"], agg["running"],
+            ])
+        totals = rollup.get("totals")
+        if totals and totals["jobs"]:
+            rows.append([
+                shard, "(total)", totals["jobs"],
+                f"{totals['task_seconds']:.3f}",
+                f"{totals['cpu_seconds']:.3f}",
+                f"{totals['gpu_seconds']:.3f}",
+                f"{totals['wait_seconds']:.3f}",
+                totals["crash_retries"], totals["running"],
+            ])
+    if not rows:
+        out.message("no usage recorded yet")
+        return
+    out.table(header, rows)
+
+
+def cmd_alerts(args) -> None:
+    """`hq alerts [--shard K|all]`: firing SLO burn-rate alerts + the
+    most recent transitions, per shard."""
+    out = make_output(args.output_mode)
+    shard = getattr(args, "shard", None)
+    with _session(args) as session:
+        if isinstance(session, FederatedSession):
+            result = session.request(
+                {"op": "alerts", "shard": shard if shard is not None
+                 else "all"}
+            )
+            records = result.get("shards") or [result]
+        else:
+            if shard is not None:
+                fail(f"--shard needs a federation root; "
+                     f"{_server_dir(args)} is a classic server dir")
+            records = [session.request({"op": "alerts"})]
+    rows = []
+    for rec in records:
+        if rec.get("error"):
+            rows.append([rec.get("shard_id", "?"), "shard-availability",
+                         "page", "DOWN", "-", "-"])
+            continue
+        for alert in rec.get("firing") or []:
+            rows.append([
+                rec.get("shard", 0), alert["slo"], alert["severity"],
+                "firing",
+                f"{alert['burn_rate']:.2f}x",
+                "/".join(f"{w:g}s" for w in alert.get("window") or ()),
+            ])
+    if rows:
+        out.table(
+            ["shard", "slo", "severity", "state", "burn", "windows"],
+            rows,
+        )
+    else:
+        out.message("no alerts firing")
+    recent = [
+        t for rec in records if not rec.get("error")
+        for t in rec.get("recent") or []
+    ]
+    if recent and args.output_mode == "cli":
+        out.message("recent transitions:")
+        for t in recent[-10:]:
+            out.message(
+                f"  {t['alert']}: {t['state']} "
+                f"(burn {t['burn_rate']:.2f}x)"
+            )
+
+
 def cmd_job_cancel(args) -> None:
     with _session(args) as session:
         ids = _resolve_job_selector(session, args.selector)
@@ -2708,6 +2834,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tasks", action="store_true",
                    help="include every task's timestamps (json mode)")
     p.set_defaults(fn=cmd_job_timeline)
+    p = jsub.add_parser(
+        "accounting",
+        help="usage ledger: task/cpu/gpu/wait seconds and crash-charged "
+             "retries per job, folded from the journal (survives "
+             "restarts and live migration exactly-once)",
+    )
+    _add_common(p)
+    p.add_argument("selector")
+    p.set_defaults(fn=cmd_job_accounting)
     p = jsub.add_parser("submit", help="alias of top-level `hq submit`")
     _add_submit_args(p)
     p = jsub.add_parser("task-ids", help="print task ids of selected jobs")
@@ -2982,6 +3117,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "in the ownership log by a crashed driver, then "
                         "exit (no job/shard arguments needed)")
     p.set_defaults(fn=cmd_fleet_migrate)
+    p = fsub.add_parser(
+        "accounting",
+        help="per-label usage rollup for every shard (task/cpu/gpu/wait "
+             "seconds, crash retries) from each shard's ledger",
+    )
+    _add_common(p)
+    p.set_defaults(fn=cmd_fleet_accounting)
+
+    # alerts: SLO burn-rate alert state (ISSUE 18)
+    p = sub.add_parser(
+        "alerts",
+        help="firing SLO burn-rate alerts + recent transitions "
+             "(tick latency, submit-ack, queue age, restore duration, "
+             "shard availability)",
+    )
+    _add_common(p)
+    p.add_argument("--shard", default=None, metavar="K|all",
+                   help="federation: which shard to query (default all)")
+    p.set_defaults(fn=cmd_alerts)
 
     # doc + completion
     p = sub.add_parser("doc", help="show documentation topics")

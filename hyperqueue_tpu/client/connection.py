@@ -362,7 +362,8 @@ class FederatedSession:
             return self.submit_session().request(msg, timeout)
         shard = msg.pop("shard", None)
         if shard in ("all", -1, "-1") and op in (
-            "server_info", "server_stats", "reset_metrics"
+            "server_info", "server_stats", "reset_metrics", "alerts",
+            "accounting",
         ):
             # per-shard fan-out: one record per shard (tick latencies and
             # lease states are per-shard facts — never summed; a

@@ -105,12 +105,29 @@ def _render(sample: dict, ticker: deque, dropped: int) -> str:
     return "\n".join(lines)
 
 
+def _alert_badge(sample: dict | None, state: str) -> str:
+    """SLO alert badge for one shard (ISSUE 18). A DOWN shard has no
+    sample to carry its badge, but its very downness IS the
+    shard-availability SLO breach — render that instead of a blank."""
+    if sample is None:
+        return "avail!" if state != "up" else "-"
+    alerts = sample.get("alerts") or {}
+    firing = alerts.get("firing", 0)
+    if not firing:
+        return "ok"
+    worst = alerts.get("worst") or "page"
+    mark = "!" if worst == "page" else "~"
+    return f"{firing}{mark}{worst}"
+
+
 def _fleet_row(shard: int, state: str, sample: dict | None) -> str:
     """One shard's line in the fleet table (DOWN shards render a row —
     that is the whole point; the client never crashes on a dead shard)."""
+    badge = _alert_badge(sample, state)
     if sample is None:
         return f"{shard:>5} {state.upper():<9} {'-':>5} {'-':>7} " \
-               f"{'-':>7} {'-':>7} {'-':>7} {'-':>6} {'-':>8} {'-':>5}"
+               f"{'-':>7} {'-':>7} {'-':>7} {'-':>6} {'-':>8} {'-':>5} " \
+               f"{badge:>7}"
     fed = sample.get("federation") or {}
     lag = (sample.get("lag") or {}).get("loop") or {}
     label = "UP"
@@ -127,7 +144,8 @@ def _fleet_row(shard: int, state: str, sample: dict | None) -> str:
         f"{len(sample.get('pending_reasons') or {}):>6} "
         + (f"{lag['last_ms']:>8.1f} " if lag.get("last_ms") is not None
            else f"{'-':>8} ")
-        + f"{sample.get('alloc_quarantined', 0):>5}"
+        + f"{sample.get('alloc_quarantined', 0):>5} "
+        + f"{badge:>7}"
     )
 
 
@@ -165,7 +183,7 @@ def _render_fleet(states: dict, samples: dict, ticker: deque,
         f"hq fleet — {len(states)} shard(s), {up} up",
         f"{'shard':>5} {'state':<9} {'epoch':>5} {'workers':>7} "
         f"{'borrow':>7} {'running':>7} {'backlog':>7} {'wait':>6} "
-        f"{'lag ms':>8} {'quar':>5}",
+        f"{'lag ms':>8} {'quar':>5} {'alerts':>7}",
     ]
     for shard in sorted(states):
         state = "up" if states[shard] == "up" else "down"

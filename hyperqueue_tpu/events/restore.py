@@ -216,6 +216,9 @@ def _seed_from_snapshot(server, acc: _RestoreAcc, state: dict) -> None:
     _seed_autoalloc(acc, state.get("autoalloc"))
     acc.n_boots = state["n_boots"]
     server.journal_uids.update(state.get("server_uids") or ())
+    # usage ledger at the snapshot watermark (ISSUE 18); None for
+    # pre-accounting snapshots — the tail replay refills what it can
+    server.accounting.seed(state.get("accounting"))
     if state["seq"] > server._event_seq:
         server._event_seq = state["seq"]
     # forgotten jobs are absent from the snapshot but their ids must not be
@@ -374,6 +377,13 @@ def _replay_record(server, acc: _RestoreAcc, record: dict) -> None:
     """One journal record into the accumulators (phase 2 / full replay)."""
     kind = record.get("event")
     job_id = record.get("job")
+    # usage-ledger fold (ISSUE 18): the same observe() the live emit
+    # path runs, on the same records in the same order — replay rebuilds
+    # the ledger bit-equal to the crashed instance's. getattr: test
+    # harnesses replay into bare fakes that carry no ledger
+    ledger = getattr(server, "accounting", None)
+    if ledger is not None:
+        ledger.observe(kind, record)
     if kind == "job-submitted":
         desc = record.get("desc") or {}
         job = server.jobs.jobs.get(job_id)
@@ -782,6 +792,7 @@ def restore_from_journal(server) -> None:
             server.journal_uids = set()
             server._event_seq = 0
             server._stream_jobs = {}
+            server.accounting.seed(None)
             acc = _RestoreAcc()
 
     # --- phase 2: journal tail replay ----------------------------------

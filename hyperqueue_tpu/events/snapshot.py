@@ -127,6 +127,16 @@ def capture_state(server) -> dict:
         "n_boots": server.n_boots,
         "server_uids": sorted(server.journal_uids),
         "next_job_id": server.jobs.job_id_counter.peek(),
+        # usage ledger as of the SAME watermark (ISSUE 18): capture runs
+        # synchronously between emits, so the captured rows correspond
+        # exactly to the events with seq < watermark — a snapshot-seeded
+        # restore is bit-equal to a full replay. Optional on read:
+        # pre-accounting snapshots seed an empty ledger.
+        "accounting": (
+            server.accounting.capture()
+            if getattr(server, "accounting", None) is not None
+            else None
+        ),
         "bodies": bodies,
         "requests": requests,
         "jobs": jobs_out,

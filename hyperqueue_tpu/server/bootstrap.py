@@ -25,6 +25,7 @@ from hyperqueue_tpu.models.greedy import GreedyCutScanModel
 from hyperqueue_tpu.models.milp import MilpModel
 from hyperqueue_tpu.models.multichip import MultichipModel
 from hyperqueue_tpu.server import reactor
+from hyperqueue_tpu.server.accounting import ACCOUNTED_KINDS, AccountingLedger
 from hyperqueue_tpu.server.core import Core
 from hyperqueue_tpu.server.ingest import (
     INGEST_CHUNKS,
@@ -43,6 +44,7 @@ from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
 from hyperqueue_tpu.transport.aead import WIRE_BACKEND
 from hyperqueue_tpu.utils import chaos
 from hyperqueue_tpu.utils.metrics import REGISTRY
+from hyperqueue_tpu.utils.slo import SloEngine
 from hyperqueue_tpu.utils.trace import TRACER
 from hyperqueue_tpu.transport.auth import (
     ROLE_CLIENT,
@@ -102,6 +104,16 @@ _DRAIN_SECONDS = REGISTRY.histogram(
     "hq_autoalloc_drain_seconds",
     "drain latency: drain start to the worker being told to stop",
     buckets=(0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 300.0),
+)
+# queue-age distribution backing the queue-age SLO (utils/slo.py): how
+# long each dispatched task sat READY before being assigned. Buckets
+# stretch past the default latency decades — queue ages are minutes on
+# a saturated cluster, not milliseconds.
+_TASK_QUEUE_AGE = REGISTRY.histogram(
+    "hq_task_queue_age_seconds",
+    "ready -> assigned latency of dispatched tasks",
+    buckets=(0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+             1800.0, 7200.0),
 )
 
 # default deadline for a drain nobody bounded explicitly
@@ -386,6 +398,35 @@ class EventBridge:
             "assigned_at": task.t_assigned if task else 0.0,
             "started_at": started_at,
         }
+        # resource amounts (human units) ride the journal record so the
+        # accounting fold is journal-self-contained: a restored or
+        # migrated-to server charges the same usage without the core
+        # task's request tables (server/accounting.py)
+        if task is not None:
+            names = self.server.core.resource_map.names()
+            gang = max(len(worker_ids), 1)
+            usage: dict[str, float] = {}
+            worker0 = (
+                self.server.core.workers.get(worker_ids[0])
+                if worker_ids else None
+            )
+            for rid, amount in self.server.core.variant_amounts(
+                task.rq_id, variant, worker0
+            ):
+                if amount > 0 and rid < len(names):
+                    usage[names[rid]] = (
+                        usage.get(names[rid], 0.0)
+                        + (amount / 10_000) * gang
+                    )
+            if usage:
+                payload["usage"] = usage
+            # queue-age SLO input: READY -> ASSIGNED latency (a reattach
+            # re-emit carries the original stamps and would re-observe;
+            # skip it — instance 0 reattaches are rare enough that the
+            # p95 is unaffected, and restarts legitimately re-observe)
+            queued, assigned = payload["queued_at"], payload["assigned_at"]
+            if queued and assigned and assigned >= queued:
+                _TASK_QUEUE_AGE.observe(assigned - queued)
         # the worker-side stamps + trace id ride the journal event so a
         # restored server rebuilds the SAME trace (replay feeds them back
         # through events/restore.py)
@@ -732,6 +773,13 @@ class Server:
         self.jobs = JobManager()
         self.comm = CommSender()
         self.events = EventBridge(self)
+        # production health plane (ISSUE 18): the usage ledger folds the
+        # SAME records the journal persists (live emit, replay, and
+        # migration import all call observe — bit-equal by construction);
+        # the SLO engine judges the metrics registry on sliding windows
+        # from _slo_loop and journals alert transitions
+        self.accounting = AccountingLedger()
+        self.slo = SloEngine()
         # lazy materialization needs the CURRENT job manager (restore may
         # swap it out on a snapshot fallback): bind a getter, not the object
         self.core.lazy.jobs_getter = lambda: self.jobs
@@ -986,10 +1034,13 @@ class Server:
                 await start_metrics_server(
                     REGISTRY, self.requested_metrics_port,
                     host=self.metrics_host,
+                    probes={"/healthz": self._probe_healthz,
+                            "/readyz": self._probe_readyz},
                 )
             )
             logger.info(
-                "metrics endpoint on http://%s:%d/metrics",
+                "metrics endpoint on http://%s:%d/metrics "
+                "(+ /healthz /readyz)",
                 self.metrics_host, self.metrics_port,
             )
 
@@ -1021,6 +1072,7 @@ class Server:
         self._tasks.append(self._spawn_loop(self._heartbeat_reaper))
         self._tasks.append(self._spawn_loop(self._drain_reaper))
         self._tasks.append(self._spawn_loop(self._loop_lag_monitor))
+        self._tasks.append(self._spawn_loop(self._slo_loop))
         if self.federation_root is not None and self.failover_watch:
             # idle-peer successor mode: this shard claims dead siblings,
             # but only while its own ready backlog is empty (a drowning
@@ -1279,6 +1331,100 @@ class Server:
             "jobs_migrated_in": len(self.migrations_in),
         }
 
+    # --- health plane (ISSUE 18) ----------------------------------------
+    async def _slo_loop(self) -> None:
+        """Periodic SLO evaluation (utils/slo.py): judge the metrics
+        registry on sliding windows and JOURNAL every alert transition —
+        firing/resolved ride the subscribe plane and the FleetFeed like
+        any other event, and a restored server re-derives alert state
+        from fresh windows rather than trusting stale ones."""
+        while True:
+            await asyncio.sleep(self.slo.interval)
+            for transition in self.slo.evaluate():
+                self.emit_event("slo-alert", transition)
+
+    def _probe_healthz(self) -> tuple[bool, dict]:
+        """Liveness: the probe answering at all IS the signal (it runs
+        on the reactor loop — a wedged loop cannot reply). Only a fatal
+        journal-plane death marks a live process unhealthy: the process
+        exists but has lost its durability guarantee."""
+        if self.jplane is not None and self.jplane._thread is not None \
+                and not self.jplane._thread.is_alive():
+            return False, {"reason": "journal plane dead"}
+        return True, {"uptime": round(clock.now() - self.started_at, 3)}
+
+    def _probe_readyz(self) -> tuple[bool, dict]:
+        """Readiness: should an orchestrator (or the standby/rebalancer)
+        route work here? Every check is O(1) reads of live state."""
+        checks: dict[str, str] = {}
+        ok = True
+        if self.jplane is not None:
+            alive = (
+                self.jplane._thread is not None
+                and self.jplane._thread.is_alive()
+            )
+            checks["journal_plane"] = "ok" if alive else "dead"
+            ok = ok and alive
+        if self.lease is not None:
+            age = self.lease.age_seconds()
+            held = (
+                not self.fenced
+                and age is not None
+                and age < self.lease_timeout
+            )
+            checks["lease"] = (
+                "ok" if held else
+                ("fenced" if self.fenced else "stale")
+            )
+            ok = ok and held
+        armed = bool(self.model.stats().get("armed"))
+        checks["solver"] = "ok" if armed else "degraded"
+        ok = ok and armed
+        if self.ingest_plane is not None:
+            depth = len(self.ingest_plane.handoff)
+            below = depth < self.ingest_handoff_max
+            checks["ingest"] = (
+                "ok" if below else f"backpressure ({depth})"
+            )
+            ok = ok and below
+        paging = self.slo.paging_alerts()
+        checks["slo"] = (
+            "ok" if not paging else
+            "paging: " + ",".join(a["alert"] for a in paging)
+        )
+        ok = ok and not paging
+        return ok, {"checks": checks}
+
+    async def _client_accounting(self, msg: dict) -> dict:
+        """Usage ledger query (`hq job accounting` / `hq fleet
+        accounting`): per-job rows for an explicit selection, or the
+        per-label rollup when none is given."""
+        job_ids = msg.get("job_ids")
+        out: dict = {"op": "accounting", "shard": self.shard_id}
+        if job_ids:
+            report = self.accounting.job_report(
+                [int(j) for j in job_ids]
+            )
+            # a LIST (each row carries its job id): the federated client
+            # splits a selector across shards and merges responses by
+            # list concatenation — a dict keyed by job id would silently
+            # keep only the first shard's rows
+            out["jobs"] = [
+                {"job": j, **row} for j, row in sorted(report.items())
+            ]
+        else:
+            out["rollup"] = self.accounting.rollup()
+        return out
+
+    async def _client_alerts(self, msg: dict) -> dict:
+        """`hq alerts`: currently-firing SLO alerts + recent transitions
+        (fan-out across shards happens client-side, like server_stats)."""
+        return {"op": "alerts", "shard": self.shard_id,
+                **self.slo.alerts()}
+
+    def _alert_badge(self) -> dict:
+        return self.slo.badge()
+
     async def _client_worker_lend(self, msg: dict) -> dict:
         """Lend an IDLE worker to another shard: order it to re-register
         there (federation coordinator RPC). No task state moves — that is
@@ -1416,6 +1562,11 @@ class Server:
             "job_state": snapshot_mod.capture_job(
                 self, job, bodies, body_index, requests, request_index
             ),
+            # accrued usage rides the record (ISSUE 18): the destination
+            # seeds it from the journaled migration-in, the source drops
+            # its row at the migration-out-done tombstone — the ledger
+            # moves exactly once, with the job
+            "accounting": self.accounting.export_job(job_id),
         }
         return {"op": "migration_export", "mig": mig, "record": record}
 
@@ -1703,6 +1854,50 @@ class Server:
                 ).set(OwnershipStore(self.federation_root).current_epoch())
             except OSError:
                 pass
+        # usage accounting rollup (ISSUE 18): per-label resource-time
+        # totals from the ledger, rebuilt each scrape so labels whose jobs
+        # all migrated away vanish instead of lingering at stale values
+        rollup = self.accounting.rollup()
+        acct_jobs = REGISTRY.gauge(
+            "hq_accounting_jobs",
+            "jobs with accrued usage in the ledger, by job label",
+            labels=("label",), max_series=256,
+        )
+        acct_task = REGISTRY.counter(
+            "hq_accounting_task_seconds_total",
+            "wall-clock task execution seconds accrued, by job label",
+            labels=("label",), max_series=256,
+        )
+        acct_cpu = REGISTRY.counter(
+            "hq_accounting_cpu_seconds_total",
+            "cpu-seconds accrued (amount x run seconds), by job label",
+            labels=("label",), max_series=256,
+        )
+        acct_gpu = REGISTRY.counter(
+            "hq_accounting_gpu_seconds_total",
+            "gpu-seconds accrued (amount x run seconds), by job label",
+            labels=("label",), max_series=256,
+        )
+        acct_wait = REGISTRY.counter(
+            "hq_accounting_wait_seconds_total",
+            "ready -> running wait seconds accrued, by job label",
+            labels=("label",), max_series=256,
+        )
+        acct_crash = REGISTRY.counter(
+            "hq_accounting_crash_retries_total",
+            "crash-charged task retries, by job label",
+            labels=("label",), max_series=256,
+        )
+        for metric in (acct_jobs, acct_task, acct_cpu, acct_gpu,
+                       acct_wait, acct_crash):
+            metric.clear()
+        for label, agg in rollup["labels"].items():
+            acct_jobs.labels(label).set(agg["jobs"])
+            acct_task.labels(label).set_total(agg["task_seconds"])
+            acct_cpu.labels(label).set_total(agg["cpu_seconds"])
+            acct_gpu.labels(label).set_total(agg["gpu_seconds"])
+            acct_wait.labels(label).set_total(agg["wait_seconds"])
+            acct_crash.labels(label).set_total(agg["crash_retries"])
         trace_stats = core.traces.stats()
         REGISTRY.gauge(
             "hq_task_traces", "tasks with spans in the bounded trace store"
@@ -1906,10 +2101,22 @@ class Server:
             and not self._event_listeners
             and not self._subscribers
         ):
-            return  # nobody consumes events; skip record construction
+            # nobody persists or streams events; the accounting fold
+            # still consumes its kinds (journal-less sim/dev servers)
+            if kind in ACCOUNTED_KINDS:
+                self.accounting.observe(
+                    kind,
+                    {"time": clock.now(), "event": kind, **payload},
+                )
+            return
         record = {"time": clock.now(), "seq": self._event_seq,
                   "event": kind, **payload}
         self._event_seq += 1
+        # fold BEFORE the append, on the exact record the journal gets:
+        # snapshot capture runs synchronously between emits, so a captured
+        # ledger corresponds exactly to `seq < watermark` — live fold and
+        # kill -9 replay are bit-identical by construction
+        self.accounting.observe(kind, record)
         if self.jplane is not None:
             # journal plane (server/journal_plane.py): the append is an
             # enqueue; the commit thread group-writes (+ flushes/fsyncs
@@ -3182,6 +3389,10 @@ class Server:
         self.model.reset_stats()
         self.core.tick_cache.full_rebuilds = 0
         self.core.tick_cache.incremental_syncs = 0
+        # SLO windows + alert state clear with the measurement window
+        # (ISSUE 18): steady-state burn rates must not inherit a breach
+        # that happened before the reset
+        self.slo.reset()
         return {"op": "ok"}
 
     async def _client_metrics_render(self, msg: dict) -> dict:
@@ -4599,6 +4810,11 @@ class Server:
             "lag": self.lag.snapshot(),
             "stalls": self.stalls_captured,
             "subscribers": len(self._subscribers),
+            # health plane (ISSUE 18): usage totals + alert badge ride
+            # every sample so `hq top` / the FleetFeed render both
+            # without extra RPCs
+            "accounting": self.accounting.brief(),
+            "alerts": self._alert_badge(),
         }
         if self.federation_root is not None:
             # fleet view context (ISSUE 15) — all in-memory reads, no

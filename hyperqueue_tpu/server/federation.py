@@ -652,14 +652,35 @@ class FailoverWatcher:
         self.eligible = eligible
         self.promoted: dict[int, object] = {}
         self._promoted_tasks: dict[int, asyncio.Task] = {}
+        # /readyz input (ISSUE 18): monotonic stamp of the last scan that
+        # COMPLETED (a scan that raised does not count as a heartbeat) —
+        # distinguishes a standby whose lease-scan loop died or wedged
+        # from a healthy idle one
+        self.last_scan: float = 0.0
+        # optional SLO engine (utils/slo.py): the standby is where
+        # hq_federation_shard_up lives, so shard-availability burn rates
+        # are evaluated here, piggybacked on the scan cadence
+        self.slo = None
 
     async def run(self) -> None:
         while True:
             await asyncio.sleep(self.poll)
             try:
                 await self.scan_once()
+                self.last_scan = clock.monotonic()
             except Exception:  # noqa: BLE001 - watcher must outlive scans
                 logger.exception("failover scan failed")
+            if self.slo is not None:
+                try:
+                    for transition in self.slo.evaluate():
+                        logger.warning(
+                            "slo %s [%s]: %s (burn %.2f over %s)",
+                            transition["slo"], transition["severity"],
+                            transition["state"], transition["burn_rate"],
+                            transition["window"][0],
+                        )
+                except Exception:  # noqa: BLE001 - alerting is advisory
+                    logger.exception("slo evaluation failed")
 
     async def scan_once(self) -> None:
         fed = serverdir.load_federation(self.root)
@@ -789,6 +810,18 @@ async def standby_main(
             root, sample_interval=sample_interval, rebalance=rebalance
         )
         coordinator.start()
+    watcher = FailoverWatcher(
+        root,
+        server_kwargs=server_kwargs,
+        lease_timeout=lease_timeout,
+        poll=poll,
+    )
+    # the standby's registry is where hq_federation_shard_up lives, so
+    # the shard-availability SLO is evaluated here (riding the scan
+    # loop); transitions land in hq_slo_* gauges on this endpoint
+    from hyperqueue_tpu.utils.slo import SloEngine
+
+    watcher.slo = SloEngine()
     metrics_server = None
     if metrics_port is not None:
         # the standby is the process that SURVIVES shard deaths, so its
@@ -796,19 +829,34 @@ async def standby_main(
         # scrapeable through a failover (ISSUE 15)
         from hyperqueue_tpu.utils.metrics import start_metrics_server
 
+        def _probe_healthz():
+            return True, {"role": "standby"}
+
+        def _probe_readyz():
+            # ready = the lease-scan loop is actually turning over: the
+            # last COMPLETED scan is recent. A standby whose watcher task
+            # died or wedged keeps serving /metrics (the endpoint is a
+            # separate task) but must fail readiness — it can no longer
+            # promote into a dead shard.
+            stale_after = max(3.0 * watcher.poll, 1.0)
+            if watcher.last_scan <= 0.0:
+                return False, {"role": "standby",
+                               "checks": {"scan": "never ran"}}
+            age = clock.monotonic() - watcher.last_scan
+            ok = age < stale_after
+            detail = "ok" if ok else f"stale ({age:.1f}s)"
+            return ok, {"role": "standby", "checks": {"scan": detail},
+                        "promoted_shards": sorted(watcher.promoted)}
+
         metrics_server, bound = await start_metrics_server(
-            REGISTRY, metrics_port, host=metrics_host
+            REGISTRY, metrics_port, host=metrics_host,
+            probes={"/healthz": _probe_healthz, "/readyz": _probe_readyz},
         )
         print(
-            f"| standby metrics on http://{metrics_host}:{bound}/metrics",
+            f"| standby metrics on http://{metrics_host}:{bound}/metrics"
+            " (+ /healthz /readyz)",
             flush=True,
         )
-    watcher = FailoverWatcher(
-        root,
-        server_kwargs=server_kwargs,
-        lease_timeout=lease_timeout,
-        poll=poll,
-    )
     logger.warning(
         "standby ready: watching %d shard(s) at %s (lease timeout %.1fs)",
         fed["shard_count"], root, lease_timeout,

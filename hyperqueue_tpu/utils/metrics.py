@@ -388,16 +388,24 @@ REGISTRY = MetricsRegistry()
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-async def start_exposition_server(render, port: int, host: str = "0.0.0.0"):
+async def start_exposition_server(render, port: int, host: str = "0.0.0.0",
+                                  probes: dict | None = None):
     """Serve GET /metrics on (host, port), answering with render()'s text
     (render may be sync or async). Returns (asyncio server, bound port) —
     pass port 0 for an ephemeral port (tests/CI).
 
+    ``probes`` maps extra paths (``/healthz``, ``/readyz``) to callables
+    returning ``(ok, detail_dict)``; they answer 200/503 with a JSON body
+    (ISSUE 18 health plane). Probes served off the same loop as the
+    process's reactor are truthful by construction: a wedged loop cannot
+    answer at all, which is the failure an orchestrator treats as down.
+
     Deliberately minimal HTTP/1.0-style handling: read the request head,
     answer one response, close. A metrics endpoint needs no keep-alive, no
-    TLS, no routing beyond /metrics."""
+    TLS, no routing beyond /metrics and the probe paths."""
     import asyncio
     import inspect
+    import json
 
     async def handle(reader, writer):
         try:
@@ -408,7 +416,24 @@ async def start_exposition_server(render, port: int, host: str = "0.0.0.0"):
                     break
             parts = request.split()
             path = parts[1].decode("latin-1") if len(parts) > 1 else "/"
-            if path.split("?")[0] in ("/", "/metrics"):
+            path = path.split("?")[0]
+            if probes and path in probes:
+                try:
+                    ok, detail = probes[path]()
+                except Exception:  # noqa: BLE001 - a broken check IS unready
+                    ok, detail = False, {"error": "probe raised"}
+                body = (
+                    json.dumps({"ok": bool(ok), **(detail or {})},
+                               sort_keys=True) + "\n"
+                ).encode("utf-8")
+                status = "200 OK" if ok else "503 Service Unavailable"
+                head = (
+                    f"HTTP/1.1 {status}\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                )
+            elif path in ("/", "/metrics"):
                 text = render()
                 if inspect.isawaitable(text):
                     text = await text
@@ -443,10 +468,42 @@ async def start_exposition_server(render, port: int, host: str = "0.0.0.0"):
 
 
 async def start_metrics_server(registry: MetricsRegistry, port: int,
-                               host: str = "0.0.0.0"):
+                               host: str = "0.0.0.0",
+                               probes: dict | None = None):
     """Serve a registry's exposition on (host, port); see
     start_exposition_server."""
-    return await start_exposition_server(registry.render, port, host)
+    return await start_exposition_server(registry.render, port, host,
+                                         probes=probes)
+
+
+def probe(host: str, port: int, path: str = "/readyz",
+          timeout: float = 5.0) -> tuple[int, dict]:
+    """Blocking one-shot health-probe request (test/bench helper).
+    Returns (http_status, parsed JSON body) — unlike :func:`scrape` a
+    503 is a RESULT here, not an error."""
+    import json
+    import socket
+
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+            "Connection: close\r\n\r\n".encode("latin-1")
+        )
+        chunks = []
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+            chunks.append(data)
+    raw = b"".join(chunks)
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0].split()
+    status = int(status_line[1]) if len(status_line) > 1 else 0
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        payload = {}
+    return status, payload
 
 
 def scrape(host: str, port: int, timeout: float = 5.0) -> str:

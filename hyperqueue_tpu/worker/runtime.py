@@ -207,6 +207,11 @@ class WorkerRuntime:
         # the killed copy would pass the fence and fail the live one
         self._discarded: set[int] = set()
         self._stop = asyncio.Event()
+        # /readyz input: True only while a registered session is live —
+        # flipped off the moment the session winds down, BEFORE the
+        # reconnect backoff starts, so a probe during the gap reports the
+        # worker unready instead of racing the re-registration
+        self._session_live = False
         # federation worker lending (ISSUE 11): a `redirect` message sets
         # the sibling shard dir to re-register with and fires this event;
         # the session winds down and run() registers fresh over there
@@ -376,10 +381,15 @@ class WorkerRuntime:
                 await start_metrics_server(
                     REGISTRY, self.requested_metrics_port,
                     host=self.metrics_host,
+                    probes={
+                        "/healthz": self._probe_healthz,
+                        "/readyz": self._probe_readyz,
+                    },
                 )
             )
             logger.info(
-                "metrics endpoint on http://%s:%d/metrics",
+                "metrics endpoint on http://%s:%d/metrics (+ /healthz "
+                "/readyz)",
                 self.metrics_host, self.metrics_port,
             )
 
@@ -606,6 +616,7 @@ class WorkerRuntime:
             )
         self.host, self.port, self.secret_key = host, port, key
         self._conn = conn
+        self._session_live = True
         if reattach:
             # plans embed the (now stale) worker id and server uid
             self._clear_launch_plans()
@@ -754,6 +765,7 @@ class WorkerRuntime:
             logger.warning("server connection lost (%s)", e)
             return "lost"
         finally:
+            self._session_live = False
             for t in tasks + list(waiters):
                 t.cancel()
             await asyncio.gather(*tasks, *waiters, return_exceptions=True)
@@ -1415,6 +1427,25 @@ class WorkerRuntime:
         while True:
             await asyncio.sleep(interval)
             await self._send({"op": "heartbeat"})
+
+    # ---- health probes (ISSUE 18) ------------------------------------
+    # Served by the metrics endpoint on the worker's own event loop: a
+    # wedged loop simply cannot answer, so a 200 is evidence the process
+    # is actually turning over, not just that a socket is bound.
+
+    def _probe_healthz(self):
+        return True, {"role": "worker", "worker_id": self.worker_id}
+
+    def _probe_readyz(self):
+        checks = {
+            # between sessions (server died, reconnect backoff running)
+            # the worker must drop out of rotation: it cannot accept work
+            "session": "ok" if self._session_live else "disconnected",
+            "stopping": "ok" if not self._stop.is_set() else "stopping",
+        }
+        ok = all(v == "ok" for v in checks.values())
+        return ok, {"role": "worker", "worker_id": self.worker_id,
+                    "checks": checks}
 
     async def _goodbye(self, reason: str) -> None:
         """Tell the server this is a DELIBERATE exit (idle/time limit), so

@@ -1,0 +1,349 @@
+"""Usage-accounting ledger tests (ISSUE 18).
+
+Unit tier: the fold itself — lifecycle charging, crash-retry deltas,
+reattach single-span, capture/seed round-trip, migration exactly-once.
+Restore tier: a snapshot restore's ledger is bit-equal to a full journal
+replay's (the same property test_snapshot.py pins for job state).
+Sim tier: a kill -9 mid-workload on the virtual clock leaves a live
+ledger bit-equal to a from-scratch refold of the journal, and reattached
+runs accrue a single span.
+"""
+
+from __future__ import annotations
+
+import shutil
+
+import pytest
+
+from hyperqueue_tpu.events import snapshot as snapshot_mod
+from hyperqueue_tpu.events.journal import Journal
+from hyperqueue_tpu.events.restore import restore_from_journal
+from hyperqueue_tpu.server.accounting import (
+    ACCOUNTED_KINDS,
+    AccountingLedger,
+)
+
+
+def _submit(ledger, job_id, name, n_tasks=4):
+    ledger.observe("job-submitted", {
+        "event": "job-submitted", "job": job_id, "time": 1.0,
+        "desc": {"name": name,
+                 "array": {"ids": list(range(n_tasks)), "body": {}}},
+    })
+
+
+def _start(ledger, job_id, task, t, instance=0, queued=None, usage=None):
+    ledger.observe("task-started", {
+        "event": "task-started", "job": job_id, "task": task,
+        "instance": instance, "workers": [1], "time": t,
+        "queued_at": queued if queued is not None else t,
+        "assigned_at": t, "started_at": t,
+        "usage": usage or {"cpus": 2.0},
+    })
+
+
+# ------------------------------------------------------------- unit: fold
+def test_ledger_basic_lifecycle_charges():
+    led = AccountingLedger()
+    _submit(led, 1, "train")
+    _start(led, 1, 0, t=12.0, queued=10.0, usage={"cpus": 2.0, "gpus": 1.0})
+    led.observe("task-finished", {
+        "event": "task-finished", "job": 1, "task": 0, "time": 22.0,
+    })
+    row = led.job_report([1])[1]
+    assert row["label"] == "train"
+    assert row["task_seconds"] == pytest.approx(10.0)
+    assert row["wait_seconds"] == pytest.approx(2.0)
+    assert row["cpu_seconds"] == pytest.approx(20.0)   # 2 cpus x 10 s
+    assert row["gpu_seconds"] == pytest.approx(10.0)
+    assert row["runs"] == 1 and row["finished"] == 1
+    assert row["running"] == 0 and row["crash_retries"] == 0
+    totals = led.rollup()["totals"]
+    assert totals["jobs"] == 1
+    assert totals["cpu_seconds"] == pytest.approx(20.0)
+    assert led.brief()["task_seconds"] == pytest.approx(10.0)
+
+
+def test_ledger_crash_retry_delta_charging():
+    led = AccountingLedger()
+    _submit(led, 1, "flaky")
+    _start(led, 1, 0, t=5.0, instance=0)
+    # worker died at t=8: span closes, crash counter went 0 -> 1
+    led.observe("task-restarted", {
+        "event": "task-restarted", "job": 1, "task": 0,
+        "crash_count": 1, "instance": 1, "time": 8.0,
+    })
+    _start(led, 1, 0, t=9.0, instance=1)
+    led.observe("task-finished", {
+        "event": "task-finished", "job": 1, "task": 0, "time": 15.0,
+    })
+    row = led.job_report([1])[1]
+    assert row["crash_retries"] == 1
+    assert row["runs"] == 2
+    assert row["task_seconds"] == pytest.approx(3.0 + 6.0)
+    # a clean-stop restart (no crash counter bump) charges no retry
+    led.observe("task-restarted", {
+        "event": "task-restarted", "job": 1, "task": 1,
+        "crash_count": 0, "instance": 1, "time": 16.0,
+    })
+    assert led.job_report([1])[1]["crash_retries"] == 1
+
+
+def test_ledger_reattach_same_instance_single_span():
+    """A reattaching worker re-emits task-started with the SAME instance
+    and the preserved original started_at (the server kill -9 + reattach
+    choreography): the fold must keep ONE unbroken span and must not
+    charge the ready->running wait twice."""
+    led = AccountingLedger()
+    _submit(led, 1, "ml")
+    _start(led, 1, 0, t=12.0, queued=10.0)
+    # the re-emit after reattach: same instance, original stamps
+    _start(led, 1, 0, t=12.0, queued=10.0)
+    led.observe("task-finished", {
+        "event": "task-finished", "job": 1, "task": 0, "time": 30.0,
+    })
+    row = led.job_report([1])[1]
+    assert row["runs"] == 1
+    assert row["task_seconds"] == pytest.approx(18.0)
+    assert row["wait_seconds"] == pytest.approx(2.0)  # charged once
+
+
+def test_ledger_capture_seed_roundtrip_bit_equal():
+    led = AccountingLedger()
+    _submit(led, 1, "a")
+    _submit(led, 2, "b")
+    _start(led, 1, 0, t=3.0, queued=1.0)
+    _start(led, 2, 1, t=4.0, usage={"cpus": 8.0})
+    led.observe("task-finished", {
+        "event": "task-finished", "job": 1, "task": 0, "time": 9.0,
+    })
+    led.observe("task-restarted", {
+        "event": "task-restarted", "job": 2, "task": 1,
+        "crash_count": 2, "instance": 1, "time": 10.0,
+    })
+    cap = led.capture()
+    other = AccountingLedger()
+    other.seed(cap)
+    assert other.capture() == cap
+    assert other.rollup() == led.rollup()
+    # and captures are deterministic (sorted) dict-for-dict
+    assert led.capture() == cap
+
+
+def test_ledger_migration_moves_usage_exactly_once():
+    src = AccountingLedger()
+    _submit(src, 7, "mover")
+    _start(src, 7, 0, t=2.0, queued=1.0)
+    src.observe("task-finished", {
+        "event": "task-finished", "job": 7, "task": 0, "time": 12.0,
+    })
+    _start(src, 7, 1, t=5.0)  # still running when the move starts
+    accrued = src.rollup()["totals"]
+
+    src.observe("migration-out", {
+        "event": "migration-out", "job": 7, "mig": "m1", "time": 20.0,
+    })
+    assert src.rows[7]["migrating"] is True
+    export = src.export_job(7)
+
+    dst = AccountingLedger()
+    mig_in = {
+        "event": "migration-in", "job": 7, "mig": "m1", "time": 21.0,
+        "record": {"job": 7, "job_state": {"name": "mover"},
+                   "accounting": export},
+    }
+    dst.observe("migration-in", mig_in)
+    # idempotent: a re-driven import (crash between journal and ack)
+    # lands on the same state
+    state_once = dst.capture()
+    dst.observe("migration-in", mig_in)
+    assert dst.capture() == state_once
+
+    src.observe("migration-out-done", {
+        "event": "migration-out-done", "job": 7, "mig": "m1", "time": 22.0,
+    })
+    assert 7 not in src.rows
+    assert src.rollup()["totals"]["jobs"] == 0
+
+    # the accrued usage moved whole: closed charges identical, the open
+    # span continues on the destination and closes there
+    moved = dst.rollup()["totals"]
+    assert moved["task_seconds"] == pytest.approx(accrued["task_seconds"])
+    assert moved["cpu_seconds"] == pytest.approx(accrued["cpu_seconds"])
+    assert moved["running"] == 1
+    assert dst.rows[7]["migrated_in"] is True
+    dst.observe("task-finished", {
+        "event": "task-finished", "job": 7, "task": 1, "time": 30.0,
+    })
+    assert dst.rollup()["totals"]["task_seconds"] == pytest.approx(
+        accrued["task_seconds"] + 25.0
+    )
+
+
+def test_ledger_ignores_unaccounted_kinds():
+    led = AccountingLedger()
+    led.observe("worker-connected", {"event": "worker-connected", "id": 1})
+    led.observe("slo-alert", {"event": "slo-alert", "alert": "x:page"})
+    assert led.rows == {}
+    assert "task-started" in ACCOUNTED_KINDS
+
+
+# --------------------------------------------- restore: snapshot bit-equal
+def _write_records(path, records):
+    j = Journal(path)
+    j.open_for_append()
+    for r in records:
+        j.write(r)
+    j.close()
+
+
+def _make_server(tmp_path, name, journal):
+    from hyperqueue_tpu.server.bootstrap import Server
+
+    server = Server(
+        server_dir=tmp_path / name, journal_path=journal,
+        reattach_timeout=60.0,
+    )
+    restore_from_journal(server)
+    return server
+
+
+def _history_with_usage():
+    records = []
+    seq = [0]
+
+    def emit(rec):
+        rec["seq"] = seq[0]
+        rec["time"] = 1_000.0 + seq[0]
+        seq[0] += 1
+        records.append(rec)
+
+    emit({"event": "server-uid", "server_uid": "uid-boot-1"})
+    emit({"event": "job-submitted", "job": 1,
+          "desc": {"name": "train",
+                   "array": {"ids": [0, 1], "body": {"cmd": ["true"]}}}})
+    emit({"event": "task-started", "job": 1, "task": 0, "instance": 0,
+          "variant": 0, "workers": [1], "queued_at": 1_000.5,
+          "assigned_at": 1_001.0, "started_at": 1_001.5,
+          "usage": {"cpus": 4.0}})
+    emit({"event": "task-finished", "job": 1, "task": 0})
+    emit({"event": "task-started", "job": 1, "task": 1, "instance": 0,
+          "variant": 0, "workers": [1], "queued_at": 1_000.5,
+          "assigned_at": 1_002.0, "started_at": 1_002.5,
+          "usage": {"cpus": 4.0}})
+    # task 1 left RUNNING: the open span must survive the snapshot
+    return records
+
+
+def test_accounting_snapshot_restore_bit_equal_to_full_replay(tmp_path):
+    """capture(snapshot restore) == capture(full replay): the ledger is
+    captured at the snapshot watermark and folded only for tail records,
+    so both paths consume every record exactly once."""
+    records = _history_with_usage()
+    j_orig = tmp_path / "orig.bin"
+    _write_records(j_orig, records)
+
+    a = _make_server(tmp_path, "a", j_orig)
+    assert a.accounting.rows[1]["task_seconds"] > 0
+    assert (1, 1) in a.accounting.open_runs
+    a.n_boots += 1
+    a.journal_uids.add("uid-boot-A")
+    a._event_seq += 1
+
+    # comparator C: full replay of the journal A would leave behind,
+    # with a tail event (task 1 finishes) after the would-be watermark
+    tail_finish = {"event": "task-finished", "job": 1, "task": 1,
+                   "time": 1_010.0}
+    j_replay = tmp_path / "replay.bin"
+    shutil.copy(j_orig, j_replay)
+    jw = Journal(j_replay)
+    jw.open_for_append()
+    jw.write({"event": "server-uid", "server_uid": "uid-boot-A",
+              "seq": a._event_seq - 1, "time": 9_999.0})
+    jw.write(dict(tail_finish, seq=a._event_seq))
+    jw.close()
+    c = _make_server(tmp_path, "c", j_replay)
+
+    # B: A's snapshot + the same tail event
+    j_snap = tmp_path / "snap.bin"
+    state = snapshot_mod.capture_state(a)
+    assert state.get("accounting"), "ledger missing from the snapshot"
+    snapshot_mod.write_snapshot(j_snap, state)
+    _write_records(j_snap, [
+        {"event": "server-uid", "server_uid": "uid-boot-A",
+         "seq": state["seq"] - 1, "time": 9_999.0},
+        dict(tail_finish, seq=state["seq"]),
+    ])
+    b = _make_server(tmp_path, "b", j_snap)
+    assert b.last_restore["snapshot"] is not None
+
+    assert b.accounting.capture() == c.accounting.capture()
+    # the tail close actually charged: 1_010.0 - 1_002.5 on task 1
+    row = b.accounting.job_report([1])[1]
+    assert row["runs"] == 2
+    assert row["task_seconds"] == pytest.approx(
+        (1_003.0 - 1_001.5) + (1_010.0 - 1_002.5)
+    )
+
+
+def test_pre_accounting_snapshot_restores_empty_ledger(tmp_path):
+    """A snapshot written before the accounting field existed (or a
+    fallback to full replay) must seed an EMPTY ledger, not crash."""
+    records = _history_with_usage()
+    j = tmp_path / "j.bin"
+    _write_records(j, records)
+    a = _make_server(tmp_path, "a", j)
+    a.n_boots += 1
+    a.journal_uids.add("uX")
+    a._event_seq += 1
+    state = snapshot_mod.capture_state(a)
+    state["accounting"] = None  # simulate a pre-ISSUE-18 snapshot
+    j2 = tmp_path / "old.bin"
+    snapshot_mod.write_snapshot(j2, state)
+    b = _make_server(tmp_path, "b", j2)
+    assert b.accounting.rows == {}
+
+
+# ------------------------------------------------- sim: kill -9 + reattach
+@pytest.mark.sim
+def test_sim_kill9_ledger_refolds_bit_equal(tmp_path):
+    """Kill -9 mid-workload on the virtual clock: the restored server's
+    final ledger must be bit-equal to a from-scratch refold of the full
+    journal (live fold == replay fold), and reattached executions accrue
+    exactly one run-span each (runs == actual executions)."""
+    from hyperqueue_tpu.sim import FaultEvent, FaultSchedule, build
+    from hyperqueue_tpu.sim.harness import Simulation
+
+    wl = build("uniform", seed=21, n_tasks=300, dur_ms=500)
+    faults = FaultSchedule(seed=21, events=[
+        FaultEvent(at=5.0, kind="server_kill", delay=1.0),
+    ])
+    sim = Simulation(wl, seed=21, n_workers=8, faults=faults,
+                     server_dir=tmp_path / "sim")
+    servers = []
+    orig_start = sim.start_server
+
+    async def start_and_note():
+        await orig_start()
+        servers.append(sim.server)
+
+    sim.start_server = start_and_note
+    res = sim.run()
+    assert res.server_boots == 2
+    assert res.audit["finished"] == 300
+
+    final = servers[-1].accounting.capture()
+    refold = AccountingLedger()
+    for rec in Journal.read_all(tmp_path / "sim" / "journal.bin"):
+        kind = rec.get("event")
+        if kind:
+            refold.observe(kind, rec)
+    assert refold.capture() == final
+
+    totals = servers[-1].accounting.rollup()["totals"]
+    assert totals["finished"] == 300
+    assert totals["task_seconds"] > 0
+    assert totals["cpu_seconds"] > 0
+    # exactly-once accrual: one closed span per actual execution — a
+    # reattach re-emit refreshed its span instead of opening a second
+    assert totals["runs"] == res.audit["executions"]

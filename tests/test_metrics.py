@@ -335,3 +335,20 @@ def test_metrics_catalog_documented():
         "metrics missing from the docs/observability.md catalog: "
         + ", ".join(missing)
     )
+
+
+def test_alert_catalog_documented():
+    """Same contract for SLO alerts (ISSUE 18): every alert name the
+    engine can fire — the (spec, burn-rule severity) cross product from
+    utils/slo.py — must appear in docs/observability.md, so an on-call
+    reader can look up any `hq alerts` row."""
+    from hyperqueue_tpu.utils.slo import alert_names
+
+    names = alert_names()
+    assert len(names) >= 10, "the default SLO catalog shrank unexpectedly"
+    docs = (REPO_ROOT / "docs" / "observability.md").read_text()
+    missing = sorted(name for name in names if name not in docs)
+    assert not missing, (
+        "alerts missing from the docs/observability.md catalog: "
+        + ", ".join(missing)
+    )
