@@ -1117,9 +1117,13 @@ def run_slo_smoke() -> None:
                     "tick-latency alert never resolved after the chaos "
                     "plan exhausted"
                 )
+            prof_summary = profile_summary(json.loads(env.command(
+                ["server", "stats", "--output-mode", "json"]
+            )))
 
     emit({
         "experiment": "slo_smoke",
+        "profile": prof_summary,
         "metric": "alert_fire_seconds",
         "value": fire_s if fire_s is not None else 0.0,
         "unit": "s",
@@ -2256,6 +2260,9 @@ def run_submit_smoke(args) -> None:
                 ["server", "stats", "--output-mode", "json"]
             ))
             lazy = stats["ingest"]["lazy"]
+            # per-plane/per-phase shares ride the row as metadata so
+            # --regress can blame the guilty plane (ISSUE 19)
+            prof_summary = profile_summary(stats)
             results.update(
                 tasks_per_s=round(tasks_per_s, 1),
                 burst_tasks_per_s=round(burst_tasks_per_s, 1),
@@ -2390,6 +2397,7 @@ def run_submit_smoke(args) -> None:
         "value": results.get("tasks_per_s", 0.0),
         "unit": "tasks/s",
         "n_tasks": n_tasks,
+        "profile": prof_summary,
         **results,
     })
     print("submit-smoke:", "OK" if not failures else failures)
@@ -3020,6 +3028,228 @@ def run_sim_smoke(args) -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_profile_smoke(args) -> None:
+    """Continuous-profiling gate (ISSUE 19). Four legs:
+
+    1. overhead: encrypted submit bursts against a server sampling at
+       19 Hz vs one at ``--profile-hz 0``, interleaved best-of-3 — the
+       always-on sampler must cost <= 5% of burst ingest throughput;
+    2. artifacts: `hq server profile` returns non-empty folded stacks
+       (written next to the run) and `hq server trace export` carries
+       the per-plane ``cpu <plane>`` Perfetto counter track;
+    3. profile-on-stall: a chaos solve-delay blows --stall-budget and
+       the auto-dump's attached profile burst names the solve plane;
+    4. blame: a deliberately grown plane share in a throwaway result db
+       makes check_regressions blame exactly that plane.
+    """
+    import json as _json
+    import os
+    import tempfile
+    import shutil
+    from pathlib import Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+    from utils_e2e import HqEnv, wait_until
+
+    from hyperqueue_tpu.client.connection import ClientSession, SubmitStream
+
+    n_tasks = min(args.tasks or 200_000, 200_000)
+    chunk = 16384
+    trials = 3
+    failures = []
+    results: dict = {}   # numeric, stable -> stored values in db.jsonl
+    diag: dict = {}      # volatile lists/dicts -> printed, never stored
+    prof_summary = None
+    artifact_dir = Path(tempfile.mkdtemp(prefix="hq-profile-smoke-"))
+    t_wall = time.perf_counter()
+
+    def burst(env, name: str) -> float:
+        """One encrypted burst submit; returns tasks/s."""
+        body = {"cmd": ["true"], "env": {},
+                "submit_dir": str(env.work_dir)}
+        with ClientSession(env.server_dir) as s:
+            stream = SubmitStream(
+                s, {"name": name, "submit_dir": str(env.work_dir)}
+            )
+            t0 = time.perf_counter()
+            for lo in range(0, n_tasks, chunk):
+                stream.send_chunk(array={
+                    "id_range": [lo, min(lo + chunk, n_tasks)],
+                    "body": body, "request": {},
+                    "priority": 0, "crash_limit": 5,
+                })
+            _job, acked = stream.finish()
+            return acked / max(time.perf_counter() - t0, 1e-9)
+
+    with tempfile.TemporaryDirectory() as td:
+        tmp = Path(td)
+        # --- leg 1: overhead, interleaved trials against two live
+        # servers (identical but for --profile-hz); no workers — the
+        # burst measures the ingest plane, and execution CPU would be
+        # noise both sides pay anyway
+        with HqEnv(tmp / "on") as env_on, HqEnv(tmp / "off") as env_off:
+            env_on.start_server("--profile-hz", "19")
+            env_off.start_server("--profile-hz", "0")
+            on_rates, off_rates = [], []
+            for i in range(trials):
+                on_rates.append(burst(env_on, f"on{i}"))
+                off_rates.append(burst(env_off, f"off{i}"))
+            best_on, best_off = max(on_rates), max(off_rates)
+            overhead = 1.0 - best_on / max(best_off, 1e-9)
+            results.update(
+                profiled_burst_tasks_per_s=round(best_on, 1),
+                unprofiled_burst_tasks_per_s=round(best_off, 1),
+                overhead_frac=round(overhead, 4),
+            )
+            if overhead > 0.05:
+                failures.append(
+                    f"sampling profiler costs {overhead * 100:.1f}% of "
+                    "burst ingest throughput (> 5% budget)"
+                )
+
+            # --- leg 2: artifacts off the profiling server -----------
+            folded = env_on.command(["server", "profile"])
+            folded_lines = [
+                ln for ln in folded.splitlines()
+                if ln and not ln.startswith("#")
+            ]
+            planes_seen = {ln.split(";", 1)[0] for ln in folded_lines}
+            results["folded_stacks"] = len(folded_lines)
+            diag["folded_planes"] = sorted(planes_seen)
+            if not folded_lines:
+                failures.append("`hq server profile` returned no stacks")
+            if "reactor" not in planes_seen:
+                failures.append(
+                    "folded stacks carry no reactor-plane samples: "
+                    f"{sorted(planes_seen)}"
+                )
+            (artifact_dir / "profile.folded").write_text(folded)
+
+            trace_path = artifact_dir / "trace.json"
+            env_on.command(["server", "trace", "export", str(trace_path)])
+            trace = _json.loads(trace_path.read_text())
+            cpu_events = [
+                e for e in trace.get("traceEvents", ())
+                if e.get("ph") == "C"
+                and str(e.get("name", "")).startswith("cpu ")
+            ]
+            results["trace_cpu_counter_events"] = len(cpu_events)
+            if not cpu_events:
+                failures.append(
+                    "trace export carries no profiler cpu counter track"
+                )
+            stats_on = _json.loads(env_on.command(
+                ["server", "stats", "--output-mode", "json"]
+            ))
+            prof_summary = profile_summary(stats_on)
+            if not (stats_on.get("profile") or {}).get("enabled"):
+                failures.append("server stats reports the profiler off")
+
+        # --- leg 3: profile-on-stall (chaos solve-delay) -------------
+        plan = json.dumps({"rules": [
+            {"site": "solve", "action": "delay", "delay_ms": 600, "at": 1}
+        ]})
+        with HqEnv(tmp / "stall") as env:
+            env.start_server("--stall-budget", "0.15",
+                             env_extra={"HQ_FAULT_PLAN": plan})
+            env.start_worker("--zero-worker", cpus=4)
+            env.wait_workers(1)
+            env.command(["submit", "--array", "0-3", "--wait", "--",
+                         "true"], timeout=60)
+
+            def stalled():
+                stats = _json.loads(env.command(
+                    ["server", "stats", "--output-mode", "json"]
+                ))
+                return (stats["stalls"]["captured"] >= 1
+                        and stats["stalls"])
+
+            stalls = wait_until(stalled, timeout=20,
+                                message="stall capture")
+            dump = _json.loads(Path(stalls["last"]["dump"]).read_text())
+            stall_planes = {
+                row["plane"] for row in dump.get("profile", ())
+            }
+            diag["stall_profile_planes"] = sorted(stall_planes)
+            if "solve" not in stall_planes:
+                failures.append(
+                    "stall dump's profile burst has no solve-plane "
+                    f"stack (saw {sorted(stall_planes)})"
+                )
+            shutil.copy(stalls["last"]["dump"],
+                        artifact_dir / "stall-dump.json")
+
+        # --- leg 4: regression blame on a throwaway db ---------------
+        from database import Database
+
+        demo_db = tmp / "blame-db.jsonl"
+        db = Database(demo_db)
+        base_prof = {"planes": {"reactor": 0.5, "solve": 0.2},
+                     "phases": {"solve_dispatch": 0.3, "mapping": 0.2}}
+        slow_prof = {"planes": {"reactor": 0.5, "solve": 0.8},
+                     "phases": {"solve_dispatch": 0.7, "mapping": 0.1}}
+        for _ in range(3):
+            db.store_emit(
+                {"experiment": "profile_blame_demo",
+                 "metric": "demo_tick_ms", "unit": "ms", "value": 10.0},
+                metadata={"profile": base_prof},
+            )
+        db.store_emit(
+            {"experiment": "profile_blame_demo",
+             "metric": "demo_tick_ms", "unit": "ms", "value": 25.0},
+            metadata={"profile": slow_prof},
+        )
+        _checked, regs = check_regressions(
+            experiment="profile_blame_demo", db_path=demo_db
+        )
+        blame = (regs[0].get("blame") or {}) if regs else {}
+        diag["blame"] = blame
+        if not regs:
+            failures.append(
+                "blame demo: deliberately slowed row did not trip the "
+                "regression gate"
+            )
+        elif blame.get("name") != "solve":
+            failures.append(
+                "blame demo: the deliberately grown solve plane was not "
+                f"blamed (got {blame})"
+            )
+
+    emit({
+        "experiment": "profile_smoke",
+        "metric": "profiled_burst_tasks_per_s",
+        "ok": not failures,
+        "failures": failures,
+        "value": results.get("profiled_burst_tasks_per_s", 0.0),
+        "unit": "tasks/s",
+        "n_tasks": n_tasks,
+        "profile": prof_summary,
+        **results,
+    })
+    print(f"# diag: {json.dumps(diag)}", file=sys.stderr)
+    print(f"# artifacts: {artifact_dir}/profile.folded, "
+          f"{artifact_dir}/trace.json, {artifact_dir}/stall-dump.json",
+          file=sys.stderr)
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="profile_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} profile_smoke metric(s) "
+                      f"within 20% of baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
+    print("profile-smoke:", "OK" if not failures else failures)
+    sys.exit(1 if failures else 0)
+
+
 # --- result-db regression gate (ISSUE 16) ------------------------------
 # Metric direction heuristics: a regression is movement in the BAD
 # direction; metrics whose direction the name/unit doesn't reveal are
@@ -3045,6 +3275,55 @@ def _metric_direction(name: str, unit: str = "") -> int:
         if hint in n:
             return -1
     return 0
+
+
+def profile_summary(stats: dict) -> dict | None:
+    """Compact per-plane/per-phase share summary from one `hq server
+    stats` payload — stored as row metadata so `--regress` can BLAME a
+    regression (ISSUE 19): name the plane/phase whose share grew most
+    instead of reporting one opaque wall-clock number."""
+    planes = {
+        plane: row.get("cpu", 0.0)
+        for plane, row in ((stats.get("profile") or {}).get("planes")
+                           or {}).items()
+    }
+    phases = stats.get("tick_shares") or {}
+    if not planes and not phases:
+        return None
+    return {"planes": planes, "phases": phases}
+
+
+def _blame_from_profiles(cur_profile: dict | None,
+                         base_profiles: list) -> dict | None:
+    """Name the plane/phase whose share grew most between the newest
+    row's profile summary and the median of the prior rows'."""
+    import statistics
+
+    if not cur_profile or not base_profiles:
+        return None
+    best = None
+    for kind in ("planes", "phases"):
+        cur = cur_profile.get(kind) or {}
+        for name, share in cur.items():
+            priors = [
+                p[kind][name] for p in base_profiles
+                if isinstance((p or {}).get(kind), dict)
+                and isinstance(p[kind].get(name), (int, float))
+            ]
+            if not priors or not isinstance(share, (int, float)):
+                continue
+            grew = share - statistics.median(priors)
+            if best is None or grew > best["grew"]:
+                best = {
+                    "kind": kind[:-1],  # plane / phase
+                    "name": name,
+                    "share": round(share, 4),
+                    "baseline_share": round(statistics.median(priors), 4),
+                    "grew": round(grew, 4),
+                }
+    if best is None or best["grew"] <= 0:
+        return None
+    return best
 
 
 def check_regressions(window: int = 5, threshold: float = 0.20,
@@ -3100,14 +3379,23 @@ def check_regressions(window: int = 5, threshold: float = 0.20,
             # positive = worse, for either direction
             regress = (baseline - value) / baseline * direction
             if regress > threshold:
-                regressions.append({
+                reg = {
                     "experiment": exp,
                     "metric": metric_name,
                     "baseline": round(baseline, 4),
                     "current": round(value, 4),
                     "change_pct": round(regress * 100, 1),
                     "n_baseline_rows": len(samples),
-                })
+                }
+                # regression blame (ISSUE 19): rows carrying a profile
+                # summary get the guilty plane/phase named alongside
+                blame = _blame_from_profiles(
+                    (cur.metadata or {}).get("profile"),
+                    [(r.metadata or {}).get("profile") for r in base],
+                )
+                if blame is not None:
+                    reg["blame"] = blame
+                regressions.append(reg)
     return checked, regressions
 
 
@@ -3178,11 +3466,15 @@ def run_regress(args) -> None:
     }))
     if regs:
         for r in regs:
+            blame = r.get("blame")
             print(
                 f"REGRESSION {r['experiment']}/{r['metric']}: "
                 f"{r['baseline']} -> {r['current']} "
                 f"({r['change_pct']}% worse, vs median of "
-                f"{r['n_baseline_rows']} prior rows)",
+                f"{r['n_baseline_rows']} prior rows)"
+                + (f" — blame: {blame['kind']} '{blame['name']}' share "
+                   f"{blame['baseline_share']} -> {blame['share']}"
+                   if blame else ""),
                 file=sys.stderr,
             )
         sys.exit(1)
@@ -3296,6 +3588,13 @@ def main() -> None:
                         help="soak task count for --sim-smoke")
     parser.add_argument("--sim-workers", type=int, default=1000,
                         help="soak worker count for --sim-smoke")
+    parser.add_argument("--profile-smoke", action="store_true",
+                        help="continuous-profiling gate (ISSUE 19): "
+                             "sampler overhead <= 5% on an encrypted "
+                             "submit burst, folded + Perfetto counter "
+                             "artifacts, solve-plane stack in the chaos "
+                             "stall dump, and regression blame naming a "
+                             "deliberately slowed plane")
     parser.add_argument("--regress", action="store_true",
                         help="result-db regression gate: newest row per "
                              "(experiment, config) vs the median of its "
@@ -3377,6 +3676,10 @@ def main() -> None:
 
     if args.restore_smoke:
         run_restore_smoke(args)
+        return
+
+    if args.profile_smoke:
+        run_profile_smoke(args)
         return
 
     if args.regress or args.regress_demo:

@@ -147,14 +147,24 @@ def emit(record: dict) -> None:
     """Print one JSON result line AND store it in the durable result
     database (benchmarks/results/db.jsonl, keyed by experiment+params+git
     rev — reference benchmarks/src/benchmark/database.py).  Set
-    HQ_BENCH_NO_DB=1 to skip the store (throwaway runs)."""
-    print(json.dumps(record), flush=True)
+    HQ_BENCH_NO_DB=1 to skip the store (throwaway runs).
+
+    A `"profile"` key (the per-plane/per-phase share summary from the
+    sampling profiler, ISSUE 19) is stored as row METADATA, not params:
+    shares vary run to run, and a params dict would fork every row into
+    its own config group and blind the regression gate."""
+    profile = record.pop("profile", None)
+    print(json.dumps(
+        {**record, **({"profile": profile} if profile else {})}
+    ), flush=True)
     if not os.environ.get("HQ_BENCH_NO_DB"):
         try:
             from database import Database
         except ImportError:
             from benchmarks.database import Database
         try:
-            Database().store_emit(record)
+            Database().store_emit(
+                record, metadata={"profile": profile} if profile else None
+            )
         except OSError as e:  # a read-only checkout must not kill the run
             print(f"# result-db store failed: {e}", file=sys.stderr)

@@ -193,6 +193,7 @@ def cmd_server_start(args) -> None:
             tick_pipeline=args.tick_pipeline,
             stall_budget=args.stall_budget,
             stall_dumps=args.stall_dumps,
+            profile_hz=args.profile_hz,
             task_trace_capacity=args.task_trace_capacity,
             client_plane=args.client_plane,
             journal_plane=args.journal_plane,
@@ -441,6 +442,21 @@ def cmd_server_stats(args) -> None:
         for plane, row in lag.items():
             print(f"{plane:<16}{row['mean_ms']:>10.3f}"
                   f"{row['last_ms']:>10.3f}{row['max_ms']:>10.3f}")
+    prof = stats.get("profile") or {}
+    if prof.get("enabled") and prof.get("planes"):
+        print(
+            f"{'cpu plane':<16}{'cpu%':>10}{'samples':>10}{'active':>10}"
+            f"   ({prof.get('hz')} Hz sampler, "
+            f"{prof.get('window_passes', 0)} passes windowed)"
+        )
+        planes = sorted(
+            prof["planes"].items(), key=lambda kv: -kv[1].get("cpu", 0.0)
+        )
+        for plane, row in planes:
+            print(
+                f"{plane:<16}{row.get('cpu', 0.0) * 100:>9.1f}%"
+                f"{row.get('samples', 0):>10}{row.get('active', 0):>10}"
+            )
     stalls = stats.get("stalls") or {}
     if stalls.get("captured"):
         last = stalls.get("last") or {}
@@ -685,6 +701,7 @@ def cmd_worker_start(args) -> None:
         "server_dir": worker_dir,
         "metrics_port": args.metrics_port,
         "metrics_host": args.metrics_host,
+        "profile_hz": args.profile_hz,
     }
     if profile_out:
         import cProfile
@@ -1557,6 +1574,43 @@ def cmd_server_reset_metrics(args) -> None:
                 out.message(f"shard {k}: metrics reset")
         return
     out.message("metrics reset")
+
+
+def cmd_server_profile(args) -> None:
+    """Pull flamegraph-ready folded stacks from the server's sampling
+    profiler (`hq server profile`). With --seconds N the server diffs its
+    cumulative trie across an N-second window (so the output shows only
+    that window); without it you get the whole-run aggregate. Pipe the
+    folded output straight into flamegraph.pl / speedscope."""
+    seconds = args.seconds or 0.0
+    with _session(args) as session:
+        result = session.request({
+            "op": "profile",
+            "seconds": seconds,
+            "shard": getattr(args, "shard", None),
+        })
+    records = result.get("shards")
+    if records is None:
+        records = [result]
+    if args.format == "json":
+        print(json.dumps(result, default=str))
+        return
+    for rec in records:
+        shard = rec.get("shard", rec.get("shard_id"))
+        if rec.get("error"):
+            print(f"# shard {shard}: DOWN ({rec['error']})",
+                  file=sys.stderr)
+            continue
+        if len(records) > 1:
+            print(f"# shard {shard}", file=sys.stderr)
+        print(
+            f"# mode={rec.get('mode')} hz={rec.get('hz')} "
+            f"passes={rec.get('passes')} seconds={rec.get('seconds')}",
+            file=sys.stderr,
+        )
+        folded = rec.get("folded") or ""
+        if folded:
+            print(folded, end="" if folded.endswith("\n") else "\n")
 
 
 _ACCOUNTING_HEADER = [
@@ -2483,6 +2537,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "never capture)")
     p.add_argument("--stall-dumps", type=int, default=8, metavar="N",
                    help="keep at most N stall dump files")
+    p.add_argument("--profile-hz", type=float, default=19.0, metavar="HZ",
+                   help="always-on sampling profiler: walk every thread's "
+                        "stack HZ times per second and fold the samples "
+                        "into per-plane CPU-share gauges (hq_profile_*) "
+                        "plus flamegraph data for `hq server profile` "
+                        "(0 = off; the odd default avoids beating against "
+                        "periodic work)")
     p.add_argument("--client-plane", choices=["thread", "reactor"],
                    default="thread",
                    help="where client connections are served: 'thread' "
@@ -2603,6 +2664,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="federation: which shard to reset (default 0; "
                         "'all' fans out for a fleet-wide window)")
     p.set_defaults(fn=cmd_server_reset_metrics)
+    p = ssub.add_parser(
+        "profile",
+        help="flamegraph-ready folded stacks from the always-on sampling "
+             "profiler (or a one-shot burst when --profile-hz 0)",
+    )
+    _add_common(p)
+    p.add_argument("--seconds", type=float, default=0.0, metavar="N",
+                   help="sample a fresh N-second window instead of the "
+                        "whole-run aggregate (burst mode always samples "
+                        "a window; default 2s there)")
+    p.add_argument("--format", choices=["folded", "json"], default="folded",
+                   help="folded: 'plane;frame;frame count' lines for "
+                        "flamegraph.pl/speedscope; json: full snapshot")
+    p.add_argument("--shard", default=None, metavar="K|all",
+                   help="federation: which shard to profile (default 0; "
+                        "'all' fans out, one block per shard)")
+    p.set_defaults(fn=cmd_server_profile)
     p = ssub.add_parser("wait", help="wait until the server is reachable")
     _add_common(p)
     p.add_argument("--timeout", type=float, default=60.0)
@@ -2673,6 +2751,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-host", default="0.0.0.0", metavar="HOST",
                    help="bind address for the (unauthenticated) metrics "
                         "endpoint; use 127.0.0.1 behind a scraping sidecar")
+    p.add_argument("--profile-hz", type=float, default=19.0, metavar="HZ",
+                   help="always-on sampling profiler for the worker "
+                        "process; per-plane shares piggyback on overview "
+                        "messages for the fleet view (0 = off)")
     p.add_argument("--log-format", choices=["plain", "json"],
                    default=os.environ.get("HQ_LOG_FORMAT", "plain"),
                    help="json: one JSON object per log line with "
@@ -3124,6 +3206,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_common(p)
     p.set_defaults(fn=cmd_fleet_accounting)
+    p = fsub.add_parser(
+        "profile",
+        help="folded profiler stacks from every shard in one stream "
+             "(equivalent to `hq server profile --shard all`)",
+    )
+    _add_common(p)
+    p.add_argument("--seconds", type=float, default=0.0, metavar="N",
+                   help="sample a fresh N-second window on each shard")
+    p.add_argument("--format", choices=["folded", "json"],
+                   default="folded")
+    p.set_defaults(fn=cmd_fleet_profile)
 
     # alerts: SLO burn-rate alert state (ISSUE 18)
     p = sub.add_parser(
@@ -3326,6 +3419,15 @@ def cmd_fleet_trace_export(args) -> None:
         + (f", DOWN: {down}" if down else "")
         + "); load at ui.perfetto.dev"
     )
+
+
+def cmd_fleet_profile(args) -> None:
+    """`hq fleet profile`: folded profiler stacks from every shard in one
+    stream. On a classic server dir it degrades to a single-server
+    profile (same convention as `hq fleet accounting`)."""
+    fed = serverdir.load_federation(_server_dir(args))
+    args.shard = "all" if fed is not None else None
+    cmd_server_profile(args)
 
 
 def cmd_fleet_status(args) -> None:

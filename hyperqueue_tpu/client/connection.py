@@ -363,7 +363,7 @@ class FederatedSession:
         shard = msg.pop("shard", None)
         if shard in ("all", -1, "-1") and op in (
             "server_info", "server_stats", "reset_metrics", "alerts",
-            "accounting",
+            "accounting", "profile",
         ):
             # per-shard fan-out: one record per shard (tick latencies and
             # lease states are per-shard facts — never summed; a

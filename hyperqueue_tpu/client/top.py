@@ -73,20 +73,36 @@ def _render(sample: dict, ticker: deque, dropped: int) -> str:
             lines.append("loop lag ms (last/max): " + "  ".join(cells))
     if sample.get("stalls"):
         lines.append(f"reactor stalls captured: {sample['stalls']}")
+    profile = sample.get("profile") or {}
+    if profile:
+        lines.append(
+            "plane cpu%: " + "  ".join(
+                f"{plane} {share * 100:.1f}"
+                for plane, share in sorted(
+                    profile.items(), key=lambda kv: -kv[1]
+                )
+            )
+        )
     workers = sample.get("workers") or []
     if workers:
         lines.append("")
         lines.append(f"{'worker':>8} {'host':<20} {'running':>8} "
-                     f"{'prefilled':>10} {'cpu%':>6}")
+                     f"{'prefilled':>10} {'cpu%':>6} planes")
         for w in sorted(workers, key=lambda w: w["id"])[:32]:
             cpu = w.get("cpu")
+            planes = w.get("planes") or {}
+            plane_cell = " ".join(
+                f"{p}:{v * 100:.0f}%"
+                for p, v in sorted(planes.items(), key=lambda kv: -kv[1])
+            )
             lines.append(
-                f"{w['id']:>8} {str(w.get('hostname', ''))[:20]:<20} "
-                f"{w.get('running', 0):>8} {w.get('prefilled', 0):>10} "
-                f"{cpu:>6.1f}" if cpu is not None else
-                f"{w['id']:>8} {str(w.get('hostname', ''))[:20]:<20} "
-                f"{w.get('running', 0):>8} {w.get('prefilled', 0):>10} "
-                f"{'-':>6}"
+                (f"{w['id']:>8} {str(w.get('hostname', ''))[:20]:<20} "
+                 f"{w.get('running', 0):>8} {w.get('prefilled', 0):>10} "
+                 f"{cpu:>6.1f}" if cpu is not None else
+                 f"{w['id']:>8} {str(w.get('hostname', ''))[:20]:<20} "
+                 f"{w.get('running', 0):>8} {w.get('prefilled', 0):>10} "
+                 f"{'-':>6}")
+                + (f" {plane_cell}" if plane_cell else "")
             )
         if len(workers) > 32:
             lines.append(f"  … {len(workers) - 32} more worker(s)")

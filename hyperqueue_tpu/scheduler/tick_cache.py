@@ -95,6 +95,22 @@ class TickPhaseStats:
         }
         return out
 
+    def shares(self) -> dict:
+        """Phase -> fraction of total tick time (all phases sum to ~1.0).
+
+        The regression-blame side of the profiling plane (ISSUE 19):
+        bench smokes store these next to the profiler's per-plane CPU
+        shares, and ``--regress`` diffs both against the prior-row
+        median so a latency regression names the phase whose share grew
+        rather than one opaque wall-clock number."""
+        total = sum(self.totals_ms.values())
+        if total <= 0:
+            return {}
+        return {
+            name: round(t / total, 4)
+            for name, t in sorted(self.totals_ms.items())
+        }
+
 
 class TickStateCache:
     """Dirty-tracked dense snapshot of the schedulable workers."""

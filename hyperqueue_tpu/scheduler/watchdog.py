@@ -65,6 +65,16 @@ class _SolveWorker:
         self._thread.start()
 
     def _loop(self) -> None:
+        from hyperqueue_tpu.utils import profiler
+
+        # sampling-profiler plane label (ISSUE 19): deadlined solves run
+        # here, so solver CPU attributes to the `solve` plane even while
+        # the reactor thread is parked in done.wait(). The label is never
+        # explicitly unregistered — an abandoned (stranded) worker keeps
+        # soaking CPU inside the solve, and THAT is exactly what the
+        # profile must show; the thread-name prefix fallback re-labels
+        # any replacement worker anyway.
+        profiler.register_plane("solve")
         while True:
             fn, box, done = self._requests.get()
             try:

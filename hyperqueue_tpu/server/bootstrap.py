@@ -43,6 +43,7 @@ from hyperqueue_tpu.server.task import Task, TaskState
 from hyperqueue_tpu.server.worker import Worker, WorkerConfiguration
 from hyperqueue_tpu.transport.aead import WIRE_BACKEND
 from hyperqueue_tpu.utils import chaos
+from hyperqueue_tpu.utils import profiler
 from hyperqueue_tpu.utils.metrics import REGISTRY
 from hyperqueue_tpu.utils.slo import SloEngine
 from hyperqueue_tpu.utils.trace import TRACER
@@ -570,6 +571,7 @@ class Server:
         tick_pipeline: bool = False,
         stall_budget: float = 1.0,
         stall_dumps: int = 8,
+        profile_hz: float = 19.0,
         task_trace_capacity: int = 16384,
         client_plane: str = "thread",
         journal_plane: str = "thread",
@@ -671,6 +673,12 @@ class Server:
         # seconds auto-captures a flight-recorder + trace dump
         # (`--stall-budget 0` keeps the histograms but never captures)
         self.lag = LagTracker()
+        # continuous profiling plane (ISSUE 19): always-on sampling
+        # profiler at --profile-hz (0 = off); inert under the simulator —
+        # start() never launches the sampler on a memory-transport server
+        # and the profiler itself refuses simulated clocks
+        self.profile_hz = float(profile_hz)
+        self._profiler_started = False
         self.stall_budget = float(stall_budget)
         self.stall_dumps = max(int(stall_dumps), 1)
         self.stalls_captured = 0
@@ -1044,6 +1052,20 @@ class Server:
                 self.metrics_host, self.metrics_port,
             )
 
+        # continuous profiling plane (ISSUE 19): the reactor thread labels
+        # itself, then the sampler starts. Memory-transport (simulator)
+        # servers never start it — the profiler is real-wall-clock
+        # telemetry and must stay inert under a virtual clock (the
+        # profiler's own is_simulated() guard backstops this).
+        if not self.memory_transport and self.profile_hz > 0:
+            profiler.register_plane("reactor")
+            self._profiler_started = profiler.start_profiler(self.profile_hz)
+            if self._profiler_started:
+                logger.info(
+                    "sampling profiler on at %.3g Hz (--profile-hz)",
+                    self.profile_hz,
+                )
+
         instance_dir = serverdir.create_instance_dir(self.server_dir)
         self._instance_dir = instance_dir
         if preshared is not None:
@@ -1177,6 +1199,9 @@ class Server:
             self._metrics_server.close()
         if self._metrics_hook is not None:
             REGISTRY.remove_collect_hook(self._metrics_hook)
+        if self._profiler_started:
+            profiler.stop_profiler()
+            self._profiler_started = False
         for conn in self._worker_conns.values():
             conn.close()
         self.sendpool.stop()
@@ -3264,6 +3289,9 @@ class Server:
         return {
             "op": "server_stats",
             "tick": self.core.tick_stats.snapshot(),
+            # phase -> fraction of tick time: the blame denominator bench
+            # smokes store next to the profiler's plane shares (ISSUE 19)
+            "tick_shares": self.core.tick_stats.shares(),
             "tick_cache": self.core.tick_cache.counters(),
             "paranoid_tick": self.core.paranoid_tick,
             "scheduler": self.scheduler_kind,
@@ -3295,6 +3323,9 @@ class Server:
                 "last": self.last_stall,
             },
             "task_traces": self.core.traces.stats(),
+            # ISSUE 19: per-plane CPU attribution from the sampling
+            # profiler (the CPU twin of the lag block above)
+            "profile": profiler.PROFILER.snapshot(),
             "subscribers": len(self._subscribers),
             # ISSUE 10: connection-plane + lazy-materialization health
             "ingest": self._ingest_stats(),
@@ -3393,7 +3424,64 @@ class Server:
         # (ISSUE 18): steady-state burn rates must not inherit a breach
         # that happened before the reset
         self.slo.reset()
+        # profiler aggregates (ISSUE 19): folded trie, CPU-share window
+        # and the stall sample ring all belong to the measurement window
+        profiler.PROFILER.reset()
         return {"op": "ok"}
+
+    async def _client_profile(self, msg: dict) -> dict:
+        """Folded stacks + per-plane CPU shares from the sampling
+        profiler (`hq server profile [--seconds N]`). With the
+        continuous sampler on, `--seconds N` diffs the folded trie
+        across the window (the cumulative view is seconds 0); on a
+        `--profile-hz 0` server a throwaway burst sampler covers the
+        window instead, so the command always answers."""
+        seconds = min(max(float(msg.get("seconds") or 0.0), 0.0), 120.0)
+        prof = profiler.PROFILER
+        if prof.running:
+            if seconds > 0:
+                before = prof.folded_counts()
+                passes0 = prof.passes
+                await asyncio.sleep(seconds)
+                counts = profiler.diff_counts(prof.folded_counts(), before)
+                window_passes = prof.passes - passes0
+            else:
+                counts = prof.folded_counts()
+                window_passes = prof.passes
+            return {
+                "op": "profile",
+                "mode": "continuous",
+                "shard": self.shard_id,
+                "hz": prof.hz,
+                "seconds": seconds,
+                "passes": window_passes,
+                "folded": profiler.render_folded(counts),
+                "profile": prof.snapshot(),
+            }
+        if self.memory_transport or clock.is_simulated():
+            return {"op": "error",
+                    "message": "profiling is unavailable on a simulated "
+                               "server (real wall-clock telemetry only)"}
+        # --profile-hz 0: sample a temporary burst for the window
+        seconds = seconds or 2.0
+        burst = profiler.SamplingProfiler(hz=max(self.profile_hz, 0)
+                                          or profiler.DEFAULT_HZ)
+        if not burst.start():
+            return {"op": "error", "message": "profiler failed to start"}
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            burst.stop()
+        return {
+            "op": "profile",
+            "mode": "burst",
+            "shard": self.shard_id,
+            "hz": burst.hz,
+            "seconds": seconds,
+            "passes": burst.passes,
+            "folded": burst.folded(),
+            "profile": burst.snapshot(),
+        }
 
     async def _client_metrics_render(self, msg: dict) -> dict:
         """The full Prometheus exposition over the client plane — the
@@ -4531,6 +4619,23 @@ class Server:
                         "ph": "f", "bp": "e", "pid": 0, "tid": wid,
                         "ts": info.started_at * 1e6, **flow,
                     })
+
+        # profiler counter tracks (ISSUE 19): one CPU-cores counter per
+        # plane, bucketed from the sampling ring — the same Perfetto file
+        # now answers "which plane was burning CPU" next to ticks, solves
+        # and task spans
+        prof = profiler.PROFILER
+        if prof.running:
+            events.append({
+                "ph": "M", "pid": 2, "tid": 0, "name": "process_name",
+                "args": {"name": "hq-profiler"},
+            })
+            for plane, series in sorted(prof.counter_track().items()):
+                for t, cores in series:
+                    events.append({
+                        "ph": "C", "pid": 2, "tid": 0, "ts": t * 1e6,
+                        "name": f"cpu {plane}", "args": {"cores": cores},
+                    })
         return {"op": "trace_export", "traceEvents": events}
 
     def _record_past_worker(self, worker_id: int, reason: str,
@@ -4776,6 +4881,17 @@ class Server:
             if lent_from >= 0:
                 row["lent_from"] = lent_from
                 borrowed += 1
+            # worker per-plane CPU attribution (ISSUE 19): the shares the
+            # worker piggybacked on its last overview — `hq top` fleet
+            # view renders them with no per-worker scrape
+            planes = {
+                s["labels"]["plane"]: s["value"]
+                for s in (w.last_metrics or ())
+                if s.get("name") == "hq_worker_profile_plane_cpu_share"
+                and (s.get("labels") or {}).get("plane")
+            }
+            if planes:
+                row["planes"] = planes
             workers.append(row)
         latest = core.flight.latest() or {}
         pending_reasons: dict[str, int] = {}
@@ -4816,6 +4932,13 @@ class Server:
             "accounting": self.accounting.brief(),
             "alerts": self._alert_badge(),
         }
+        if profiler.PROFILER.running:
+            # per-plane CPU shares ride every sample (ISSUE 19) so
+            # `hq top` renders the CPU block push-fed, like the lag block
+            sample["profile"] = {
+                plane: agg["cpu"]
+                for plane, agg in profiler.PROFILER.plane_shares().items()
+            }
         if self.federation_root is not None:
             # fleet view context (ISSUE 15) — all in-memory reads, no
             # lease-file I/O on the sample path (self.lease.epoch is the
@@ -5006,6 +5129,14 @@ class Server:
             "tick": self.core.tick_counter,
             "lag": self.lag.snapshot(),
             "trace": TRACER.snapshot(),
+            # profile-on-stall (ISSUE 19): the aggregated stack burst the
+            # sampler captured during the stall window itself — what every
+            # plane was executing while the budget was being blown (the
+            # stall is detected only after the blocking work returns, so
+            # the ring is the only honest source of this)
+            "profile": profiler.PROFILER.stall_burst(
+                duration_s + 1.0
+            ) if profiler.PROFILER.running else [],
             "queues": {
                 "ready": self.core.queues.total_ready(),
                 "mn_queued": len(self.core.mn_queue),

@@ -190,6 +190,11 @@ class IngestPlane:
         boot: dict = {}
 
         def run() -> None:
+            from hyperqueue_tpu.utils import profiler
+
+            # sampling-profiler plane label (ISSUE 19): connection-plane
+            # CPU (framing, decode, backpressure) attributes to `ingest`
+            profiler.register_plane("ingest")
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self.loop = loop
@@ -211,6 +216,7 @@ class IngestPlane:
             loop.run_until_complete(bind())
             if "error" in boot:
                 loop.close()
+                profiler.unregister_plane()
                 return
             try:
                 loop.run_forever()
@@ -225,6 +231,7 @@ class IngestPlane:
                 except Exception:  # noqa: BLE001
                     pass
                 loop.close()
+                profiler.unregister_plane()
 
         self._thread = threading.Thread(
             target=run, name="hq-ingest", daemon=True

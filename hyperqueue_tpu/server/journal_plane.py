@@ -249,6 +249,19 @@ class JournalPlane:
 
     # --- commit thread --------------------------------------------------
     def _run(self) -> None:
+        from hyperqueue_tpu.utils import profiler
+
+        # plane label for the sampling profiler (ISSUE 19): commit-thread
+        # CPU shows up as the `journal` plane next to its lag histogram.
+        # Unregistered on EVERY exit path (clean drain and crash alike) so
+        # a recycled thread ident can never wear a stale label.
+        profiler.register_plane("journal")
+        try:
+            self._run_inner()
+        finally:
+            profiler.unregister_plane()
+
+    def _run_inner(self) -> None:
         try:
             while True:
                 with self._cv:

@@ -125,6 +125,13 @@ _GPU_MEM = REGISTRY.gauge(
     "hq_worker_gpu_mem_percent", "per-GPU memory utilization (HwSampler)",
     labels=("gpu",),
 )
+_PLANE_SHARE = REGISTRY.gauge(
+    "hq_worker_profile_plane_cpu_share",
+    "CPU cores used by each worker plane over the sampling window "
+    "(sampling profiler, ISSUE 19); piggybacks on overview messages so "
+    "the server re-exports it fleet-wide under a worker label",
+    labels=("plane",), max_series=16,
+)
 
 
 class RunningTask:
@@ -160,6 +167,7 @@ class WorkerRuntime:
         server_dir: Path | None = None,
         metrics_port: int | None = None,
         metrics_host: str = "0.0.0.0",
+        profile_hz: float = 19.0,
     ):
         self.host = host
         self.port = port
@@ -237,6 +245,19 @@ class WorkerRuntime:
         self.metrics_host = metrics_host
         self.metrics_port: int | None = None
         self._metrics_server = None
+        # sampling profiler (ISSUE 19): 0 disables; the "runtime" plane is
+        # this asyncio thread (drainer + overview are tasks on it)
+        self.profile_hz = float(profile_hz)
+        self._profiler_started = False
+
+    def _publish_plane_shares(self) -> None:
+        from hyperqueue_tpu.utils import profiler
+
+        if not profiler.PROFILER.running:
+            return
+        _PLANE_SHARE.clear()
+        for plane, agg in profiler.PROFILER.plane_shares().items():
+            _PLANE_SHARE.labels(plane).set(agg["cpu"])
 
     def _collect_metrics(self) -> None:
         """Scrape-time gauges from live runtime state (collect hook — no
@@ -244,6 +265,7 @@ class WorkerRuntime:
         _RUNNING.set(len(self.running))
         _PARKED.set(self._n_blocked)
         _SENDQ.set(self._sendq.qsize())
+        self._publish_plane_shares()
 
     async def _send(self, msg: dict) -> None:
         """Enqueue an uplink message; a drainer batches queued messages into
@@ -374,6 +396,11 @@ class WorkerRuntime:
             )
 
         REGISTRY.add_collect_hook(self._collect_metrics)
+        if self.profile_hz > 0 and not clock.is_simulated():
+            from hyperqueue_tpu.utils import profiler
+
+            profiler.register_plane("runtime")
+            self._profiler_started = profiler.start_profiler(self.profile_hz)
         if self.requested_metrics_port is not None:
             from hyperqueue_tpu.utils.metrics import start_metrics_server
 
@@ -478,6 +505,11 @@ class WorkerRuntime:
             if self._metrics_server is not None:
                 self._metrics_server.close()
             REGISTRY.remove_collect_hook(self._collect_metrics)
+            if self._profiler_started:
+                from hyperqueue_tpu.utils import profiler
+
+                profiler.stop_profiler()
+                self._profiler_started = False
             if self._conn:
                 self._conn.close()
 
@@ -1490,10 +1522,11 @@ async def run_worker(
     server_dir: Path | None = None,
     metrics_port: int | None = None,
     metrics_host: str = "0.0.0.0",
+    profile_hz: float = 19.0,
 ) -> None:
     runtime = WorkerRuntime(
         host, port, secret_key, configuration, zero_worker=zero_worker,
         server_dir=server_dir, metrics_port=metrics_port,
-        metrics_host=metrics_host,
+        metrics_host=metrics_host, profile_hz=profile_hz,
     )
     await runtime.run()

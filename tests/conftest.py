@@ -81,3 +81,9 @@ def pytest_configure(config):
         "count=8, set above) so tier-1 exercises the 8-device path on "
         "CPU-only hosts",
     )
+    config.addinivalue_line(
+        "markers",
+        "profile: continuous-profiling-plane tests (sampling profiler, "
+        "per-plane CPU attribution, profile-on-stall, regression blame; "
+        "ISSUE 19)",
+    )

@@ -144,6 +144,29 @@ def test_same_seed_bit_identical_digests():
     assert c.journal_digest != a.journal_digest
 
 
+@pytest.mark.profile
+def test_profiler_inert_under_sim_digests_unchanged():
+    """ISSUE 19 satellite: profiling requested ON in one run of a
+    determinism pair must be a no-op under the simulator — the sampler
+    is double-gated (memory-transport servers never start it, and
+    SamplingProfiler.start() refuses under a simulated clock), so the
+    journals stay bit-identical. The no-metrics scan above keeps
+    hq_profile_* literals out of sim code for the same reason."""
+    from hyperqueue_tpu.utils.profiler import PROFILER
+
+    def one_run(profile_hz: float):
+        wl = build("uniform", seed=21, n_tasks=150, dur_ms=300)
+        return run_scenario(wl, seed=21, n_workers=6,
+                            server_kwargs={"profile_hz": profile_hz})
+
+    a = one_run(0.0)
+    b = one_run(19.0)   # requested on; must stay inert
+    assert not PROFILER.running
+    assert a.journal_digest == b.journal_digest
+    assert a.decision_digest == b.decision_digest
+    assert a.audit == b.audit
+
+
 # --- kill -9 re-enactment (satellite: sim/e2e agreement) --------------
 def test_kill9_mid_chunked_submit_exactly_once():
     """Sim re-enactment of the real-process chaos scenario
