@@ -1548,9 +1548,14 @@ def run_reshard_smoke() -> None:
             finally:
                 os.environ.pop("HQ_SHARD", None)
             t0 = time.perf_counter()
+            # pin the rebalancer control loop to a fast deterministic
+            # cadence (HQ_REBALANCE_INTERVAL, server/federation.py) so
+            # convergence is bounded by migration work, not by where the
+            # default sampling interval happened to land
             env.start_standby("--lease-timeout", "2.0",
                               "--coordinator-interval", "0.25",
-                              "--rebalance")
+                              "--rebalance",
+                              env_extra={"HQ_REBALANCE_INTERVAL": "0.25"})
             store = OwnershipStore(env.server_dir)
 
             def engaged() -> bool:
@@ -1594,16 +1599,17 @@ def run_reshard_smoke() -> None:
                 failures.append("online shard add never served")
             env.start_worker("--shard", "2", cpus=2)
             # move one job onto the shard that did not exist at submit
-            # time (retry once: the rebalancer may hold the job's claim)
+            # time (retry on a short cadence matched to the pinned
+            # rebalancer interval: it may briefly hold the job's claim)
             migrated_to_new = False
-            for _ in range(3):
+            for _ in range(12):
                 try:
                     env.command(["fleet", "migrate", "1", "2"],
                                 timeout=60)
                     migrated_to_new = True
                     break
                 except AssertionError:
-                    time.sleep(2.0)
+                    time.sleep(0.5)
             if not migrated_to_new:
                 failures.append("migration onto the added shard failed")
             env.command(["job", "wait", "all"], timeout=180)
@@ -3028,6 +3034,259 @@ def run_sim_smoke(args) -> None:
     sys.exit(1 if failures else 0)
 
 
+def run_policy_smoke(args) -> None:
+    """Weighted-objective gate (ISSUE 20): the policy brain A/B'd in the
+    simulator, flat placement-count objective vs heterogeneity weights +
+    runtime prediction + fairness, on the same seeded workloads.
+
+    Legs:
+
+    1. Model-level weighted-kernel soak: numpy twin vs the jax device
+       path (resident state + paranoid fresh-solve cross-check every
+       tick) on the same affinity matrix, including zero-weight hard
+       exclusions — counts must be bitwise identical and excluded
+       (batch, worker) pairs must never place.
+    2. Bursty multi-tenant A/B (opt-in per-tenant duration scales):
+       weighted makespan must be STRICTLY better and the time-averaged
+       Jain fairness index must improve.
+    3. Straggler-tail A/B (opt-in split long job): the weighted leg's
+       predictor is seeded OFFLINE from the flat leg's journal (PR 14
+       replay), and the LPT boost must strictly beat the flat makespan.
+    4. Stress-dag A/B under a worker-group affinity matrix: weighted
+       makespan must not regress.
+
+    Weighted tick p95 must stay inside the 50 ms north-star budget on
+    every leg. One db.jsonl row per scenario (with the PR 19 per-phase
+    profile summary as blame metadata), auto-gated by --regress."""
+    import os
+    import tempfile
+    from pathlib import Path as _Path
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(_Path(__file__).resolve().parent / "benchmarks"))
+    from common import emit
+
+    from hyperqueue_tpu.models.greedy import GreedyCutScanModel
+    from hyperqueue_tpu.sim import build
+    from hyperqueue_tpu.sim.harness import run_scenario
+
+    failures = []
+    t_wall = time.perf_counter()
+
+    # --- leg 1: weighted kernel, numpy twin vs resident device path --
+    free, nt_free, lifetime, needs, sizes, min_time, _sc = build_instance(
+        n_workers=64, n_tasks=2000, n_b=16
+    )
+    n_b, n_w = needs.shape[0], free.shape[0]
+    rng = np.random.default_rng(7)
+    affinity = rng.choice(
+        [0.5, 1.0, 2.0], size=(n_b, n_w)
+    ).astype(np.float32)
+    affinity[:2, :8] = 0.0  # zero weight = hard exclusion
+    needs64 = needs.astype(np.int64)
+    host = GreedyCutScanModel(backend="numpy")
+    dev = GreedyCutScanModel(backend="jax")
+    dev.paranoid_resident = 1  # fresh-solve cross-check every tick
+    f, nt = free.copy(), nt_free.copy()
+    soak_ticks = 0
+    try:
+        for tick in range(5):
+            kwargs = dict(lifetime=lifetime, needs=needs, sizes=sizes,
+                          min_time=min_time, affinity=affinity)
+            a = host.solve(free=f.copy(), nt_free=nt.copy(), **kwargs)
+            b = dev.solve(free=f.copy(), nt_free=nt.copy(), **kwargs)
+            if not np.array_equal(a, b):
+                failures.append(
+                    f"soak tick {tick}: weighted numpy counts diverge "
+                    f"from the device path"
+                )
+                break
+            if a[:2, :, :8].any():
+                failures.append(
+                    f"soak tick {tick}: zero-weight workers received "
+                    f"placements"
+                )
+                break
+            used = np.einsum("bvw,bvr->wr", a.astype(np.int64), needs64)
+            f = (f - used).astype(np.int32)
+            nt = (nt - a.sum(axis=(0, 1))).astype(np.int32)
+            f[tick % n_w] = free[tick % n_w]
+            nt[tick % n_w] = nt_free[tick % n_w]
+            soak_ticks += 1
+    except Exception as e:  # noqa: BLE001 - recorded as a failure
+        failures.append(f"soak: {type(e).__name__}: {e}")
+    if soak_ticks and not dev.paranoid_checks:
+        failures.append("soak: resident paranoid check never engaged")
+
+    # --- A/B legs: flat objective vs the weighted policy -------------
+    def write_toml(path, text):
+        path.write_text(text)
+        return str(path)
+
+    def tick_p95(res) -> float:
+        ticks = sorted(res.tick_ms)
+        if not ticks:
+            return 0.0
+        return ticks[min(int(len(ticks) * 0.95), len(ticks) - 1)]
+
+    rows = []
+    with tempfile.TemporaryDirectory(prefix="hq-policy-") as td:
+        tmp = _Path(td)
+        # the flat leg still loads a (no-op) policy so both sides record
+        # the same Jain fairness telemetry through the same code path
+        flat_toml = write_toml(tmp / "flat.toml", "[fairness]\n"
+                               "enabled = false\n")
+        specs = []
+        # bursty multi-tenant, heterogeneous per-tenant durations, all
+        # bursts landing at once on a SATURATED pool (backlog far beyond
+        # the prefill budgets, so the boosted batch order decides which
+        # tenant's work flows to the workers every refill tick): fairness
+        # + prediction must strictly improve makespan AND Jain
+        specs.append(dict(
+            label="bursty-hetero",
+            wl=lambda: build("bursty", seed=11, n_tenants=4,
+                             bursts_per_tenant=2, tasks_per_burst=150,
+                             window=0.0,
+                             tenant_dur_scales=[0.25, 4.0, 1.0, 0.5]),
+            workers=2, groups=1, seed=11, strict=True, jain_gate=True,
+            policy="[fairness]\nenabled = true\nmax_boost = 8\n"
+                   "[prediction]\nenabled = true\nmax_boost = 2\n"
+                   "ewma_alpha = 0.3\nseed_journal = \"{journal}\"\n",
+        ))
+        # straggler tail, long tasks as their own job: the journal-seeded
+        # LPT boost must start the tail first and strictly win
+        specs.append(dict(
+            label="straggler-tail",
+            wl=lambda: build("tail", seed=5, n_tasks=500, split_long=True),
+            workers=8, groups=1, seed=5, strict=True, jain_gate=False,
+            policy="[prediction]\nenabled = true\nmax_boost = 4\n"
+                   "ewma_alpha = 0.3\nseed_journal = \"{journal}\"\n",
+        ))
+        # stress dag under a worker-group affinity matrix: reordering
+        # the water-fill must never cost makespan
+        specs.append(dict(
+            label="stress-dag",
+            wl=lambda: build("dag", seed=9, layers=8, width=16),
+            workers=8, groups=2, seed=9, strict=False, jain_gate=False,
+            policy="[affinity.\"cpus\"]\n\"g0\" = 2.0\n\"*\" = 1.0\n",
+        ))
+        for spec in specs:
+            label = spec["label"]
+            flat_dir = tmp / f"{label}-flat"
+            flat_dir.mkdir()
+            try:
+                flat = run_scenario(
+                    spec["wl"](), seed=spec["seed"],
+                    n_workers=spec["workers"],
+                    worker_groups=spec["groups"],
+                    scheduler="greedy-fused", server_dir=flat_dir,
+                    server_kwargs={"policy_file": flat_toml},
+                )
+                policy_toml = write_toml(
+                    tmp / f"{label}.toml",
+                    spec["policy"].format(
+                        journal=flat_dir / "journal.bin"
+                    ),
+                )
+                weighted = run_scenario(
+                    spec["wl"](), seed=spec["seed"],
+                    n_workers=spec["workers"],
+                    worker_groups=spec["groups"],
+                    scheduler="greedy-fused",
+                    server_kwargs={"policy_file": policy_toml},
+                )
+            except Exception as e:  # noqa: BLE001 - recorded
+                failures.append(f"{label}: {type(e).__name__}: {e}")
+                continue
+            p95 = tick_p95(weighted)
+            jain_flat = ((flat.policy or {}).get("jain") or {}).get("avg")
+            jain_w = (
+                (weighted.policy or {}).get("jain") or {}
+            ).get("avg")
+            row = {
+                "experiment": "policy_smoke",
+                "workload": label,
+                "scheduler": "greedy-fused",
+                "metric": "weighted_makespan_s",
+                "unit": "s",
+                "value": round(weighted.makespan, 2),
+                "makespan_flat_s": round(flat.makespan, 2),
+                "weighted_vs_flat": round(
+                    weighted.makespan / flat.makespan, 4
+                ) if flat.makespan else 0.0,
+                "jain_flat": jain_flat,
+                "jain_weighted": jain_w,
+                "tick_p95_ms": round(p95, 3),
+                "policy": weighted.policy,
+                "profile": {"planes": {}, "phases": weighted.tick_shares},
+            }
+            rows.append(row)
+            if weighted.makespan > flat.makespan + 1e-6:
+                failures.append(
+                    f"{label}: weighted makespan {weighted.makespan:.2f}s"
+                    f" > flat {flat.makespan:.2f}s"
+                )
+            elif spec["strict"] and not (
+                weighted.makespan < flat.makespan - 1e-6
+            ):
+                failures.append(
+                    f"{label}: weighted makespan {weighted.makespan:.2f}s"
+                    f" not strictly better than flat "
+                    f"{flat.makespan:.2f}s"
+                )
+            if spec["jain_gate"]:
+                if jain_flat is None or jain_w is None:
+                    failures.append(f"{label}: Jain telemetry missing")
+                elif jain_w <= jain_flat:
+                    failures.append(
+                        f"{label}: Jain {jain_w} did not improve on "
+                        f"flat {jain_flat}"
+                    )
+            if p95 > 50.0:
+                failures.append(
+                    f"{label}: weighted tick p95 {p95:.1f}ms > 50ms "
+                    f"budget"
+                )
+            pred = ((weighted.policy or {}).get("prediction") or {})
+            if "seed_journal" in spec["policy"] and not pred.get(
+                "observations", 0
+            ):
+                failures.append(
+                    f"{label}: predictor never observed a runtime "
+                    f"(policy={weighted.policy})"
+                )
+    for row in rows:
+        row["ok"] = not failures
+        row["failures"] = failures
+        emit(row)
+    emit({
+        "experiment": "policy_smoke",
+        "metric": "policy_soak_ticks",
+        "value": soak_ticks,
+        "unit": "ticks",
+        "paranoid_checks": dev.paranoid_checks,
+        "ok": not failures,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_wall, 2),
+    })
+    # --- regression gate: the rows just stored vs their prior rows ---
+    if not os.environ.get("HQ_BENCH_NO_DB"):
+        try:
+            checked, regs = check_regressions(experiment="policy_smoke")
+            if regs:
+                failures.append(
+                    f"regress: {len(regs)} metric(s) >20% worse than "
+                    f"their stored baselines: {regs}"
+                )
+            else:
+                print(f"# regress: OK ({checked} policy_smoke metric(s) "
+                      f"within 20% of baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - recorded as a failure
+            failures.append(f"regress: {type(e).__name__}: {e}")
+    print("policy-smoke:", "OK" if not failures else failures)
+    sys.exit(1 if failures else 0)
+
+
 def run_profile_smoke(args) -> None:
     """Continuous-profiling gate (ISSUE 19). Four legs:
 
@@ -3346,7 +3605,21 @@ def check_regressions(window: int = 5, threshold: float = 0.20,
     for r in db.records():
         if experiment is not None and r.experiment != experiment:
             continue
-        groups.setdefault((r.experiment, config_key(r.params)), []).append(r)
+        params = r.params or {}
+        # a failed smoke run stores {"ok": false, "value": null,
+        # "failures": [...]} — those rows are crash markers, not
+        # measurements, and must not seed prior-row medians
+        if params.get("ok") is False or (
+            "value" in params and params.get("value") is None
+        ):
+            continue
+        # volatile outcome fields would fork the config grouping (every
+        # distinct failure list becomes its own singleton group)
+        key_params = {k: v for k, v in params.items()
+                      if k not in ("ok", "failures")}
+        groups.setdefault(
+            (r.experiment, config_key(key_params)), []
+        ).append(r)
 
     checked = 0
     regressions = []
@@ -3588,6 +3861,14 @@ def main() -> None:
                         help="soak task count for --sim-smoke")
     parser.add_argument("--sim-workers", type=int, default=1000,
                         help="soak worker count for --sim-smoke")
+    parser.add_argument("--policy-smoke", action="store_true",
+                        help="weighted-objective gate (ISSUE 20): "
+                             "numpy-vs-device weighted-kernel soak with "
+                             "zero-weight exclusions, then seeded flat-vs-"
+                             "weighted A/B sims (bursty hetero, straggler "
+                             "tail, stress dag) gating makespan, Jain "
+                             "fairness, and tick p95; rows auto-gated by "
+                             "--regress")
     parser.add_argument("--profile-smoke", action="store_true",
                         help="continuous-profiling gate (ISSUE 19): "
                              "sampler overhead <= 5% on an encrypted "
@@ -3688,6 +3969,10 @@ def main() -> None:
 
     if args.sim_smoke:
         run_sim_smoke(args)
+        return
+
+    if args.policy_smoke:
+        run_policy_smoke(args)
         return
 
     if args.multichip_smoke:

@@ -191,6 +191,9 @@ def cmd_server_start(args) -> None:
             metrics_host=args.metrics_host,
             flight_recorder_ticks=args.flight_recorder_ticks,
             tick_pipeline=args.tick_pipeline,
+            policy_file=(
+                Path(args.policy_file) if args.policy_file else None
+            ),
             stall_budget=args.stall_budget,
             stall_dumps=args.stall_dumps,
             profile_hz=args.profile_hz,
@@ -267,6 +270,9 @@ def _run_standby(args, shards: int) -> None:
         client_plane=args.client_plane,
         journal_plane=args.journal_plane,
         fanout_senders=args.fanout_senders,
+        policy_file=(
+            Path(args.policy_file) if args.policy_file else None
+        ),
         lazy_array_threshold=args.lazy_array_threshold,
     )
     print(f"+-- HyperQueue TPU standby watching {root} --", flush=True)
@@ -353,6 +359,34 @@ def cmd_server_stats(args) -> None:
     tick = stats.get("tick") or {}
     print(f"scheduler: {stats.get('scheduler')} "
           f"(backend {stats.get('solve_backend')})")
+    pol = stats.get("policy")
+    if pol:
+        print(
+            f"policy: {pol.get('source')} — "
+            f"{pol.get('affinity_classes', 0)} affinity class(es), "
+            f"fairness {'on' if (pol.get('fairness') or {}).get('enabled') else 'off'}, "
+            f"prediction {'on' if (pol.get('prediction') or {}).get('enabled') else 'off'}, "
+            f"boost range {pol.get('boost_range')}"
+        )
+        pred = pol.get("prediction") or {}
+        if pred.get("enabled"):
+            line = (
+                f"  predictor: {pred.get('classes', 0)} class(es), "
+                f"{pred.get('observations', 0)} observation(s), "
+                f"hit rate {pred.get('hit_rate', 0.0):.2f}"
+            )
+            if pred.get("seeded_from"):
+                line += (
+                    f", seeded {pred.get('seeded_samples', 0)} sample(s) "
+                    f"from {pred['seeded_from']}"
+                )
+            print(line)
+        jain = pol.get("jain")
+        if jain:
+            print(
+                f"  fairness jain: last {jain.get('last')}, "
+                f"avg {jain.get('avg')} over {jain.get('ticks')} tick(s)"
+            )
     print(f"ticks: {tick.get('ticks', 0)}")
     phase_rows = tick.get("phases") or {}
     if phase_rows:
@@ -2512,6 +2546,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlapping device execution with inter-tick "
                         "host work (scheduler/pipeline.py); assignments "
                         "lag one tick")
+    p.add_argument("--policy-file", default=None, metavar="TOML",
+                   help="weighted scheduling objective (requires "
+                        "--scheduler greedy-fused): TOML with [affinity] "
+                        "per-(task-class, worker-group) weight rows "
+                        "(0 = hard exclusion), [fairness] dominant-"
+                        "resource-deficit priority boosts, and "
+                        "[prediction] runtime-EWMA critical-path boosts "
+                        "(docs/scheduler.md \"Scheduling policies\")")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve Prometheus metrics on this port (0 = "
                         "ephemeral, see `hq server info`; off by default)")
@@ -3292,6 +3334,18 @@ def cmd_task_explain(args) -> None:
             line += f" ({result['solver_backend_reason']})"
         if result.get("solver_pipelined"):
             line += " [pipelined]"
+        out.message(line)
+    pol = result.get("policy")
+    if pol:
+        pred = pol.get("prediction") or {}
+        line = (
+            f"policy: {pol.get('source')} "
+            f"({pol.get('affinity_classes', 0)} affinity class(es), "
+            f"boost range {pol.get('boost_range')}"
+        )
+        if pred.get("enabled"):
+            line += f", predictor hit rate {pred.get('hit_rate', 0.0):.2f}"
+        line += ")"
         out.message(line)
     if result["n_waiting_deps"]:
         out.message(f"waiting for {result['n_waiting_deps']} dependencies")

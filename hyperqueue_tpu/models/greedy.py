@@ -419,19 +419,22 @@ class GreedyCutScanModel:
         gang_nodes: np.ndarray | None = None,    # (B,) int32 gang sizes
         gang_ok: np.ndarray | None = None,       # (W,) int32 host idleness
         group_onehot: np.ndarray | None = None,  # (W, G) int32 group map
+        affinity: np.ndarray | None = None,      # (B, W) float policy
+                                                 # weights (heterogeneity
+                                                 # matrix rows per batch)
     ) -> np.ndarray:
         """Returns counts (B, V, W) int32 (unpadded, C-contiguous)."""
         return self.solve_async(
             free, nt_free, lifetime, needs, sizes, min_time,
             priorities=priorities, total=total, all_mask=all_mask,
             weights=weights, gang_nodes=gang_nodes, gang_ok=gang_ok,
-            group_onehot=group_onehot,
+            group_onehot=group_onehot, affinity=affinity,
         ).result()
 
     def solve_async(
         self, free, nt_free, lifetime, needs, sizes, min_time,
         priorities=None, total=None, all_mask=None, weights=None,
-        gang_nodes=None, gang_ok=None, group_onehot=None,
+        gang_nodes=None, gang_ok=None, group_onehot=None, affinity=None,
     ):
         """Dispatch one solve; returns a handle whose `.result()` yields the
         unpadded counts.  Host backends compute eagerly (the handle is just
@@ -442,6 +445,7 @@ class GreedyCutScanModel:
         prep = self._prepare(
             free, nt_free, lifetime, needs, sizes, min_time, total, all_mask,
             gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
+            affinity=affinity,
         )
         backend, reason = self._backend_decision(prep["shape_key"])
         self.last_backend_reason = reason
@@ -464,7 +468,7 @@ class GreedyCutScanModel:
     # -- preparation (shared by every backend) ----------------------------
     def _prepare(self, free, nt_free, lifetime, needs, sizes, min_time,
                  total, all_mask, gang_nodes=None, gang_ok=None,
-                 group_onehot=None) -> dict:
+                 group_onehot=None, affinity=None) -> dict:
         _t0 = time.perf_counter()
         n_w, n_r = free.shape
         n_b, n_v, _ = needs.shape
@@ -480,6 +484,16 @@ class GreedyCutScanModel:
         if gang_nodes is not None and not np.any(np.asarray(gang_nodes) > 0):
             gang_nodes = None  # keep the common no-gang compiled program
         has_gang = gang_nodes is not None
+        if affinity is not None:
+            affinity = np.asarray(affinity, dtype=np.float32)
+            if (
+                affinity.size == 0
+                or (affinity.min() == affinity.max() and affinity.min() > 0)
+            ):
+                # a uniform positive matrix cannot change the visit order or
+                # exclude a worker: keep the flat-objective program
+                affinity = None
+        has_pmask = affinity is not None and bool(np.any(affinity <= 0))
 
         buf = self._get_buffers(pw, pb, pr, pv, has_all)
         free_p = buf["free"]
@@ -549,13 +563,24 @@ class GreedyCutScanModel:
             goh_p = np.zeros((pw, pg), dtype=np.int32)
             if group_onehot is not None:
                 goh_p[:n_w, :n_g] = group_onehot
+        aff_p = pmask_p = None
+        if affinity is not None:
+            # like the gang inputs: FRESH per-solve allocations — weighted
+            # objectives appear only under an active policy, and keying the
+            # donated-buffer cache on their presence would churn the
+            # steady-state shape; both arrays are small ((B, W))
+            aff_p = np.zeros((pb, pw), dtype=np.float32)
+            aff_p[:n_b, :n_w] = affinity
+            if has_pmask:
+                pmask_p = np.zeros((pb, pw), dtype=np.int32)
+                pmask_p[:n_b, :n_w] = (affinity > 0).astype(np.int32)
         _t1 = time.perf_counter()
 
         scarcity = np.asarray(
             scarcity_weights(free_p.astype(np.int64).sum(axis=0))
         ).astype(np.float32)
         class_m, order_ids = host_visit_classes(
-            free_p, needs_p, scarcity, all_mask=amask_p
+            free_p, needs_p, scarcity, all_mask=amask_p, affinity=aff_p
         )
         # bucket the mask-table dimension so steady-state ticks reuse the
         # compiled program; padding rows are all-class-0 (never referenced)
@@ -570,10 +595,13 @@ class GreedyCutScanModel:
             "needs_p": needs_p, "sizes_p": sizes_p, "mt_p": mt_p,
             "total_p": total_p, "amask_p": amask_p,
             "gang_p": gang_p, "gok_p": gok_p, "goh_p": goh_p,
+            "pmask_p": pmask_p,
             "class_m": class_m, "order_ids": order_ids,
             "extents": (n_b, n_v, n_w),
-            "shape_key": (pw, pb, pr, pv, pm, has_all, has_gang, pg),
+            "shape_key": (pw, pb, pr, pv, pm, has_all, has_gang, pg,
+                          has_pmask),
             "has_all": has_all, "has_gang": has_gang,
+            "has_pmask": has_pmask,
             "pad_ms": (_t1 - _t0) * 1e3,
             "visit_ms": (_t2 - _t1) * 1e3,
             "dispatch_ms": 0.0,
@@ -606,7 +634,10 @@ class GreedyCutScanModel:
         it rather than silently dropping the constraint."""
         from hyperqueue_tpu.utils.native import native_cut_scan
 
-        if prep["has_gang"]:
+        if prep["has_gang"] or prep["has_pmask"]:
+            # the native scan predates both the gang rows and the policy
+            # mask: a solve carrying either bypasses it rather than
+            # silently dropping the constraint
             self.last_backend = "host-numpy"
             counts, _free_after, _nt_after = greedy_cut_scan_numpy(
                 prep["free_p"], prep["nt_p"], prep["life_p"],
@@ -614,6 +645,7 @@ class GreedyCutScanModel:
                 prep["class_m"], prep["order_ids"], total=prep["total_p"],
                 all_mask=prep["amask_p"], gang_nodes=prep["gang_p"],
                 gang_ok=prep["gok_p"], group_onehot=prep["goh_p"],
+                policy_mask=prep["pmask_p"],
             )
             return counts
         counts = native_cut_scan(
@@ -695,6 +727,7 @@ class GreedyCutScanModel:
             gang_nodes=res.place_cached("gang_nodes", prep["gang_p"]),
             gang_ok=res.place_cached("gang_ok", prep["gok_p"]),
             group_onehot=res.place_cached("group_onehot", prep["goh_p"]),
+            policy_mask=res.place_cached("policy_mask", prep["pmask_p"]),
         )
 
     def _maybe_paranoid_check(self, prep, out: np.ndarray) -> None:
@@ -729,6 +762,7 @@ class GreedyCutScanModel:
             total=None if prep["total_p"] is None else prep["total_p"].copy(),
             all_mask=prep["amask_p"], gang_nodes=prep["gang_p"],
             gang_ok=prep["gok_p"], group_onehot=prep["goh_p"],
+            policy_mask=prep["pmask_p"],
         )
         return counts
 

@@ -136,6 +136,9 @@ class MultichipModel(GreedyCutScanModel):
             group_onehot=res.place_cached(
                 "group_onehot", prep["goh_p"], kind=0
             ),
+            policy_mask=res.place_cached(
+                "policy_mask", prep["pmask_p"], kind=3
+            ),
         )
 
     def _fresh_device_counts(self, prep):
@@ -153,6 +156,7 @@ class MultichipModel(GreedyCutScanModel):
             prep["class_m"], prep["order_ids"], total=prep["total_p"],
             all_mask=prep["amask_p"], gang_nodes=prep["gang_p"],
             gang_ok=prep["gok_p"], group_onehot=prep["goh_p"],
+            policy_mask=prep["pmask_p"],
         )
         counts, _f, _n = sharded_cut_scan(mesh, *placed)
         return counts

@@ -68,6 +68,12 @@ def _load_jax() -> None:
 # key stays well inside int32.
 _WASTE_Q = 65536
 
+# Policy affinity weights are clamped to [0, _AFF_MAX] before quantization;
+# the visit-class key combines (-affinity, waste) lexicographically, so the
+# affinity term needs a multiplier strictly above the waste range.
+_AFF_MAX = 256.0
+_AFF_STRIDE = _WASTE_Q * 2  # > max waste_q (waste <= 1 since scarcity sums to 1)
+
 
 MAX_KERNEL_AMOUNT = 2**23  # all amounts must be below this (float32-exact)
 
@@ -164,7 +170,7 @@ def _water_fill_classed(
 N_VISIT_CLASSES = 16
 
 
-def host_visit_classes(free0, needs, scarcity, all_mask=None):
+def host_visit_classes(free0, needs, scarcity, all_mask=None, affinity=None):
     """Precompute worker visit classes per distinct request mask (numpy).
 
     The preference order (avoid burning scarce resources a request does not
@@ -175,6 +181,14 @@ def host_visit_classes(free0, needs, scarcity, all_mask=None):
     permutations (arbitrary-permutation gathers cost ~140us per scan step on
     TPU), each worker gets a visit CLASS = dense rank of its waste score; the
     kernel water-fills class-by-class with cumsums only.
+
+    affinity (B, W) float, optional: per-(batch, worker) policy weight (the
+    heterogeneity matrix `S` sliced per batch row). The visit key becomes the
+    lexicographic pair (-affinity, waste): higher-throughput workers are
+    water-filled first, waste breaks ties. Deduplication then keys on (mask,
+    affinity row) so two batches with identical request shapes but different
+    weight rows get distinct classes. With affinity=None the behavior is
+    bit-identical to the unweighted kernel.
 
     Returns (class_m (M, W) int32 in [0, N_VISIT_CLASSES), order_ids (B, V)
     int32). Only ~M*W ints cross the host->device boundary per tick.
@@ -188,14 +202,27 @@ def host_visit_classes(free0, needs, scarcity, all_mask=None):
         # an ALL-policy entry requests the resource (amount is the pool)
         masks = masks & ~(np.asarray(all_mask) > 0)
     flat = masks.reshape(n_b * n_v, -1)
-    uniq, inverse = np.unique(flat, axis=0, return_inverse=True)
+    if affinity is None:
+        uniq, inverse = np.unique(flat, axis=0, return_inverse=True)
+        aff_u = None
+    else:
+        aff = np.clip(np.asarray(affinity, dtype=np.float64), 0.0, _AFF_MAX)
+        aff_q = np.round(aff * _WASTE_Q).astype(np.int64)  # (B, W)
+        aff_rep = np.repeat(aff_q, n_v, axis=0)  # (B*V, W)
+        combined = np.concatenate([flat.astype(np.int64), aff_rep], axis=1)
+        _u, index, inverse = np.unique(
+            combined, axis=0, return_index=True, return_inverse=True
+        )
+        uniq = flat[index]
+        aff_u = aff_rep[index]  # (M, W)
     weighted = has * np.asarray(scarcity)[None, :]  # (W, R)
     waste = np.einsum("mr,wr->mw", uniq.astype(np.float32), weighted)
     waste_q = np.round(waste * _WASTE_Q).astype(np.int64)
-    class_m = np.empty_like(waste_q, dtype=np.int32)
-    for m in range(waste_q.shape[0]):
-        levels = np.unique(waste_q[m])  # sorted ascending
-        class_m[m] = np.searchsorted(levels, waste_q[m]).astype(np.int32)
+    key = waste_q if aff_u is None else waste_q - aff_u * np.int64(_AFF_STRIDE)
+    class_m = np.empty_like(key, dtype=np.int32)
+    for m in range(key.shape[0]):
+        levels = np.unique(key[m])  # sorted ascending
+        class_m[m] = np.searchsorted(levels, key[m]).astype(np.int32)
     np.clip(class_m, 0, N_VISIT_CLASSES - 1, out=class_m)
     order_ids = inverse.reshape(n_b, n_v).astype(np.int32)
     return class_m, order_ids
@@ -248,6 +275,7 @@ def scan_batches(
     free, nt_free, lifetime, needs, sizes, min_time, onehots, water_fill,
     total=None, all_mask=None,
     gang_nodes=None, gang_ok=None, group_onehot=None, gang_select=None,
+    policy_mask=None,
 ):
     """Scan priority-ordered batches, water-filling each over the workers.
 
@@ -275,11 +303,19 @@ def scan_batches(
     zeroed) for the rest of the scan — the in-solve equivalent of the host
     `mn_reserved` reservation drain, so lower-priority work cannot steal
     members while a gang accumulates.
+
+    policy_mask (B, W) int32 0/1, optional: zero marks workers a batch's
+    policy weight row excludes (affinity 0 = hard incompatibility per the
+    Gavel throughput-matrix semantics). A masked worker contributes no
+    capacity to the batch and is ineligible as a gang member. Callers pass
+    it only when at least one zero exists; the all-ones mask is the None
+    path.
     """
     _load_jax()
     n_variants = needs.shape[1]
     has_all = all_mask is not None
     has_gang = gang_nodes is not None
+    has_pmask = policy_mask is not None
     if has_gang and gang_select is None:
         gang_select = _gang_select_local
 
@@ -294,6 +330,7 @@ def scan_batches(
         rest = batch[4:]
         b_all = rest.pop(0) if has_all else None
         b_gang = rest.pop(0) if has_gang else None
+        b_pmask = rest.pop(0) if has_pmask else None
         remaining = b_size
         counts_v = []
         emit = None
@@ -304,6 +341,8 @@ def scan_batches(
                 gang_avail * time_ok0
                 * (nt_free >= 1).astype(jnp.int32)
             )
+            if has_pmask:
+                elig = elig * b_pmask
             take, any_feas = gang_select(elig, group_onehot, b_gang)
             take = take * is_gang
             emit = take * any_feas.astype(jnp.int32)
@@ -321,6 +360,8 @@ def scan_batches(
                 free, nt_free, need, time_ok, total=total, all_r=all_r
             )
             cap = jnp.minimum(cap, remaining)
+            if has_pmask:
+                cap = cap * b_pmask
             assign, assigned = water_fill(cap, remaining, b_onehot[v])
             remaining = remaining - assigned
             free = free - assign[:, None] * need[None, :]
@@ -342,6 +383,9 @@ def scan_batches(
         xs = xs + (all_mask,)
     if has_gang:
         xs = xs + (gang_nodes,)
+    if has_pmask:
+        xs = xs + (policy_mask,)
+    if has_gang:
         carry0 = (free, nt_free, gang_ok.astype(jnp.int32))
         (free, nt_free, _), counts = jax.lax.scan(batch_body, carry0, xs)
     else:
@@ -354,7 +398,7 @@ def scan_batches(
 def greedy_cut_scan_impl(
     free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
-    gang_nodes=None, gang_ok=None, group_onehot=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, policy_mask=None,
 ):
     """Single-chip kernel: one-hot expansion + the shared batch scan.
 
@@ -371,6 +415,7 @@ def greedy_cut_scan_impl(
         free, nt_free, lifetime, needs, sizes, min_time, onehots,
         _water_fill_classed, total=total, all_mask=all_mask,
         gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
+        policy_mask=policy_mask,
     )
 
 
@@ -394,7 +439,7 @@ def greedy_cut_scan(*args, **kwargs):
 def greedy_cut_scan_numpy(
     free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
-    gang_nodes=None, gang_ok=None, group_onehot=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, policy_mask=None,
 ):
     """Vectorized numpy implementation of the cut-scan (identical semantics).
 
@@ -419,6 +464,9 @@ def greedy_cut_scan_numpy(
         gang_nodes = np.asarray(gang_nodes)
         gang_avail = np.asarray(gang_ok, dtype=bool).copy()
         group_oh = np.asarray(group_onehot, dtype=bool)  # (W, G)
+    pmask = (
+        np.asarray(policy_mask) > 0 if policy_mask is not None else None
+    )  # (B, W) bool
 
     for b in range(n_b):
         remaining = int(sizes[b])
@@ -432,6 +480,8 @@ def greedy_cut_scan_numpy(
                 & (min_time[b, 0] <= lifetime)
                 & (nt_free >= 1)
             )
+            if pmask is not None:
+                elig = elig & pmask[b]
             per_group = (elig[:, None] & group_oh).sum(axis=0)  # (G,)
             feasible = per_group >= n
             chosen = int(
@@ -477,6 +527,8 @@ def greedy_cut_scan_numpy(
             cap = np.minimum(per_res, nt_free)
             cap[min_time[b, v] > lifetime] = 0
             np.clip(cap, 0, remaining, out=cap)
+            if pmask is not None:
+                cap[~pmask[b]] = 0
             if not cap.any():
                 continue
             order = np.lexsort((idx, class_ids[b, v]))

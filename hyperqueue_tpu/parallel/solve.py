@@ -134,7 +134,7 @@ def _sharded_gang_select(elig, group_onehot, n, axis):
 def _sharded_body(
     free, nt_free, lifetime, needs, sizes, min_time, class_m, order_ids,
     total=None, all_mask=None,
-    gang_nodes=None, gang_ok=None, group_onehot=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, policy_mask=None,
 ):
     """shard_map body: free/nt_free/lifetime/class_m/total are local worker
     shards; needs/sizes/min_time/order_ids/all_mask are replicated. The
@@ -161,13 +161,14 @@ def _sharded_body(
         total=total, all_mask=all_mask,
         gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
         gang_select=gang_select if gang_nodes is not None else None,
+        policy_mask=policy_mask,
     )
 
 
 def _sharded_cut_scan_impl(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
-    gang_nodes=None, gang_ok=None, group_onehot=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, policy_mask=None,
 ):
     in_specs = [
         P("w", None),              # free
@@ -192,11 +193,14 @@ def _sharded_cut_scan_impl(
     if gang_nodes is not None:
         in_specs.extend([P(), P("w"), P("w", None)])
         args.extend([gang_nodes, gang_ok, group_onehot])
+    if policy_mask is not None:
+        in_specs.append(P(None, "w"))  # (B, W) per-batch worker mask
+        args.append(policy_mask)
 
     def body(free, nt_free, lifetime, needs, sizes, min_time, class_m,
              order_ids, *extra):
         i = 0
-        t = m = gn = go = goh = None
+        t = m = gn = go = goh = pm = None
         if total is not None:
             t = extra[i]
             i += 1
@@ -205,10 +209,13 @@ def _sharded_cut_scan_impl(
             i += 1
         if gang_nodes is not None:
             gn, go, goh = extra[i:i + 3]
+            i += 3
+        if policy_mask is not None:
+            pm = extra[i]
         return _sharded_body(
             free, nt_free, lifetime, needs, sizes, min_time, class_m,
             order_ids, total=t, all_mask=m,
-            gang_nodes=gn, gang_ok=go, group_onehot=goh,
+            gang_nodes=gn, gang_ok=go, group_onehot=goh, policy_mask=pm,
         )
 
     return _shard_map(
@@ -224,20 +231,21 @@ def _sharded_cut_scan_impl(
 def sharded_cut_scan(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
-    gang_nodes=None, gang_ok=None, group_onehot=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, policy_mask=None,
 ):
     """Worker-sharded variant of ops.assign.greedy_cut_scan — same inputs,
     same outputs, identical semantics.
 
-    free/total (W, R), nt_free/lifetime/gang_ok (W,), class_m (M, W) and
-    group_onehot (W, G) sharded on axis "w"; needs/sizes/min_time/
-    order_ids/all_mask/gang_nodes replicated. Returns counts (B, V, W)
-    sharded on W, plus free/nt_free after.
+    free/total (W, R), nt_free/lifetime/gang_ok (W,), class_m (M, W),
+    policy_mask (B, W) and group_onehot (W, G) sharded on axis "w";
+    needs/sizes/min_time/order_ids/all_mask/gang_nodes replicated. Returns
+    counts (B, V, W) sharded on W, plus free/nt_free after.
     """
     return _sharded_cut_scan_impl(
         mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
         order_ids, total=total, all_mask=all_mask,
         gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
+        policy_mask=policy_mask,
     )
 
 
@@ -247,7 +255,7 @@ def sharded_cut_scan(
 def sharded_cut_scan_donate(
     mesh: Mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
     order_ids, total=None, all_mask=None,
-    gang_nodes=None, gang_ok=None, group_onehot=None,
+    gang_nodes=None, gang_ok=None, group_onehot=None, policy_mask=None,
 ):
     """`sharded_cut_scan` with `free`/`nt_free` DONATED: the input buffers
     are consumed and their storage reused for `free_after`/`nt_after`.
@@ -261,6 +269,7 @@ def sharded_cut_scan_donate(
         mesh, free, nt_free, lifetime, needs, sizes, min_time, class_m,
         order_ids, total=total, all_mask=all_mask,
         gang_nodes=gang_nodes, gang_ok=gang_ok, group_onehot=group_onehot,
+        policy_mask=policy_mask,
     )
 
 
@@ -283,7 +292,7 @@ def _mesh_shardings(mesh: Mesh):
 def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
                       min_time, class_m, order_ids, total=None,
                       all_mask=None, gang_nodes=None, gang_ok=None,
-                      group_onehot=None):
+                      group_onehot=None, policy_mask=None):
     """Device-put the tick tensors with the proper shardings."""
     w2, w1, rep, cm = _mesh_shardings(mesh)
     out = (
@@ -297,7 +306,8 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
         jax.device_put(order_ids, rep),
     )
     has_gang = gang_nodes is not None
-    if total is not None or all_mask is not None or has_gang:
+    has_pmask = policy_mask is not None
+    if total is not None or all_mask is not None or has_gang or has_pmask:
         out = out + (
             None if total is None else jax.device_put(total, w2),
             None if all_mask is None else jax.device_put(all_mask, rep),
@@ -308,4 +318,8 @@ def place_tick_inputs(mesh: Mesh, free, nt_free, lifetime, needs, sizes,
             jax.device_put(gang_ok, w1),
             jax.device_put(group_onehot, w2),
         )
+    elif has_pmask:
+        out = out + (None, None, None)
+    if has_pmask:
+        out = out + (jax.device_put(policy_mask, cm),)
     return out

@@ -53,6 +53,10 @@ REASON_GANG_GROUP_DEFERRED = "gang-group-deferred"
 # The solve placed deeper critical-path work of the SAME job first (b-level
 # lookahead); this class was deliberately held behind it this tick.
 REASON_LOOKAHEAD_HELD = "lookahead-held"
+# A fairness-boosted job (scheduler/policy.py dominant-resource deficit)
+# jumped ahead of this class's original priority this tick; the class waits
+# while the under-served job catches up to its fair share.
+REASON_FAIRNESS_DEFERRED = "fairness-deferred"
 
 ALL_REASONS = frozenset(
     value
@@ -205,6 +209,7 @@ FREE_SCAN_BUDGET = 20_000
 def build_unplaced_entries(
     core, leftover_batches, rq_reasons, degraded: bool = False,
     placed_blevel: dict | None = None,
+    fairness_placed: tuple | None = None,
 ) -> list[dict]:
     """Fold leftover batches into per-(class, job) unplaced entries.
 
@@ -222,6 +227,12 @@ def build_unplaced_entries(
     DID receive assignments this tick; a solver-deferred class whose own
     b-level is strictly below that mark was held behind deeper
     critical-path work of its own job and reports lookahead-held instead.
+
+    `fairness_placed` is the LOWEST original priority tuple among batches
+    of fairness-boosted jobs that received assignments this tick (None when
+    no boosted job placed work): a still-solver-deferred class whose own
+    original priority is strictly ABOVE that mark was overtaken by the
+    fairness boost and reports fairness-deferred instead.
     """
     entries: list[dict] = []
     truncated = 0
@@ -251,6 +262,12 @@ def build_unplaced_entries(
                 and decode_sched_blevel(batch.priority[1]) < placed
             ):
                 reason = REASON_LOOKAHEAD_HELD
+        if (
+            fairness_placed is not None
+            and reason == REASON_SOLVER_DEFERRED
+            and tuple(batch.priority) > tuple(fairness_placed)
+        ):
+            reason = REASON_FAIRNESS_DEFERRED
         entries.append({
             "rq_id": batch.rq_id,
             "job": job_id,

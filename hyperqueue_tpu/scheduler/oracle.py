@@ -12,7 +12,8 @@ from __future__ import annotations
 
 
 def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity,
-                 gang_nodes=None, gang_ok=None, group_ids=None):
+                 gang_nodes=None, gang_ok=None, group_ids=None,
+                 affinity=None):
     """Same contract as ops.assign.greedy_cut_scan, lists/nested lists in,
     counts[b][v][w] out. Mutates nothing.
 
@@ -24,6 +25,12 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity,
     the selected members are held (free/nt zeroed) for the rest of the
     scan, and any single-node assignment makes a worker ineligible for
     later gangs.
+
+    affinity[b][w] (optional) is the policy weight matrix row per batch
+    (scheduler/policy.py): workers are visited in (-affinity, waste, index)
+    order — the same lexicographic key host_visit_classes encodes into
+    visit classes — and a zero weight is a hard exclusion (the worker
+    contributes no capacity and no gang membership for that batch).
     """
     n_w = len(free)
     n_r = len(free[0]) if n_w else 0
@@ -47,6 +54,7 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity,
                     gang_avail[w]
                     and min_time[b][0] <= lifetime[w]
                     and nt_free[w] >= 1
+                    and (affinity is None or affinity[b][w] > 0)
                 ):
                     per_group[group_ids[w]] += 1
                     members[group_ids[w]].append(w)
@@ -77,7 +85,8 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity,
                     if need[r] > 0:
                         cap = min(cap, free[w][r] // need[r])
                 caps.append(max(cap, 0))
-            # worker order: scarcity-weighted waste of unrequested resources
+            # worker order: policy affinity descending first (when active),
+            # then scarcity-weighted waste of unrequested resources
             # (computed from the tick's INITIAL free state, like the kernel's
             # precomputed visit orders), then index
             def key(w):
@@ -86,11 +95,17 @@ def solve_oracle(free, nt_free, lifetime, needs, sizes, min_time, scarcity,
                     for r in range(n_r)
                     if free0[w][r] > 0 and need[r] == 0
                 )
-                return (round(waste * 65536), w)
+                aff_q = (
+                    0 if affinity is None
+                    else round(affinity[b][w] * 65536)
+                )
+                return (-aff_q, round(waste * 65536), w)
 
             for w in sorted(range(n_w), key=key):
                 if remaining <= 0:
                     break
+                if affinity is not None and affinity[b][w] <= 0:
+                    continue  # zero weight = hard exclusion
                 take = min(caps[w], remaining)
                 if take <= 0:
                     continue

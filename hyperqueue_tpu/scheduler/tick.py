@@ -166,6 +166,7 @@ def run_tick(
     pipeline=None,
     gang_ok=None,
     group_ids=None,
+    policy=None,
 ) -> list[Assignment]:
     """Solve one tick and pop assigned tasks from the queues.
 
@@ -192,6 +193,13 @@ def run_tick(
     and THIS call returns no assignments — the caller maps the pending
     solve at the top of its next tick (pipeline.take_result), overlapping
     the device execution with the inter-tick host work.
+
+    `policy` (a scheduler/policy.TickPolicyContext) carries this tick's
+    resolved heterogeneity-affinity rows and per-job priority boosts; both
+    fold into assemble_solve_inputs (the boost into the batch sort, the
+    rows into the (B, W) affinity matrix the model consumes), so every
+    solve path — device, numpy twin, watchdog fallback, pipelined — sees
+    the same weighted objective.
     """
     if batches is None:
         batches = create_batches(queues)
@@ -204,7 +212,7 @@ def run_tick(
             queues, None, rq_map, resource_map, model, batches,
             dense=dense, phases=phases, key_cache=key_cache,
             decision=decision, pipeline=pipeline,
-            gang_ok=gang_ok, group_ids=group_ids,
+            gang_ok=gang_ok, group_ids=group_ids, policy=policy,
         )
     if not batches or not workers:
         return []
@@ -231,13 +239,20 @@ def run_tick(
                 count=len(workers),
             ),
             phases=phases, key_cache=key_cache, decision=decision,
+            policy=policy,
         )
     workers = [w for w in workers if w.cpu_floor <= 0]
     if not workers:
         return _solve_mu_workers(queues, mu_workers, rq_map, resource_map)
+    if mu_workers and policy is not None and policy.rows:
+        # the mu carve-out just dropped workers from the row list, so the
+        # (B, W) affinity rows (built against the unfiltered order) no
+        # longer align — keep only the alignment-free priority boosts
+        policy = type(policy)(rows={}, boosts=policy.boosts)
     assignments = _run_main_solve(
         queues, workers, rq_map, resource_map, model, batches,
         phases=phases, key_cache=key_cache, decision=decision,
+        policy=policy,
     )
     if mu_workers:
         assignments.extend(
@@ -248,7 +263,7 @@ def run_tick(
 
 def assemble_solve_inputs(workers, batches, rq_map, resource_map,
                           cpu_floor=None, dense=None, key_cache=None,
-                          gang_ok=None, group_ids=None):
+                          gang_ok=None, group_ids=None, policy=None):
     """Build the dense model.solve inputs for `batches` over `workers`.
 
     Sorts `batches` IN PLACE into the production solve order (priority,
@@ -420,6 +435,16 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
             out.append((variant.weight * share, fit))
         return out
 
+    # policy priority boosts (scheduler/policy.py): a boosted job's batches
+    # sort as if the job had been submitted `boost` jobs earlier — one
+    # BLEVEL_STRIDE per boost step, the same arithmetic the sched encoding
+    # uses for job ordering (queues.encode_sched_priority).  The batch's
+    # own priority tuple is NOT mutated: the mapping phase and the decision
+    # record keep the original submission order.
+    pol_boosts = policy is not None and bool(policy.boosts)
+    if pol_boosts:
+        from hyperqueue_tpu.scheduler.queues import BLEVEL_STRIDE
+
     def _sort_key(b: Batch):
         cached = _key_cache.get(b.rq_id)
         if cached is None:
@@ -434,12 +459,17 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
             cand = (value * (size if size < fit else fit), -value)
             if cand > best:
                 best = cand
+        sched = b.priority[1]
+        if pol_boosts:
+            boost = policy.boost_for_sched(sched)
+            if boost:
+                sched = sched + boost * BLEVEL_STRIDE
         # gang rows sort ahead of same-user-priority single-node work (the
         # in-solve mirror of the host gang phase running before the dense
         # solve); without the boost a deep filler backlog would touch every
         # idle worker before any gang row scans, starving gangs forever
         return (
-            (b.priority[0], 1 if b.gang_nodes else 0, b.priority[1]),
+            (b.priority[0], 1 if b.gang_nodes else 0, sched),
             scarcity, best,
         )
 
@@ -554,6 +584,21 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
         extra = {"total": total.astype(np.int32), "all_mask": all_mask}
     if w_arr is not None:
         extra["weights"] = w_arr
+    if policy is not None and policy.rows:
+        # heterogeneity affinity (B, W): one row per batch in the SORTED
+        # order, index-aligned with the solve's worker axis.  Classes the
+        # policy does not name keep a flat 1.0 row; distinct from the
+        # (B, V) request-weight `weights` input above.
+        aff = None
+        for bi, b in enumerate(batches):
+            row = policy.affinity_for(b.rq_id)
+            if row is None:
+                continue
+            if aff is None:
+                aff = np.ones((n_b, n_w), dtype=np.float32)
+            aff[bi, : min(len(row), n_w)] = row[:n_w]
+        if aff is not None:
+            extra["affinity"] = aff
     if any(b.gang_nodes for b in batches):
         # fused gang rows: per-batch gang sizes plus the worker-side
         # idleness/group inputs the kernel's all-or-nothing selection needs
@@ -595,12 +640,12 @@ def assemble_solve_inputs(workers, batches, rq_map, resource_map,
 def _run_main_solve(queues, workers, rq_map, resource_map, model, batches,
                     cpu_floor=None, dense=None, phases=None, key_cache=None,
                     decision=None, pipeline=None, gang_ok=None,
-                    group_ids=None):
+                    group_ids=None, policy=None):
     _t0 = _time.perf_counter()
     kwargs = assemble_solve_inputs(
         workers, batches, rq_map, resource_map, cpu_floor=cpu_floor,
         dense=dense, key_cache=key_cache, gang_ok=gang_ok,
-        group_ids=group_ids,
+        group_ids=group_ids, policy=policy,
     )
     _t1 = _time.perf_counter()
     if pipeline is not None and hasattr(model, "solve_async"):

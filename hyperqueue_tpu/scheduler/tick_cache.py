@@ -306,15 +306,16 @@ class TickStateCache:
 
 
 def paranoid_check(core, snapshot: DenseSnapshot, batches, rq_map,
-                   resource_map, gang_ok=None, group_ids=None) -> None:
+                   resource_map, gang_ok=None, group_ids=None,
+                   policy=None) -> None:
     """Assert the incremental assembly is bit-identical to from-scratch.
 
     Runs BOTH assemble paths on copies of the batch list (assemble sorts
     in place but pops nothing), and compares every kwargs array exactly —
     including the fused-gang inputs (gang_nodes/gang_ok/group_onehot)
-    when the tick carries gang rows.  Raises AssertionError naming the
-    first differing array.  Debug tool: `hq server start
-    --paranoid-tick N` runs this every N ticks.
+    and the policy affinity matrix when the tick carries them.  Raises
+    AssertionError naming the first differing array.  Debug tool:
+    `hq server start --paranoid-tick N` runs this every N ticks.
     """
     from hyperqueue_tpu.scheduler.tick import Batch, assemble_solve_inputs
 
@@ -326,7 +327,7 @@ def paranoid_check(core, snapshot: DenseSnapshot, batches, rq_map,
     scratch_rows = [r for r in core.worker_rows() if r.cpu_floor <= 0]
     k_scratch = assemble_solve_inputs(
         scratch_rows, copy_batches(batches), rq_map, resource_map,
-        gang_ok=gang_ok, group_ids=group_ids,
+        gang_ok=gang_ok, group_ids=group_ids, policy=policy,
     )
     # key_cache=core.tick_cache: the check must exercise the SAME memoized
     # sort-key/batch-layout/needs32 path the production assemble uses, or
@@ -334,6 +335,7 @@ def paranoid_check(core, snapshot: DenseSnapshot, batches, rq_map,
     k_incr = assemble_solve_inputs(
         None, copy_batches(batches), rq_map, resource_map, dense=snapshot,
         key_cache=core.tick_cache, gang_ok=gang_ok, group_ids=group_ids,
+        policy=policy,
     )
     scratch_ids = [r.worker_id for r in scratch_rows]
     assert scratch_ids == snapshot.worker_ids, (

@@ -63,6 +63,10 @@ class Core:
     # two-stage async tick pipeline (scheduler/pipeline.TickPipeline) when
     # the server started with --tick-pipeline; None = synchronous ticks
     tick_pipeline: object = None
+    # weighted scheduling objective (scheduler/policy.PolicyState) when the
+    # server started with --policy-file; None = flat placement-count
+    # objective. Only consulted on the fused dense path.
+    policy: object = None
     tick_counter: int = 0
     # bumped on every change of the schedulable-worker SET (connect,
     # disconnect, gang reservation/claim/release): lets the tick cache

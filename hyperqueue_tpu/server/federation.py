@@ -471,6 +471,8 @@ class FederationCoordinator:
 
     # --- rebalancing (ISSUE 17) -----------------------------------------
     def _rebalance_control(self) -> None:
+        import os
+
         from hyperqueue_tpu.utils.ownership import OwnershipStore
 
         store = OwnershipStore(self.root)
@@ -480,7 +482,17 @@ class FederationCoordinator:
             recover_migrations(self.root, store=store)
         except Exception:  # noqa: BLE001 - recovery must not kill the loop
             logger.exception("migration recovery failed")
-        while not self._stop.wait(self.sample_interval):
+        # HQ_REBALANCE_INTERVAL decouples the rebalancer's tick from the
+        # sampling interval: bench.py --reshard-smoke drives it fast and
+        # deterministically instead of sleeping for the sampler's cadence
+        try:
+            interval = float(
+                os.environ.get("HQ_REBALANCE_INTERVAL", "") or
+                self.sample_interval
+            )
+        except ValueError:
+            interval = self.sample_interval
+        while not self._stop.wait(interval):
             try:
                 self._rebalance_pass(store)
             except Exception:  # noqa: BLE001 - the loop must survive

@@ -68,6 +68,22 @@ _SOLVE_LOOKAHEAD_DEPTH = REGISTRY.gauge(
     "critical-path depth (b-level) of the deepest task in the last "
     "dependency-carrying submit batch",
 )
+_POLICY_JAIN = REGISTRY.gauge(
+    "hq_policy_fairness_jain",
+    "Jain fairness index of per-job running resource usage at the last "
+    "tick that had work running (1.0 = perfectly even; --policy-file "
+    "fairness fold, scheduler/policy.py)",
+)
+_POLICY_HIT_RATE = REGISTRY.gauge(
+    "hq_policy_predictor_hit_rate",
+    "fraction of runtime-predictor lookups that had a learned EWMA "
+    "(scheduler/predict.py; 0 until the table warms or is journal-seeded)",
+)
+_POLICY_BOOST_MAX = REGISTRY.gauge(
+    "hq_policy_boost_max",
+    "largest per-job priority boost (fairness + prediction) applied to "
+    "the last scheduling tick's batch sort",
+)
 
 # at most this many gang rows ride one fused solve: gangs are rare and a
 # deep mn backlog must not grow the padded batch axis (each row holds its
@@ -1095,6 +1111,8 @@ def schedule(
     )
     run_gangs_fused = bool(fused_gang_batches) and snapshot is not None
     placed_blevel: dict[int, int] | None = None
+    policy_ctx = None
+    fairness_placed: tuple | None = None
     if have_workers and (core.queues.total_ready() or run_gangs_fused):
         _t_batches = _time.perf_counter()
         batches = create_batches(core.queues)
@@ -1112,12 +1130,27 @@ def schedule(
                 gang_ok.append(1 if w.is_idle() else 0)
                 group_ids.append(gmap.setdefault(w.group, len(gmap)))
         phases["batches"] = (_time.perf_counter() - _t_batches) * 1e3
+        if core.policy is not None:
+            # weighted objective (--policy-file): resolve this tick's
+            # affinity rows + priority boosts against the tick's worker
+            # order — the dense snapshot's worker_ids when the cache
+            # served, else the row list order (run_tick only reorders
+            # workers on the mu path, which strips the rows itself and
+            # keeps the alignment-free boosts).
+            wids = (
+                snapshot.worker_ids if snapshot is not None
+                else [r.worker_id for r in rows]
+            )
+            policy_ctx = core.policy.tick_context(
+                core.workers, core.rq_map, core.resource_map,
+                wids, batches,
+            )
         if snapshot is not None and paranoid_now:
             from hyperqueue_tpu.scheduler.tick_cache import paranoid_check
 
             paranoid_check(
                 core, snapshot, batches, core.rq_map, core.resource_map,
-                gang_ok=gang_ok, group_ids=group_ids,
+                gang_ok=gang_ok, group_ids=group_ids, policy=policy_ctx,
             )
         pipeline_this_tick = (
             pipeline
@@ -1149,7 +1182,7 @@ def schedule(
                 key_cache=core.tick_cache,
                 decision=decision_info if record_decision else None,
                 pipeline=pipeline_this_tick,
-                gang_ok=gang_ok, group_ids=group_ids,
+                gang_ok=gang_ok, group_ids=group_ids, policy=policy_ctx,
             )
             if (
                 pipeline_this_tick is not None
@@ -1204,6 +1237,16 @@ def schedule(
                     bl = decode_sched_blevel(prio[1])
                     if bl > placed_blevel.get(j, -1):
                         placed_blevel[j] = bl
+            if policy_ctx is not None and policy_ctx.boosts:
+                # lowest original priority among placed batches of
+                # fairness/prediction-boosted jobs: a leftover class whose
+                # own priority sits ABOVE it was overtaken by the boost
+                # (decision.build_unplaced_entries fairness-deferred)
+                for (_rq, prio), _n in taken_by_batch.items():
+                    if policy_ctx.boost_for_sched(prio[1]) > 0:
+                        t = tuple(prio)
+                        if fairness_placed is None or t < fairness_placed:
+                            fairness_placed = t
             if run_gangs_fused:
                 still_waiting = set(core.mn_queue)
                 for gb in fused_gang_batches:
@@ -1261,6 +1304,17 @@ def schedule(
         # every race against streams of small tasks.
         if leftover_batches is None:
             leftover_batches = create_batches(core.queues)
+        if policy_ctx is not None and policy_ctx.boosts:
+            # the solve's boost-weighted order lives in run_tick's COPY of
+            # the batch list; prefill consumes the caller's list, so fold
+            # the same boost arithmetic here — under deep prefill budgets
+            # this order, not the solve's ~capacity-sized mapping, decides
+            # which job's backlog reaches the workers first
+            leftover_batches.sort(key=lambda b: (
+                b.priority[0],
+                b.priority[1]
+                + policy_ctx.boost_for_sched(b.priority[1]) * BLEVEL_STRIDE,
+            ), reverse=True)
         reservations: dict[int, Priority_t] = {}
         for batch in leftover_batches:
             rqv = core.rq_map.get_variants(batch.rq_id)
@@ -1505,6 +1559,7 @@ def schedule(
                 unplaced.extend(decision_mod.build_unplaced_entries(
                     core, leftover_batches, {}, degraded=degraded,
                     placed_blevel=placed_blevel,
+                    fairness_placed=fairness_placed,
                 ))
             n_paused = 0
             for job_id, held in core.paused_held.items():
@@ -1543,6 +1598,15 @@ def schedule(
 
     phases["total"] = (_time.perf_counter() - _t_tick) * 1e3
     core.tick_stats.record(phases)
+    if core.policy is not None:
+        # fairness/prediction telemetry: one ledger fold + two dict reads
+        # per tick, surfaced as gauges and through `hq server stats`
+        jain = core.policy.observe_jain()
+        if jain is not None:
+            _POLICY_JAIN.set(jain)
+        if core.policy.predictor is not None:
+            _POLICY_HIT_RATE.set(core.policy.predictor.hit_rate())
+        _POLICY_BOOST_MAX.set(core.policy.last_boost_range[1])
     _TICKS_TOTAL.inc()
     if assigned:
         _ASSIGNED_TOTAL.inc(assigned)

@@ -79,6 +79,12 @@ class SimResult:
     # real per-tick scheduler latencies (ms), collected BEFORE decision
     # records are normalized (normalization strips wall timings)
     tick_ms: list = field(repr=False, default_factory=list)
+    # PolicyState.stats() harvested at quiescence when the server ran
+    # with a --policy-file (None on the flat objective)
+    policy: dict | None = None
+    # TickStats.shares() of the final incarnation — the per-phase half of
+    # the PR 19 profile-blame summary (bench.py profile_summary)
+    tick_shares: dict = field(repr=False, default_factory=dict)
 
     @property
     def virtual_tasks_per_wall_s(self) -> float:
@@ -512,8 +518,16 @@ class Simulation:
         await asyncio.sleep(0.05)
         server = self.server
         audit = {}
+        policy_stats = None
+        tick_shares = {}
         if server is not None:
             self._collect_decisions(server)
+            if server.core.policy is not None:
+                policy_stats = server.core.policy.stats()
+            try:
+                tick_shares = server.core.tick_stats.shares()
+            except Exception:  # noqa: BLE001 - telemetry only
+                tick_shares = {}
             if self._event_tap_task is not None:
                 self._event_tap_task.cancel()
             server._event_listeners.clear()
@@ -544,6 +558,8 @@ class Simulation:
             decisions=self._decisions,
             violations=list(self.monitor.violations),
             tick_ms=self._tick_ms,
+            policy=policy_stats,
+            tick_shares=tick_shares,
         )
 
 

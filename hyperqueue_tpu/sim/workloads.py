@@ -79,15 +79,25 @@ def uniform_array(n_tasks: int = 1000, dur_ms: float = 500.0,
 
 def bursty_multi_tenant(n_tenants: int = 4, bursts_per_tenant: int = 3,
                         tasks_per_burst: int = 200, window: float = 120.0,
-                        seed: int = 0) -> Workload:
+                        seed: int = 0,
+                        tenant_dur_scales: list | None = None) -> Workload:
+    """`tenant_dur_scales` (opt-in, policy A/B) scales tenant i's task
+    durations by scales[i % len]: heterogeneous per-tenant runtimes give
+    the runtime predictor distinct classes to learn and the fairness fold
+    a skewed usage profile. Default None is byte-identical to the
+    original shape (the digest-pinned determinism tests)."""
     rng = random.Random(f"bursty:{seed}")
     submits = []
     for tenant in range(n_tenants):
         priority = rng.choice([-1, 0, 0, 1])
+        scale = (
+            tenant_dur_scales[tenant % len(tenant_dur_scales)]
+            if tenant_dur_scales else 1.0
+        )
         for burst in range(bursts_per_tenant):
             at = rng.uniform(0.0, window)
             n = max(int(tasks_per_burst * rng.uniform(0.3, 1.7)), 1)
-            body = {"sim": {"dur_range_ms": [100, 2000],
+            body = {"sim": {"dur_range_ms": [100 * scale, 2000 * scale],
                             "seed": seed * 1000 + tenant}}
             submits.append(SubmitSpec(
                 at=at,
@@ -159,31 +169,48 @@ def gang_heavy(n_gangs: int = 8, gang_size: int = 4,
     return Workload("gang-heavy", submits)
 
 
-def straggler_tailed(n_tasks: int = 1500, seed: int = 0) -> Workload:
+def straggler_tailed(n_tasks: int = 1500, seed: int = 0,
+                     split_long: bool = False) -> Workload:
     """Wide and short with a heavy tail: ~2% of tasks run 20-60x the
-    median (encoded per-task via the entries channel)."""
+    median (encoded per-task via the entries channel).
+
+    `split_long` (opt-in, policy A/B) emits the heavy tail as a separate
+    ``straggler-long`` job so the runtime predictor can learn a distinct
+    per-job-name class and LPT-boost it. Default False keeps the single
+    digest-pinned ``straggler-tail`` job; the rng draw sequence is
+    identical either way."""
     rng = random.Random(f"tail:{seed}")
     entries = []
+    long_entries = []
     for i in range(n_tasks):
         if rng.random() < 0.02:
-            entries.append({"dur_ms": rng.uniform(4000, 12000)})
+            e = {"dur_ms": rng.uniform(4000, 12000)}
+            (long_entries if split_long else entries).append(e)
         else:
             entries.append({"dur_ms": rng.uniform(50, 300)})
-    desc = {
-        "name": "straggler-tail",
-        "submit_dir": "/sim",
-        "array": {
-            "id_range": [0, n_tasks],
-            "body": {},
-            "entries": entries,
-            "request": {"variants": [{"entries": [
-                {"name": "cpus", "amount": 10_000},
-            ]}]},
-        },
-    }
-    return Workload("straggler-tailed", [
-        SubmitSpec(at=0.0, job_desc=desc, n_tasks=n_tasks),
-    ])
+
+    def _tail_desc(name: str, ents: list) -> dict:
+        return {
+            "name": name,
+            "submit_dir": "/sim",
+            "array": {
+                "id_range": [0, len(ents)],
+                "body": {},
+                "entries": ents,
+                "request": {"variants": [{"entries": [
+                    {"name": "cpus", "amount": 10_000},
+                ]}]},
+            },
+        }
+
+    submits = [SubmitSpec(at=0.0, job_desc=_tail_desc("straggler-tail", entries),
+                          n_tasks=len(entries))]
+    if long_entries:
+        submits.append(SubmitSpec(
+            at=0.0, job_desc=_tail_desc("straggler-long", long_entries),
+            n_tasks=len(long_entries),
+        ))
+    return Workload("straggler-tailed", submits)
 
 
 WORKLOADS = {

@@ -87,3 +87,8 @@ def pytest_configure(config):
         "per-plane CPU attribution, profile-on-stall, regression blame; "
         "ISSUE 19)",
     )
+    config.addinivalue_line(
+        "markers",
+        "policy: weighted scheduling-objective tests (heterogeneity "
+        "affinity, runtime prediction, fairness boosts; ISSUE 20)",
+    )

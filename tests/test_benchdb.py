@@ -241,3 +241,32 @@ def test_regression_gate_reads_metric_name_from_value_rows(tmp_path):
     assert checked == 1
     (reg,) = regs
     assert reg["metric"] == "tick_p99_ms"
+
+
+def test_regression_gate_skips_crash_marker_rows(tmp_path):
+    """A failed smoke run stores {"ok": false, "value": null, "failures":
+    [...]}; those rows are crash markers, not measurements — they must
+    neither fire the gate nor seed the baseline median, and the volatile
+    ok/failures fields must not fork the config grouping."""
+    bench = _load_bench()
+    dbp = tmp_path / "db.jsonl"
+    db = Database(dbp)
+    for v in (10.0, 10.0):
+        db.store_emit({"experiment": "e", "metric": "m_ms", "value": v,
+                       "ok": True, "failures": []})
+    db.store_emit({"experiment": "e", "metric": "m_ms", "value": None,
+                   "ok": False, "failures": ["smoke blew up"]})
+    # the crash row is not the "current" measurement: the two healthy rows
+    # agree, so the gate stays quiet
+    checked, regs = bench.check_regressions(db_path=dbp)
+    assert (checked, regs) == (1, [])
+    # ...and it never enters the median for the next real row either
+    db.store_emit({"experiment": "e", "metric": "m_ms", "value": 30.0,
+                   "ok": True, "failures": []})
+    checked, regs = bench.check_regressions(db_path=dbp)
+    assert checked == 1
+    (reg,) = regs
+    assert reg["metric"] == "m_ms"
+    assert reg["baseline"] == 10.0
+    assert reg["current"] == 30.0
+    assert reg["n_baseline_rows"] == 2

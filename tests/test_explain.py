@@ -551,6 +551,61 @@ def test_reason_lookahead_held_for_shallow_same_job_work():
     assert entry["reason"] == decision.REASON_LOOKAHEAD_HELD
 
 
+@pytest.mark.policy
+def test_reason_fairness_deferred_when_boosted_job_overtakes():
+    """A fairness-boosted (dominant-resource-deficit) job that overtakes an
+    earlier job leaves the overtaken work classified fairness-deferred, not
+    a bare solver-deferred."""
+    import types
+
+    from hyperqueue_tpu.ids import make_task_id
+    from hyperqueue_tpu.scheduler.policy import PolicyState, PolicyTable
+    from hyperqueue_tpu.scheduler.queues import encode_sched_priority
+    from hyperqueue_tpu.server import reactor
+    from hyperqueue_tpu.server.task import Task
+
+    class _HeadOnlyModel:
+        # places exactly one task from the top-sorted batch; the other
+        # job's leftover classifies solver-deferred (capacity stays free)
+        def solve(self, free, nt_free, lifetime, needs, sizes, min_time,
+                  priorities, **kw):
+            out = np.zeros(
+                (needs.shape[0], needs.shape[1], free.shape[0]),
+                dtype=np.int32,
+            )
+            out[0, 0, 0] = 1
+            return out
+
+    env = TestEnv(model=_HeadOnlyModel())
+    env.worker(cpus=2)
+    # job 1 monopolizes the ledger; job 2 is starved -> deficit boost
+    ledger = types.SimpleNamespace(rows={
+        1: {"label": "hog", "resource_seconds": {"cpus": 30.0}},
+        2: {"label": "starved", "resource_seconds": {}},
+    }, open_runs={})
+    env.core.policy = PolicyState(
+        PolicyTable(fairness_enabled=True, fairness_max_boost=4),
+        ledger=ledger,
+    )
+    rq_id = env.core.intern_rqv(env.rqv())
+    id1 = make_task_id(1, 1)
+    id2 = make_task_id(2, 1)
+    reactor.on_new_tasks(env.core, env.comm, [
+        Task(task_id=id1, rq_id=rq_id,
+             priority=(0, encode_sched_priority(1)), body={}),
+        Task(task_id=id2, rq_id=rq_id,
+             priority=(0, encode_sched_priority(2)), body={}),
+    ])
+    assert env.schedule() == 1
+    # the boost jumps job 2 ahead of the earlier-submitted job 1
+    assert env.core.tasks[id2].assigned_worker
+    assert not env.core.tasks[id1].assigned_worker
+    rec = env.core.flight.latest()
+    (entry,) = rec["unplaced"]
+    assert entry["job"] == 1
+    assert entry["reason"] == decision.REASON_FAIRNESS_DEFERRED
+
+
 # --------------------------------------------------------------------------
 # docs catalog checker: no reason code ships undocumented
 # --------------------------------------------------------------------------

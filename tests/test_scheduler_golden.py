@@ -1154,3 +1154,79 @@ def test_blevel_priority_encoding_roundtrip_and_order():
     # legacy raw priorities are outside the encoded band
     assert -5 > -BLEVEL_STRIDE
     assert encode_sched_priority(1, 0) < -1
+
+
+# ---------------------------------------------------------------------------
+# weighted scheduling objective (--policy-file; scheduler/policy.py)
+# ---------------------------------------------------------------------------
+
+def _policy_tick_case(n_workers=1):
+    """Two 4-task jobs at the same user priority over n 4-cpu workers,
+    driven through the production run_tick path."""
+    from hyperqueue_tpu.resources.map import ResourceIdMap, ResourceRqMap
+    from hyperqueue_tpu.resources.request import (
+        ResourceRequest,
+        ResourceRequestEntry,
+        ResourceRequestVariants,
+    )
+    from hyperqueue_tpu.scheduler.queues import (
+        TaskQueues,
+        encode_sched_priority,
+    )
+    from hyperqueue_tpu.scheduler.tick import WorkerRow
+
+    resource_map = ResourceIdMap()
+    cpus = resource_map.get_or_create("cpus")
+    rq_map = ResourceRqMap()
+    rq = rq_map.get_or_create(ResourceRequestVariants.single(
+        ResourceRequest(entries=(ResourceRequestEntry(cpus, U),))
+    ))
+    queues = TaskQueues()
+    for t in range(1, 5):
+        queues.add(rq, (0, encode_sched_priority(1)), t)
+    for t in range(101, 105):
+        queues.add(rq, (0, encode_sched_priority(2)), t)
+    rows = [
+        WorkerRow(worker_id=i + 1, free=[4 * U], nt_free=8,
+                  lifetime_secs=INF)
+        for i in range(n_workers)
+    ]
+    return queues, rows, rq_map, resource_map, rq
+
+
+@pytest.mark.policy
+def test_policy_boost_reorders_jobs_in_tick():
+    """A fairness/prediction boost of k strides makes a later job drain
+    before an earlier one at the same user priority — the golden pin of the
+    BLEVEL_STRIDE fold the solve and the reactor prefill both apply."""
+    from hyperqueue_tpu.scheduler.policy import TickPolicyContext
+    from hyperqueue_tpu.scheduler.tick import run_tick
+
+    queues, rows, rq_map, resource_map, _rq = _policy_tick_case()
+    flat = run_tick(queues, rows, rq_map, resource_map, MODEL)
+    assert sorted(t for t, *_ in flat) == [1, 2, 3, 4]
+
+    queues, rows, rq_map, resource_map, _rq = _policy_tick_case()
+    ctx = TickPolicyContext({}, {2: 2})
+    boosted = run_tick(queues, rows, rq_map, resource_map, MODEL,
+                       policy=ctx)
+    assert sorted(t for t, *_ in boosted) == [101, 102, 103, 104]
+
+
+@pytest.mark.policy
+def test_policy_affinity_row_excludes_worker_in_tick():
+    """A zero affinity weight is a hard exclusion on the production tick
+    path: every placement lands on the weighted-in worker even while the
+    excluded one idles."""
+    import numpy as np
+
+    from hyperqueue_tpu.scheduler.policy import TickPolicyContext
+    from hyperqueue_tpu.scheduler.tick import run_tick
+
+    queues, rows, rq_map, resource_map, rq = _policy_tick_case(n_workers=2)
+    ctx = TickPolicyContext(
+        {rq: np.asarray([0.0, 1.0], dtype=np.float32)}, {})
+    assignments = run_tick(queues, rows, rq_map, resource_map, MODEL,
+                           policy=ctx)
+    assert assignments, "weighted-in worker must still be used"
+    assert {w for _t, w, *_ in assignments} == {2}
